@@ -1,0 +1,53 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+Brand-new JAX/XLA/Pallas implementation of the capabilities of the reference
+PaddlePaddle codebase (see SURVEY.md at the repo root for the layer map).
+Top-level namespace mirrors ``paddle.*``: tensor ops, ``nn``, ``optimizer``,
+``amp``, ``autograd``, ``distributed``, ``io``, ``jit``, ``vision``, ``text``,
+plus framework services (``save``/``load``, ``seed``, ``set_device``, flags).
+
+A "Tensor" is ``jax.Array``; eager mode is JAX op-by-op dispatch on TPU and
+"static graph" is the same code under ``jax.jit`` (XLA). Collectives ride
+ICI/DCN through ``jax.sharding`` meshes rather than NCCL process groups.
+"""
+
+__version__ = "0.1.0"
+
+from . import core  # noqa: F401
+from .core import (seed, set_device, get_device, device_count,  # noqa: F401
+                   get_flags, set_flags, is_compiled_with_tpu, synchronize,
+                   get_rng_state, set_rng_state)
+from .core.dtype import (bool_, uint8, int8, int16, int32, int64,  # noqa: F401
+                         float16, bfloat16, float32, float64, complex64,
+                         complex128, get_default_dtype, set_default_dtype)
+from .tensor import *  # noqa: F401,F403
+from .tensor.logic import is_tensor  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import autograd  # noqa: F401
+from .autograd import no_grad, grad  # noqa: F401
+from . import framework  # noqa: F401
+from .framework.functional import functional_call  # noqa: F401
+
+# Submodules imported lazily to keep import light are still exposed eagerly
+# for paddle parity; they only pull in jax which is already loaded.
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import vision  # noqa: F401
+from . import distributed  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .hapi.model import Model  # noqa: F401
+from . import profiler  # noqa: F401
+from . import static  # noqa: F401
+from . import incubate  # noqa: F401
+from . import text  # noqa: F401
+
+# paddle.Tensor alias: a Tensor IS a jax.Array.
+import jax as _jax
+Tensor = _jax.Array
+
+from .nn.layer import ParamAttr  # noqa: F401
+from .framework.dataparallel_api import DataParallel  # noqa: F401

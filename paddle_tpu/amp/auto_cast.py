@@ -1,0 +1,134 @@
+"""Automatic mixed precision — the policy side.
+
+Reference design: ``python/paddle/amp/auto_cast.py:687`` (``auto_cast`` context
+sets tracer AMP level; generated dygraph functions consult per-op black/white
+lists and insert casts — ``eager_amp_auto_cast.h``).
+
+TPU-native re-design: TPU MXU is bfloat16-native, so mixed precision is a
+*dtype policy*, not per-op cast interception. ``auto_cast(level='O1')``
+installs a thread-local AmpState consulted by compute layers (Linear, Conv2D,
+attention) which cast their inputs/weights to the compute dtype on entry;
+normalizations, softmax and reductions stay fp32 (the black list). ``O2``
+additionally expects model params cast to bf16 (``amp.decorate``), with fp32
+master weights kept by the optimizer (``multi_precision=True``, the default).
+Loss scaling (GradScaler) is only required for float16 parity mode — bf16 has
+fp32's exponent range and needs no scaling.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+
+__all__ = ["auto_cast", "amp_guard", "get_amp_state", "AmpState",
+           "white_list", "black_list", "decorate", "maybe_cast_input"]
+
+# Ops (by layer-family name) that run in low precision under O1.
+WHITE_LIST: Set[str] = {
+    "linear", "matmul", "conv2d", "attention", "einsum", "bmm", "mm",
+}
+# Ops forced to fp32 even under O2 numerics (norms/softmax/losses already
+# compute internally in fp32 in our functional library).
+BLACK_LIST: Set[str] = {
+    "layer_norm", "batch_norm", "softmax", "cross_entropy", "log_softmax",
+    "mean", "sum", "exp", "log", "rms_norm", "group_norm",
+}
+
+
+def white_list():
+    return set(WHITE_LIST)
+
+
+def black_list():
+    return set(BLACK_LIST)
+
+
+@dataclass
+class AmpState:
+    enable: bool = False
+    level: str = "O0"
+    dtype: object = None
+    custom_white_list: Set[str] = field(default_factory=set)
+    custom_black_list: Set[str] = field(default_factory=set)
+
+    def should_cast(self, op: str) -> bool:
+        if not self.enable:
+            return False
+        if op in self.custom_black_list or op in BLACK_LIST:
+            return False
+        if self.level == "O2":
+            return True
+        return op in WHITE_LIST or op in self.custom_white_list
+
+
+_state = threading.local()
+
+
+def get_amp_state() -> AmpState:
+    st = getattr(_state, "amp", None)
+    return st if st is not None else AmpState()
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1", dtype: str = None):
+    """paddle.amp.auto_cast parity context."""
+    from ..core import flags
+    dtype = dtype or flags.flag("amp_dtype")
+    prev = getattr(_state, "amp", None)
+    _state.amp = AmpState(
+        enable=enable, level=level if enable else "O0",
+        dtype=dtypes.to_dtype(dtype),
+        custom_white_list=set(custom_white_list or ()),
+        custom_black_list=set(custom_black_list or ()))
+    try:
+        yield
+    finally:
+        _state.amp = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_input(op: str, *arrays):
+    """Called by compute layers: cast fp32 inputs to the AMP compute dtype."""
+    st = get_amp_state()
+    if not st.should_cast(op):
+        return arrays if len(arrays) > 1 else arrays[0]
+    out = tuple(
+        a.astype(st.dtype)
+        if a is not None and hasattr(a, "dtype") and a.dtype == jnp.float32
+        else a
+        for a in arrays)
+    return out if len(out) > 1 else out[0]
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = None,
+             master_weight: Optional[bool] = None, save_dtype: str = None):
+    """paddle.amp.decorate parity: cast model params to the AMP dtype (O2).
+
+    Optimizers keep fp32 master weights (multi_precision default). Returns
+    (models, optimizers) like paddle.
+    """
+    from ..core import flags
+    dtype = dtype or flags.flag("amp_dtype")
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.astype(dtypes.to_dtype(dtype))
+    if optimizers is None:
+        return models if single else model_list
+    opt_single = not isinstance(optimizers, (list, tuple))
+    opt_list = [optimizers] if opt_single else list(optimizers)
+    if master_weight is not None:
+        for o in opt_list:
+            o.multi_precision = bool(master_weight)
+    return (models if single else model_list,
+            optimizers if opt_single else opt_list)

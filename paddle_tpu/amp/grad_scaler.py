@@ -1,0 +1,156 @@
+"""Dynamic loss scaling.
+
+Parity with ``python/paddle/amp/grad_scaler.py:576`` (GradScaler / AmpScaler
+at ``:41``: dynamic loss scale, ``found_inf`` via the
+``check_finite_and_unscale`` op, incr/decr ratios and windows).
+
+TPU note: bf16 training needs no loss scaling (full fp32 exponent range);
+this exists for fp16 parity mode and numerical-robustness workflows. The
+functional core (``scale_loss_value`` / ``unscale_and_check``) is jittable and
+is what hapi's train step uses; the imperative scale()/step()/update() surface
+wraps it for paddle-style loops.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradScaler", "AmpScaler", "unscale_and_check"]
+
+
+def unscale_and_check(grads, scale: jax.Array):
+    """Divide grads by scale; return (unscaled_grads, found_inf[bool scalar]).
+    The jittable analog of paddle's check_finite_and_unscale kernel."""
+    inv = 1.0 / scale
+
+    def unscale(g):
+        return (g.astype(jnp.float32) * inv).astype(g.dtype)
+
+    unscaled = jax.tree_util.tree_map(unscale, grads)
+    leaves = jax.tree_util.tree_leaves(unscaled)
+    if not leaves:
+        return unscaled, jnp.asarray(False)
+    finite = jnp.stack([jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+                        for g in leaves])
+    return unscaled, ~jnp.all(finite)
+
+
+class AmpScaler:
+    """Functional-state dynamic loss scaler."""
+
+    def __init__(self, enable: bool = True, init_loss_scaling: float = 2.0 ** 15,
+                 incr_ratio: float = 2.0, decr_ratio: float = 0.5,
+                 incr_every_n_steps: int = 1000,
+                 decr_every_n_nan_or_inf: int = 2,
+                 use_dynamic_loss_scaling: bool = True):
+        self._enable = enable
+        self._init_loss_scaling = init_loss_scaling
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._use_dynamic = use_dynamic_loss_scaling
+        self._scale = jnp.asarray(init_loss_scaling, jnp.float32)
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    # -- functional core (jittable pieces) ---------------------------------
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        return {"scale": jnp.asarray(self._init_loss_scaling, jnp.float32),
+                "good": jnp.zeros((), jnp.int32),
+                "bad": jnp.zeros((), jnp.int32)}
+
+    def update_state(self, state: Dict[str, jax.Array], found_inf: jax.Array):
+        """Pure update of (scale, good, bad) given this step's found_inf."""
+        if not (self._enable and self._use_dynamic):
+            return state
+        scale, good, bad = state["scale"], state["good"], state["bad"]
+        bad = jnp.where(found_inf, bad + 1, jnp.zeros_like(bad))
+        good = jnp.where(found_inf, jnp.zeros_like(good), good + 1)
+        decr = bad >= self._decr_every_n_nan_or_inf
+        incr = good >= self._incr_every_n_steps
+        scale = jnp.where(decr, jnp.maximum(scale * self._decr_ratio, 1.0), scale)
+        scale = jnp.where(incr, scale * self._incr_ratio, scale)
+        good = jnp.where(incr | decr, jnp.zeros_like(good), good)
+        bad = jnp.where(decr, jnp.zeros_like(bad), bad)
+        return {"scale": scale, "good": good, "bad": bad}
+
+    # -- imperative surface (paddle parity) --------------------------------
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._use_dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def set_init_loss_scaling(self, v: float):
+        self._scale = jnp.asarray(v, jnp.float32)
+
+    def scale(self, loss):
+        if not self._enable:
+            return loss
+        return loss * self._scale.astype(loss.dtype)
+
+    def unscale_(self, optimizer) -> None:
+        """Unscale param.grad in place; record found_inf."""
+        if not self._enable:
+            return
+        refs = [r for r in optimizer._refs() if r.grad is not None]
+        grads = {r.name: r.grad for r in refs}
+        unscaled, found = unscale_and_check(grads, self._scale)
+        self._found_inf = bool(found)
+        for r in refs:
+            r.grad = unscaled[r.name]
+        self._unscaled = True
+
+    def step(self, optimizer) -> None:
+        if not self._enable:
+            optimizer.step()
+            return
+        if not getattr(self, "_unscaled", False):
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._unscaled = False
+
+    def update(self) -> None:
+        if not (self._enable and self._use_dynamic):
+            return
+        state = {"scale": self._scale,
+                 "good": jnp.asarray(self._good_steps, jnp.int32),
+                 "bad": jnp.asarray(self._bad_steps, jnp.int32)}
+        new = self.update_state(state, jnp.asarray(self._found_inf))
+        self._scale = new["scale"]
+        self._good_steps = int(new["good"])
+        self._bad_steps = int(new["bad"])
+        self._found_inf = False
+
+    def minimize(self, optimizer, scaled_loss) -> None:
+        self.step(optimizer)
+        self.update()
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n_steps,
+                "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps,
+                "use_dynamic_loss_scaling": self._use_dynamic}
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self._scale = jnp.asarray(state["scale"], jnp.float32)
+        self._good_steps = int(state.get("good_steps", 0))
+        self._bad_steps = int(state.get("bad_steps", 0))
+
+
+class GradScaler(AmpScaler):
+    """paddle.amp.GradScaler (subclass of AmpScaler, same surface)."""
+    pass

@@ -1,0 +1,186 @@
+"""Autograd surface.
+
+The reference implements reverse-mode AD with a C++ GradNode graph engine
+(``paddle/fluid/eager/backward.cc:104`` RunBackward queue traversal, generated
+GradNode classes, GradTensorHolder accumulation). On TPU/JAX none of that
+machinery exists as runtime data structures — ``jax.grad``/``jax.vjp`` derive
+the backward computation at trace time and XLA compiles it. This module maps
+paddle's autograd *API* onto that:
+
+- :func:`backward` — imperative parity for ``loss.backward()``: runs
+  ``jax.grad`` over the model's functional view and populates ``param.grad``
+  so paddle-style ``opt.step()`` works.
+- :func:`grad` — ``paddle.grad`` parity for explicit input/output grads.
+- :class:`PyLayer` — custom forward/backward (ref
+  ``python/paddle/autograd/py_layer.py:29``) lowered to ``jax.custom_vjp``.
+- :func:`no_grad` — contextual no-op kept for API compatibility (JAX only
+  differentiates what you ask it to).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.functional import functional_call, get_params
+from ..nn.layer import Layer, ParamRef
+
+__all__ = ["backward", "grad", "value_and_grad", "PyLayer", "no_grad",
+           "enable_grad", "set_grad_enabled", "jacobian", "hessian", "vjp", "jvp"]
+
+
+def backward(model: Layer, loss_fn: Callable[[], jax.Array] = None, *,
+             loss_closure: Optional[Callable[[Layer], jax.Array]] = None,
+             accumulate: bool = True):
+    """Populate ``param.grad`` for all trainable params of `model`.
+
+    Usage (imperative parity path):
+        loss = autograd.backward(model, lambda: loss_of(model(x), y))
+        opt.step()
+
+    The closure must compute the loss by calling `model` (the call is re-run
+    under jax.grad with parameters substituted).
+    """
+    fn = loss_closure if loss_closure is not None else (lambda _m: loss_fn())
+    params = get_params(model, trainable_only=True)
+
+    def loss_of_params(p):
+        # Substitute params, then let the closure run the model.
+        from ..framework.functional import _swapped_state
+        with _swapped_state(model, p, None):
+            return fn(model)
+
+    loss, grads = jax.value_and_grad(loss_of_params)(params)
+    refs = dict(model.named_parameters())
+    for name, g in grads.items():
+        ref = refs[name]
+        if accumulate and ref.grad is not None:
+            ref.grad = ref.grad + g
+        else:
+            ref.grad = g
+    return loss
+
+
+def grad(outputs_fn: Callable, inputs, create_graph: bool = False,
+         allow_unused: bool = False):
+    """paddle.grad-style: d outputs_fn(inputs) / d inputs (inputs a pytree)."""
+    g = jax.grad(lambda x: jnp.sum(outputs_fn(x)))(inputs)
+    return g
+
+
+def value_and_grad(fn: Callable, argnums=0, has_aux: bool = False):
+    return jax.value_and_grad(fn, argnums=argnums, has_aux=has_aux)
+
+
+def jacobian(fn: Callable, xs, mode: str = "reverse"):
+    return (jax.jacrev if mode == "reverse" else jax.jacfwd)(fn)(xs)
+
+
+def hessian(fn: Callable, xs):
+    return jax.hessian(fn)(xs)
+
+
+def vjp(fn: Callable, xs, v=None):
+    out, pullback = jax.vjp(fn, xs)
+    if v is None:
+        v = jnp.ones_like(out)
+    return out, pullback(v)[0]
+
+
+def jvp(fn: Callable, xs, v=None):
+    if v is None:
+        v = jax.tree_util.tree_map(jnp.ones_like, xs)
+    return jax.jvp(fn, (xs,), (v,))
+
+
+@contextlib.contextmanager
+def no_grad():
+    yield
+
+
+enable_grad = no_grad
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    yield
+
+
+class _PyLayerContext:
+    """Parity with PyLayerContext: save_for_backward / saved_tensor."""
+
+    def __init__(self):
+        self._saved = ()
+        self.non_differentiable = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable = tensors
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, ns):
+        super().__init__(name, bases, ns)
+        if name != "PyLayer" and "forward" in ns:
+            cls._build_custom_vjp()
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom op with user forward/backward (ref py_layer.py:29).
+
+    class Scale(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+        @staticmethod
+        def backward(ctx, dy):
+            (x,) = ctx.saved_tensor()
+            return dy * 2
+
+    y = Scale.apply(x)
+    """
+
+    @classmethod
+    def _build_custom_vjp(cls):
+        @jax.custom_vjp
+        def fn(*args):
+            ctx = _PyLayerContext()
+            return cls.forward(ctx, *args)
+
+        def fwd(*args):
+            ctx = _PyLayerContext()
+            out = cls.forward(ctx, *args)
+            return out, (ctx, args)
+
+        def bwd(res, g):
+            ctx, args = res
+            grads = cls.backward(ctx, g)
+            if not isinstance(grads, tuple):
+                grads = (grads,)
+            # pad to the number of inputs with zeros for non-diff args
+            out = []
+            gi = 0
+            for a in args:
+                if isinstance(a, jax.Array) or hasattr(a, "shape"):
+                    out.append(grads[gi] if gi < len(grads) and grads[gi] is not None
+                               else jnp.zeros_like(a))
+                    gi += 1
+                else:
+                    out.append(None)
+            return tuple(out)
+
+        fn.defvjp(fwd, bwd)
+        cls._fn = fn
+
+    @classmethod
+    def apply(cls, *args):
+        return cls._fn(*args)

@@ -1,0 +1,5 @@
+from . import device, dtype, flags, random  # noqa: F401
+from .flags import get_flags, set_flags, define_flag, flag  # noqa: F401
+from .device import (set_device, get_device, device_count,  # noqa: F401
+                     is_compiled_with_tpu, synchronize)
+from .random import seed, get_rng_state, set_rng_state, rng_scope  # noqa: F401

@@ -1,0 +1,96 @@
+"""Dtype registry and helpers.
+
+Parity surface for the reference's dtype system (``paddle/phi/common/data_type.h``,
+fp16/bf16 types in ``paddle/fluid/platform``): exposes paddle-style dtype names
+(`float32`, `bfloat16`, ...) as jnp dtypes plus conversion helpers. On TPU the
+preferred compute dtype is bfloat16 (MXU-native).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (jnp dtypes are numpy dtypes under the hood).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3 = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3": float8_e4m3,
+    "float8_e5m2": float8_e5m2,
+    # paddle aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+DTypeLike = Union[str, np.dtype, type, Any]
+
+
+def to_dtype(dtype: DTypeLike):
+    """Normalize a paddle/numpy/jnp dtype spec to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return _NAME_TO_DTYPE[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype name {dtype!r}")
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype: DTypeLike) -> str:
+    return jnp.dtype(to_dtype(dtype)).name
+
+
+def is_floating_point(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(to_dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype: DTypeLike) -> bool:
+    return jnp.issubdtype(to_dtype(dtype), jnp.integer)
+
+
+def finfo(dtype: DTypeLike):
+    return jnp.finfo(to_dtype(dtype))
+
+
+def iinfo(dtype: DTypeLike):
+    return jnp.iinfo(to_dtype(dtype))
+
+
+def get_default_dtype():
+    from . import flags
+    return to_dtype(flags.flag("default_dtype"))
+
+
+def set_default_dtype(dtype: DTypeLike) -> None:
+    from . import flags
+    flags.set_flags({"default_dtype": dtype_name(dtype)})

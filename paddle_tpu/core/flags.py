@@ -1,0 +1,117 @@
+"""Global flag registry.
+
+TPU-native re-design of the reference's gflags-style exported-flag system
+(``paddle/phi/core/flags.cc`` — 98 exported flags; Python surface
+``paddle.set_flags``/``get_flags`` at ``python/paddle/fluid/framework.py:7804``).
+
+Flags are plain Python here (no C++ gflags): a typed registry seeded from
+``FLAGS_*`` environment variables at import time, mutable at runtime via
+``set_flags``.  Subsystems read flags lazily so runtime changes take effect.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Union
+
+__all__ = [
+    "define_flag",
+    "get_flags",
+    "set_flags",
+    "flag",
+]
+
+
+@dataclass
+class _FlagSpec:
+    name: str
+    default: Any
+    type: type
+    help: str
+    on_change: Optional[Callable[[Any], None]] = None
+
+
+_registry: Dict[str, _FlagSpec] = {}
+_values: Dict[str, Any] = {}
+_lock = threading.RLock()
+
+
+def _coerce(spec: _FlagSpec, value: Any) -> Any:
+    if spec.type is bool and isinstance(value, str):
+        return value.lower() in ("1", "true", "yes", "on")
+    return spec.type(value)
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                on_change: Optional[Callable[[Any], None]] = None) -> None:
+    """Register a flag. Environment variable ``FLAGS_<name>`` overrides default."""
+    with _lock:
+        spec = _FlagSpec(name=name, default=default, type=type(default),
+                         help=help, on_change=on_change)
+        _registry[name] = spec
+        env = os.environ.get("FLAGS_" + name)
+        _values[name] = _coerce(spec, env) if env is not None else default
+
+
+def flag(name: str) -> Any:
+    """Read a single flag value (fast path used by subsystems)."""
+    try:
+        return _values[name]
+    except KeyError:
+        raise KeyError(f"Unknown flag {name!r}; known: {sorted(_registry)}")
+
+
+def get_flags(names: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """Paddle-parity ``paddle.get_flags``: dict of flag values."""
+    with _lock:
+        if names is None:
+            return dict(_values)
+        if isinstance(names, str):
+            names = [names]
+        return {n: flag(n) for n in names}
+
+
+def set_flags(flags_map: Dict[str, Any]) -> None:
+    """Paddle-parity ``paddle.set_flags({'FLAGS_x': v})`` (prefix optional)."""
+    with _lock:
+        for name, value in flags_map.items():
+            if name.startswith("FLAGS_"):
+                name = name[len("FLAGS_"):]
+            if name not in _registry:
+                raise KeyError(f"Unknown flag {name!r}")
+            spec = _registry[name]
+            _values[name] = _coerce(spec, value)
+            if spec.on_change is not None:
+                spec.on_change(_values[name])
+
+
+def list_flags() -> List[_FlagSpec]:
+    with _lock:
+        return list(_registry.values())
+
+
+# ---------------------------------------------------------------------------
+# Built-in flags (subset of the reference's phi/core/flags.cc surface that is
+# meaningful on TPU/XLA; allocator/cudnn flags have no TPU analog).
+# ---------------------------------------------------------------------------
+
+define_flag("check_nan_inf", False,
+            "Scan op outputs for NaN/Inf during training steps "
+            "(ref: FLAGS_check_nan_inf, phi/core/flags.cc).")
+define_flag("check_nan_inf_level", 0,
+            "0: error on NaN/Inf; higher levels only warn/log.")
+define_flag("use_deterministic_reductions", False,
+            "Force deterministic XLA reductions (bitwise reproducibility).")
+define_flag("default_dtype", "float32", "Default floating point dtype.")
+define_flag("jit_cache_size", 4096, "Max entries in the compiled-step cache.")
+define_flag("log_level", 0, "Framework VLOG-style verbosity (0=off).")
+define_flag("allocator_strategy", "xla",
+            "Parity stub: memory is managed by XLA/PJRT on TPU.")
+define_flag("embedding_deterministic", False,
+            "Use deterministic (slower) embedding gradient scatter.")
+define_flag("flash_attn_version", 2, "Pallas flash-attention kernel version.")
+define_flag("use_pallas_kernels", True,
+            "Use Pallas TPU kernels where available (else jnp reference).")
+define_flag("amp_dtype", "bfloat16", "Preferred mixed-precision compute dtype.")

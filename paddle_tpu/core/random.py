@@ -1,0 +1,191 @@
+"""RNG machinery.
+
+Re-design of the reference's RNG stack for JAX's functional (threefry) PRNG:
+
+- ``paddle.seed`` + per-device Generator (ref ``phi/core/generator.h``) becomes a
+  global stateful :class:`Generator` that splits a threefry key on demand. This
+  serves *eager* ops (outside jit).
+- Inside ``jit``-traced code, stateful key-splitting is illegal (the trace is
+  cached), so layers pull keys from an explicit :func:`rng_scope` context seeded
+  per step by the training loop. This is the TPU-native answer to paddle's
+  hidden global generator: determinism comes from (seed, step) rather than
+  mutation order.
+- :class:`RNGStatesTracker` mirrors the tensor-parallel RNG discipline of
+  ``python/paddle/distributed/fleet/layers/mpu/random.py`` (RNGStatesTracker):
+  named streams ("global_seed", "local_seed") so dropout masks can be replicated
+  across a TP group or decorrelated per rank, by folding the rank into the key.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Dict, Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "seed", "default_generator", "Generator", "rng_scope", "next_key",
+    "get_rng_state", "set_rng_state", "RNGStatesTracker",
+    "model_parallel_rng_tracker",
+]
+
+
+class Generator:
+    """Stateful key source for eager-mode randomness."""
+
+    def __init__(self, seed_: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed_)
+
+    def manual_seed(self, seed_: int) -> "Generator":
+        with self._lock:
+            self._seed = int(seed_)
+            self._count = 0
+        return self
+
+    def next_key(self) -> jax.Array:
+        with self._lock:
+            self._count += 1
+            count = self._count
+        return jax.random.fold_in(jax.random.key(self._seed), count)
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state) -> None:
+        with self._lock:
+            self._seed, self._count = int(state[0]), int(state[1])
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(seed_: int) -> Generator:
+    """paddle.seed parity: reseed the global generator, the TP tracker base,
+    and numpy's global RNG (host-side shuffling in samplers/datasets derives
+    from it, so data order is reproducible too)."""
+    import numpy as _np
+    _default_generator.manual_seed(seed_)
+    model_parallel_rng_tracker().reset(seed_)
+    _np.random.seed(seed_ % (2 ** 32))
+    return _default_generator
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state) -> None:
+    _default_generator.set_state(state)
+
+
+# ---------------------------------------------------------------------------
+# Traced-code RNG: explicit key scope.
+# ---------------------------------------------------------------------------
+
+_scope = threading.local()
+
+
+@contextlib.contextmanager
+def rng_scope(key: jax.Array) -> Iterator[None]:
+    """Provide a PRNG key to layers executed inside (works under jit tracing:
+    the key is a traced value; successive next_key() calls fold in a trace-time
+    counter, so the *structure* of randomness is baked into the compiled step
+    while the *values* vary with the key fed each step)."""
+    prev = getattr(_scope, "state", None)
+    _scope.state = [key, 0]
+    try:
+        yield
+    finally:
+        _scope.state = prev
+
+
+def in_rng_scope() -> bool:
+    return getattr(_scope, "state", None) is not None
+
+
+def next_key() -> jax.Array:
+    """Fresh key: from the active rng_scope if any, else the global generator."""
+    state = getattr(_scope, "state", None)
+    if state is not None:
+        state[1] += 1
+        return jax.random.fold_in(state[0], state[1])
+    return _default_generator.next_key()
+
+
+# ---------------------------------------------------------------------------
+# Tensor-parallel RNG streams (ref: fleet/layers/mpu/random.py).
+# ---------------------------------------------------------------------------
+
+class RNGStatesTracker:
+    """Named RNG streams for hybrid parallelism.
+
+    Stream semantics (matching the reference): under tensor parallelism,
+    dropout *between* TP ops must be identical across the TP group
+    ("global_seed" stream), while dropout *inside* sharded regions must differ
+    per rank ("local_seed" stream, rank folded in). In the JAX build a stream
+    is just a deterministic transform of (base_seed, stream_offset, rank).
+    """
+
+    GLOBAL = "global_seed"
+    LOCAL = "local_seed"
+
+    def __init__(self, base_seed: int = 0):
+        self.reset(base_seed)
+
+    def reset(self, base_seed: int) -> None:
+        self._base_seed = int(base_seed)
+        self._streams: Dict[str, int] = {}
+        self._rank = 0
+
+    def set_rank(self, rank: int) -> None:
+        self._rank = int(rank)
+
+    def add(self, name: str, seed_: int) -> None:
+        if name in self._streams:
+            raise ValueError(f"RNG stream {name!r} already exists")
+        self._streams[name] = int(seed_)
+
+    def ensure_default_streams(self, tp_rank: int = 0) -> None:
+        if self.GLOBAL not in self._streams:
+            self._streams[self.GLOBAL] = self._base_seed
+        if self.LOCAL not in self._streams:
+            self._streams[self.LOCAL] = self._base_seed + 1
+        self._rank = int(tp_rank)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = LOCAL):
+        """Run the body with keys drawn from the named stream. A 'local'
+        stream folds the TP rank into the key (decorrelated); 'global' does
+        not (replicated)."""
+        if name not in self._streams:
+            self.ensure_default_streams(self._rank)
+        if name not in self._streams:
+            raise ValueError(f"Unknown RNG stream {name!r}")
+        stream_seed = self._streams[name]
+        key = jax.random.key(stream_seed)
+        if name != self.GLOBAL:
+            key = jax.random.fold_in(key, self._rank + 1)
+        # Mix in the outer scope's key (if any) so per-step variation from the
+        # training loop propagates into the stream.
+        state = getattr(_scope, "state", None)
+        if state is not None:
+            state[1] += 1
+            outer_sub = jax.random.fold_in(state[0], state[1])
+            key = jax.random.wrap_key_data(
+                jax.random.key_data(key) ^ jax.random.key_data(outer_sub))
+        with rng_scope(key):
+            yield
+
+
+_mp_tracker = RNGStatesTracker(0)
+
+
+def model_parallel_rng_tracker() -> RNGStatesTracker:
+    return _mp_tracker

@@ -1,0 +1,11 @@
+from .env import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
+                  ParallelEnv, is_initialized, parallel_device_count)
+from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
+                       create_hybrid_mesh, get_hybrid_mesh, set_hybrid_mesh)
+from .collective import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
+                         all_gather, reduce_scatter, all_to_all, broadcast,
+                         reduce, scatter, barrier, world_group, axis_rank,
+                         in_axis_context, ppermute_next)
+from .parallel import DataParallel, shard_batch, replicate, scale_loss  # noqa: F401
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401

@@ -1,0 +1,107 @@
+"""Distributed environment & bootstrap.
+
+Reference design: ``init_parallel_env`` (``python/paddle/distributed/
+parallel.py:925``) spawns one OS process per GPU, rendezvouses over a global
+``TCPStore`` and builds NCCL process groups.
+
+TPU-native design: JAX is multi-controller — one process per *host*, each
+seeing its local chips; ``jax.distributed.initialize`` (coordinator address =
+the TCPStore analog) wires up the cluster, and *all* collectives afterwards are
+XLA ops over the mesh, not process-group calls. For single-host work (and the
+CPU fake-cluster used in tests via ``xla_force_host_platform_device_count``),
+"rank" means *device* index within the mesh rather than process; the
+collective API in paddle_tpu.distributed.collective accounts for both.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+           "is_initialized", "parallel_device_count"]
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None) -> "ParallelEnv":
+    """paddle.distributed.init_parallel_env parity.
+
+    Multi-host: pass coordinator_address/num_processes/process_id or set
+    PADDLE_MASTER / PADDLE_TRAINERS_NUM / PADDLE_TRAINER_ID env vars
+    (reference names honored). Single-host: no-op beyond marking init.
+    """
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = coordinator_address or os.environ.get("PADDLE_MASTER") or \
+        os.environ.get("MASTER_ADDR")
+    nproc = num_processes if num_processes is not None else \
+        int(os.environ.get("PADDLE_TRAINERS_NUM", "0") or 0)
+    pid = process_id if process_id is not None else \
+        int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    if coord and nproc > 1:
+        port = os.environ.get("MASTER_PORT")
+        if port and ":" not in coord:
+            coord = f"{coord}:{port}"
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank() -> int:
+    """Global device-rank of this process's first device (== process rank in
+    the one-device-per-process picture the reference uses)."""
+    return jax.process_index()
+
+
+def get_world_size() -> int:
+    return jax.process_count()
+
+
+def parallel_device_count() -> int:
+    """Total devices across the cluster (the TPU notion of world size for
+    SPMD: collectives span devices, not processes)."""
+    return jax.device_count()
+
+
+class ParallelEnv:
+    """ref: python/paddle/distributed/parallel.py ParallelEnv."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return jax.local_devices()[0].id
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nranks(self) -> int:
+        return get_world_size()
+
+    @property
+    def local_rank(self) -> int:
+        return get_rank()

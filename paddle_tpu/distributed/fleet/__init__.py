@@ -1,0 +1,7 @@
+from .strategy import DistributedStrategy  # noqa: F401
+from .fleet import (init, distributed_model, distributed_optimizer,  # noqa: F401
+                    get_hybrid_communicate_group, worker_index, worker_num,
+                    is_first_worker)
+from . import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from .layers import mpu  # noqa: F401

@@ -1,0 +1,92 @@
+"""fleet: hybrid-parallel orchestration entry points.
+
+Parity with ``python/paddle/distributed/fleet/fleet.py:169`` (``fleet.init``)
+and ``:372`` (``_init_hybrid_parallel_env``): degrees from
+DistributedStrategy.hybrid_configs → mesh (the HybridCommunicateGroup
+equivalent) → ``distributed_model``/``distributed_optimizer`` wrap the user's
+net/opt for the chosen parallelism.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..env import init_parallel_env, get_rank, get_world_size
+from ..topology import HybridCommunicateGroup, create_hybrid_mesh
+from .strategy import DistributedStrategy
+
+__all__ = ["init", "distributed_model", "distributed_optimizer",
+           "get_hybrid_communicate_group", "worker_index", "worker_num",
+           "is_first_worker"]
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None,
+         devices=None) -> None:
+    """fleet.init parity: build the hybrid mesh from strategy degrees."""
+    global _hcg, _strategy
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    _strategy = strategy
+    h = strategy.hybrid_configs
+    n = len(devices) if devices is not None else jax.device_count()
+    dp = h.dp_degree
+    known = h.mp_degree * h.pp_degree * h.sharding_degree * h.sep_degree
+    if dp == -1:
+        dp = max(1, n // known)
+    mesh = create_hybrid_mesh(dp=dp, mp=h.mp_degree, pp=h.pp_degree,
+                              sharding=h.sharding_degree, sep=h.sep_degree,
+                              devices=devices)
+    _hcg = HybridCommunicateGroup(mesh)
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def fleet_initialized() -> bool:
+    return _hcg is not None
+
+
+def worker_index() -> int:
+    return get_rank()
+
+
+def worker_num() -> int:
+    return get_world_size()
+
+
+def is_first_worker() -> bool:
+    return get_rank() == 0
+
+
+def distributed_model(model):
+    """Wrap the net per the active strategy (ref fleet.py distributed_model):
+    pp>1 → PipelineParallel; mp>1 → TensorParallel marker; else DataParallel."""
+    assert _hcg is not None, "call fleet.init() first"
+    from ..parallel import DataParallel
+    from .meta_parallel import PipelineParallel, TensorParallel
+    if _hcg.get_pipe_parallel_world_size() > 1:
+        from .meta_parallel.pp_layers import PipelineLayer
+        if not isinstance(model, PipelineLayer):
+            raise TypeError("pipeline parallel requires a PipelineLayer model")
+        return PipelineParallel(model, _hcg, _strategy)
+    if _hcg.get_model_parallel_world_size() > 1 or \
+            _hcg.get_sep_parallel_world_size() > 1:
+        return TensorParallel(model, _hcg, _strategy)
+    return DataParallel(model)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """Wrap optimizer with TP-aware clip + hybrid grad sync semantics
+    (ref HybridParallelOptimizer hybrid_parallel_optimizer.py:251). In the
+    mesh world, grad reductions are emitted by XLA from shardings, so the
+    wrapper only needs to keep the API and the global-norm semantics (norm
+    contributions cross shards automatically inside pjit)."""
+    from .meta_optimizers import HybridParallelOptimizer
+    return HybridParallelOptimizer(optimizer, _hcg, _strategy or DistributedStrategy())

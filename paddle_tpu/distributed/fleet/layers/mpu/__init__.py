@@ -1,0 +1,4 @@
+from .mp_layers import (VocabParallelEmbedding, ColumnParallelLinear,  # noqa: F401
+                        RowParallelLinear, ParallelCrossEntropy)
+from .random import RNGStatesTracker, get_rng_state_tracker  # noqa: F401
+from . import mp_ops  # noqa: F401

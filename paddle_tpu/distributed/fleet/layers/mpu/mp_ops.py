@@ -1,0 +1,77 @@
+"""Explicit MP communication ops (shard_map building blocks).
+
+Reference: ``fleet/layers/mpu/mp_ops.py`` (``_c_identity``, ``_c_concat``,
+``_c_split``, ``_mp_allreduce``) — autograd-aware collectives used by the
+hand-written TP layers.
+
+Under GSPMD these are normally *implicit*; the explicit forms below are for
+shard_map-based code paths (custom kernels, ring attention) where the user
+manages shards manually. Each has the correct transpose (VJP) — e.g. identity
+forward / psum backward — mirroring the reference's op pairs. Inside
+shard_map, jax already transposes psum/all_gather correctly, so these are
+thin named wrappers that document intent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["c_identity", "c_split", "c_concat", "mp_allreduce",
+           "scatter_to_sequence_parallel", "gather_from_sequence_parallel"]
+
+MP_AXIS = "mp"
+
+
+@jax.custom_vjp
+def _identity_psum_bwd(x, axis_name):
+    return x
+
+
+def _ipb_fwd(x, axis_name):
+    return x, axis_name
+
+
+def _ipb_bwd(axis_name, g):
+    return lax.psum(g, axis_name), None
+
+
+_identity_psum_bwd.defvjp(_ipb_fwd, _ipb_bwd)
+
+
+def c_identity(x, axis: str = MP_AXIS):
+    """Forward identity, backward allreduce (enter a column-parallel region).
+    ref: mp_ops._c_identity."""
+    return _identity_psum_bwd(x, axis)
+
+
+def mp_allreduce(x, axis: str = MP_AXIS):
+    """Forward allreduce, backward identity (exit a row-parallel region).
+    ref: mp_ops._mp_allreduce. lax.psum's transpose is already identity-like
+    inside shard_map."""
+    return lax.psum(x, axis)
+
+
+def c_concat(x, axis: str = MP_AXIS, dim: int = -1):
+    """All-gather shards along `dim` (ref _c_concat)."""
+    return lax.all_gather(x, axis, axis=dim % x.ndim, tiled=True)
+
+
+def c_split(x, axis: str = MP_AXIS, dim: int = -1):
+    """Keep this rank's slice along `dim` (ref _c_split)."""
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    dim = dim % x.ndim
+    chunk = x.shape[dim] // n
+    return lax.dynamic_slice_in_dim(x, idx * chunk, chunk, dim)
+
+
+def scatter_to_sequence_parallel(x, axis: str = "sep", dim: int = 1):
+    """ref sequence_parallel_utils.scatter: split activations along seq dim."""
+    return c_split(x, axis, dim)
+
+
+def gather_from_sequence_parallel(x, axis: str = "sep", dim: int = 1):
+    """ref sequence_parallel_utils.all_gather along seq dim."""
+    return lax.all_gather(x, axis, axis=dim, tiled=True)

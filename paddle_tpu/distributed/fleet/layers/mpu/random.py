@@ -1,0 +1,15 @@
+"""TP RNG discipline — re-export of the core tracker.
+
+ref: python/paddle/distributed/fleet/layers/mpu/random.py (RNGStatesTracker):
+'global_seed' stream for dropout replicated across the TP group, 'local_seed'
+for per-rank-decorrelated dropout. Implementation lives in
+paddle_tpu.core.random (deterministic key derivation instead of CUDA RNG
+state save/restore)."""
+
+from .....core.random import RNGStatesTracker, model_parallel_rng_tracker
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker"]
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return model_parallel_rng_tracker()

@@ -1,0 +1,41 @@
+"""HybridParallelOptimizer (ref: fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py:251).
+
+In the reference this wrapper (a) makes global-norm grad clip span mp/pp/
+sharding groups, (b) triggers DP/sharding grad allreduce after backward.
+Under pjit both happen structurally: grads of sharded params are produced
+already-reduced, and a global-norm computed over the (sharded) grad pytree
+inside the compiled step contributes partial norms with XLA inserting the
+cross-shard psum. So this class only preserves the API and delegates."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def step(self):
+        return self._inner_opt.step()
+
+    def clear_grad(self):
+        return self._inner_opt.clear_grad()
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, s):
+        return self._inner_opt.set_state_dict(s)

@@ -1,0 +1,89 @@
+"""Pipeline-parallel runtime.
+
+Reference design: ``fleet/meta_parallel/pipeline_parallel.py:132``
+(PipelineParallel.train_batch → forward_backward_pipeline :387 = imperative
+1F1B over eager p2p NCCL sends; interleaved VPP variant :822).
+
+TPU-native design: the schedule is *compiled*, not imperative. The 1F1B/GPipe
+loop is expressed with ``lax.scan`` over microbatch ticks inside one
+``shard_map`` over the ``pp`` mesh axis; stage-to-stage transfer is a single
+``ppermute`` per tick riding ICI neighbors. XLA overlaps the permute with
+each stage's compute. See paddle_tpu.distributed.pipeline for the schedule
+kernels; this class is the fleet-facing wrapper that owns microbatching,
+loss scaling and the shared-embedding grad sync.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ....framework.functional import functional_call, get_params, set_params
+from ....nn.layer import Layer
+from .pp_layers import PipelineLayer
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel(Layer):
+    def __init__(self, layers: PipelineLayer, hcg=None, strategy=None):
+        super().__init__()
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("PipelineParallel requires a PipelineLayer")
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = getattr(strategy, "hybrid_configs", None)
+        self.micro_batch_size = getattr(cfg, "micro_batch_size", 1)
+        self.accumulate_steps = getattr(cfg, "accumulate_steps", 1)
+        self.schedule_mode = getattr(cfg, "schedule_mode", "1F1B")
+        self._train_step = None
+
+    def forward(self, x):
+        return self._layers(x)
+
+    # ------------------------------------------------------------------
+    # train_batch: compiled pipeline step (built lazily per batch shape).
+    # ------------------------------------------------------------------
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """One pipelined optimizer step over `data` = (inputs, labels).
+
+        The compiled step runs the pipeline schedule over
+        ``accumulate_steps`` microbatches and applies the optimizer once,
+        returning the mean loss (ref train_batch semantics)."""
+        from ...pipeline_schedule import make_pipeline_train_step
+        inputs, labels = data
+        inputs = jnp.asarray(inputs)
+        labels = jnp.asarray(labels)
+        opt = optimizer.inner_opt if hasattr(optimizer, "inner_opt") else optimizer
+        if self._train_step is None:
+            self._train_step = make_pipeline_train_step(
+                self._layers, opt, self._hcg,
+                n_microbatch=self.accumulate_steps,
+                schedule=self.schedule_mode)
+        params = get_params(self._layers)
+        if getattr(self, "_opt_state", None) is None:
+            self._opt_state = opt.init(
+                {k: v for k, v in params.items()})
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        new_params, self._opt_state, loss = self._train_step(
+            params, self._opt_state, inputs, labels, lr)
+        set_params(self._layers, new_params)
+        return np.asarray(loss)
+
+    def eval_batch(self, data, compute_loss: bool = True):
+        inputs, labels = data
+        out = self._layers(jnp.asarray(inputs))
+        if compute_loss:
+            return np.asarray(jnp.mean(self._layers.loss_fn(out, jnp.asarray(labels))))
+        return out
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

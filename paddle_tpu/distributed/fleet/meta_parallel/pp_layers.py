@@ -1,0 +1,146 @@
+"""PipelineLayer: stage partitioning of a layer sequence.
+
+Parity with ``fleet/meta_parallel/parallel_layers/pp_layers.py:239``
+(PipelineLayer: LayerDesc list, partition by layer count or compute-weight,
+shared params across stages e.g. tied embeddings, and per-stage
+sub-model extraction). The schedule itself lives in pipeline_parallel.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ....nn.layer import Layer
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    """Deferred layer construction (so each stage only materializes its own
+    params — the reference builds only local layers too)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer) and not callable(layer_cls):
+            raise TypeError("LayerDesc needs a Layer subclass or factory")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.args, **self.kwargs)
+
+    def __repr__(self):
+        return f"LayerDesc({getattr(self.layer_cls, '__name__', self.layer_cls)})"
+
+
+class SharedLayerDesc(LayerDesc):
+    """Layer whose params are shared across stages (tied embeddings —
+    ref pp_layers SharedLayerDesc + allreduce_shared_weight_gradients)."""
+
+    def __init__(self, key: str, layer_cls, forward_func: Optional[Callable] = None,
+                 shared_weight_attr: str = "weight", *args, **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Sequence of LayerDescs partitioned into pp stages.
+
+    In the TPU build the full layer list is retained (single-controller sees
+    all params; per-stage placement happens via stage-tagged param specs and
+    the pipeline schedule), and `get_stage_layers(i)` gives the callables for
+    stage i. seg_method: 'uniform' (by count) or 'layer:<ClassName>' (split at
+    occurrences of a class, like the reference's "layer:TransformerLayer").
+    """
+
+    def __init__(self, layers: Sequence[Union[LayerDesc, Layer, Callable]],
+                 num_stages: Optional[int] = None, topology=None,
+                 loss_fn=None, seg_method: str = "uniform",
+                 recompute_interval: int = 0, num_virtual_pipeline_stages: int = 1):
+        super().__init__()
+        self._descs = list(layers)
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._num_virtual_stages = num_virtual_pipeline_stages
+        self.seg_method = seg_method
+        self.recompute_interval = recompute_interval
+
+        # Build all layers (deferred descs included).
+        built: List[Any] = []
+        self._shared: Dict[str, Layer] = {}
+        for i, d in enumerate(self._descs):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    layer = self._shared[d.layer_name]
+                else:
+                    layer = d.build_layer()
+                    self._shared[d.layer_name] = layer
+                built.append((layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append((d.build_layer(), None))
+            else:
+                built.append((d, None))
+        self._built = built
+        for i, (layer, _) in enumerate(built):
+            if isinstance(layer, Layer):
+                # shared layers register once under their first position
+                if layer not in [l for l, _ in built[:i]]:
+                    self.add_sublayer(str(i), layer)
+
+        self._segments = self._partition(len(built), self.total_stages)
+
+    @property
+    def total_stages(self) -> int:
+        return self._num_stages * self._num_virtual_stages
+
+    def _partition(self, n_layers: int, n_stages: int) -> List[int]:
+        """Boundaries [b_0..b_S]; stage i owns [b_i, b_{i+1})."""
+        if self.seg_method.startswith("layer:"):
+            cls_name = self.seg_method.split(":", 1)[1]
+            marks = [i for i, (l, _) in enumerate(self._built)
+                     if type(l).__name__ == cls_name]
+            if len(marks) < n_stages:
+                raise ValueError(
+                    f"only {len(marks)} {cls_name} layers for {n_stages} stages")
+            per = len(marks) / n_stages
+            bounds = [0]
+            for s in range(1, n_stages):
+                bounds.append(marks[int(round(s * per))])
+            bounds.append(n_layers)
+            return bounds
+        # uniform by count
+        per = n_layers / n_stages
+        return [int(round(s * per)) for s in range(n_stages)] + [n_layers]
+
+    def get_stage_layers(self, stage: int) -> List[Any]:
+        lo, hi = self._segments[stage], self._segments[stage + 1]
+        return self._built[lo:hi]
+
+    def stage_of_layer(self, idx: int) -> int:
+        for s in range(self.total_stages):
+            if self._segments[s] <= idx < self._segments[s + 1]:
+                return s
+        raise IndexError(idx)
+
+    def forward_stage(self, x, stage: int):
+        for layer, fwd in self.get_stage_layers(stage):
+            x = fwd(layer, x) if fwd is not None else layer(x)
+        return x
+
+    def forward(self, x):
+        """Full-model forward (used single-device and for parity tests)."""
+        for s in range(self.total_stages):
+            x = self.forward_stage(x, s)
+        return x
+
+    def shared_layers(self) -> Dict[str, Layer]:
+        return dict(self._shared)
+
+    def loss_fn(self, *args):
+        if self._loss_fn is None:
+            raise RuntimeError("PipelineLayer built without loss_fn")
+        return self._loss_fn(*args)

@@ -1,0 +1,32 @@
+"""TensorParallel wrapper (ref: fleet/meta_parallel/tensor_parallel.py).
+
+In the reference this wrapper broadcasts params across the MP group at init
+and syncs gradients. Under GSPMD neither is needed: params carry
+PartitionSpecs (set by the mpu layers) and pjit materializes/reduces them.
+The wrapper keeps the API and exposes the model's sharding plan."""
+
+from __future__ import annotations
+
+from ....nn.layer import Layer
+
+__all__ = ["TensorParallel"]
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers: Layer, hcg=None, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def param_specs(self):
+        return self._layers.named_param_specs()
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

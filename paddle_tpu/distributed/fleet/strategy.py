@@ -1,0 +1,75 @@
+"""DistributedStrategy.
+
+Parity with ``python/paddle/distributed/fleet/base/distributed_strategy.py:121``
+(protobuf-backed config: hybrid_configs, amp_configs, sharding_configs,
+recompute_configs...). Plain dataclasses here — the config surface is kept,
+the protobuf plumbing is not (nothing crosses a language boundary anymore).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+__all__ = ["DistributedStrategy", "HybridConfig"]
+
+
+@dataclass
+class HybridConfig:
+    dp_degree: int = -1
+    mp_degree: int = 1
+    pp_degree: int = 1
+    sharding_degree: int = 1
+    sep_degree: int = 1
+    ep_degree: int = 1
+    micro_batch_size: int = 1
+    accumulate_steps: int = 1
+    schedule_mode: str = "1F1B"  # or "FThenB", "VPP"
+    virtual_pp_degree: int = 1
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs = HybridConfig()
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {
+            "init_loss_scaling": 2.0 ** 15, "use_dynamic_loss_scaling": True,
+            "custom_white_list": [], "custom_black_list": [], "level": "O1",
+            "dtype": "bfloat16",
+        }
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {"stage": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {"k_steps": 1}
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = {
+            "accumulate_steps": 1, "micro_batch_size": 1,
+            "schedule_mode": "1F1B"}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs: Dict[str, Any] = {}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True  # XLA does this natively
+        self.lamb = False
+        self.lars = False
+
+    def _set_hybrid(self, cfg: Dict[str, Any]):
+        for k, v in cfg.items():
+            if hasattr(self.hybrid_configs, k):
+                setattr(self.hybrid_configs, k, v)
+            else:
+                raise KeyError(f"unknown hybrid config {k!r}")
+
+    def __setattr__(self, name, value):
+        if name == "hybrid_configs" and isinstance(value, dict):
+            self._set_hybrid(value)
+            return
+        object.__setattr__(self, name, value)
+
+    def __repr__(self):
+        h = self.hybrid_configs
+        return (f"DistributedStrategy(dp={h.dp_degree}, mp={h.mp_degree}, "
+                f"pp={h.pp_degree}, sharding={h.sharding_degree}, "
+                f"sep={h.sep_degree}, amp={self.amp}, "
+                f"recompute={self.recompute})")

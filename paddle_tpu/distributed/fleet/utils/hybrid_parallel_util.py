@@ -1,0 +1,40 @@
+"""Hybrid-parallel gradient utilities.
+
+ref: ``fleet/utils/hybrid_parallel_util.py:241`` fused_allreduce_gradients —
+coalesced DP/sharding allreduce after backward. Under pjit, gradient
+reduction is emitted (and fused/overlapped) by XLA from the shardings; this
+explicit form exists for imperative eager loops operating on stacked-ranks
+grads or inside shard_map."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+from jax import lax
+
+from ...collective import Group, all_reduce, in_axis_context
+
+__all__ = ["fused_allreduce_gradients", "sync_params_buffers"]
+
+
+def fused_allreduce_gradients(parameter_refs: List, hcg=None,
+                              axis: str = "dp"):
+    """Eager path: allreduce `.grad` of each ParamRef over the dp axis.
+    Inside shard_map: psum each grad. No bucketing needed — XLA coalesces."""
+    if in_axis_context(axis):
+        for ref in parameter_refs:
+            if ref.grad is not None:
+                ref.grad = lax.psum(ref.grad, axis)
+        return
+    # Eager single-controller: grads are global arrays already (no-op), kept
+    # for API parity with multi-controller flows.
+    return
+
+
+def sync_params_buffers(model, comm_group=None, src_rank: int = 0,
+                        is_model_parallel: bool = False):
+    """ref: broadcast params from rank0 across DP at startup. Global arrays
+    are already consistent in single-controller; multi-controller inits from
+    the same seed (deterministic per-path keys), so this is a no-op check."""
+    return

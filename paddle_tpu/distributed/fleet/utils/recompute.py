@@ -1,0 +1,78 @@
+"""Activation recomputation.
+
+ref: ``fleet/recompute/recompute.py:88`` (RecomputeFunction PyLayer: saves
+inputs + RNG state, re-runs forward in backward) and ``recompute_sequential``
+(:508).
+
+TPU-native: ``jax.checkpoint`` (rematerialization) is the same trade
+implemented at trace level, with XLA-aware policies (e.g. save dot outputs,
+recompute elementwise). RNG consistency is automatic: keys are values, so
+the recomputed forward sees identical randomness — no CUDA RNG state
+save/restore dance.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+
+from ....nn.layer import Layer
+from ....framework.functional import functional_call
+
+__all__ = ["recompute", "recompute_sequential", "RecomputePolicy"]
+
+
+class RecomputePolicy:
+    """Named remat policies mapped to jax.checkpoint policies."""
+
+    FULL = None  # recompute everything
+    DOTS = "dots_saveable"
+    DOTS_NO_BATCH = "dots_with_no_batch_dims_saveable"
+    NOTHING = "nothing_saveable"
+    EVERYTHING = "everything_saveable"
+
+    @staticmethod
+    def resolve(name):
+        if name is None:
+            return None
+        import jax.ad_checkpoint as adc
+        return getattr(adc.checkpoint_policies, name)
+
+
+def recompute(function, *args, policy=None, prevent_cse: bool = True,
+              use_reentrant: bool = True, **kwargs):
+    """ref recompute(): run `function` under rematerialization."""
+    if isinstance(function, Layer):
+        layer = function
+
+        def fn(*a, **k):
+            return layer(*a, **k)
+    else:
+        fn = function
+    ck = jax.checkpoint(fn, policy=RecomputePolicy.resolve(policy),
+                        prevent_cse=prevent_cse)
+    return ck(*args, **kwargs)
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """ref recompute_sequential(:508): chunked remat over a Sequential."""
+    segments = ctx.get("segments", 1)
+    if isinstance(functions, Layer):
+        layers = list(functions)  # Sequential is iterable
+    else:
+        layers = list(functions)
+    n = len(layers)
+    per = max(1, n // segments)
+    x = args[0] if len(args) == 1 else args
+
+    def seg_fn(layers_slice):
+        def run(x):
+            for l in layers_slice:
+                x = l(x)
+            return x
+        return run
+
+    for s in range(0, n, per):
+        x = jax.checkpoint(seg_fn(layers[s:s + per]))(x)
+    return x

@@ -1,0 +1,75 @@
+"""Megatron-style sequence parallelism.
+
+Reference: ``fleet/utils/sequence_parallel_utils.py`` — scatter/all_gather
+along the sequence dim (:36/:54) as PyLayers, ColumnSequenceParallelLinear /
+RowSequenceParallelLinear, and allreduce hooks for SP params.
+
+TPU-native: between TP regions, activations carry a sharding constraint
+splitting the sequence dim over the mp axis; XLA then replaces the
+(identity fwd, allreduce bwd) pair with (all-gather fwd, reduce-scatter bwd)
+exactly as hand-coded Megatron-SP does — it falls out of the specs. The
+explicit shard_map forms are in mpu.mp_ops for custom paths.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..layers.mpu.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                    _constrain, MP_AXIS)
+from ..layers.mpu import mp_ops
+
+__all__ = ["scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "register_sequence_parallel_allreduce_hooks",
+           "sequence_parallel_constraint"]
+
+
+def scatter(x, axis: str = MP_AXIS):
+    """Inside shard_map: keep this rank's sequence slice (ref :36)."""
+    return mp_ops.c_split(x, axis, dim=1)
+
+
+def all_gather(x, axis: str = MP_AXIS):
+    """Inside shard_map: gather sequence shards (ref :54)."""
+    return mp_ops.gather_from_sequence_parallel(x, axis, dim=1)
+
+
+def sequence_parallel_constraint(x, seq_dim: int = 1):
+    """GSPMD: constrain activations [B, S, H] to shard S over mp."""
+    spec = [None] * x.ndim
+    spec[seq_dim] = MP_AXIS
+    return _constrain(x, P(*spec))
+
+
+def mark_as_sequence_parallel_parameter(param_ref):
+    """ref: marks LayerNorm params so their grads allreduce over mp. Under
+    GSPMD replicated params already get correct (psum'd) grads; keep the
+    marker for checkpoints/tools."""
+    param_ref.meta.is_sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse=False):
+    """No-op under GSPMD (grads of replicated params are reduced by XLA)."""
+    return model
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear whose input arrives sequence-sharded: the
+    input constraint triggers the SP all-gather in forward."""
+
+    def forward(self, x):
+        x = sequence_parallel_constraint(x)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose output leaves sequence-sharded (the SP
+    reduce-scatter instead of allreduce)."""
+
+    def forward(self, x):
+        y = super().forward(x)
+        return sequence_parallel_constraint(y)

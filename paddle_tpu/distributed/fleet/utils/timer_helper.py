@@ -1,0 +1,68 @@
+"""Per-stage timers (ref: fleet/utils/timer_helper.py:93 Timers — ips/stage
+timing for hybrid-parallel training loops)."""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+__all__ = ["Timers", "get_timers", "set_timers"]
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started = False
+        self._start_t = 0.0
+
+    def start(self):
+        assert not self.started, f"timer {self.name} already started"
+        self._start_t = time.perf_counter()
+        self.started = True
+
+    def stop(self):
+        assert self.started
+        self.elapsed_ += time.perf_counter() - self._start_t
+        self.started = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        return e
+
+
+class Timers:
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names=None, normalizer: float = 1.0, reset: bool = True) -> str:
+        names = names or list(self.timers)
+        parts = [f"{n}: {self.timers[n].elapsed(reset) * 1000 / normalizer:.2f}ms"
+                 for n in names if n in self.timers]
+        return " | ".join(parts)
+
+
+_timers: Optional[Timers] = None
+
+
+def get_timers() -> Timers:
+    global _timers
+    if _timers is None:
+        _timers = Timers()
+    return _timers
+
+
+def set_timers(t: Timers) -> None:
+    global _timers
+    _timers = t

@@ -1,0 +1,214 @@
+"""Hybrid-parallel topology = one device mesh with named axes.
+
+Reference design: ``CommunicateTopology``/``HybridCommunicateGroup``
+(``python/paddle/distributed/fleet/base/topology.py:60/173``) carve the world
+into per-axis NCCL process groups over a 5-D cartesian topology
+``[dp, pp, sharding, sep, mp]``.
+
+TPU-native design: the topology IS a ``jax.sharding.Mesh`` whose named axes
+are the parallelism axes. There are no process groups to create — annotating
+shardings with axis names makes XLA emit the collectives over ICI. Axis order
+matters physically: later (minor) axes get adjacent devices, so put the
+highest-bandwidth-hungry axis (mp/tp) last — same reasoning as the reference
+putting mp innermost (topology.py order ['pp','dp','sharding','sep','mp']).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup",
+           "create_hybrid_mesh", "get_hybrid_mesh", "set_hybrid_mesh",
+           "AXIS_ORDER"]
+
+# Canonical axis order, outermost → innermost (innermost axes map to
+# ICI-adjacent devices under the default device enumeration).
+AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
+
+
+def create_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1,
+                       sharding: int = 1, sep: int = 1,
+                       devices: Optional[Sequence[jax.Device]] = None,
+                       extra_axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Build the hybrid mesh. Degrees must multiply to the device count
+    (a degree of -1 is inferred)."""
+    devices = list(devices if devices is not None else jax.devices())
+    degrees = {"pp": pp, "dp": dp, "sharding": sharding, "sep": sep, "mp": mp}
+    if extra_axes:
+        degrees.update(extra_axes)
+    names = list(AXIS_ORDER) + [a for a in (extra_axes or {}) if a not in AXIS_ORDER]
+    sizes = [degrees[n] for n in names]
+    n_dev = len(devices)
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes = [n_dev // known if s == -1 else s for s in sizes]
+    total = int(np.prod(sizes))
+    if total != n_dev:
+        raise ValueError(f"Mesh degrees {dict(zip(names, sizes))} multiply to "
+                         f"{total}, but {n_dev} devices are available")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+_current_mesh: Optional[Mesh] = None
+
+
+def set_hybrid_mesh(mesh: Mesh) -> None:
+    global _current_mesh
+    _current_mesh = mesh
+
+
+def get_hybrid_mesh() -> Optional[Mesh]:
+    return _current_mesh
+
+
+class CommunicateTopology:
+    """ref: fleet/base/topology.py:60 — world coordinates over hybrid axes."""
+
+    def __init__(self, hybrid_group_names: Sequence[str] = AXIS_ORDER,
+                 dims: Sequence[int] = (1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(*map(range, self._dims)))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name: str) -> int:
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self) -> int:
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs) -> int:
+        coord = tuple(kwargs[name] for name in self._parallel_names)
+        return self._coord2rank[coord]
+
+    def get_coord(self, rank: int):
+        return self.coordinate[rank]
+
+    def get_axis_list(self, axis_name: str, index: int) -> List[int]:
+        axis = self._parallel_names.index(axis_name)
+        return sorted(self._coord2rank[c] for c in self.coordinate
+                      if c[axis] == index)
+
+    def get_comm_list(self, axis_name: str) -> List[List[int]]:
+        """All rank-groups along `axis_name` (ref get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        other_axes = [i for i in range(len(self._dims)) if i != axis]
+        groups = []
+        for other in itertools.product(*(range(self._dims[i]) for i in other_axes)):
+            ranks = []
+            for k in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, k)
+                ranks.append(self._coord2rank[tuple(coord)])
+            groups.append(ranks)
+        return groups
+
+
+class HybridCommunicateGroup:
+    """ref: fleet/base/topology.py:173 — but holds a Mesh, not NCCL groups.
+
+    Rank queries use the calling process's first local device's position in
+    the mesh (multi-controller) — under single-controller SPMD these are
+    trace-time concepts and per-device values come from axis indices inside
+    shard_map instead.
+    """
+
+    def __init__(self, mesh: Mesh):
+        self._mesh = mesh
+        shape = mesh.devices.shape
+        self._topo = CommunicateTopology(mesh.axis_names, shape)
+        set_hybrid_mesh(mesh)
+
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def topology(self) -> CommunicateTopology:
+        return self._topo
+
+    def _axis_size(self, name: str) -> int:
+        if name not in self._mesh.axis_names:
+            return 1
+        return self._mesh.shape[name]
+
+    def _my_coords(self) -> Dict[str, int]:
+        dev = jax.local_devices()[0]
+        idx = np.argwhere(self._mesh.devices == dev)
+        if idx.size == 0:  # device not in mesh (e.g. CPU fake mesh on TPU host)
+            return {n: 0 for n in self._mesh.axis_names}
+        pos = idx[0]
+        return {n: int(pos[i]) for i, n in enumerate(self._mesh.axis_names)}
+
+    # -- paddle-parity accessors ------------------------------------------
+
+    def get_data_parallel_world_size(self) -> int:
+        return self._axis_size("dp")
+
+    def get_model_parallel_world_size(self) -> int:
+        return self._axis_size("mp")
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self._axis_size("pp")
+
+    def get_sharding_parallel_world_size(self) -> int:
+        return self._axis_size("sharding")
+
+    def get_sep_parallel_world_size(self) -> int:
+        return self._axis_size("sep")
+
+    def get_data_parallel_rank(self) -> int:
+        return self._my_coords().get("dp", 0)
+
+    def get_model_parallel_rank(self) -> int:
+        return self._my_coords().get("mp", 0)
+
+    def get_stage_id(self) -> int:
+        return self._my_coords().get("pp", 0)
+
+    def get_sharding_parallel_rank(self) -> int:
+        return self._my_coords().get("sharding", 0)
+
+    def get_sep_parallel_rank(self) -> int:
+        return self._my_coords().get("sep", 0)
+
+    # Axis-name handles (the mesh-native "group" notion). The collective API
+    # accepts these axis names via Group objects.
+
+    def get_data_parallel_group(self):
+        from .collective import Group
+        return Group(self._mesh, "dp")
+
+    def get_model_parallel_group(self):
+        from .collective import Group
+        return Group(self._mesh, "mp")
+
+    def get_pipe_parallel_group(self):
+        from .collective import Group
+        return Group(self._mesh, "pp")
+
+    def get_sharding_parallel_group(self):
+        from .collective import Group
+        return Group(self._mesh, "sharding")
+
+    def get_sep_parallel_group(self):
+        from .collective import Group
+        return Group(self._mesh, "sep")
+
+    def get_check_parallel_group(self, *a, **k):
+        from .collective import Group
+        return Group(self._mesh, self._mesh.axis_names)
+
+    def topology_description(self) -> str:
+        return ", ".join(f"{n}={s}" for n, s in self._mesh.shape.items())

@@ -1,0 +1,2 @@
+from .functional import (functional_call, get_params, get_buffers,  # noqa: F401
+                         set_params, set_buffers)

@@ -1,0 +1,4 @@
+"""Top-level ``paddle_tpu.DataParallel`` alias (paddle exposes DataParallel at
+the root namespace; implementation lives in distributed.parallel)."""
+
+from ..distributed.parallel import DataParallel  # noqa: F401

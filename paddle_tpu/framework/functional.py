@@ -1,0 +1,142 @@
+"""Functional view over the mutable Layer tree.
+
+This module is the TPU-native replacement for the reference's entire execution
+stack: instead of an eager GradNode engine (``paddle/fluid/eager/backward.cc:104``)
+plus a static-graph executor (``paddle/fluid/framework/new_executor/``), a
+Layer's forward is an ordinary traceable function of a parameter pytree:
+
+    params  = get_params(model)                 # {dot-path: jax.Array}
+    out     = functional_call(model, params, x) # pure w.r.t. params
+    grads   = jax.grad(loss_of(functional_call))(params)
+
+``jax.jit`` over such a function IS the static graph (XLA compiles and fuses
+it); calling the Layer directly IS dygraph mode. The executor/interpreter/
+program-cache machinery collapses into XLA's compiled-executable cache.
+
+Buffer mutations (BatchNorm running stats) are handled functionally: with
+``mutable=True`` the call returns the post-forward buffer pytree and restores
+the originals, so a jitted step can thread buffer state explicitly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+
+__all__ = [
+    "get_params", "get_buffers", "set_params", "set_buffers",
+    "functional_call", "module_scan",
+]
+
+
+def get_params(model: Layer, trainable_only: bool = False) -> Dict[str, jax.Array]:
+    out = {}
+    for name, ref in model.named_parameters():
+        if trainable_only and not ref.trainable:
+            continue
+        out[name] = ref.value
+    return out
+
+
+def get_buffers(model: Layer) -> Dict[str, jax.Array]:
+    return dict(model.named_buffers())
+
+
+def set_params(model: Layer, params: Dict[str, Any]) -> None:
+    refs = dict(model.named_parameters())
+    for name, value in params.items():
+        refs[name].value = value
+
+
+def set_buffers(model: Layer, buffers: Dict[str, Any]) -> None:
+    index = {}
+    for lpref, layer in model.named_sublayers(include_self=True):
+        for bname in layer._buffers:
+            index[f"{lpref}.{bname}" if lpref else bname] = (layer, bname)
+    for name, value in buffers.items():
+        layer, bname = index[name]
+        layer._buffers[bname] = jnp.asarray(value)
+
+
+@contextlib.contextmanager
+def _swapped_state(model: Layer, params: Optional[Dict[str, Any]],
+                   buffers: Optional[Dict[str, Any]]):
+    """Temporarily install `params`/`buffers` into the layer tree."""
+    saved_params: Dict[str, Any] = {}
+    saved_buffers: Dict[str, Any] = {}
+    refs = dict(model.named_parameters()) if params else {}
+    if params:
+        for name, value in params.items():
+            ref = refs[name]
+            saved_params[name] = ref.value
+            ref.layer._parameters[ref.attr_name] = value
+    if buffers:
+        index = {}
+        for lpref, layer in model.named_sublayers(include_self=True):
+            for bname in layer._buffers:
+                index[f"{lpref}.{bname}" if lpref else bname] = (layer, bname)
+        for name, value in buffers.items():
+            layer, bname = index[name]
+            saved_buffers[name] = layer._buffers[bname]
+            layer._buffers[bname] = value
+    try:
+        yield refs
+    finally:
+        if params:
+            for name, value in saved_params.items():
+                ref = refs[name]
+                ref.layer._parameters[ref.attr_name] = value
+        if buffers:
+            for name, value in saved_buffers.items():
+                layer, bname = index[name]
+                layer._buffers[bname] = value
+
+
+def functional_call(model: Layer, params: Optional[Dict[str, Any]],
+                    *args, buffers: Optional[Dict[str, Any]] = None,
+                    mutable: bool = False, training: Optional[bool] = None,
+                    **kwargs):
+    """Run ``model(*args, **kwargs)`` with `params`/`buffers` substituted.
+
+    Returns ``out`` or, when ``mutable=True``, ``(out, new_buffers)`` where
+    ``new_buffers`` reflects in-forward buffer writes (running stats etc.).
+    The model's own state is always restored afterwards, so tracer values
+    never leak into the persistent Layer tree.
+    """
+    if mutable and buffers is None:
+        # Snapshot all buffers so in-forward writes (tracers!) are captured
+        # into the return value but never persist in the Layer tree.
+        buffers = dict(model.named_buffers())
+    mode_set = training is not None
+    prev_modes = {}
+    if mode_set:
+        for layer in model.sublayers(include_self=True):
+            prev_modes[id(layer)] = layer.training
+            layer.__dict__["training"] = training
+    try:
+        with _swapped_state(model, params, buffers):
+            out = model(*args, **kwargs)
+            if mutable:
+                new_buffers = dict(model.named_buffers())
+        if mutable:
+            return out, new_buffers
+        return out
+    finally:
+        if mode_set:
+            for layer in model.sublayers(include_self=True):
+                layer.__dict__["training"] = prev_modes[id(layer)]
+
+
+def module_scan(model: Layer):
+    """Debug helper: (n_params, n_elements, n_buffers)."""
+    n = e = 0
+    for _, ref in model.named_parameters():
+        n += 1
+        e += ref.value.size
+    b = sum(1 for _ in model.named_buffers())
+    return n, e, b

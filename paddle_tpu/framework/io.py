@@ -1,0 +1,58 @@
+"""Serialization: paddle.save / paddle.load.
+
+Parity with ``python/paddle/framework/io.py:646/889`` (pickle state_dicts,
+protocol >= 2, >4GB handling). Arrays are converted to numpy before pickling
+(device → host) and restored as jax Arrays on load. Distributed/sharded
+checkpointing (per-rank shards + topology reshard) lives in
+``paddle_tpu.distributed.checkpoint`` (orbax-backed).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["save", "load"]
+
+
+def _to_host(obj):
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_host(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_host(v) for v in obj)
+    return obj
+
+
+def _to_device(obj):
+    if isinstance(obj, np.ndarray):
+        return jnp.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _to_device(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_device(v) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4) -> None:
+    if protocol < 2 or protocol > 5:
+        raise ValueError(f"pickle protocol must be in [2, 5], got {protocol}")
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_host(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return obj if return_numpy else _to_device(obj)

@@ -1,0 +1,383 @@
+"""High-level Model API.
+
+Parity with ``python/paddle/hapi/model.py:1050`` (``Model``; ``fit`` at
+``:1752``; DynamicGraphAdapter.train_batch at ``:817``).
+
+TPU-native design: instead of an eager per-op loop, ``prepare()`` builds ONE
+jitted train step over the functional view of (params, buffers, opt_state,
+scaler_state, batch, lr, rng_key). XLA compiles forward+backward+optimizer
+into a single fused program per batch signature — this is the reference's
+"static graph mode" performance with dygraph UX, and is exactly the step the
+distributed wrappers shard via pjit. AMP is handled inside the step (policy
+casts under auto_cast; optional fp16 loss scaling with found_inf masking).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..amp.auto_cast import auto_cast
+from ..amp.grad_scaler import GradScaler, unscale_and_check
+from ..core.random import rng_scope, default_generator
+from ..framework.functional import (functional_call, get_buffers, get_params,
+                                    set_buffers, set_params)
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..nn.layer import Layer
+from ..optimizer.optimizer import Optimizer
+from .callbacks import config_callbacks
+
+__all__ = ["Model"]
+
+
+def _as_tuple(x):
+    if x is None:
+        return ()
+    if isinstance(x, (tuple, list)):
+        return tuple(x)
+    return (x,)
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer: Optional[Optimizer] = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._amp_level = "O0"
+        self._amp_custom_lists = {}
+        self._scaler: Optional[GradScaler] = None
+        self._train_step_fn = None
+        self._eval_step_fn = None
+        self._predict_fn = None
+        self._opt_state = None
+        self._scaler_state = None
+        self._step_count = 0
+
+    # -- setup ---------------------------------------------------------------
+
+    def prepare(self, optimizer: Optional[Optimizer] = None, loss=None,
+                metrics: Optional[Sequence[Metric]] = None,
+                amp_configs: Union[None, str, Dict] = None) -> None:
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = list(metrics or [])
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+            self._amp_custom_lists = {
+                k: amp_configs[k] for k in
+                ("custom_white_list", "custom_black_list") if k in amp_configs}
+            if self._amp_level != "O0" and amp_configs.get("use_fp16_guard") is None:
+                pass
+        if self._amp_level == "O2":
+            from ..amp.auto_cast import decorate
+            decorate(self.network, level="O2")
+        self._train_step_fn = None  # force rebuild
+        self._eval_step_fn = None
+
+    # -- functional step builders ---------------------------------------------
+
+    def _loss_value(self, outputs, labels):
+        losses = self._loss(*_as_tuple(outputs), *_as_tuple(labels))
+        total = sum(jnp.sum(l) for l in _as_tuple(losses)) \
+            if isinstance(losses, (tuple, list)) else losses
+        return total, losses
+
+    def _build_train_step(self):
+        net = self.network
+        opt = self._optimizer
+        amp_level = self._amp_level
+        amp_lists = self._amp_custom_lists
+        use_scaler = self._scaler is not None and self._scaler.is_enable()
+        scaler = self._scaler
+
+        def step(params, buffers, opt_state, scaler_state, inputs, labels,
+                 lr, key):
+            trainable = {k: v for k, v in params.items()
+                         if k in self._trainable_names}
+            frozen = {k: v for k, v in params.items()
+                      if k not in self._trainable_names}
+
+            def loss_fn(tp):
+                full = {**tp, **frozen}
+                with rng_scope(key):
+                    if amp_level in ("O1", "O2"):
+                        with auto_cast(enable=True, level=amp_level, **amp_lists):
+                            out, new_buf = functional_call(
+                                net, full, *inputs, buffers=buffers,
+                                mutable=True, training=True)
+                    else:
+                        out, new_buf = functional_call(
+                            net, full, *inputs, buffers=buffers,
+                            mutable=True, training=True)
+                total, losses = self._loss_value(out, labels)
+                if use_scaler:
+                    scaled = total * scaler_state["scale"].astype(total.dtype)
+                else:
+                    scaled = total
+                return scaled, (total, out, new_buf)
+
+            grads, (total, out, new_buf) = jax.grad(
+                loss_fn, has_aux=True)(trainable)
+
+            if use_scaler:
+                grads, found_inf = unscale_and_check(grads, scaler_state["scale"])
+                new_scaler_state = scaler.update_state(scaler_state, found_inf)
+            else:
+                found_inf = jnp.asarray(False)
+                new_scaler_state = scaler_state
+
+            new_trainable, new_opt_state = opt.apply_gradients(
+                trainable, grads, opt_state, lr)
+            # Skip the update when grads overflowed (fp16 mode).
+            if use_scaler:
+                new_trainable = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(found_inf, old, new),
+                    new_trainable, trainable)
+                new_opt_state = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(found_inf, old, new),
+                    new_opt_state, opt_state)
+            new_params = {**new_trainable, **frozen}
+            return (new_params, new_buf, new_opt_state, new_scaler_state,
+                    total, out)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _build_eval_step(self):
+        net = self.network
+
+        def step(params, buffers, inputs, labels):
+            out = functional_call(net, params, *inputs, buffers=buffers,
+                                  training=False)
+            total, losses = self._loss_value(out, labels) \
+                if self._loss is not None else (None, None)
+            return total, out
+
+        return jax.jit(step)
+
+    # -- batch-level API -------------------------------------------------------
+
+    @property
+    def _trainable_names(self):
+        return {name for name, ref in self.network.named_parameters()
+                if ref.trainable}
+
+    def _ensure_state(self):
+        params = get_params(self.network)
+        if self._opt_state is None:
+            trainable = {k: v for k, v in params.items()
+                         if k in self._trainable_names}
+            self._opt_state = self._optimizer.init(trainable)
+        if self._scaler_state is None:
+            self._scaler_state = (self._scaler.init_state() if self._scaler
+                                  else {"scale": jnp.ones((), jnp.float32)})
+
+    def train_batch(self, inputs, labels=None, update: bool = True):
+        """One optimizer step on a batch; returns loss (ref train_batch :817)."""
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) first"
+        inputs = tuple(jnp.asarray(x) for x in _as_tuple(inputs))
+        labels = tuple(jnp.asarray(y) for y in _as_tuple(labels))
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        self._ensure_state()
+        params = get_params(self.network)
+        buffers = get_buffers(self.network)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        key = default_generator().next_key()
+        (new_params, new_buffers, self._opt_state, self._scaler_state,
+         loss, out) = self._train_step_fn(
+            params, buffers, self._opt_state, self._scaler_state,
+            inputs, labels, lr, key)
+        set_params(self.network, new_params)
+        set_buffers(self.network, new_buffers)
+        self._step_count += 1
+        return np.asarray(loss)
+
+    def eval_batch(self, inputs, labels=None):
+        inputs = tuple(jnp.asarray(x) for x in _as_tuple(inputs))
+        labels = tuple(jnp.asarray(y) for y in _as_tuple(labels))
+        if self._eval_step_fn is None:
+            self._eval_step_fn = self._build_eval_step()
+        params = get_params(self.network)
+        buffers = get_buffers(self.network)
+        loss, out = self._eval_step_fn(params, buffers, inputs, labels)
+        return (np.asarray(loss) if loss is not None else None), out
+
+    def predict_batch(self, inputs):
+        inputs = tuple(jnp.asarray(x) for x in _as_tuple(inputs))
+        if self._predict_fn is None:
+            net = self.network
+
+            def fwd(params, buffers, inputs):
+                return functional_call(net, params, *inputs, buffers=buffers,
+                                       training=False)
+
+            self._predict_fn = jax.jit(fwd)
+        out = self._predict_fn(get_params(self.network),
+                               get_buffers(self.network), inputs)
+        return out
+
+    # -- loops -----------------------------------------------------------------
+
+    def _to_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch, n_labels_hint: int = 1):
+        batch = _as_tuple(batch)
+        if len(batch) == 1:
+            return batch, ()
+        return batch[:-n_labels_hint], batch[-n_labels_hint:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size: int = 1,
+            epochs: int = 1, eval_freq: int = 1, log_freq: int = 10,
+            save_dir: Optional[str] = None, save_freq: int = 1,
+            verbose: int = 1, drop_last: bool = False, shuffle: bool = True,
+            num_workers: int = 0, callbacks=None, accumulate_grad_batches=1,
+            num_iters: Optional[int] = None) -> None:
+        """ref: hapi/model.py:1752."""
+        loader = self._to_loader(train_data, batch_size, shuffle, num_workers,
+                                 drop_last)
+        eval_loader = self._to_loader(eval_data, batch_size, False,
+                                      num_workers, False)
+        cbks = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                                verbose=verbose, save_freq=save_freq,
+                                save_dir=save_dir, metrics=self._metrics)
+        self.stop_training = False
+        cbks.on_train_begin()
+        iters_done = 0
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            logs: Dict[str, Any] = {}
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                inputs, labels = self._split_batch(batch)
+                loss = self.train_batch(inputs, labels)
+                logs["loss"] = loss
+                logs["lr"] = self._optimizer.get_lr()
+                cbks.on_train_batch_end(step, logs)
+                iters_done += 1
+                if num_iters is not None and iters_done >= num_iters:
+                    self.stop_training = True
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0,
+                                          _callbacks=cbks)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+        cbks.on_train_end(logs if "logs" in dir() else None)
+
+    def evaluate(self, eval_data, batch_size: int = 1, log_freq: int = 10,
+                 verbose: int = 1, num_workers: int = 0, callbacks=None,
+                 num_samples: Optional[int] = None, _callbacks=None) -> Dict[str, Any]:
+        loader = self._to_loader(eval_data, batch_size, False, num_workers, False)
+        cbks = _callbacks or config_callbacks(callbacks, model=self,
+                                              verbose=verbose)
+        cbks.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            inputs, labels = self._split_batch(batch)
+            loss, out = self.eval_batch(inputs, labels)
+            if loss is not None:
+                losses.append(float(np.asarray(loss)))
+            for m in self._metrics:
+                args = m.compute(*_as_tuple(out), *labels)
+                m.update(*_as_tuple(args))
+            cbks.on_eval_batch_end(step)
+        logs: Dict[str, Any] = {}
+        if losses:
+            logs["loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                logs[n] = v
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size: int = 1, num_workers: int = 0,
+                stack_outputs: bool = False, verbose: int = 1, callbacks=None):
+        loader = self._to_loader(test_data, batch_size, False, num_workers, False)
+        outputs = []
+        for batch in loader:
+            inputs = _as_tuple(batch)
+            out = self.predict_batch(inputs)
+            outputs.append(np.asarray(out))
+        if stack_outputs:
+            return np.concatenate(outputs, axis=0)
+        return outputs
+
+    # -- persistence ------------------------------------------------------------
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def state_dict(self):
+        return self.network.state_dict()
+
+    def save(self, path: str, training: bool = True) -> None:
+        from ..framework.io import save as fsave
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            # Persist functional opt state in paddle's name@key format.
+            opt_state = {"step": self._opt_state["step"]} if self._opt_state else {}
+            if self._opt_state:
+                for pname, st in self._opt_state["param_states"].items():
+                    for k, v in st.items():
+                        opt_state[f"{pname}@{k}"] = v
+            sched = self._optimizer.lr_scheduler
+            if sched is not None:
+                opt_state["LR_Scheduler"] = sched.state_dict()
+            fsave(opt_state, path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False, reset_optimizer: bool = False):
+        from ..framework.io import load as fload
+        state = fload(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and os.path.exists(opt_path) and self._optimizer:
+            raw = fload(opt_path)
+            sched_state = raw.pop("LR_Scheduler", None)
+            if sched_state and self._optimizer.lr_scheduler:
+                self._optimizer.lr_scheduler.set_state_dict(sched_state)
+            step = raw.pop("step", 0)
+            pstates: Dict[str, Dict[str, Any]] = {}
+            for key, v in raw.items():
+                pname, _, k = key.rpartition("@")
+                pstates.setdefault(pname, {})[k] = jnp.asarray(v)
+            if pstates:
+                self._opt_state = {"step": jnp.asarray(step, jnp.int32),
+                                   "param_states": pstates}
+
+    def summary(self, input_size=None, dtype=None):
+        n, e, b = 0, 0, 0
+        lines = []
+        for name, ref in self.network.named_parameters():
+            n += 1
+            e += int(np.prod(ref.shape))
+            lines.append(f"{name:60s} {str(ref.shape):20s} {str(ref.dtype)}")
+        text = "\n".join(lines)
+        total = f"\nTotal params: {e:,} ({n} tensors)"
+        print(text + total)
+        return {"total_params": e, "trainable_params": e}

@@ -1,0 +1,117 @@
+"""MoE gates.
+
+ref: ``python/paddle/incubate/distributed/models/moe/gate/`` —
+{naive,gshard,switch}_gate.py. Each gate scores tokens over experts and
+produces (combine_weights, dispatch_mask, aux_loss) in the capacity-bucketed
+einsum formulation (the TPU-native dense dispatch, GShard-style) rather than
+the reference's sparse scatter."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..... import nn
+from .....nn import functional as F
+from .....core.random import next_key
+
+__all__ = ["NaiveGate", "GShardGate", "SwitchGate"]
+
+
+def _top1_dispatch(logits, capacity: int):
+    """Common top-1 capacity-bucketed dispatch.
+
+    Returns combine [G, S, E, C], dispatch bool [G, S, E, C], aux loss.
+    G=groups(batch), S=tokens/group, E=experts, C=capacity.
+    """
+    g, s, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)              # [G, S]
+    expert_mask = jax.nn.one_hot(expert_idx, e)          # [G, S, E]
+    # position of each token within its expert's queue
+    pos_in_expert = (jnp.cumsum(expert_mask, axis=1) - 1.0) * expert_mask
+    keep = pos_in_expert < capacity
+    expert_mask = expert_mask * keep
+    gate_val = (probs * expert_mask).sum(-1)             # [G, S]
+    # aux load-balance loss (GShard eq.)
+    density = expert_mask.mean(axis=1)                   # [G, E]
+    density_proxy = probs.mean(axis=1)
+    aux = (density * density_proxy).sum(-1).mean() * (e * e)
+    pos = jax.nn.one_hot((pos_in_expert.sum(-1)).astype(jnp.int32), capacity)
+    combine = (gate_val[..., None, None] * expert_mask[..., None] *
+               pos[:, :, None, :])                        # [G,S,E,C]
+    dispatch = combine > 0
+    return combine.astype(logits.dtype), dispatch, aux
+
+
+class _GateBase(nn.Layer):
+    def __init__(self, d_model: int, num_experts: int, capacity_factor: float = 1.25):
+        super().__init__()
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+        self.weight = self.create_parameter((d_model, num_experts))
+
+    def capacity(self, tokens_per_group: int) -> int:
+        return max(4, int(self.capacity_factor * tokens_per_group /
+                          self.num_experts))
+
+
+class NaiveGate(_GateBase):
+    """ref naive_gate.py: plain top-1, no noise."""
+
+    def forward(self, x):
+        logits = jnp.matmul(x, self.weight)
+        return _top1_dispatch(logits, self.capacity(x.shape[1]))
+
+
+class SwitchGate(_GateBase):
+    """ref switch_gate.py: top-1 with jitter noise during training."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.25,
+                 jitter: float = 0.01):
+        super().__init__(d_model, num_experts, capacity_factor)
+        self.jitter = jitter
+
+    def forward(self, x):
+        if self.training and self.jitter > 0:
+            noise = jax.random.uniform(next_key(), x.shape, minval=1 - self.jitter,
+                                       maxval=1 + self.jitter)
+            x = x * noise.astype(x.dtype)
+        logits = jnp.matmul(x, self.weight)
+        return _top1_dispatch(logits, self.capacity(x.shape[1]))
+
+
+class GShardGate(_GateBase):
+    """ref gshard_gate.py: top-2 with capacity + second-expert sampling."""
+
+    def forward(self, x):
+        g, s, _ = x.shape
+        e = self.num_experts
+        cap = self.capacity(s) * 2
+        logits = jnp.matmul(x, self.weight)
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top1 = jnp.argmax(probs, axis=-1)
+        mask1 = jax.nn.one_hot(top1, e)
+        probs2 = probs * (1 - mask1)
+        top2 = jnp.argmax(probs2, axis=-1)
+        mask2 = jax.nn.one_hot(top2, e)
+        # capacity positions: experts fill from top1 stream then top2 stream
+        pos1 = (jnp.cumsum(mask1, axis=1) - 1.0) * mask1
+        used = mask1.sum(axis=1, keepdims=True)
+        pos2 = (jnp.cumsum(mask2, axis=1) - 1.0) * mask2 + used * mask2
+        keep1 = pos1 < cap
+        keep2 = pos2 < cap
+        mask1 = mask1 * keep1
+        mask2 = mask2 * keep2
+        w1 = (probs * mask1).sum(-1)
+        w2 = (probs * mask2).sum(-1)
+        denom = jnp.clip(w1 + w2, 1e-9, None)
+        w1, w2 = w1 / denom, w2 / denom
+        density = mask1.mean(axis=1)
+        density_proxy = probs.mean(axis=1)
+        aux = (density * density_proxy).sum(-1).mean() * (e * e)
+        p1 = jax.nn.one_hot(pos1.sum(-1).astype(jnp.int32), cap)
+        p2 = jax.nn.one_hot(pos2.sum(-1).astype(jnp.int32), cap)
+        combine = (w1[..., None, None] * mask1[..., None] * p1[:, :, None, :] +
+                   w2[..., None, None] * mask2[..., None] * p2[:, :, None, :])
+        return combine.astype(x.dtype), combine > 0, aux

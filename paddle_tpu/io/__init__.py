@@ -1,0 +1,6 @@
+from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
+                      ComposeDataset, Subset, random_split)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
+                      BatchSampler, DistributedBatchSampler,
+                      WeightedRandomSampler)
+from .dataloader import DataLoader, default_collate_fn  # noqa: F401

@@ -1,0 +1,185 @@
+"""paddle.jit parity: to_static / save / load.
+
+The reference converts imperative Python to a static ProgramDesc by AST
+rewriting (``python/paddle/jit/api.py:233`` @to_static, dy2static
+transformers, ``StaticFunction`` at ``program_translator.py:313``). On
+JAX none of that is needed: tracing a jittable forward IS the conversion.
+
+- :func:`to_static` wraps a function or Layer into a :class:`StaticFunction`
+  that jit-compiles per input signature (shape/dtype cache, the analog of
+  the reference's program cache keyed like ``_ExecutorCache``).
+- :func:`save`/:func:`load` AOT-export a traced function via jax.export
+  (StableHLO) — the inference deployment format (the reference's
+  ``jit.save`` → TranslatedLayer path).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import Layer
+from ..framework.functional import functional_call, get_params, get_buffers
+
+__all__ = ["to_static", "StaticFunction", "save", "load", "TranslatedLayer",
+           "not_to_static", "ignore_module"]
+
+
+def _abstractify(tree):
+    return jax.tree_util.tree_map(
+        lambda a: (tuple(a.shape), jnp.asarray(a).dtype)
+        if hasattr(a, "shape") or isinstance(a, (int, float)) else a, tree)
+
+
+class StaticFunction:
+    """Compiled-function cache front (ref StaticFunction/partial_program)."""
+
+    def __init__(self, fn_or_layer, input_spec=None, build_strategy=None,
+                 full_graph: bool = True):
+        self._target = fn_or_layer
+        self._input_spec = input_spec
+        self._is_layer = isinstance(fn_or_layer, Layer)
+        self._cache: Dict[Any, Callable] = {}
+
+    @property
+    def code_cache_size(self) -> int:
+        return len(self._cache)
+
+    def _compiled_for(self, args, kwargs):
+        key = (pickle.dumps(_abstractify(args)), pickle.dumps(_abstractify(kwargs)))
+        fn = self._cache.get(key)
+        if fn is None:
+            if self._is_layer:
+                layer = self._target
+
+                def pure(params, buffers, *a, **k):
+                    out, new_buf = functional_call(layer, params, *a,
+                                                   buffers=buffers,
+                                                   mutable=True, **k)
+                    return out, new_buf
+
+                fn = jax.jit(pure)
+            else:
+                fn = jax.jit(self._target)
+            self._cache[key] = fn
+        return fn
+
+    def __call__(self, *args, **kwargs):
+        fn = self._compiled_for(args, kwargs)
+        if self._is_layer:
+            layer = self._target
+            params = get_params(layer)
+            buffers = get_buffers(layer)
+            out, new_buf = fn(params, buffers, *args, **kwargs)
+            from ..framework.functional import set_buffers
+            if new_buf:
+                set_buffers(layer, new_buf)
+            return out
+        return fn(*args, **kwargs)
+
+    # paddle parity: concrete_program etc. are not meaningful; expose the
+    # lowered StableHLO for inspection instead.
+    def lowered(self, *args, **kwargs):
+        if self._is_layer:
+            params = get_params(self._target)
+            buffers = get_buffers(self._target)
+            return self._compiled_for(args, kwargs).lower(params, buffers,
+                                                          *args, **kwargs)
+        return self._compiled_for(args, kwargs).lower(*args, **kwargs)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """@paddle.jit.to_static parity decorator."""
+
+    def decorate(fn):
+        return StaticFunction(fn, input_spec=input_spec,
+                              build_strategy=build_strategy)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(fn=None):
+    return fn
+
+
+def ignore_module(modules):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# AOT export (inference format)
+# ---------------------------------------------------------------------------
+
+def save(layer, path: str, input_spec=None, **configs) -> None:
+    """Serialize a Layer for inference: params (pickle) + exported StableHLO.
+
+    input_spec: list of (shape, dtype) tuples or example arrays for tracing.
+    """
+    from jax import export as jax_export
+
+    if input_spec is None:
+        raise ValueError("jit.save requires input_spec (shapes can't be guessed)")
+    example = []
+    for spec in input_spec:
+        if hasattr(spec, "shape") and hasattr(spec, "dtype"):
+            example.append(jax.ShapeDtypeStruct(tuple(spec.shape), spec.dtype))
+        else:
+            shape, dtype = spec
+            example.append(jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype)))
+
+    params = get_params(layer)
+    buffers = get_buffers(layer)
+
+    def infer_fn(params, buffers, *xs):
+        layer.eval()
+        return functional_call(layer, params, *xs, buffers=buffers)
+
+    exported = jax_export.export(jax.jit(infer_fn))(
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), buffers),
+        *example)
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    import numpy as np
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump({
+            "params": {k: np.asarray(v) for k, v in params.items()},
+            "buffers": {k: np.asarray(v) for k, v in buffers.items()},
+        }, f, protocol=4)
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+
+
+class TranslatedLayer:
+    """Loaded inference function (ref: translated_layer.py)."""
+
+    def __init__(self, exported, params, buffers):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+
+    def __call__(self, *args):
+        return self._exported.call(self._params, self._buffers, *args)
+
+    def eval(self):
+        return self
+
+
+def load(path: str) -> TranslatedLayer:
+    from jax import export as jax_export
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    with open(path + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    params = {k: jnp.asarray(v) for k, v in state["params"].items()}
+    buffers = {k: jnp.asarray(v) for k, v in state["buffers"].items()}
+    return TranslatedLayer(exported, params, buffers)

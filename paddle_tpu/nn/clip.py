@@ -1,0 +1,68 @@
+"""Gradient clipping.
+
+Parity with paddle's clip classes (``python/paddle/nn/clip.py``:
+ClipGradByGlobalNorm / ClipGradByNorm / ClipGradByValue). Operates on the
+gradient pytree functionally (used inside jitted train steps). The
+distributed-aware variant (TP/PP groups contribute partial norms, ref
+``hybrid_parallel_optimizer.py:251``) lives in paddle_tpu.distributed: under
+pjit/shard_map the global norm is computed on sharded grads and XLA inserts
+the cross-device reductions automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+           "clip_grads_by_global_norm", "global_norm"]
+
+
+def global_norm(grads) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(grads)
+    if not leaves:
+        return jnp.asarray(0.0, jnp.float32)
+    sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+    return jnp.sqrt(sq)
+
+
+def clip_grads_by_global_norm(grads, clip_norm: float, norm: Optional[jax.Array] = None):
+    n = global_norm(grads) if norm is None else norm
+    scale = jnp.minimum(1.0, clip_norm / jnp.maximum(n, 1e-12))
+    return jax.tree_util.tree_map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads)
+
+
+class ClipGradByGlobalNorm:
+    def __init__(self, clip_norm: float, group_name: str = "default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def __call__(self, grads):
+        return clip_grads_by_global_norm(grads, self.clip_norm)
+
+
+class ClipGradByNorm:
+    """Per-tensor L2 norm clip."""
+
+    def __init__(self, clip_norm: float):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, grads):
+        def clip_one(g):
+            n = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(n, 1e-12))
+            return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+        return jax.tree_util.tree_map(clip_one, grads)
+
+
+class ClipGradByValue:
+    def __init__(self, max: float, min: Optional[float] = None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)

@@ -1,0 +1,522 @@
+"""nn.functional: stateless NN ops.
+
+TPU-native equivalent of the reference's PHI kernel library for NN ops
+(``paddle/phi/kernels/`` — activations, conv, norm, softmax, cross-entropy)
+exposed with paddle's ``paddle.nn.functional`` signatures. Every op is a thin
+composition of jax.numpy / lax primitives so XLA fuses elementwise chains into
+matmul/conv epilogues on the MXU; there is no kernel registry or dispatch —
+XLA *is* the dispatch.
+
+Layout note: conv/pool default to NCHW for paddle parity but accept
+data_format="NHWC"; on TPU, XLA canonicalizes layouts internally.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core import dtype as dtypes
+from ..core.random import next_key
+
+__all__ = [
+    "relu", "relu6", "gelu", "silu", "swish", "sigmoid", "tanh", "softmax",
+    "log_softmax", "leaky_relu", "elu", "selu", "hardswish", "hardsigmoid",
+    "mish", "softplus", "glu", "dropout", "linear", "embedding",
+    "conv2d", "max_pool2d", "avg_pool2d", "adaptive_avg_pool2d",
+    "batch_norm", "layer_norm", "rms_norm", "group_norm",
+    "cross_entropy", "binary_cross_entropy_with_logits", "mse_loss",
+    "l1_loss", "nll_loss", "smooth_l1_loss", "softmax_with_cross_entropy",
+    "one_hot", "pad", "interpolate", "scaled_dot_product_attention",
+    "label_smooth", "cosine_similarity", "normalize", "kl_div",
+]
+
+
+# ---------------------------------------------------------------------------
+# Activations
+# ---------------------------------------------------------------------------
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def gelu(x, approximate: bool = False):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+swish = silu
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def leaky_relu(x, negative_slope: float = 0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def elu(x, alpha: float = 1.0):
+    return jax.nn.elu(x, alpha=alpha)
+
+
+def selu(x, scale: float = 1.0507009873554805, alpha: float = 1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def hardsigmoid(x, slope: float = 1 / 6, offset: float = 0.5):
+    return jnp.clip(slope * x + offset, 0.0, 1.0)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softplus(x, beta: float = 1.0, threshold: float = 20.0):
+    scaled = beta * x
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+def glu(x, axis: int = -1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def softmax(x, axis: int = -1, dtype=None):
+    out = jax.nn.softmax(x, axis=axis)
+    return out.astype(dtypes.to_dtype(dtype)) if dtype is not None else out
+
+
+def log_softmax(x, axis: int = -1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Dropout / linear / embedding
+# ---------------------------------------------------------------------------
+
+def dropout(x, p: float = 0.5, training: bool = True,
+            mode: str = "upscale_in_train", key: Optional[jax.Array] = None):
+    """ref: paddle.nn.functional.dropout (phi dropout kernel). Under jit the
+    key comes from the ambient rng_scope (see core.random)."""
+    if not training:
+        # paddle semantics: downscale_in_infer multiplies by keep-prob at
+        # inference; upscale_in_train is identity at inference.
+        if mode == "downscale_in_infer" and p > 0.0:
+            return x * (1.0 - p)
+        return x
+    if p == 0.0:
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    if key is None:
+        key = next_key()
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(mask, x / keep, 0).astype(x.dtype)
+    return jnp.where(mask, x, 0).astype(x.dtype)
+
+
+def linear(x, weight, bias=None):
+    """paddle layout: weight [in_features, out_features]."""
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(ids, weight, padding_idx: Optional[int] = None, sparse: bool = False):
+    out = jnp.take(weight, ids, axis=0)
+    if padding_idx is not None:
+        out = jnp.where((ids == padding_idx)[..., None], 0.0, out)
+    return out
+
+
+def one_hot(x, num_classes: int, dtype=None):
+    return jax.nn.one_hot(x, num_classes,
+                          dtype=dtypes.to_dtype(dtype) if dtype else dtypes.get_default_dtype())
+
+
+# ---------------------------------------------------------------------------
+# Convolution / pooling
+# ---------------------------------------------------------------------------
+
+def _pair(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v), int(v))
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCHW"):
+    """ref: phi conv2d kernel. weight layout [out_c, in_c/groups, kh, kw]."""
+    stride, dilation = _pair(stride), _pair(dilation)
+    if isinstance(padding, str):
+        pad = padding.upper()  # "SAME"/"VALID"
+    else:
+        ph, pw = _pair(padding)
+        pad = [(ph, ph), (pw, pw)]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
+        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+    out = out.astype(x.dtype)
+    if bias is not None:
+        if data_format == "NCHW":
+            out = out + bias.reshape(1, -1, 1, 1)
+        else:
+            out = out + bias
+    return out
+
+
+def _pool2d(x, kernel_size, stride, padding, data_format, init, op, norm=None):
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    ph, pw = _pair(padding)
+    if data_format == "NCHW":
+        window = (1, 1, k[0], k[1])
+        strides = (1, 1, s[0], s[1])
+        pads = ((0, 0), (0, 0), (ph, ph), (pw, pw))
+    else:
+        window = (1, k[0], k[1], 1)
+        strides = (1, s[0], s[1], 1)
+        pads = ((0, 0), (ph, ph), (pw, pw), (0, 0))
+    out = lax.reduce_window(x, init, op, window, strides, pads)
+    if norm is not None:
+        out = norm(out, k, pads, x)
+    return out
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, data_format: str = "NCHW"):
+    return _pool2d(x, kernel_size, stride, padding, data_format,
+                   -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
+                   lax.max)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NCHW", exclusive: bool = True):
+    k = _pair(kernel_size)
+    summed = _pool2d(x, kernel_size, stride, padding, data_format, 0.0, lax.add)
+    if exclusive and _pair(padding) != (0, 0):
+        ones = jnp.ones(x.shape, dtype=x.dtype)
+        counts = _pool2d(ones, kernel_size, stride, padding, data_format, 0.0, lax.add)
+        return summed / counts
+    return summed / (k[0] * k[1])
+
+
+def adaptive_avg_pool2d(x, output_size, data_format: str = "NCHW"):
+    oh, ow = _pair(output_size)
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        if h % oh == 0 and w % ow == 0:
+            x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+            return x.mean(axis=(3, 5))
+    else:
+        n, h, w, c = x.shape
+        if h % oh == 0 and w % ow == 0:
+            x = x.reshape(n, oh, h // oh, ow, w // ow, c)
+            return x.mean(axis=(2, 4))
+    raise NotImplementedError(
+        "adaptive_avg_pool2d requires output_size to divide input size")
+
+
+# ---------------------------------------------------------------------------
+# Normalization
+# ---------------------------------------------------------------------------
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training: bool = False, momentum: float = 0.9, epsilon: float = 1e-5,
+               data_format: str = "NCHW"):
+    """Returns (out, new_mean, new_var). ref: phi batch_norm kernel.
+
+    Stats are computed in float32 for bf16 inputs (TPU-native mixed precision).
+    """
+    axis = 1 if data_format == "NCHW" else -1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+
+    if training:
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(axis=reduce_axes)
+        var = xf.var(axis=reduce_axes)
+        n = x.size // x.shape[axis % x.ndim]
+        unbiased = var * n / max(n - 1, 1)
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * unbiased
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+
+    inv = lax.rsqrt(var.astype(jnp.float32) + epsilon)
+    out = (x.astype(jnp.float32) - mean.reshape(shape)) * inv.reshape(shape)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype), new_mean, new_var
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=axes, keepdims=True)
+    var = xf.var(axis=axes, keepdims=True)
+    out = (xf - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6, axis: int = -1):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=axis, keepdims=True)
+    out = xf * lax.rsqrt(ms + epsilon)
+    if weight is not None:
+        out = out * weight.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def group_norm(x, num_groups: int, weight=None, bias=None, epsilon: float = 1e-5,
+               data_format: str = "NCHW"):
+    if data_format != "NCHW":
+        raise NotImplementedError("group_norm: NCHW only for now")
+    n, c, h, w = x.shape
+    xf = x.astype(jnp.float32).reshape(n, num_groups, c // num_groups, h, w)
+    mean = xf.mean(axis=(2, 3, 4), keepdims=True)
+    var = xf.var(axis=(2, 3, 4), keepdims=True)
+    out = ((xf - mean) * lax.rsqrt(var + epsilon)).reshape(n, c, h, w)
+    if weight is not None:
+        out = out * weight.reshape(1, c, 1, 1)
+    if bias is not None:
+        out = out + bias.reshape(1, c, 1, 1)
+    return out.astype(x.dtype)
+
+
+def normalize(x, p: float = 2, axis: int = 1, epsilon: float = 1e-12):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+def cosine_similarity(x1, x2, axis: int = 1, eps: float = 1e-8):
+    dot = (x1 * x2).sum(axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+def cross_entropy(input, label, weight=None, ignore_index: int = -100,
+                  reduction: str = "mean", soft_label: bool = False,
+                  axis: int = -1, label_smoothing: float = 0.0):
+    """ref: phi cross_entropy (softmax_with_cross_entropy) kernel family."""
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    num_classes = input.shape[axis]
+    if soft_label:
+        target = label.astype(jnp.float32)
+    else:
+        label = label.squeeze(-1) if (label.ndim == input.ndim and label.shape[-1] == 1) else label
+        target = jax.nn.one_hot(label, num_classes, dtype=jnp.float32)
+    if label_smoothing > 0.0:
+        target = target * (1.0 - label_smoothing) + label_smoothing / num_classes
+    loss = -(target * logp).sum(axis=axis)
+    sample_w = None
+    if weight is not None:
+        if soft_label:
+            raise ValueError("weight with soft_label not supported")
+        sample_w = jnp.take(jnp.asarray(weight, jnp.float32), label, axis=0)
+        loss = loss * sample_w
+    if not soft_label:
+        valid = (label != ignore_index)
+        loss = jnp.where(valid, loss, 0.0)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return loss.sum()
+    if not soft_label:
+        if sample_w is not None:
+            # weighted mean: divide by the sum of weights of valid samples
+            denom = jnp.maximum(jnp.where(valid, sample_w, 0.0).sum(), 1e-12)
+        else:
+            denom = jnp.maximum(valid.sum(), 1)
+        return loss.sum() / denom
+    return loss.mean()
+
+
+def softmax_with_cross_entropy(logits, label, soft_label: bool = False,
+                               ignore_index: int = -100, axis: int = -1,
+                               return_softmax: bool = False):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = jnp.expand_dims(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(log_probs, label, weight=None, ignore_index: int = -100,
+             reduction: str = "mean"):
+    picked = jnp.take_along_axis(log_probs, label[..., None], axis=-1).squeeze(-1)
+    loss = -picked
+    if weight is not None:
+        loss = loss * jnp.take(weight, label, axis=0)
+    valid = label != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return loss.sum()
+    return loss.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction: str = "mean", pos_weight=None):
+    logit = logit.astype(jnp.float32)
+    label = label.astype(jnp.float32)
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1.0 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1.0 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "none":
+        return loss
+    return loss.sum() if reduction == "sum" else loss.mean()
+
+
+def mse_loss(input, label, reduction: str = "mean"):
+    loss = jnp.square(input - label)
+    if reduction == "none":
+        return loss
+    return loss.sum() if reduction == "sum" else loss.mean()
+
+
+def l1_loss(input, label, reduction: str = "mean"):
+    loss = jnp.abs(input - label)
+    if reduction == "none":
+        return loss
+    return loss.sum() if reduction == "sum" else loss.mean()
+
+
+def smooth_l1_loss(input, label, reduction: str = "mean", delta: float = 1.0):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    if reduction == "none":
+        return loss
+    return loss.sum() if reduction == "sum" else loss.mean()
+
+
+def kl_div(input, label, reduction: str = "mean"):
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return loss.sum()
+    if reduction == "batchmean":
+        return loss.sum() / input.shape[0]
+    return loss.mean()
+
+
+def label_smooth(label, prior_dist=None, epsilon: float = 0.1):
+    num_classes = label.shape[-1]
+    if prior_dist is None:
+        prior = 1.0 / num_classes
+        return (1.0 - epsilon) * label + epsilon * prior
+    return (1.0 - epsilon) * label + epsilon * prior_dist
+
+
+# ---------------------------------------------------------------------------
+# Misc
+# ---------------------------------------------------------------------------
+
+def pad(x, pad_width, mode: str = "constant", value: float = 0.0,
+        data_format: str = "NCHW"):
+    """paddle-style pad: `pad_width` is a flat [lo_last, hi_last, lo_prev, ...]
+    over trailing spatial dims, or per-dim list of pairs."""
+    if isinstance(pad_width[0], (tuple, list)):
+        widths = pad_width
+    else:
+        assert len(pad_width) % 2 == 0
+        n_spatial = len(pad_width) // 2
+        widths = [(0, 0)] * (x.ndim - n_spatial)
+        spatial = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(n_spatial)]
+        widths = widths + spatial
+    kw = {"constant_values": value} if mode == "constant" else {}
+    jmode = {"constant": "constant", "reflect": "reflect",
+             "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, widths, mode=jmode, **kw)
+
+
+def interpolate(x, size=None, scale_factor=None, mode: str = "nearest",
+                data_format: str = "NCHW"):
+    if data_format != "NCHW":
+        raise NotImplementedError
+    n, c, h, w = x.shape
+    if size is None:
+        sf = _pair(scale_factor)
+        size = (int(h * sf[0]), int(w * sf[1]))
+    oh, ow = _pair(size)
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic"}[mode]
+    return jax.image.resize(x, (n, c, oh, ow), method=method).astype(x.dtype)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p: float = 0.0, is_causal: bool = False,
+                                 training: bool = True, scale: Optional[float] = None):
+    """Reference (jnp) attention; the Pallas flash-attention kernel in
+    paddle_tpu.ops.flash_attention is the fast path. Layout: [B, S, H, D]
+    (paddle flash_attn layout, ref phi/kernels/gpu/flash_attn_kernel.cu:324)."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    q = jnp.einsum("bshd->bhsd", query)
+    k = jnp.einsum("bshd->bhsd", key)
+    v = jnp.einsum("bshd->bhsd", value)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if is_causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            scores = jnp.where(attn_mask, scores, -jnp.inf)
+        else:
+            scores = scores + attn_mask
+    probs = jax.nn.softmax(scores, axis=-1).astype(query.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, dropout_p, training=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.einsum("bhsd->bshd", out)

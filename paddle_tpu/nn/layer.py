@@ -1,0 +1,443 @@
+"""Layer: the module base class.
+
+TPU-native re-design of the reference's ``paddle.nn.Layer``
+(``python/paddle/nn/layer/layers.py:339``; ``state_dict`` at ``:1890``).
+
+Design: a Layer is a *mutable* object tree (paddle-style imperative UX:
+``self.weight = self.create_parameter(...)``, ``model.state_dict()``), but its
+parameters/buffers are plain ``jax.Array`` leaves that can be *extracted* into a
+pytree and run *functionally* under ``jax.jit``/``jax.grad`` via
+:func:`paddle_tpu.functional_call`. This replaces the reference's dual
+dygraph/static worlds (eager GradNode engine ``paddle/fluid/eager/backward.cc``
++ ProgramDesc executors): eager mode is JAX op-by-op dispatch; "static graph"
+is the same forward traced by XLA. There is no autograd tape on the Layer —
+gradients come from ``jax.grad`` over the functional view; the imperative
+``loss.backward()``-style surface is provided by ``paddle_tpu.autograd``.
+
+Parameters are addressed by dot-path (e.g. ``"fc.weight"``); a
+:class:`ParamRef` is a stable handle (layer, attr-name) used by optimizers to
+read ``.value``/``.grad`` and write updates back imperatively.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.random import next_key
+from . import initializer as I
+
+__all__ = ["Layer", "Parameter", "ParamRef", "ParamAttr"]
+
+
+class ParamAttr:
+    """Parity with paddle.ParamAttr: per-parameter config."""
+
+    def __init__(self, name: Optional[str] = None, initializer=None,
+                 learning_rate: float = 1.0, trainable: bool = True,
+                 regularizer=None, need_clip: bool = True,
+                 partition_spec=None):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.trainable = trainable
+        self.regularizer = regularizer
+        self.need_clip = need_clip
+        # TPU-native: how this parameter shards over the hybrid mesh
+        # (jax.sharding.PartitionSpec). None = replicated. This replaces the
+        # reference's per-layer process-group plumbing (mp_layers.py): the
+        # spec is consumed by pjit'd train steps to place params.
+        self.partition_spec = partition_spec
+
+    @staticmethod
+    def _to_attr(attr) -> "ParamAttr":
+        if attr is None or attr is True:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        raise TypeError(f"Cannot interpret {attr!r} as ParamAttr")
+
+
+class Parameter:
+    """Marker wrapper used at assignment time (``self.w = Parameter(arr)``).
+
+    The Layer stores the raw array; attribute access returns the raw array.
+    """
+
+    def __init__(self, value, trainable: bool = True, attr: Optional[ParamAttr] = None):
+        self.value = jnp.asarray(value)
+        self.trainable = trainable
+        self.attr = attr or ParamAttr(trainable=trainable)
+
+
+class ParamRef:
+    """Stable handle to one parameter of a Layer (used by optimizers)."""
+
+    __slots__ = ("layer", "attr_name", "name")
+
+    def __init__(self, layer: "Layer", attr_name: str, name: str):
+        self.layer = layer
+        self.attr_name = attr_name
+        self.name = name  # full dot-path from the root used to collect it
+
+    @property
+    def value(self) -> jax.Array:
+        return self.layer._parameters[self.attr_name]
+
+    @value.setter
+    def value(self, v) -> None:
+        self.layer._parameters[self.attr_name] = jnp.asarray(v)
+
+    @property
+    def grad(self):
+        return self.layer._grads.get(self.attr_name)
+
+    @grad.setter
+    def grad(self, g) -> None:
+        if g is None:
+            self.layer._grads.pop(self.attr_name, None)
+        else:
+            self.layer._grads[self.attr_name] = g
+
+    @property
+    def meta(self) -> ParamAttr:
+        return self.layer._param_meta[self.attr_name]
+
+    @property
+    def trainable(self) -> bool:
+        return self.meta.trainable
+
+    @trainable.setter
+    def trainable(self, t: bool) -> None:
+        self.meta.trainable = bool(t)
+
+    # paddle parity: param.stop_gradient == not trainable
+    @property
+    def stop_gradient(self) -> bool:
+        return not self.meta.trainable
+
+    @property
+    def shape(self):
+        return tuple(self.value.shape)
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    def __repr__(self):
+        return (f"ParamRef(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype}, trainable={self.trainable})")
+
+
+class Layer:
+    """Base class for all neural network layers."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype=None):
+        d = self.__dict__
+        d["_parameters"] = OrderedDict()
+        d["_param_meta"] = {}
+        d["_grads"] = {}
+        d["_buffers"] = OrderedDict()
+        d["_non_persistable_buffers"] = set()
+        d["_sub_layers"] = OrderedDict()
+        d["_forward_pre_hooks"] = OrderedDict()
+        d["_forward_post_hooks"] = OrderedDict()
+        d["training"] = True
+        d["_dtype"] = dtypes.to_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+        d["_name_scope"] = name_scope or type(self).__name__.lower()
+
+    # -- attribute plumbing -------------------------------------------------
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if isinstance(value, Parameter):
+            self._parameters[name] = value.value
+            self._param_meta[name] = value.attr
+            self._param_meta[name].trainable = value.trainable
+            self._sub_layers.pop(name, None)
+            self._buffers.pop(name, None)
+            self.__dict__.pop(name, None)
+            return
+        if isinstance(value, Layer):
+            self._sub_layers[name] = value
+            self._parameters.pop(name, None)
+            self._buffers.pop(name, None)
+            self.__dict__.pop(name, None)
+            return
+        if name in self._parameters:
+            if value is None:
+                del self._parameters[name]
+                del self._param_meta[name]
+            else:
+                self._parameters[name] = jnp.asarray(value)
+            return
+        if name in self._buffers:
+            self._buffers[name] = None if value is None else jnp.asarray(value)
+            return
+        if name in self._sub_layers and value is None:
+            del self._sub_layers[name]
+            return
+        object.__setattr__(self, name, value)
+
+    def __getattr__(self, name: str):
+        # Only called when normal lookup fails.
+        d = self.__dict__
+        if "_parameters" in d and name in d["_parameters"]:
+            return d["_parameters"][name]
+        if "_buffers" in d and name in d["_buffers"]:
+            return d["_buffers"][name]
+        if "_sub_layers" in d and name in d["_sub_layers"]:
+            return d["_sub_layers"][name]
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
+    def __delattr__(self, name: str) -> None:
+        for store in (self._parameters, self._buffers, self._sub_layers):
+            if name in store:
+                del store[name]
+                self._param_meta.pop(name, None)
+                self._grads.pop(name, None)
+                return
+        object.__delattr__(self, name)
+
+    # -- construction helpers ----------------------------------------------
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias: bool = False,
+                         default_initializer: Optional[I.Initializer] = None,
+                         key: Optional[jax.Array] = None) -> Parameter:
+        """Create (but not register) a parameter; assign it to an attribute to
+        register (paddle parity: Layer.create_parameter)."""
+        attr = ParamAttr._to_attr(attr)
+        dtype = dtypes.to_dtype(dtype) if dtype is not None else self._dtype
+        init = attr.initializer or default_initializer
+        if init is None:
+            init = I.Constant(0.0) if is_bias else I.XavierNormal()
+        value = init(shape, dtype=dtype, key=key)
+        return Parameter(value, trainable=attr.trainable, attr=attr)
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        if parameter is None:
+            self._parameters.pop(name, None)
+            self._param_meta.pop(name, None)
+            return None
+        setattr(self, name, parameter)
+        return self._parameters[name]
+
+    def add_sublayer(self, name: str, sublayer: "Layer") -> "Layer":
+        setattr(self, name, sublayer)
+        return sublayer
+
+    def register_buffer(self, name: str, tensor, persistable: bool = True) -> None:
+        self._buffers[name] = None if tensor is None else jnp.asarray(tensor)
+        if not persistable:
+            self._non_persistable_buffers.add(name)
+
+    # -- traversal ----------------------------------------------------------
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        _memo=None) -> Iterator[Tuple[str, "Layer"]]:
+        if _memo is None:
+            _memo = set()
+        if id(self) in _memo:
+            return
+        _memo.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True,
+                                           _memo=_memo)
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self) -> Iterator["Layer"]:
+        for _, sub in self._sub_layers.items():
+            if sub is not None:
+                yield sub
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        for name, sub in self._sub_layers.items():
+            if sub is not None:
+                yield name, sub
+
+    def named_parameters(self, prefix: str = "",
+                         include_sublayers: bool = True) -> Iterator[Tuple[str, ParamRef]]:
+        layers = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for lpref, layer in layers:
+            for pname in layer._parameters:
+                ref = ParamRef(layer, pname, f"{lpref}.{pname}" if lpref else pname)
+                yield ref.name, ref
+
+    def parameters(self, include_sublayers: bool = True) -> List[ParamRef]:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "",
+                      include_non_persistable: bool = True) -> Iterator[Tuple[str, jax.Array]]:
+        for lpref, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, buf in layer._buffers.items():
+                if buf is None:
+                    continue
+                if not include_non_persistable and bname in layer._non_persistable_buffers:
+                    continue
+                yield (f"{lpref}.{bname}" if lpref else bname), buf
+
+    def buffers(self) -> List[jax.Array]:
+        return [b for _, b in self.named_buffers()]
+
+    def named_param_specs(self) -> Dict[str, Any]:
+        """{dot-path: PartitionSpec or None} for every parameter — the
+        sharding plan consumed by pjit'd train steps."""
+        return {name: ref.meta.partition_spec
+                for name, ref in self.named_parameters()}
+
+    # -- state dict ----------------------------------------------------------
+
+    def state_dict(self, include_non_persistable_buffer: bool = False) -> Dict[str, jax.Array]:
+        out: "OrderedDict[str, jax.Array]" = OrderedDict()
+        for name, ref in self.named_parameters():
+            out[name] = ref.value
+        for name, buf in self.named_buffers(
+                include_non_persistable=include_non_persistable_buffer):
+            out[name] = buf
+        return out
+
+    def set_state_dict(self, state_dict: Dict[str, Any], use_structured_name: bool = True):
+        missing, unexpected = [], []
+        own_params = dict(self.named_parameters())
+        own_buffers = {}
+        for lpref, layer in self.named_sublayers(include_self=True):
+            for bname in layer._buffers:
+                full = f"{lpref}.{bname}" if lpref else bname
+                own_buffers[full] = (layer, bname)
+        for key in own_params:
+            if key not in state_dict:
+                missing.append(key)
+        for key, value in state_dict.items():
+            if key in own_params:
+                ref = own_params[key]
+                value = jnp.asarray(value, dtype=ref.dtype)
+                if tuple(value.shape) != ref.shape:
+                    raise ValueError(
+                        f"Shape mismatch for {key}: checkpoint {tuple(value.shape)} "
+                        f"vs model {ref.shape}")
+                ref.value = value
+            elif key in own_buffers:
+                layer, bname = own_buffers[key]
+                layer._buffers[bname] = jnp.asarray(value)
+            else:
+                unexpected.append(key)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- modes / transforms ---------------------------------------------------
+
+    def train(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            layer.__dict__["training"] = True
+        return self
+
+    def eval(self) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            layer.__dict__["training"] = False
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]) -> "Layer":
+        for layer in self.sublayers(include_self=True):
+            fn(layer)
+        return self
+
+    def astype(self, dtype) -> "Layer":
+        """Cast all floating-point params/buffers (paddle ``Layer.to``)."""
+        dtype = dtypes.to_dtype(dtype)
+        for _, layer in self.named_sublayers(include_self=True):
+            for pname, value in layer._parameters.items():
+                if dtypes.is_floating_point(value.dtype):
+                    layer._parameters[pname] = value.astype(dtype)
+            for bname, value in layer._buffers.items():
+                if value is not None and dtypes.is_floating_point(value.dtype):
+                    layer._buffers[bname] = value.astype(dtype)
+            layer.__dict__["_dtype"] = dtype
+        return self
+
+    to = astype
+
+    def clear_gradients(self) -> None:
+        for _, layer in self.named_sublayers(include_self=True):
+            layer._grads.clear()
+
+    # -- hooks ----------------------------------------------------------------
+
+    def register_forward_pre_hook(self, hook) -> "HookRemoveHelper":
+        handle = HookRemoveHelper(self._forward_pre_hooks)
+        self._forward_pre_hooks[handle.id] = hook
+        return handle
+
+    def register_forward_post_hook(self, hook) -> "HookRemoveHelper":
+        handle = HookRemoveHelper(self._forward_post_hooks)
+        self._forward_post_hooks[handle.id] = hook
+        return handle
+
+    # -- call -----------------------------------------------------------------
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement forward()")
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, args)
+            if result is not None:
+                args = result if isinstance(result, tuple) else (result,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, args, out)
+            if result is not None:
+                out = result
+        return out
+
+    def full_name(self) -> str:
+        return self._name_scope
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = "\n  ".join(sub_repr)
+            lines.append(f"  ({name}): {sub_repr}")
+        main = f"{type(self).__name__}({extra}"
+        if lines:
+            main += "\n" + "\n".join(lines) + "\n"
+        return main + ")"
+
+
+class HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks_dict):
+        self._hooks = hooks_dict
+        self.id = HookRemoveHelper._next_id
+        HookRemoveHelper._next_id += 1
+
+    def remove(self):
+        self._hooks.pop(self.id, None)

@@ -1,0 +1,593 @@
+"""Standard layers.
+
+Parity with the reference's layer zoo (``python/paddle/nn/layer/`` — common,
+conv, norm, activation, transformer, containers) built on
+:mod:`paddle_tpu.nn.functional`. Layers hold parameters (paddle layout:
+Linear weight is ``[in, out]``, Conv2D weight is ``[out, in, kh, kw]``) and
+buffers; the forward is pure jnp so the whole tree jits.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from . import functional as F
+from . import initializer as I
+from .layer import Layer, Parameter, ParamAttr
+
+__all__ = [
+    "Linear", "Conv2D", "BatchNorm2D", "BatchNorm1D", "LayerNorm", "RMSNorm",
+    "GroupNorm", "Embedding", "Dropout", "ReLU", "ReLU6", "GELU", "Silu",
+    "Sigmoid", "Tanh", "Softmax", "LeakyReLU", "Hardswish", "Hardsigmoid",
+    "MaxPool2D", "AvgPool2D", "AdaptiveAvgPool2D", "Flatten", "Identity",
+    "Sequential", "LayerList", "ParameterList", "Pad2D", "Upsample",
+    "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCEWithLogitsLoss",
+    "SmoothL1Loss", "KLDivLoss", "MultiHeadAttention", "TransformerEncoderLayer",
+    "TransformerEncoder", "Unfold",
+]
+
+
+class Linear(Layer):
+    """ref: python/paddle/nn/layer/common.py Linear (weight [in, out])."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 bias_attr=None, name=None, dtype=None):
+        super().__init__(dtype=dtype)
+        self.in_features, self.out_features = in_features, out_features
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_features,), attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        from ..amp.auto_cast import maybe_cast_input
+        x, w, b = maybe_cast_input("linear", x, self.weight,
+                                   getattr(self, "bias", None))
+        return F.linear(x, w, b)
+
+    def extra_repr(self):
+        return f"in={self.in_features}, out={self.out_features}"
+
+
+class Conv2D(Layer):
+    """ref: python/paddle/nn/layer/conv.py Conv2D (weight [out,in/g,kh,kw])."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 padding_mode: str = "zeros", weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW", dtype=None):
+        super().__init__(dtype=dtype)
+        kh, kw = F._pair(kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups, self.data_format = groups, data_format
+        fan_in = in_channels // groups * kh * kw
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in,
+                                                 negative_slope=math.sqrt(5),
+                                                 nonlinearity="leaky_relu"))
+        if bias_attr is not False:
+            bound = 1 / math.sqrt(fan_in) if fan_in > 0 else 0
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        from ..amp.auto_cast import maybe_cast_input
+        x, w, b = maybe_cast_input("conv2d", x, self.weight,
+                                   getattr(self, "bias", None))
+        return F.conv2d(x, w, b,
+                        stride=self.stride, padding=self.padding,
+                        dilation=self.dilation, groups=self.groups,
+                        data_format=self.data_format)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW", use_global_stats: Optional[bool] = None,
+                 dtype=None):
+        super().__init__(dtype=dtype)
+        self.num_features = num_features
+        self.momentum, self.epsilon = momentum, epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                (num_features,), attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                              is_bias=True)
+        else:
+            self.bias = None
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_variance", jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, x):
+        training = self.training and not (self.use_global_stats or False)
+        out, new_mean, new_var = F.batch_norm(
+            x, self._mean, self._variance,
+            getattr(self, "weight", None), getattr(self, "bias", None),
+            training=training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format)
+        if training:
+            self._mean = new_mean
+            self._variance = new_var
+        return out
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    def forward(self, x):
+        squeeze = False
+        if x.ndim == 2:
+            x = x[:, :, None]
+            squeeze = True
+        # treat [N, C, L] as NCHW with W=1
+        x4 = x[..., None]
+        out = super().forward(x4)[..., 0]
+        return out[:, :, 0] if squeeze else out
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None, dtype=None):
+        super().__init__(dtype=dtype)
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                self.normalized_shape, attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(self.normalized_shape,
+                                              attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.layer_norm(x, self.normalized_shape,
+                            getattr(self, "weight", None),
+                            getattr(self, "bias", None), self.epsilon)
+
+
+class RMSNorm(Layer):
+    def __init__(self, hidden_size: int, epsilon: float = 1e-6, dtype=None):
+        super().__init__(dtype=dtype)
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (hidden_size,), default_initializer=I.Constant(1.0))
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups: int, num_channels: int, epsilon: float = 1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NCHW", dtype=None):
+        super().__init__(dtype=dtype)
+        self.num_groups, self.epsilon = num_groups, epsilon
+        self.data_format = data_format
+        if weight_attr is not False:
+            self.weight = self.create_parameter(
+                (num_channels,), attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        else:
+            self.weight = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter((num_channels,), attr=bias_attr,
+                                              is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.group_norm(x, self.num_groups, getattr(self, "weight", None),
+                            getattr(self, "bias", None), self.epsilon,
+                            self.data_format)
+
+
+class Embedding(Layer):
+    """ref: python/paddle/nn/layer/common.py Embedding."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 padding_idx: Optional[int] = None, sparse: bool = False,
+                 weight_attr=None, name=None, dtype=None):
+        super().__init__(dtype=dtype)
+        self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
+        self.padding_idx = padding_idx
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0))
+        if padding_idx is not None:
+            w = self._parameters["weight"]
+            self._parameters["weight"] = w.at[padding_idx].set(0.0)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight, self.padding_idx)
+
+
+class Dropout(Layer):
+    def __init__(self, p: float = 0.5, mode: str = "upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.mode = p, mode
+
+    def forward(self, x):
+        return F.dropout(x, self.p, training=self.training, mode=self.mode)
+
+
+def _act_layer(name, fn):
+    class _Act(Layer):
+        def __init__(self, *a, **k):
+            super().__init__()
+            self._args, self._kwargs = a, k
+
+        def forward(self, x):
+            return fn(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _act_layer("ReLU", F.relu)
+ReLU6 = _act_layer("ReLU6", F.relu6)
+GELU = _act_layer("GELU", F.gelu)
+Silu = _act_layer("Silu", F.silu)
+Sigmoid = _act_layer("Sigmoid", F.sigmoid)
+Tanh = _act_layer("Tanh", F.tanh)
+Softmax = _act_layer("Softmax", F.softmax)
+LeakyReLU = _act_layer("LeakyReLU", F.leaky_relu)
+Hardswish = _act_layer("Hardswish", F.hardswish)
+Hardsigmoid = _act_layer("Hardsigmoid", F.hardsigmoid)
+
+
+class MaxPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AvgPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 data_format="NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+        self.exclusive, self.data_format = exclusive, data_format
+
+    def forward(self, x):
+        return F.avg_pool2d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format, self.exclusive)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW"):
+        super().__init__()
+        self.output_size, self.data_format = output_size, data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class Flatten(Layer):
+    def __init__(self, start_axis: int = 1, stop_axis: int = -1):
+        super().__init__()
+        self.start_axis, self.stop_axis = start_axis, stop_axis
+
+    def forward(self, x):
+        start = self.start_axis % x.ndim
+        stop = self.stop_axis % x.ndim
+        shape = x.shape[:start] + (-1,) + x.shape[stop + 1:]
+        return x.reshape(shape)
+
+
+class Identity(Layer):
+    def forward(self, x):
+        return x
+
+
+class Pad2D(Layer):
+    def __init__(self, padding, mode="constant", value=0.0, data_format="NCHW"):
+        super().__init__()
+        self.padding, self.mode, self.value = padding, mode, value
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self.padding, self.mode, self.value, self.data_format)
+
+
+class Upsample(Layer):
+    def __init__(self, size=None, scale_factor=None, mode="nearest",
+                 data_format="NCHW"):
+        super().__init__()
+        self.size, self.scale_factor, self.mode = size, scale_factor, mode
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, self.mode,
+                             self.data_format)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1):
+        super().__init__()
+        self.k = F._pair(kernel_sizes)
+        self.s = F._pair(strides)
+        self.p = F._pair(paddings)
+        self.d = F._pair(dilations)
+
+    def forward(self, x):
+        n, c, h, w = x.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            x, self.k, self.s, [(self.p[0], self.p[0]), (self.p[1], self.p[1])],
+            rhs_dilation=self.d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        return patches.reshape(n, patches.shape[1], -1)
+
+
+# -- containers --------------------------------------------------------------
+
+class Sequential(Layer):
+    def __init__(self, *layers):
+        super().__init__()
+        if len(layers) == 1 and isinstance(layers[0], (list, tuple)):
+            layers = tuple(layers[0])
+        if layers and isinstance(layers[0], tuple):
+            for name, layer in layers:
+                self.add_sublayer(name, layer)
+        else:
+            for i, layer in enumerate(layers):
+                if not isinstance(layer, Layer):
+                    raise TypeError(
+                        f"Sequential sublayer {i} is {type(layer).__name__}, "
+                        "expected a Layer")
+                self.add_sublayer(str(i), layer)
+
+    def forward(self, x):
+        for layer in self._sub_layers.values():
+            x = layer(x)
+        return x
+
+    def __getitem__(self, idx):
+        return list(self._sub_layers.values())[idx]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class LayerList(Layer):
+    def __init__(self, sublayers: Optional[Sequence[Layer]] = None):
+        super().__init__()
+        if sublayers:
+            for i, layer in enumerate(sublayers):
+                self.add_sublayer(str(i), layer)
+
+    def append(self, layer: Layer):
+        self.add_sublayer(str(len(self._sub_layers)), layer)
+        return self
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            return list(self._sub_layers.values())[idx]
+        return self._sub_layers[str(idx % len(self._sub_layers))]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers.values())
+
+
+class ParameterList(Layer):
+    def __init__(self, parameters: Optional[Sequence[Parameter]] = None):
+        super().__init__()
+        if parameters:
+            for i, p in enumerate(parameters):
+                self.add_parameter(str(i), p)
+
+    def append(self, p: Parameter):
+        self.add_parameter(str(len(self._parameters)), p)
+        return self
+
+    def __getitem__(self, idx):
+        return self._parameters[str(idx)]
+
+    def __len__(self):
+        return len(self._parameters)
+
+
+# -- loss layers --------------------------------------------------------------
+
+class CrossEntropyLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100,
+                 reduction: str = "mean", soft_label: bool = False,
+                 label_smoothing: float = 0.0, axis: int = -1):
+        super().__init__()
+        self.weight, self.ignore_index = weight, ignore_index
+        self.reduction, self.soft_label = reduction, soft_label
+        self.label_smoothing, self.axis = label_smoothing, axis
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, self.weight, self.ignore_index,
+                               self.reduction, self.soft_label, self.axis,
+                               self.label_smoothing)
+
+
+class MSELoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.mse_loss(input, label, self.reduction)
+
+
+class L1Loss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.l1_loss(input, label, self.reduction)
+
+
+class NLLLoss(Layer):
+    def __init__(self, weight=None, ignore_index: int = -100, reduction="mean"):
+        super().__init__()
+        self.weight, self.ignore_index, self.reduction = weight, ignore_index, reduction
+
+    def forward(self, input, label):
+        return F.nll_loss(input, label, self.weight, self.ignore_index,
+                          self.reduction)
+
+
+class BCEWithLogitsLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", pos_weight=None):
+        super().__init__()
+        self.weight, self.reduction, self.pos_weight = weight, reduction, pos_weight
+
+    def forward(self, logit, label):
+        return F.binary_cross_entropy_with_logits(
+            logit, label, self.weight, self.reduction, self.pos_weight)
+
+
+class SmoothL1Loss(Layer):
+    def __init__(self, reduction="mean", delta=1.0):
+        super().__init__()
+        self.reduction, self.delta = reduction, delta
+
+    def forward(self, input, label):
+        return F.smooth_l1_loss(input, label, self.reduction, self.delta)
+
+
+class KLDivLoss(Layer):
+    def __init__(self, reduction="mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.kl_div(input, label, self.reduction)
+
+
+# -- attention / transformer ---------------------------------------------------
+
+class MultiHeadAttention(Layer):
+    """ref: python/paddle/nn/layer/transformer.py MultiHeadAttention.
+
+    Uses the flash-attention path (paddle_tpu.ops) when available, else the
+    jnp reference in F.scaled_dot_product_attention.
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int, dropout: float = 0.0,
+                 kdim=None, vdim=None, need_weights: bool = False,
+                 weight_attr=None, bias_attr=None, dtype=None):
+        super().__init__(dtype=dtype)
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.head_dim = embed_dim // num_heads
+        assert self.head_dim * num_heads == embed_dim
+        self.dropout = dropout
+        kdim = kdim or embed_dim
+        vdim = vdim or embed_dim
+        self.q_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+        self.k_proj = Linear(kdim, embed_dim, weight_attr, bias_attr)
+        self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
+        self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        key = query if key is None else key
+        value = query if value is None else value
+        b, sq, _ = query.shape
+        q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
+        k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads, self.head_dim)
+        v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads, self.head_dim)
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
+            training=self.training)
+        out = out.reshape(b, sq, self.embed_dim)
+        return self.out_proj(out)
+
+
+class TransformerEncoderLayer(Layer):
+    """ref: python/paddle/nn/layer/transformer.py TransformerEncoderLayer."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "relu",
+                 attn_dropout=None, act_dropout=None,
+                 normalize_before: bool = False, weight_attr=None,
+                 bias_attr=None, dtype=None):
+        super().__init__(dtype=dtype)
+        self.normalize_before = normalize_before
+        self.self_attn = MultiHeadAttention(
+            d_model, nhead, attn_dropout if attn_dropout is not None else dropout,
+            weight_attr=weight_attr, bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr, bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr, bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout_act = Dropout(act_dropout if act_dropout is not None else dropout)
+        self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
+
+    def forward(self, src, src_mask=None):
+        residual = src
+        if self.normalize_before:
+            src = self.norm1(src)
+        src = self.self_attn(src, attn_mask=src_mask)
+        src = residual + self.dropout1(src)
+        if not self.normalize_before:
+            src = self.norm1(src)
+        residual = src
+        if self.normalize_before:
+            src = self.norm2(src)
+        src = self.linear2(self.dropout_act(self.activation(self.linear1(src))))
+        src = residual + self.dropout2(src)
+        if not self.normalize_before:
+            src = self.norm2(src)
+        return src
+
+
+class TransformerEncoder(Layer):
+    def __init__(self, encoder_layer_fn, num_layers: int, norm=None):
+        super().__init__()
+        self.layers = LayerList([encoder_layer_fn() for _ in range(num_layers)]
+                                if callable(encoder_layer_fn) else None)
+        if not callable(encoder_layer_fn):
+            raise TypeError("pass a factory: TransformerEncoder(lambda: layer, N)")
+        self.norm = norm
+
+    def forward(self, src, src_mask=None):
+        out = src
+        for layer in self.layers:
+            out = layer(out, src_mask=src_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        return out

@@ -1,0 +1,10 @@
+"""Custom TPU ops (Pallas kernels with jnp reference fallbacks).
+
+This package is the analog of the reference's hand-written CUDA kernel layer
+(``phi/kernels/gpu``, ``phi/kernels/fusion``, vendored flash-attention): the
+small set of ops where XLA's automatic fusion isn't enough and a Pallas
+kernel buys real throughput — flash attention (fwd+bwd), fused optimizer
+update, ring-attention comm-compute overlap.
+"""
+
+from .flash_attention import flash_attention, flash_attn_unpadded  # noqa: F401

@@ -1,0 +1,113 @@
+"""Flash attention.
+
+Reference: ``paddle/phi/kernels/gpu/flash_attn_kernel.cu:324`` (FlashAttnKernel
+dispatching to the vendored CUTLASS flash-attention; varlen variant at :289).
+
+TPU-native: a Pallas kernel (``_pallas/flash_attention.py``) implementing the
+standard online-softmax blocked algorithm tiled for the MXU (block sizes
+multiples of 128), with a custom VJP whose backward is also a Pallas kernel.
+Layout follows paddle's flash_attn: [batch, seq, heads, head_dim].
+``FLAGS_use_pallas_kernels=0`` (or unsupported shapes/platform) falls back to
+the jnp reference — numerically identical module-level semantics, used for
+CPU tests and gradient checks.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..core import flags
+
+__all__ = ["flash_attention", "flash_attn_unpadded", "reference_attention"]
+
+
+def reference_attention(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None,
+                        bias: Optional[jax.Array] = None):
+    """jnp reference, [B,S,H,D] layout, fp32 softmax."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        scores = scores + bias
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), sk - sq)
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _use_pallas(q) -> bool:
+    if not flags.flag("use_pallas_kernels"):
+        return False
+    try:
+        platform = q.devices().pop().platform if hasattr(q, "devices") \
+            else jax.default_backend()
+    except Exception:
+        platform = jax.default_backend()
+    if platform not in ("tpu", "axon"):
+        return False
+    b, s, h, d = q.shape
+    # MXU-friendly shapes only; else reference path.
+    return s % 128 == 0 and d in (64, 128, 256)
+
+
+def flash_attention(query, key, value, dropout: float = 0.0,
+                    causal: bool = False, return_softmax: bool = False,
+                    *, scale: Optional[float] = None, training: bool = True):
+    """paddle.nn.functional.flash_attention parity ([B,S,H,D])."""
+    if return_softmax:
+        raise NotImplementedError("return_softmax is a debug-only GPU feature")
+    if dropout > 0.0 and training:
+        # Attention-prob dropout breaks the flash recomputation trick cheaply
+        # on TPU; paddle models we target use dropout=0 in attention core.
+        out = reference_attention(query, key, value, causal, scale)
+        from ..nn.functional import dropout as F_dropout
+        return F_dropout(out, dropout, training=True)
+    if _use_pallas(query):
+        from ._pallas.flash_attention import flash_attention_pallas
+        return flash_attention_pallas(query, key, value, causal=causal,
+                                      scale=scale)
+    return reference_attention(query, key, value, causal, scale)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q: int, max_seqlen_k: int,
+                        scale: Optional[float] = None, dropout: float = 0.0,
+                        causal: bool = False):
+    """Varlen parity (ref flash_attn_kernel.cu:289). XLA needs static shapes,
+    so varlen is expressed as a padded batch + segment mask (the TPU idiom —
+    bucketing/padding policy per SURVEY §7 hard-part (c))."""
+    # cu_seqlens: [B+1] prefix sums. Build a segment mask and run dense.
+    b = cu_seqlens_q.shape[0] - 1
+    total_q, h, d = query.shape
+    # Scatter the packed tokens into [B, max_seqlen, H, D].
+    def to_padded(x, cu, max_len):
+        out = jnp.zeros((b, max_len, x.shape[-2], x.shape[-1]), x.dtype)
+        idx = jnp.arange(x.shape[0])
+        seg = jnp.searchsorted(cu, idx, side="right") - 1
+        pos = idx - cu[seg]
+        return out.at[seg, pos].set(x)
+
+    qp = to_padded(query, cu_seqlens_q, max_seqlen_q)
+    kp = to_padded(key, cu_seqlens_k, max_seqlen_k)
+    vp = to_padded(value, cu_seqlens_k, max_seqlen_k)
+    lens_q = cu_seqlens_q[1:] - cu_seqlens_q[:-1]
+    lens_k = cu_seqlens_k[1:] - cu_seqlens_k[:-1]
+    qmask = jnp.arange(max_seqlen_q)[None, :] < lens_q[:, None]
+    kmask = jnp.arange(max_seqlen_k)[None, :] < lens_k[:, None]
+    bias = jnp.where(kmask[:, None, None, :], 0.0, -jnp.inf)
+    out = reference_attention(qp, kp, vp, causal=causal, scale=scale, bias=bias)
+    out = jnp.where(qmask[:, :, None, None], out, 0.0)
+    # Pack back.
+    idx = jnp.arange(total_q)
+    seg = jnp.searchsorted(cu_seqlens_q, idx, side="right") - 1
+    pos = idx - cu_seqlens_q[seg]
+    return out[seg, pos]

@@ -1,0 +1,373 @@
+"""Optimizers.
+
+Re-design of the reference's optimizer stack
+(``python/paddle/optimizer/optimizer.py:1584`` ``Optimizer.step`` dispatching
+to fused ``_C_ops.adam_`` kernels) for the functional world:
+
+- **Functional core** (the TPU-fast path): ``state = opt.init(params)``;
+  ``new_params, new_state = opt.apply_gradients(params, grads, state, lr)``.
+  Pure, jittable, shardable — inside pjit the update runs fully fused by XLA
+  (the analog of paddle's fused multi-tensor adam kernels, and what the
+  reference's ``_apply_optimize`` loop becomes when XLA fuses across params).
+- **Imperative shim** (paddle-parity UX): construct with
+  ``parameters=model.parameters()``; after ``autograd.backward`` has populated
+  ``param.grad``, ``opt.step()`` applies updates in place and ``clear_grad()``
+  resets. This path is eager jnp (still async-dispatched) — fine for tests
+  and small models; training loops that matter use the functional core via
+  hapi/Model or make_train_step.
+
+Master weights: with ``multi_precision=True`` (ref: paddle's master-weight
+support for fp16/bf16 params), fp32 master copies live in the optimizer state;
+updates happen in fp32 and are cast back to the param dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer import ParamRef
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
+           "RMSProp", "Lamb"]
+
+Params = Dict[str, jax.Array]
+Grads = Dict[str, jax.Array]
+State = Dict[str, Any]
+
+
+def _f32(x):
+    return x.astype(jnp.float32)
+
+
+class Optimizer:
+    def __init__(self, learning_rate: Union[float, LRScheduler] = 0.001,
+                 parameters: Optional[Sequence[ParamRef]] = None,
+                 weight_decay: float = 0.0, grad_clip=None,
+                 multi_precision: bool = True, name: Optional[str] = None):
+        self._learning_rate = learning_rate
+        self._param_refs: Optional[List[ParamRef]] = \
+            list(parameters) if parameters is not None else None
+        self.weight_decay = float(weight_decay or 0.0)
+        self.grad_clip = grad_clip
+        self.multi_precision = multi_precision
+        self._eager_state: Optional[State] = None
+
+    # -- lr -----------------------------------------------------------------
+
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate.get_lr())
+        return float(self._learning_rate)
+
+    def set_lr(self, lr: float) -> None:
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("set_lr not allowed when using an LRScheduler")
+        self._learning_rate = float(lr)
+
+    @property
+    def lr_scheduler(self) -> Optional[LRScheduler]:
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) else None
+
+    # -- functional core ------------------------------------------------------
+
+    def _needs_master(self, p: jax.Array) -> bool:
+        return self.multi_precision and p.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _init_param_state(self, p: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    def _update_param(self, p32: jax.Array, g32: jax.Array,
+                      st: Dict[str, jax.Array], lr: jax.Array,
+                      step: jax.Array) -> jax.Array:
+        """Returns updated fp32 param; mutates `st` entries by returning new
+        dict via caller. Implemented by subclasses through _update()."""
+        raise NotImplementedError
+
+    def init(self, params: Params) -> State:
+        pstates = {}
+        for name, p in params.items():
+            st = self._init_param_state(p)
+            if self._needs_master(p):
+                st["master"] = _f32(p)
+            pstates[name] = st
+        return {"step": jnp.zeros((), jnp.int32), "param_states": pstates}
+
+    def apply_gradients(self, params: Params, grads: Grads, state: State,
+                        lr: Optional[jax.Array] = None) -> (Params, State):
+        if lr is None:
+            lr = self.get_lr()
+        lr = jnp.asarray(lr, jnp.float32)
+        if self.grad_clip is not None:
+            grads = self.grad_clip(grads)
+        step = state["step"] + 1
+        new_params: Params = dict(params)
+        new_pstates = dict(state["param_states"])
+        for name, g in grads.items():
+            if g is None:
+                continue
+            p = params[name]
+            st = dict(new_pstates.get(name) or {})
+            if "master" in st:
+                p32 = st["master"]
+            else:
+                p32 = _f32(p)
+            g32 = _f32(g)
+            new_p32, st = self._update(name, p32, g32, st, lr, step)
+            if "master" in st:
+                st["master"] = new_p32
+            new_pstates[name] = st
+            new_params[name] = new_p32.astype(p.dtype)
+        return new_params, {"step": step, "param_states": new_pstates}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        raise NotImplementedError
+
+    # -- imperative shim -------------------------------------------------------
+
+    def _refs(self) -> List[ParamRef]:
+        if self._param_refs is None:
+            raise RuntimeError(
+                "Optimizer was constructed without `parameters=`; use the "
+                "functional API (init/apply_gradients) instead of step().")
+        return self._param_refs
+
+    def step(self) -> None:
+        refs = [r for r in self._refs() if r.trainable and r.grad is not None]
+        params = {r.name: r.value for r in refs}
+        grads = {r.name: r.grad for r in refs}
+        if self._eager_state is None:
+            self._eager_state = self.init(
+                {r.name: r.value for r in self._refs() if r.trainable})
+        missing = [n for n in params if n not in self._eager_state["param_states"]]
+        for n in missing:
+            self._eager_state["param_states"][n] = self._init_param_state(params[n])
+        new_params, self._eager_state = self.apply_gradients(
+            params, grads, self._eager_state)
+        for r in refs:
+            r.value = new_params[r.name]
+
+    def minimize(self, loss=None, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """paddle parity: the loss's backward has already populated
+        param.grad (autograd.backward); minimize just applies the step."""
+        self.step()
+
+    def clear_grad(self) -> None:
+        for r in self._refs():
+            r.clear_grad()
+
+    clear_gradients = clear_grad
+
+    # -- checkpointing ---------------------------------------------------------
+
+    def state_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        if self._eager_state is not None:
+            out["step"] = self._eager_state["step"]
+            for pname, st in self._eager_state["param_states"].items():
+                for k, v in st.items():
+                    out[f"{pname}@{k}"] = v
+        sched = self.lr_scheduler
+        if sched is not None:
+            out["LR_Scheduler"] = sched.state_dict()
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        sched_state = state.pop("LR_Scheduler", None)
+        if sched_state is not None and self.lr_scheduler is not None:
+            self.lr_scheduler.set_state_dict(sched_state)
+        step = state.pop("step", None)
+        pstates: Dict[str, Dict[str, jax.Array]] = {}
+        for key, v in state.items():
+            pname, _, k = key.rpartition("@")
+            pstates.setdefault(pname, {})[k] = jnp.asarray(v)
+        self._eager_state = {
+            "step": jnp.asarray(step if step is not None else 0, jnp.int32),
+            "param_states": pstates,
+        }
+
+
+class SGD(Optimizer):
+    def _update(self, name, p32, g32, st, lr, step):
+        if self.weight_decay:
+            g32 = g32 + self.weight_decay * p32
+        return p32 - lr * g32, st
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum: float = 0.9,
+                 parameters=None, use_nesterov: bool = False,
+                 weight_decay=0.0, grad_clip=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.momentum = momentum
+        self.use_nesterov = use_nesterov
+
+    def _init_param_state(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        if self.weight_decay:
+            g32 = g32 + self.weight_decay * p32
+        v = self.momentum * st["velocity"] + g32
+        if self.use_nesterov:
+            new_p = p32 - lr * (g32 + self.momentum * v)
+        else:
+            new_p = p32 - lr * v
+        st = dict(st)
+        st["velocity"] = v
+        return new_p, st
+
+
+class Adam(Optimizer):
+    """ref: python/paddle/optimizer/adam.py (fused _C_ops.adam_ at :321).
+    weight_decay here is L2 (coupled); use AdamW for decoupled decay."""
+
+    def __init__(self, learning_rate=0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8, parameters=None,
+                 weight_decay=0.0, grad_clip=None, lazy_mode: bool = False,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def _init_param_state(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32)}
+
+    def _decay(self, p32, g32):
+        if self.weight_decay:
+            return g32 + self.weight_decay * p32
+        return g32
+
+    def _update(self, name, p32, g32, st, lr, step):
+        g32 = self._decay(p32, g32)
+        m = self.beta1 * st["moment1"] + (1 - self.beta1) * g32
+        v = self.beta2 * st["moment2"] + (1 - self.beta2) * jnp.square(g32)
+        stepf = step.astype(jnp.float32)
+        bc1 = 1 - self.beta1 ** stepf
+        bc2 = 1 - self.beta2 ** stepf
+        m_hat = m / bc1
+        v_hat = v / bc2
+        new_p = self._apply_update(p32, m_hat, v_hat, lr)
+        st = dict(st)
+        st["moment1"], st["moment2"] = m, v
+        return new_p, st
+
+    def _apply_update(self, p32, m_hat, v_hat, lr):
+        return p32 - lr * m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay: float = 0.01,
+                 lr_ratio=None, apply_decay_param_fun: Optional[Callable[[str], bool]] = None,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         0.0, grad_clip, multi_precision=multi_precision)
+        self.decoupled_weight_decay = float(weight_decay)
+        self.apply_decay_param_fun = apply_decay_param_fun
+
+    def _update(self, name, p32, g32, st, lr, step):
+        apply_decay = (self.apply_decay_param_fun is None or
+                       self.apply_decay_param_fun(name))
+        if apply_decay and self.decoupled_weight_decay:
+            p32 = p32 * (1.0 - lr * self.decoupled_weight_decay)
+        return super()._update(name, p32, g32, st, lr, step)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon: float = 1e-6,
+                 parameters=None, weight_decay=0.0, grad_clip=None,
+                 initial_accumulator_value: float = 0.0, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.epsilon = epsilon
+        self.initial_accumulator_value = initial_accumulator_value
+
+    def _init_param_state(self, p):
+        return {"moment": jnp.full(p.shape, self.initial_accumulator_value,
+                                   jnp.float32)}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        if self.weight_decay:
+            g32 = g32 + self.weight_decay * p32
+        acc = st["moment"] + jnp.square(g32)
+        new_p = p32 - lr * g32 / (jnp.sqrt(acc) + self.epsilon)
+        st = dict(st)
+        st["moment"] = acc
+        return new_p, st
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.01, rho: float = 0.95,
+                 epsilon: float = 1e-6, momentum: float = 0.0,
+                 centered: bool = False, parameters=None, weight_decay=0.0,
+                 grad_clip=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.rho, self.epsilon = rho, epsilon
+        self.momentum, self.centered = momentum, centered
+
+    def _init_param_state(self, p):
+        st = {"mean_square": jnp.zeros(p.shape, jnp.float32),
+              "momentum": jnp.zeros(p.shape, jnp.float32)}
+        if self.centered:
+            st["mean_grad"] = jnp.zeros(p.shape, jnp.float32)
+        return st
+
+    def _update(self, name, p32, g32, st, lr, step):
+        if self.weight_decay:
+            g32 = g32 + self.weight_decay * p32
+        ms = self.rho * st["mean_square"] + (1 - self.rho) * jnp.square(g32)
+        st = dict(st)
+        st["mean_square"] = ms
+        if self.centered:
+            mg = self.rho * st["mean_grad"] + (1 - self.rho) * g32
+            st["mean_grad"] = mg
+            denom = jnp.sqrt(ms - jnp.square(mg) + self.epsilon)
+        else:
+            denom = jnp.sqrt(ms + self.epsilon)
+        mom = self.momentum * st["momentum"] + lr * g32 / denom
+        st["momentum"] = mom
+        return p32 - mom, st
+
+
+class Lamb(Optimizer):
+    """ref: python/paddle/optimizer/lamb.py (layer-wise adaptive rates)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay: float = 0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=True):
+        super().__init__(learning_rate, parameters, 0.0, grad_clip,
+                         multi_precision)
+        self.lamb_weight_decay = lamb_weight_decay
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_param_state(self, p):
+        return {"moment1": jnp.zeros(p.shape, jnp.float32),
+                "moment2": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        m = self.beta1 * st["moment1"] + (1 - self.beta1) * g32
+        v = self.beta2 * st["moment2"] + (1 - self.beta2) * jnp.square(g32)
+        stepf = step.astype(jnp.float32)
+        m_hat = m / (1 - self.beta1 ** stepf)
+        v_hat = v / (1 - self.beta2 ** stepf)
+        update = m_hat / (jnp.sqrt(v_hat) + self.epsilon)
+        if self.lamb_weight_decay and not (self.exclude_fn and self.exclude_fn(name)):
+            update = update + self.lamb_weight_decay * p32
+        w_norm = jnp.linalg.norm(p32)
+        u_norm = jnp.linalg.norm(update)
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        st = dict(st)
+        st["moment1"], st["moment2"] = m, v
+        return p32 - lr * ratio * update, st

@@ -1,0 +1,198 @@
+"""Profiler.
+
+Re-design of the reference's two-tier profiler
+(C++ ``paddle/fluid/platform/profiler/`` HostTracer + CUPTI CudaTracer merged
+into chrome-trace JSON; Python ``paddle.profiler.Profiler`` with scheduler
+states at ``profiler.py:79`` and ``export_chrome_tracing``): on TPU the
+device-side tracer is XLA/XPlane via ``jax.profiler`` (viewable in
+TensorBoard/Perfetto — the chrome-tracing analog), and host spans are
+``jax.profiler.TraceAnnotation``/``named_scope`` (our RecordEvent).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
+           "export_chrome_tracing", "load_profiler_result", "SummaryView"]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed: int = 0, ready: int = 0, record: int = 1,
+                   repeat: int = 0, skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """ref: paddle.profiler.make_scheduler — step-indexed state machine."""
+    cycle = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        step -= skip_first
+        if repeat and step >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = step % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+class RecordEvent:
+    """Host span: shows up in the XLA trace as a named range and is also
+    timed host-side (ref: paddle.profiler.RecordEvent)."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._ann = None
+        self.begin_ns = 0
+        self.end_ns = 0
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+    def __enter__(self):
+        self.begin_ns = time.perf_counter_ns()
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        self.end_ns = time.perf_counter_ns()
+        _host_events.append((self.name, self.begin_ns, self.end_ns))
+        return False
+
+
+_host_events: List[Tuple[str, int, int]] = []
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready handler writing host-span chrome trace JSON (device
+    trace goes to the jax.profiler XPlane dump in the same dir)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        import json
+        events = []
+        for name, b, e in _host_events:
+            events.append({"name": name, "ph": "X", "ts": b / 1000.0,
+                           "dur": (e - b) / 1000.0, "pid": 0, "tid": 0})
+        fname = os.path.join(dir_name,
+                             f"{worker_name or 'worker'}_host_trace.json")
+        with open(fname, "w") as f:
+            json.dump({"traceEvents": events}, f)
+
+    return handler
+
+
+class Profiler:
+    """ref: paddle.profiler.Profiler (profiler.py:349)."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes: bool = False, profile_memory: bool = False,
+                 timer_only: bool = False, log_dir: str = "./profiler_log"):
+        if callable(scheduler):
+            self._scheduler = scheduler
+        elif isinstance(scheduler, (tuple, list)) and len(scheduler) == 2:
+            start, end = scheduler
+            self._scheduler = make_scheduler(closed=start, ready=0,
+                                             record=end - start, repeat=1)
+        else:
+            self._scheduler = None  # always record
+        self.on_trace_ready = on_trace_ready
+        self.log_dir = log_dir
+        self.timer_only = timer_only
+        self.step_num = 0
+        self._device_tracing = False
+        self._state = ProfilerState.CLOSED
+        self._step_times: List[float] = []
+        self._last_step_t: Optional[float] = None
+
+    def start(self):
+        self._transition()
+
+    def stop(self):
+        if self._device_tracing:
+            jax.profiler.stop_trace()
+            self._device_tracing = False
+        if self.on_trace_ready:
+            self.on_trace_ready(self)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _transition(self):
+        state = (self._scheduler(self.step_num) if self._scheduler
+                 else ProfilerState.RECORD)
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            if not self._device_tracing and not self.timer_only:
+                os.makedirs(self.log_dir, exist_ok=True)
+                jax.profiler.start_trace(self.log_dir)
+                self._device_tracing = True
+        else:
+            if self._device_tracing:
+                jax.profiler.stop_trace()
+                self._device_tracing = False
+        self._state = state
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self.step_num += 1
+        self._transition()
+
+    def step_info(self, unit: str = "samples") -> str:
+        if not self._step_times:
+            return "no steps recorded"
+        import statistics
+        avg = statistics.mean(self._step_times)
+        return f"avg step {avg * 1000:.2f} ms ({1.0 / avg:.2f} steps/s)"
+
+    def summary(self, sorted_by=None, op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms"):
+        return self.step_info()
+
+
+def load_profiler_result(path: str):
+    import json
+    with open(path) as f:
+        return json.load(f)
+
+
+class SummaryView(Enum):
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6

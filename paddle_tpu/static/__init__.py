@@ -1,0 +1,118 @@
+"""paddle.static parity facade.
+
+The reference maintains a whole declarative world: ``Program``/``Block``
+(``python/paddle/fluid/framework.py``), ``Executor`` → C++
+``StandaloneExecutor``/``InterpreterCore`` (``executor.py:1036``,
+``new_executor/``). In the TPU build a "Program" is simply a traced,
+jit-compiled function: building a program = defining a Python function over
+InputSpec placeholders; ``Executor.run`` = calling the compiled function with
+a feed dict. This module keeps enough of the static API surface for user code
+and tests to port; the heavy machinery (instruction lists, dependency
+builders, GC) is XLA's job.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
+           "Executor", "data", "name_scope"]
+
+
+@dataclass(frozen=True)
+class InputSpec:
+    shape: Tuple[int, ...]
+    dtype: Any = jnp.float32
+    name: Optional[str] = None
+
+    def to_sds(self) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(tuple(self.shape), jnp.dtype(self.dtype))
+
+
+class Program:
+    """A deferred computation: feed names -> fetch function."""
+
+    def __init__(self):
+        self._inputs: Dict[str, InputSpec] = {}
+        self._build_fn: Optional[Callable] = None
+        self._compiled = None
+
+    def set_build_fn(self, fn: Callable) -> None:
+        self._build_fn = fn
+        self._compiled = None
+
+    def add_input(self, spec: InputSpec) -> InputSpec:
+        self._inputs[spec.name] = spec
+        return spec
+
+    def compile(self):
+        if self._compiled is None:
+            if self._build_fn is None:
+                raise RuntimeError(
+                    "Program has no build function; use Program.set_build_fn "
+                    "or the jit/to_static path")
+            self._compiled = jax.jit(self._build_fn)
+        return self._compiled
+
+
+_default_program = Program()
+_program_stack: List[Program] = [_default_program]
+
+
+def default_main_program() -> Program:
+    return _program_stack[-1]
+
+
+class program_guard:
+    def __init__(self, main_program: Program, startup_program: Optional[Program] = None):
+        self.program = main_program
+
+    def __enter__(self):
+        _program_stack.append(self.program)
+        return self.program
+
+    def __exit__(self, *exc):
+        _program_stack.pop()
+        return False
+
+
+def data(name: str, shape, dtype="float32") -> InputSpec:
+    spec = InputSpec(tuple(shape), jnp.dtype(dtype), name)
+    default_main_program().add_input(spec)
+    return spec
+
+
+class name_scope:
+    def __init__(self, name: str):
+        self._ctx = jax.named_scope(name)
+
+    def __enter__(self):
+        return self._ctx.__enter__()
+
+    def __exit__(self, *exc):
+        return self._ctx.__exit__(*exc)
+
+
+class Executor:
+    """ref: paddle.static.Executor (executor.py:1036). run() compiles the
+    program's build function once per signature and executes it."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence[Any]] = None):
+        program = program or default_main_program()
+        feed = feed or {}
+        compiled = program.compile()
+        out = compiled(**{k: jnp.asarray(v) for k, v in feed.items()})
+        if fetch_list is None:
+            return out
+        if not isinstance(out, (tuple, list)):
+            out = [out]
+        return list(out)

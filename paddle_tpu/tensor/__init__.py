@@ -1,0 +1,17 @@
+"""Tensor op surface.
+
+Parity with ``python/paddle/tensor/`` (creation/math/manipulation/linalg/stat,
+e.g. ``matmul`` at ``tensor/linalg.py:233``). There is no generated pybind
+layer (``_C_ops``) here: a "Tensor" IS ``jax.Array`` and every op is a direct
+jnp/lax call — the whole 6-step dygraph dispatch stack of the reference
+(SURVEY §3.1) collapses to one Python call into XLA's eager dispatch.
+"""
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403

@@ -1,0 +1,127 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..core.device import get_default_device
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "arange", "linspace", "eye", "empty", "empty_like",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "numel", "tolist",
+]
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> jax.Array:
+    """paddle.to_tensor: device placement via jax.device_put (place string
+    like 'tpu:0'); stop_gradient is advisory (grads are explicit in JAX)."""
+    if dtype is not None:
+        dtype = dtypes.to_dtype(dtype)
+    elif isinstance(data, (float,)) or (
+            isinstance(data, np.ndarray) and data.dtype == np.float64):
+        dtype = dtypes.get_default_dtype()
+    arr = jnp.asarray(data, dtype=dtype)
+    if place is not None:
+        from ..core import device as dev
+        kind, idx = dev._parse(place) if isinstance(place, str) else (None, None)
+        if kind is not None:
+            target = dev._platform_devices(kind)[idx]
+            arr = jax.device_put(arr, target)
+    return arr
+
+
+def zeros(shape, dtype=None) -> jax.Array:
+    return jnp.zeros(shape, dtypes.to_dtype(dtype) if dtype else dtypes.get_default_dtype())
+
+
+def ones(shape, dtype=None) -> jax.Array:
+    return jnp.ones(shape, dtypes.to_dtype(dtype) if dtype else dtypes.get_default_dtype())
+
+
+def full(shape, fill_value, dtype=None) -> jax.Array:
+    return jnp.full(shape, fill_value,
+                    dtypes.to_dtype(dtype) if dtype else dtypes.get_default_dtype())
+
+
+def zeros_like(x, dtype=None) -> jax.Array:
+    return jnp.zeros_like(x, dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def ones_like(x, dtype=None) -> jax.Array:
+    return jnp.ones_like(x, dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def full_like(x, fill_value, dtype=None) -> jax.Array:
+    return jnp.full_like(x, fill_value, dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def arange(start=0, end=None, step=1, dtype=None) -> jax.Array:
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step,
+                      dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def linspace(start, stop, num, dtype=None) -> jax.Array:
+    return jnp.linspace(start, stop, int(num),
+                        dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def eye(num_rows, num_columns=None, dtype=None) -> jax.Array:
+    return jnp.eye(num_rows, num_columns,
+                   dtype=dtypes.to_dtype(dtype) if dtype else dtypes.get_default_dtype())
+
+
+def empty(shape, dtype=None) -> jax.Array:
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None) -> jax.Array:
+    return zeros_like(x, dtype)
+
+
+def diag(x, offset: int = 0, padding_value: float = 0) -> jax.Array:
+    out = jnp.diag(x, k=offset)
+    if padding_value != 0 and x.ndim == 1:
+        mask = jnp.eye(out.shape[0], out.shape[1], k=offset, dtype=bool)
+        out = jnp.where(mask, out, padding_value)
+    return out
+
+
+def diagflat(x, offset: int = 0) -> jax.Array:
+    return jnp.diagflat(x, k=offset)
+
+
+def tril(x, diagonal: int = 0) -> jax.Array:
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal: int = 0) -> jax.Array:
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args):
+    return jnp.meshgrid(*args, indexing="ij")
+
+
+def assign(x, output=None) -> jax.Array:
+    return jnp.asarray(x)
+
+
+def clone(x) -> jax.Array:
+    return jnp.copy(x)
+
+
+def numel(x) -> int:
+    return int(np.prod(x.shape)) if x.shape else 1
+
+
+def tolist(x):
+    return np.asarray(x).tolist()

@@ -1,0 +1,117 @@
+"""Linear algebra ops (ref: python/paddle/tensor/linalg.py, matmul at :233).
+
+matmul defaults to bf16-friendly MXU dispatch: inputs keep their dtype and XLA
+selects the MXU path; accumulate dtype is controlled by preferred_element_type.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "norm", "dist", "cross", "cholesky",
+    "qr", "svd", "eig", "eigh", "inv", "pinv", "det", "slogdet", "solve",
+    "triangular_solve", "lstsq", "matrix_power", "matrix_rank", "mv",
+    "histogram", "bincount", "multi_dot", "einsum",
+]
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return jnp.matmul(x, y)
+
+
+mm = matmul
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def t(x):
+    if x.ndim < 2:
+        return x
+    return jnp.swapaxes(x, -1, -2)
+
+
+def norm(x, p="fro", axis=None, keepdim: bool = False):
+    if p == "fro":
+        if axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(x)))
+        return jnp.linalg.norm(x, ord=None, axis=tuple(axis) if isinstance(axis, list) else axis,
+                               keepdims=keepdim)
+    if p == "nuc":
+        return jnp.linalg.norm(x, ord="nuc", axis=axis, keepdims=keepdim)
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def dist(x, y, p: float = 2):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+def cross(x, y, axis: int = 9):
+    axis = axis if axis != 9 else -1
+    return jnp.cross(x, y, axis=axis)
+
+
+cholesky = jnp.linalg.cholesky
+
+
+def qr(x, mode: str = "reduced"):
+    return jnp.linalg.qr(x, mode=mode)
+
+
+def svd(x, full_matrices: bool = False):
+    return jnp.linalg.svd(x, full_matrices=full_matrices)
+
+
+eig = jnp.linalg.eig
+eigh = jnp.linalg.eigh
+inv = jnp.linalg.inv
+pinv = jnp.linalg.pinv
+det = jnp.linalg.det
+slogdet = jnp.linalg.slogdet
+solve = jnp.linalg.solve
+matrix_power = jnp.linalg.matrix_power
+multi_dot = jnp.linalg.multi_dot
+einsum = jnp.einsum
+
+
+def triangular_solve(x, y, upper: bool = True, transpose: bool = False,
+                     unitriangular: bool = False):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+def lstsq(x, y, rcond=None):
+    return jnp.linalg.lstsq(x, y, rcond=rcond)
+
+
+def matrix_rank(x, tol=None, hermitian: bool = False):
+    return jnp.linalg.matrix_rank(x, tol=tol)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def histogram(x, bins: int = 100, min: float = 0.0, max: float = 0.0):
+    if min == 0.0 and max == 0.0:
+        min, max = float(jnp.min(x)), float(jnp.max(x))
+    hist, _ = jnp.histogram(x, bins=bins, range=(min, max))
+    return hist
+
+
+def bincount(x, weights=None, minlength: int = 0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)

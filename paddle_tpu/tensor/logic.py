@@ -1,0 +1,54 @@
+"""Comparison / logical ops (ref: python/paddle/tensor/logic.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
+    "equal_all", "allclose", "isclose", "is_tensor", "bitwise_and",
+    "bitwise_or", "bitwise_xor", "bitwise_not", "all", "any",
+]
+
+equal = jnp.equal
+not_equal = jnp.not_equal
+greater_than = jnp.greater
+greater_equal = jnp.greater_equal
+less_than = jnp.less
+less_equal = jnp.less_equal
+logical_and = jnp.logical_and
+logical_or = jnp.logical_or
+logical_not = jnp.logical_not
+logical_xor = jnp.logical_xor
+bitwise_and = jnp.bitwise_and
+bitwise_or = jnp.bitwise_or
+bitwise_xor = jnp.bitwise_xor
+bitwise_not = jnp.bitwise_not
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def allclose(x, y, rtol: float = 1e-5, atol: float = 1e-8,
+             equal_nan: bool = False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol: float = 1e-5, atol: float = 1e-8,
+            equal_nan: bool = False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def is_tensor(x) -> bool:
+    import jax
+    return isinstance(x, jax.Array)
+
+
+def all(x, axis=None, keepdim: bool = False):
+    return jnp.all(x, axis=axis, keepdims=keepdim)
+
+
+def any(x, axis=None, keepdim: bool = False):
+    return jnp.any(x, axis=axis, keepdims=keepdim)

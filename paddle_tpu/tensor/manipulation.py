@@ -1,0 +1,230 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py)."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+
+__all__ = [
+    "reshape", "flatten", "transpose", "concat", "stack", "unstack", "split",
+    "chunk", "squeeze", "unsqueeze", "expand", "expand_as", "tile",
+    "broadcast_to", "flip", "roll", "gather", "gather_nd", "scatter",
+    "scatter_nd_add", "index_select", "masked_select", "where", "take_along_axis",
+    "put_along_axis", "slice", "strided_slice", "cast", "repeat_interleave",
+    "unbind", "moveaxis", "swapaxes", "as_complex", "as_real", "unique",
+    "masked_fill", "index_put", "rot90", "atleast_1d", "atleast_2d", "atleast_3d",
+]
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def flatten(x, start_axis: int = 0, stop_axis: int = -1):
+    start = start_axis % x.ndim
+    stop = stop_axis % x.ndim
+    return x.reshape(x.shape[:start] + (-1,) + x.shape[stop + 1:])
+
+
+def transpose(x, perm: Sequence[int]):
+    return jnp.transpose(x, perm)
+
+
+def concat(xs, axis: int = 0):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def stack(xs, axis: int = 0):
+    return jnp.stack(xs, axis=axis)
+
+
+def unstack(x, axis: int = 0, num=None):
+    return [jnp.squeeze(a, axis=axis) for a in
+            jnp.split(x, x.shape[axis], axis=axis)]
+
+
+def split(x, num_or_sections, axis: int = 0):
+    if isinstance(num_or_sections, int):
+        return jnp.split(x, num_or_sections, axis=axis)
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections = [total - known if s == -1 else s for s in sections]
+    indices = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        indices.append(acc)
+    return jnp.split(x, indices, axis=axis)
+
+
+def chunk(x, chunks: int, axis: int = 0):
+    return jnp.array_split(x, chunks, axis=axis)
+
+
+def squeeze(x, axis=None):
+    return jnp.squeeze(x, axis=axis)
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+def expand(x, shape):
+    shape = [x.shape[i - (len(shape) - x.ndim)] if s == -1 else s
+             for i, s in enumerate(shape)]
+    return jnp.broadcast_to(x, shape)
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, shape)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def gather(x, index, axis: int = 0):
+    return jnp.take(x, index, axis=axis)
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite: bool = True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def index_select(x, index, axis: int = 0):
+    return jnp.take(x, index, axis=axis)
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, value, x)
+
+
+def index_put(x, indices, value, accumulate: bool = False):
+    if accumulate:
+        return x.at[tuple(indices)].add(value)
+    return x.at[tuple(indices)].set(value)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        return jnp.where(condition)
+    return jnp.where(condition, x, y)
+
+
+def take_along_axis(x, indices, axis: int):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis: int, reduce: str = "assign"):
+    dnums = jnp.arange(x.ndim)
+    if reduce == "assign":
+        mode = "set"
+    elif reduce == "add":
+        mode = "add"
+    else:
+        raise ValueError(reduce)
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in range(x.ndim)])
+           for d, s in enumerate(x.shape)]
+    idx[axis] = indices
+    return getattr(x.at[tuple(idx)], mode)(values)
+
+
+def slice(x, axes, starts, ends):
+    slices = [jnp.s_[:]] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        slices[ax] = jnp.s_[st:en]
+    return x[tuple(slices)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    slices = [jnp.s_[:]] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        slices[ax] = jnp.s_[st:en:sd]
+    return x[tuple(slices)]
+
+
+def cast(x, dtype):
+    return x.astype(dtypes.to_dtype(dtype))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def unbind(x, axis: int = 0):
+    return unstack(x, axis)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def swapaxes(x, axis1, axis2):
+    return jnp.swapaxes(x, axis1, axis2)
+
+
+def as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None):
+    return jnp.unique(x, return_index=return_index,
+                      return_inverse=return_inverse,
+                      return_counts=return_counts, axis=axis)
+
+
+def rot90(x, k: int = 1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=axes)
+
+
+def atleast_1d(*xs):
+    return jnp.atleast_1d(*xs)
+
+
+def atleast_2d(*xs):
+    return jnp.atleast_2d(*xs)
+
+
+def atleast_3d(*xs):
+    return jnp.atleast_3d(*xs)

@@ -1,0 +1,186 @@
+"""Elementwise & reduction math ops (ref: python/paddle/tensor/math.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod", "pow",
+    "sqrt", "rsqrt", "square", "abs", "exp", "expm1", "log", "log2", "log10",
+    "log1p", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
+    "tanh", "floor", "ceil", "round", "trunc", "sign", "neg", "reciprocal",
+    "maximum", "minimum", "fmax", "fmin", "clip", "sum", "mean", "max", "min",
+    "prod", "cumsum", "cumprod", "logsumexp", "logcumsumexp", "isnan", "isinf",
+    "isfinite", "erf", "erfinv", "lerp", "addmm", "inner", "outer", "trace",
+    "kron", "nan_to_num", "amax", "amin", "diff", "angle", "frac", "rad2deg",
+    "deg2rad", "gcd", "lcm", "heaviside", "digamma", "lgamma", "multiplex",
+    "stanh", "atan2", "logit", "scale", "increment",
+]
+
+add = jnp.add
+subtract = jnp.subtract
+multiply = jnp.multiply
+divide = jnp.divide
+floor_divide = jnp.floor_divide
+mod = jnp.mod
+pow = jnp.power
+sqrt = jnp.sqrt
+
+
+def rsqrt(x):
+    return jax.lax.rsqrt(x)
+
+
+square = jnp.square
+abs = jnp.abs
+exp = jnp.exp
+expm1 = jnp.expm1
+log = jnp.log
+log2 = jnp.log2
+log10 = jnp.log10
+log1p = jnp.log1p
+sin = jnp.sin
+cos = jnp.cos
+tan = jnp.tan
+asin = jnp.arcsin
+acos = jnp.arccos
+atan = jnp.arctan
+atan2 = jnp.arctan2
+sinh = jnp.sinh
+cosh = jnp.cosh
+tanh = jnp.tanh
+floor = jnp.floor
+ceil = jnp.ceil
+round = jnp.round
+trunc = jnp.trunc
+sign = jnp.sign
+neg = jnp.negative
+reciprocal = jnp.reciprocal
+maximum = jnp.maximum
+minimum = jnp.minimum
+fmax = jnp.fmax
+fmin = jnp.fmin
+isnan = jnp.isnan
+isinf = jnp.isinf
+isfinite = jnp.isfinite
+erf = jax.scipy.special.erf
+erfinv = jax.scipy.special.erfinv
+digamma = jax.scipy.special.digamma
+lgamma = jax.scipy.special.gammaln
+kron = jnp.kron
+inner = jnp.inner
+outer = jnp.outer
+heaviside = jnp.heaviside
+gcd = jnp.gcd
+lcm = jnp.lcm
+angle = jnp.angle
+diff = jnp.diff
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def sum(x, axis=None, dtype=None, keepdim: bool = False):
+    return jnp.sum(x, axis=axis, keepdims=keepdim,
+                   dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def mean(x, axis=None, keepdim: bool = False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def max(x, axis=None, keepdim: bool = False):
+    return jnp.max(x, axis=axis, keepdims=keepdim)
+
+
+def min(x, axis=None, keepdim: bool = False):
+    return jnp.min(x, axis=axis, keepdims=keepdim)
+
+
+amax = max
+amin = min
+
+
+def prod(x, axis=None, keepdim: bool = False, dtype=None):
+    return jnp.prod(x, axis=axis, keepdims=keepdim,
+                    dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def cumprod(x, dim=None, dtype=None):
+    return jnp.cumprod(x, axis=dim, dtype=dtypes.to_dtype(dtype) if dtype else None)
+
+
+def logsumexp(x, axis=None, keepdim: bool = False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def trace(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def nan_to_num(x, nan: float = 0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def rad2deg(x):
+    return jnp.degrees(x)
+
+
+def deg2rad(x):
+    return jnp.radians(x)
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)
+    idx = index.reshape(-1)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def stanh(x, scale_a: float = 0.67, scale_b: float = 1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1 - eps)
+    return jnp.log(x / (1 - x))
+
+
+def scale(x, scale: float = 1.0, bias: float = 0.0,
+          bias_after_scale: bool = True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return out
+
+
+def increment(x, value: float = 1.0):
+    return x + value

@@ -1,0 +1,79 @@
+"""Random ops (ref: python/paddle/tensor/random.py).
+
+Eager calls draw keys from the global Generator; under an active rng_scope
+(jit-traced code) keys come from the scope (see core.random).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.random import next_key
+
+__all__ = ["rand", "randn", "randint", "uniform", "normal", "randperm",
+           "bernoulli", "multinomial", "standard_normal", "poisson", "shuffle"]
+
+
+def poisson(x, key=None):
+    import jax
+    return jax.random.poisson(key or next_key(), x).astype(x.dtype)
+
+
+def _dt(dtype):
+    return dtypes.to_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+
+
+def rand(shape, dtype=None, key=None):
+    return jax.random.uniform(key or next_key(), tuple(shape), dtype=_dt(dtype))
+
+
+def uniform(shape, dtype=None, min: float = -1.0, max: float = 1.0, seed=None,
+            key=None):
+    if seed is not None:
+        key = jax.random.key(seed)
+    return jax.random.uniform(key or next_key(), tuple(shape), dtype=_dt(dtype),
+                              minval=min, maxval=max)
+
+
+def randn(shape, dtype=None, key=None):
+    return jax.random.normal(key or next_key(), tuple(shape), dtype=_dt(dtype))
+
+
+standard_normal = randn
+
+
+def normal(mean: float = 0.0, std: float = 1.0, shape=None, key=None):
+    assert shape is not None
+    return mean + std * jax.random.normal(key or next_key(), tuple(shape),
+                                          dtype=dtypes.get_default_dtype())
+
+
+def randint(low: int = 0, high=None, shape=(1,), dtype="int64", key=None):
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key or next_key(), tuple(shape), low, high,
+                              dtype=dtypes.to_dtype(dtype))
+
+
+def randperm(n: int, dtype="int64", key=None):
+    return jax.random.permutation(key or next_key(), n).astype(dtypes.to_dtype(dtype))
+
+
+def bernoulli(x, key=None):
+    return jax.random.bernoulli(key or next_key(), x).astype(x.dtype)
+
+
+def multinomial(x, num_samples: int = 1, replacement: bool = False, key=None):
+    key = key or next_key()
+    logits = jnp.log(jnp.clip(x, 1e-30, None))
+    if replacement:
+        return jax.random.categorical(key, logits, shape=x.shape[:-1] + (num_samples,))
+    # without replacement: Gumbel top-k
+    g = jax.random.gumbel(key, x.shape)
+    return jnp.argsort(-(logits + g), axis=-1)[..., :num_samples]
+
+
+def shuffle(x, axis: int = 0, key=None):
+    return jax.random.permutation(key or next_key(), x, axis=axis)

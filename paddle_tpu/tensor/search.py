@@ -1,0 +1,63 @@
+"""Search/sort ops (ref: python/paddle/tensor/search.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["argmax", "argmin", "argsort", "sort", "topk", "searchsorted",
+           "nonzero", "index_sample", "bucketize"]
+
+
+def argmax(x, axis=None, keepdim: bool = False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim: bool = False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim)
+    return out.astype(jnp.dtype(dtype))
+
+
+def argsort(x, axis: int = -1, descending: bool = False, stable: bool = True):
+    out = jnp.argsort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def sort(x, axis: int = -1, descending: bool = False, stable: bool = True):
+    out = jnp.sort(x, axis=axis, stable=stable, descending=descending)
+    return out
+
+
+def topk(x, k: int, axis: int = -1, largest: bool = True, sorted: bool = True):
+    if axis != -1 and axis != x.ndim - 1:
+        x_moved = jnp.moveaxis(x, axis, -1)
+        vals, idxs = topk(x_moved, k, -1, largest, sorted)
+        return jnp.moveaxis(vals, -1, axis), jnp.moveaxis(idxs, -1, axis)
+    if largest:
+        vals, idxs = lax.top_k(x, k)
+    else:
+        vals, idxs = lax.top_k(-x, k)
+        vals = -vals
+    return vals, idxs.astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32: bool = False,
+                 right: bool = False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+bucketize = searchsorted
+
+
+def nonzero(x, as_tuple: bool = False):
+    idx = jnp.nonzero(x)
+    if as_tuple:
+        return idx
+    return jnp.stack(idx, axis=1)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)

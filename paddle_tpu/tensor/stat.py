@@ -1,0 +1,57 @@
+"""Statistics ops (ref: python/paddle/tensor/stat.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["mean", "std", "var", "median", "quantile", "nanmean", "nansum",
+           "nanmedian", "kthvalue", "mode"]
+
+
+def mean(x, axis=None, keepdim: bool = False):
+    return jnp.mean(x, axis=axis, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased: bool = True, keepdim: bool = False):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased: bool = True, keepdim: bool = False):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim: bool = False):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim: bool = False):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim: bool = False):
+    return jnp.nanmean(x, axis=axis, keepdims=keepdim)
+
+
+def nansum(x, axis=None, keepdim: bool = False):
+    return jnp.nansum(x, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim: bool = False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def kthvalue(x, k: int, axis: int = -1, keepdim: bool = False):
+    sorted_x = jnp.sort(x, axis=axis)
+    idx = jnp.argsort(x, axis=axis)
+    taken = jnp.take(sorted_x, k - 1, axis=axis)
+    taken_idx = jnp.take(idx, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        taken_idx = jnp.expand_dims(taken_idx, axis)
+    return taken, taken_idx
+
+
+def mode(x, axis: int = -1, keepdim: bool = False):
+    import jax.scipy.stats as jss
+    m, _ = jss.mode(x, axis=axis, keepdims=keepdim)
+    return m

@@ -1,0 +1,2 @@
+from .gpt import GPT, GPTConfig, GPTForCausalLM  # noqa: F401
+from .bert import Bert, BertConfig, BertForPretraining  # noqa: F401

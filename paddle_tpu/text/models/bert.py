@@ -1,0 +1,133 @@
+"""BERT (BASELINE config 3: BERT-base pretraining under AMP O2).
+
+Encoder-only transformer with MLM + NSP heads, built from the same TP-capable
+blocks as GPT (reference analog: paddlenlp-style BERT assembled from
+nn.TransformerEncoder; pretraining heads per the fleet AMP tests)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import ParamAttr
+
+__all__ = ["BertConfig", "Bert", "BertForPretraining", "bert_base", "bert_tiny"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+
+
+def bert_base(**overrides) -> BertConfig:
+    return BertConfig(**overrides)
+
+
+def bert_tiny(**overrides) -> BertConfig:
+    return BertConfig(**{**dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                                num_heads=4, intermediate_size=512,
+                                max_position_embeddings=128), **overrides})
+
+
+def _attr(cfg) -> ParamAttr:
+    return ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=_attr(cfg))
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size,
+                                                weight_attr=_attr(cfg))
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size,
+                                                  weight_attr=_attr(cfg))
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = jnp.arange(s)[None, :]
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class Bert(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        self.encoder = nn.TransformerEncoder(
+            lambda: nn.TransformerEncoderLayer(
+                cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+                dropout=cfg.hidden_dropout, activation="gelu",
+                attn_dropout=cfg.attention_dropout,
+                weight_attr=_attr(cfg)),
+            cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                weight_attr=_attr(cfg))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids)
+        mask = None
+        if attention_mask is not None:
+            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            mask = (1.0 - attention_mask[:, None, None, :].astype(x.dtype)) * -1e9
+        x = self.encoder(x, src_mask=mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (loss as in the reference's pretraining tests)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.bert = Bert(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                       weight_attr=_attr(cfg))
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_epsilon)
+        self.mlm_bias = self.create_parameter(
+            (cfg.vocab_size,), is_bias=True)
+        self.nsp_head = nn.Linear(cfg.hidden_size, 2, weight_attr=_attr(cfg))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        logits = jnp.matmul(h, self.bert.embeddings.word_embeddings.weight.T) \
+            + self.mlm_bias
+        nsp_logits = self.nsp_head(pooled)
+        if masked_lm_labels is None:
+            return logits, nsp_logits
+        mlm_loss = F.cross_entropy(logits, masked_lm_labels,
+                                   ignore_index=-100, reduction="mean")
+        total = mlm_loss
+        if next_sentence_labels is not None:
+            total = total + F.cross_entropy(nsp_logits,
+                                            next_sentence_labels.reshape(-1),
+                                            reduction="mean")
+        return total

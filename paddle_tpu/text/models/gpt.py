@@ -1,0 +1,200 @@
+"""GPT model family — the flagship (BASELINE config 4: GPT-3 1.3B hybrid
+parallel).
+
+A from-scratch decoder-only transformer built on the TP layer library: QKV
+and MLP-up are column-parallel, attention-out and MLP-down are row-parallel
+(Megatron sharding over the 'mp' mesh axis), attention runs through the
+Pallas flash-attention op, and the lm head is the (optionally tied)
+vocab-parallel projection with parallel cross-entropy. Compare the
+reference's fleet GPT cases (test/collective/fleet hybrid_parallel_mp_model /
+pp_model) which assemble the same structure from mp_layers.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ... import nn
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import ParamAttr
+from ...distributed.fleet.layers.mpu.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy, _constrain, MP_AXIS)
+from ...ops import flash_attention
+
+__all__ = ["GPTConfig", "GPT", "GPTForCausalLM", "gpt3_1p3b", "gpt_tiny"]
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 2048
+    num_layers: int = 24
+    num_heads: int = 16
+    max_position_embeddings: int = 2048
+    intermediate_size: Optional[int] = None  # default 4*hidden
+    hidden_dropout: float = 0.0
+    attention_dropout: float = 0.0
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    use_flash_attention: bool = True
+    tie_word_embeddings: bool = True
+    sequence_parallel: bool = False
+    recompute: bool = False
+
+    @property
+    def ffn_size(self) -> int:
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+def gpt3_1p3b(**overrides) -> "GPTConfig":
+    """GPT-3 XL / 1.3B: 24 layers, d=2048, 16 heads."""
+    return GPTConfig(**{**dict(hidden_size=2048, num_layers=24, num_heads=16),
+                        **overrides})
+
+
+def gpt_tiny(**overrides) -> "GPTConfig":
+    return GPTConfig(**{**dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                               num_heads=4, max_position_embeddings=256),
+                        **overrides})
+
+
+def _init_attr(cfg: GPTConfig, spec=None) -> ParamAttr:
+    return ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range),
+                     partition_spec=spec)
+
+
+class GPTAttention(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_heads
+        self.head_dim = cfg.hidden_size // cfg.num_heads
+        h = cfg.hidden_size
+        self.qkv_proj = ColumnParallelLinear(
+            h, 3 * h, weight_attr=_init_attr(cfg), has_bias=True,
+            gather_output=False)
+        self.out_proj = RowParallelLinear(
+            h, h, weight_attr=_init_attr(cfg), has_bias=True,
+            input_is_parallel=True)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, x):
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x)  # [b, s, 3h] (h sharded over mp)
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        # Keep heads sharded over mp: heads dim = mp * local_heads.
+        qkv = _constrain(qkv, P(None, None, None, MP_AXIS, None))
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if self.cfg.use_flash_attention:
+            out = flash_attention(q, k, v, dropout=self.cfg.attention_dropout,
+                                  causal=True, training=self.training)
+        else:
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.cfg.attention_dropout,
+                training=self.training)
+        out = out.reshape(b, s, h)
+        out = self.out_proj(out)
+        return self.dropout(out)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.up = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_size,
+                                       weight_attr=_init_attr(cfg),
+                                       gather_output=False)
+        self.down = RowParallelLinear(cfg.ffn_size, cfg.hidden_size,
+                                      weight_attr=_init_attr(cfg),
+                                      input_is_parallel=True)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, x):
+        x = self.up(x)
+        x = F.gelu(x, approximate=True)
+        x = self.down(x)
+        return self.dropout(x)
+
+
+class GPTBlock(nn.Layer):
+    """Pre-LN decoder block."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ln_1 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.mlp = GPTMLP(cfg)
+
+    def _inner(self, x):
+        if self.cfg.sequence_parallel:
+            from ...distributed.fleet.utils.sequence_parallel_utils import \
+                sequence_parallel_constraint
+            x = sequence_parallel_constraint(x)
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+    def forward(self, x):
+        if self.cfg.recompute and self.training:
+            return jax.checkpoint(self._inner,
+                                  policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)(x)
+        return self._inner(x)
+
+
+class GPT(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.wte = VocabParallelEmbedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=_init_attr(cfg, P(MP_AXIS, None)))
+        self.wpe = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            weight_attr=_init_attr(cfg))
+        self.drop = nn.Dropout(cfg.hidden_dropout)
+        self.h = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.num_layers)])
+        self.ln_f = nn.LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        pos = jnp.arange(s)[None, :]
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPT(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, weight_attr=_init_attr(cfg),
+                has_bias=False, gather_output=False)
+        self.loss_fn = ParallelCrossEntropy()
+
+    def logits(self, hidden):
+        if self.cfg.tie_word_embeddings:
+            w = self.gpt.wte.weight  # [vocab(mp-sharded), hidden]
+            logits = jnp.matmul(hidden, w.T)
+            return _constrain(logits, P(None, None, MP_AXIS))
+        return self.lm_head(hidden)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        logits = self.logits(hidden)
+        if labels is None:
+            return logits
+        loss = self.loss_fn(logits, labels)
+        return jnp.mean(loss)

@@ -1,0 +1,68 @@
+"""Vision datasets (ref: python/paddle/vision/datasets/). Network download is
+unavailable in this environment, so MNIST supports a synthetic mode used by
+tests/benchmarks; with a local `image_path`/`label_path` it reads the standard
+IDX files."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional
+
+import numpy as np
+
+from ...io.dataset import Dataset
+
+__all__ = ["MNIST", "FashionMNIST"]
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path: Optional[str] = None,
+                 label_path: Optional[str] = None, mode: str = "train",
+                 transform=None, download: bool = False,
+                 backend: str = "numpy", synthetic_size: Optional[int] = None):
+        self.transform = transform
+        if image_path and os.path.exists(image_path):
+            self.images = self._read_idx_images(image_path)
+            self.labels = self._read_idx_labels(label_path)
+        else:
+            # Synthetic fallback: deterministic pseudo-MNIST. Class
+            # prototypes are shared across train/test (fixed seed) so
+            # generalization is measurable; noise/labels differ per split.
+            n = synthetic_size or (6000 if mode == "train" else 1000)
+            base = np.random.default_rng(12345).standard_normal(
+                (10, 28, 28)).astype(np.float32)
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            self.labels = rng.integers(0, 10, size=(n,)).astype(np.int64)
+            noise = 0.3 * rng.standard_normal((n, 28, 28)).astype(np.float32)
+            self.images = base[self.labels] + noise
+
+    @staticmethod
+    def _read_idx_images(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return (data.reshape(n, rows, cols).astype(np.float32) / 255.0)
+
+    @staticmethod
+    def _read_idx_labels(path):
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "rb") as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx][None, :, :]  # CHW
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass

@@ -1,0 +1,3 @@
+from .lenet import LeNet  # noqa: F401
+from .resnet import (ResNet, resnet18, resnet34, resnet50,  # noqa: F401
+                     resnet101, resnet152)

@@ -1,0 +1,131 @@
+"""Vision transforms (ref: python/paddle/vision/transforms/) — numpy host-side
+preprocessing; heavy augmentation pipelines belong in the input pipeline, not
+on the TPU."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+__all__ = ["Compose", "Normalize", "ToTensor", "Resize", "RandomHorizontalFlip",
+           "RandomCrop", "CenterCrop", "Transpose"]
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        self.mean = np.asarray(mean, dtype=np.float32)
+        self.std = np.asarray(std, dtype=np.float32)
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
+        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        img = np.asarray(img, dtype=np.float32)
+        if img.max() > 1.0:
+            img = img / 255.0
+        if img.ndim == 2:
+            img = img[None] if self.data_format == "CHW" else img[..., None]
+        elif self.data_format == "CHW" and img.shape[-1] in (1, 3, 4):
+            img = img.transpose(2, 0, 1)
+        return img
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        try:
+            import PIL.Image
+            if isinstance(img, PIL.Image.Image):
+                return np.asarray(img.resize(self.size[::-1]))
+        except ImportError:
+            pass
+        # nearest-neighbor numpy resize
+        img = np.asarray(img)
+        h, w = img.shape[-2:] if img.ndim == 3 and img.shape[0] in (1, 3, 4) \
+            else img.shape[:2]
+        oh, ow = self.size
+        ys = (np.arange(oh) * h / oh).astype(int)
+        xs = (np.arange(ow) * w / ow).astype(int)
+        if img.ndim == 3 and img.shape[0] in (1, 3, 4):
+            return img[:, ys][:, :, xs]
+        return img[ys][:, xs]
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+        self._rng = np.random.default_rng()
+
+    def __call__(self, img):
+        if self._rng.random() < self.prob:
+            return np.asarray(img)[..., ::-1].copy()
+        return img
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self._rng = np.random.default_rng()
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        if self.padding:
+            pad = [(0, 0)] * img.ndim
+            if chw:
+                pad[1] = pad[2] = (self.padding, self.padding)
+            else:
+                pad[0] = pad[1] = (self.padding, self.padding)
+            img = np.pad(img, pad, mode="constant")
+        h, w = img.shape[1:3] if chw else img.shape[:2]
+        th, tw = self.size
+        i = self._rng.integers(0, h - th + 1)
+        j = self._rng.integers(0, w - tw + 1)
+        if chw:
+            return img[:, i:i + th, j:j + tw]
+        return img[i:i + th, j:j + tw]
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        img = np.asarray(img)
+        chw = img.ndim == 3 and img.shape[0] in (1, 3, 4)
+        h, w = img.shape[1:3] if chw else img.shape[:2]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        if chw:
+            return img[:, i:i + th, j:j + tw]
+        return img[i:i + th, j:j + tw]
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return np.asarray(img).transpose(self.order)

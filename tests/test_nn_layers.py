@@ -1,0 +1,169 @@
+"""Layer system + op tests vs numpy references (the OpTest analog,
+ref test/legacy_test/eager_op_test.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.framework.functional import functional_call, get_params
+
+
+def test_linear_matches_numpy():
+    l = nn.Linear(8, 4)
+    x = np.random.randn(3, 8).astype(np.float32)
+    w = np.asarray(l.weight)
+    b = np.asarray(l.bias)
+    np.testing.assert_allclose(np.asarray(l(jnp.asarray(x))), x @ w + b,
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_parameter_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 4)
+            self.fc2 = nn.Linear(4, 2, bias_attr=False)
+            self.register_buffer("counter", jnp.zeros(()))
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight"]
+    sd = net.state_dict()
+    assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "counter"}
+
+    # round-trip
+    sd2 = {k: np.asarray(v) * 0 + 1 for k, v in sd.items()}
+    net.set_state_dict(sd2)
+    np.testing.assert_allclose(np.asarray(net.fc1.weight),
+                               np.ones((4, 4)), rtol=0)
+
+
+def test_train_eval_mode_dropout():
+    d = nn.Dropout(0.5)
+    x = jnp.ones((100, 100))
+    y_train = d(x)
+    assert float(jnp.mean(y_train == 0)) > 0.3
+    d.eval()
+    np.testing.assert_array_equal(np.asarray(d(x)), np.asarray(x))
+
+
+def test_conv2d_matches_numpy():
+    conv = nn.Conv2D(2, 3, 3, padding=1)
+    x = np.random.randn(1, 2, 5, 5).astype(np.float32)
+    out = np.asarray(conv(jnp.asarray(x)))
+    # naive numpy conv reference
+    w = np.asarray(conv.weight)
+    b = np.asarray(conv.bias)
+    xp = np.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)))
+    ref = np.zeros((1, 3, 5, 5), np.float32)
+    for oc in range(3):
+        for i in range(5):
+            for j in range(5):
+                ref[0, oc, i, j] = np.sum(xp[0, :, i:i + 3, j:j + 3] * w[oc]) + b[oc]
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_batch_norm_running_stats():
+    bn = nn.BatchNorm2D(3)
+    x = jnp.asarray(np.random.randn(4, 3, 8, 8).astype(np.float32) * 2 + 1)
+    bn.train()
+    _ = bn(x)
+    # running stats moved toward batch stats
+    assert not np.allclose(np.asarray(bn._mean), 0.0)
+    bn.eval()
+    y = bn(x)
+    assert y.shape == x.shape
+
+
+def test_layer_norm_matches_numpy():
+    ln = nn.LayerNorm(16)
+    x = np.random.randn(4, 16).astype(np.float32)
+    out = np.asarray(ln(jnp.asarray(x)))
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mu) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_cross_entropy_matches_numpy():
+    logits = np.random.randn(8, 5).astype(np.float32)
+    labels = np.random.randint(0, 5, (8,))
+    out = float(F.cross_entropy(jnp.asarray(logits), jnp.asarray(labels)))
+    # numpy reference
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    ref = -np.log(p[np.arange(8), labels]).mean()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_functional_call_purity():
+    net = nn.Linear(4, 4)
+    params = get_params(net)
+    orig = np.asarray(net.weight).copy()
+    new_params = {k: v * 2 for k, v in params.items()}
+    x = jnp.ones((1, 4))
+    out_new = functional_call(net, new_params, x)
+    # layer unchanged afterwards
+    np.testing.assert_array_equal(np.asarray(net.weight), orig)
+    out_orig = net(x)
+    np.testing.assert_allclose(np.asarray(out_new),
+                               np.asarray(out_orig * 2) - np.asarray(net.bias),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_grad_check_linear():
+    """Numeric-gradient check (the reference OpTest check_grad analog)."""
+    net = nn.Linear(3, 2)
+    x = jnp.asarray(np.random.randn(4, 3).astype(np.float32))
+    params = get_params(net)
+
+    def loss(p):
+        return jnp.sum(functional_call(net, p, x) ** 2)
+
+    grads = jax.grad(loss)(params)
+    eps = 1e-3
+    for name in params:
+        p0 = params[name]
+        idx = 0
+        plus = np.asarray(p0).reshape(-1).copy()
+        plus[idx] += eps
+        minus = np.asarray(p0).reshape(-1).copy()
+        minus[idx] -= eps
+        # fresh buffers per perturbation (jnp.asarray may alias numpy memory)
+        p_plus = {**params, name: jnp.asarray(plus.reshape(p0.shape))}
+        p_minus = {**params, name: jnp.asarray(minus.reshape(p0.shape))}
+        num = (float(loss(p_plus)) - float(loss(p_minus))) / (2 * eps)
+        ana = float(np.asarray(grads[name]).reshape(-1)[idx])
+        np.testing.assert_allclose(ana, num, rtol=1e-2, atol=1e-2)
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    assert len(s) == 3
+    out = s(jnp.ones((1, 4)))
+    assert out.shape == (1, 2)
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(list(ll.parameters())) == 6
+
+
+def test_astype_bf16():
+    net = nn.Linear(4, 4)
+    net.astype(paddle.bfloat16)
+    assert net.weight.dtype == jnp.bfloat16
+    out = net(jnp.ones((2, 4), jnp.bfloat16))
+    assert out.dtype == jnp.bfloat16
+
+
+def test_initializers_reproducible():
+    paddle.seed(7)
+    a = nn.Linear(16, 16)
+    paddle.seed(7)
+    b = nn.Linear(16, 16)
+    np.testing.assert_array_equal(np.asarray(a.weight), np.asarray(b.weight))
