@@ -1,0 +1,127 @@
+"""Driver benchmark: GPT causal-LM training throughput on one chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+Workload: BASELINE config 4's per-chip slice — a GPT decoder LM trained with
+AdamW, bf16 compute + fp32 master weights (AMP O2), flash-attention Pallas
+kernel, remat on every block. The reference publishes no numbers
+(BASELINE.md), so ``vs_baseline`` reports measured MFU / 0.40 — 0.40 MFU
+being the strong H100+NCCL Megatron-class utilization the north star asks us
+to match per chip (raw FLOPs differ per accelerator; utilization is the
+comparable quantity).
+
+Env overrides: BENCH_LAYERS, BENCH_HIDDEN, BENCH_HEADS, BENCH_SEQ,
+BENCH_BATCH, BENCH_STEPS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import functional_call, get_params
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    small = os.environ.get("BENCH_SMALL") == "1"  # CPU smoke mode
+    layers = int(os.environ.get("BENCH_LAYERS", 2 if small else 16))
+    hidden = int(os.environ.get("BENCH_HIDDEN", 128 if small else 1024))
+    heads = int(os.environ.get("BENCH_HEADS", 4 if small else 16))
+    seq = int(os.environ.get("BENCH_SEQ", 128 if small else 1024))
+    batch = int(os.environ.get("BENCH_BATCH", 2 if small else 8))
+    steps = int(os.environ.get("BENCH_STEPS", 2 if small else 10))
+    vocab = 512 if small else 50304
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=seq,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    recompute=True)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    # AMP O2: bf16 params/compute, fp32 master weights in the optimizer.
+    model.astype(paddle.bfloat16)
+    opt = AdamW(learning_rate=1e-4, weight_decay=0.01, multi_precision=True)
+
+    params = get_params(model)
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+    opt_state = opt.init(params)
+
+    def loss_fn(p, ids, labels):
+        return functional_call(model, p, ids, labels, training=True)
+
+    @jax.jit
+    def step(p, st, ids, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+        new_p, new_st = opt.apply_gradients(p, grads, st, 1e-4)
+        return loss, new_p, new_st
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1), jnp.int32)
+
+    # Compile + warmup (2 steps), then timed steps.
+    loss, params, opt_state = step(params, opt_state, ids, labels)
+    loss.block_until_ready()
+    loss, params, opt_state = step(params, opt_state, ids, labels)
+    loss.block_until_ready()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, params, opt_state = step(params, opt_state, ids, labels)
+    loss.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    # Model FLOPs per token: 6N (fwd+bwd matmuls) + causal attention
+    # 12*L*seq*hidden/2 (QK^T + PV, fwd+bwd, halved by causal masking).
+    flops_per_token = 6 * n_params + 6 * layers * seq * hidden
+    achieved = tokens_per_sec * flops_per_token
+    dev = jax.devices()[0]
+    peak = _peak_flops(dev)
+    mfu = achieved / peak if peak else 0.0
+    vs_baseline = mfu / 0.40 if peak else 0.0
+
+    print(json.dumps({
+        "metric": f"gpt_{n_params/1e6:.0f}M_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(vs_baseline, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "loss": float(loss),
+            "n_params": n_params,
+            "config": {"layers": layers, "hidden": hidden, "heads": heads,
+                       "seq": seq, "batch": batch, "steps": steps},
+            "device": str(dev),
+            "step_ms": round(1000 * dt / steps, 2),
+        },
+    }))
+
+
+def _peak_flops(dev) -> float:
+    """Peak bf16 FLOPs for the chip (v5e default; override BENCH_PEAK_TFLOPS)."""
+    env = os.environ.get("BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env) * 1e12
+    kind = getattr(dev, "device_kind", "").lower()
+    table = {"v5 lite": 197e12, "v5e": 197e12, "v4": 275e12,
+             "v5p": 459e12, "v6e": 918e12, "v6 lite": 918e12}
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+if __name__ == "__main__":
+    main()

@@ -108,9 +108,11 @@ def functional_call(model: Layer, params: Optional[Dict[str, Any]],
     The model's own state is always restored afterwards, so tracer values
     never leak into the persistent Layer tree.
     """
-    if mutable and buffers is None:
-        # Snapshot all buffers so in-forward writes (tracers!) are captured
-        # into the return value but never persist in the Layer tree.
+    if buffers is None:
+        # Always snapshot buffers: in-forward writes (BatchNorm running
+        # stats) may be tracers, and must never persist in the Layer tree
+        # after the call — with mutable=True they're captured into the
+        # return value instead.
         buffers = dict(model.named_buffers())
     mode_set = training is not None
     prev_modes = {}

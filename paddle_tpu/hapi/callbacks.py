@@ -125,7 +125,13 @@ class LRSchedulerCallback(Callback):
     def on_train_batch_end(self, step, logs=None):
         sched = self._sched()
         if self.by_step and sched is not None:
-            sched.step()
+            # Step per *optimizer update*, not per micro-batch: under
+            # gradient accumulation only batches that applied an update
+            # advance the schedule.
+            count = getattr(self.model, "_step_count", None)
+            if count is None or count != getattr(self, "_last_step_count", None):
+                sched.step()
+                self._last_step_count = count
 
     def on_epoch_end(self, epoch, logs=None):
         sched = self._sched()

@@ -57,9 +57,14 @@ class Model:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._predict_fn = None
+        self._grad_step_fn = None
+        self._apply_step_fn = None
         self._opt_state = None
         self._scaler_state = None
         self._step_count = 0
+        self._accum_grads = None
+        self._accum_count = 0
+        self._accum_found_inf = None
 
     # -- setup ---------------------------------------------------------------
 
@@ -83,6 +88,8 @@ class Model:
             decorate(self.network, level="O2")
         self._train_step_fn = None  # force rebuild
         self._eval_step_fn = None
+        self._grad_step_fn = None
+        self._apply_step_fn = None
 
     # -- functional step builders ---------------------------------------------
 
@@ -92,16 +99,16 @@ class Model:
             if isinstance(losses, (tuple, list)) else losses
         return total, losses
 
-    def _build_train_step(self):
+    def _make_grads_fn(self):
+        """Shared gradient-computation closure (AMP autocast, loss scaling,
+        unscale + inf check) used by both the fused train step and the
+        accumulation grad step."""
         net = self.network
-        opt = self._optimizer
         amp_level = self._amp_level
         amp_lists = self._amp_custom_lists
         use_scaler = self._scaler is not None and self._scaler.is_enable()
-        scaler = self._scaler
 
-        def step(params, buffers, opt_state, scaler_state, inputs, labels,
-                 lr, key):
+        def grads_of(params, buffers, scaler_state, inputs, labels, key):
             trainable = {k: v for k, v in params.items()
                          if k in self._trainable_names}
             frozen = {k: v for k, v in params.items()
@@ -111,7 +118,8 @@ class Model:
                 full = {**tp, **frozen}
                 with rng_scope(key):
                     if amp_level in ("O1", "O2"):
-                        with auto_cast(enable=True, level=amp_level, **amp_lists):
+                        with auto_cast(enable=True, level=amp_level,
+                                       **amp_lists):
                             out, new_buf = functional_call(
                                 net, full, *inputs, buffers=buffers,
                                 mutable=True, training=True)
@@ -119,21 +127,36 @@ class Model:
                         out, new_buf = functional_call(
                             net, full, *inputs, buffers=buffers,
                             mutable=True, training=True)
-                total, losses = self._loss_value(out, labels)
-                if use_scaler:
-                    scaled = total * scaler_state["scale"].astype(total.dtype)
-                else:
-                    scaled = total
+                total, _ = self._loss_value(out, labels)
+                scaled = (total * scaler_state["scale"].astype(total.dtype)
+                          if use_scaler else total)
                 return scaled, (total, out, new_buf)
 
             grads, (total, out, new_buf) = jax.grad(
                 loss_fn, has_aux=True)(trainable)
-
             if use_scaler:
-                grads, found_inf = unscale_and_check(grads, scaler_state["scale"])
-                new_scaler_state = scaler.update_state(scaler_state, found_inf)
+                grads, found_inf = unscale_and_check(
+                    grads, scaler_state["scale"])
             else:
                 found_inf = jnp.asarray(False)
+            return trainable, frozen, grads, total, out, new_buf, found_inf
+
+        return grads_of
+
+    def _build_train_step(self):
+        opt = self._optimizer
+        use_scaler = self._scaler is not None and self._scaler.is_enable()
+        scaler = self._scaler
+        grads_of = self._make_grads_fn()
+
+        def step(params, buffers, opt_state, scaler_state, inputs, labels,
+                 lr, key):
+            (trainable, frozen, grads, total, out, new_buf,
+             found_inf) = grads_of(params, buffers, scaler_state, inputs,
+                                   labels, key)
+            if use_scaler:
+                new_scaler_state = scaler.update_state(scaler_state, found_inf)
+            else:
                 new_scaler_state = scaler_state
 
             new_trainable, new_opt_state = opt.apply_gradients(
@@ -151,6 +174,49 @@ class Model:
                     total, out)
 
         return jax.jit(step, donate_argnums=(0, 1, 2, 3))
+
+    def _build_grad_step(self):
+        """Gradient-only step for accumulation (update=False): returns
+        unscaled grads without touching optimizer state."""
+        grads_of = self._make_grads_fn()
+
+        def step(params, buffers, scaler_state, inputs, labels, key):
+            (_, _, grads, total, _, new_buf,
+             found_inf) = grads_of(params, buffers, scaler_state, inputs,
+                                   labels, key)
+            return grads, new_buf, total, found_inf
+
+        return jax.jit(step)
+
+    def _build_apply_step(self):
+        """Apply pre-accumulated grads (the final micro-batch of an
+        accumulation window)."""
+        opt = self._optimizer
+        scaler = self._scaler
+        use_scaler = scaler is not None and scaler.is_enable()
+
+        def step(params, opt_state, scaler_state, grads, lr, denom,
+                 found_inf):
+            trainable = {k: v for k, v in params.items()
+                         if k in self._trainable_names}
+            frozen = {k: v for k, v in params.items()
+                      if k not in self._trainable_names}
+            grads = jax.tree_util.tree_map(lambda g: g / denom, grads)
+            new_trainable, new_opt_state = opt.apply_gradients(
+                trainable, grads, opt_state, lr)
+            if use_scaler:
+                new_scaler_state = scaler.update_state(scaler_state, found_inf)
+                new_trainable = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(found_inf, old, new),
+                    new_trainable, trainable)
+                new_opt_state = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(found_inf, old, new),
+                    new_opt_state, opt_state)
+            else:
+                new_scaler_state = scaler_state
+            return {**new_trainable, **frozen}, new_opt_state, new_scaler_state
+
+        return jax.jit(step)
 
     def _build_eval_step(self):
         net = self.network
@@ -187,21 +253,64 @@ class Model:
             "call prepare(optimizer, loss) first"
         inputs = tuple(jnp.asarray(x) for x in _as_tuple(inputs))
         labels = tuple(jnp.asarray(y) for y in _as_tuple(labels))
-        if self._train_step_fn is None:
-            self._train_step_fn = self._build_train_step()
         self._ensure_state()
         params = get_params(self.network)
         buffers = get_buffers(self.network)
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         key = default_generator().next_key()
-        (new_params, new_buffers, self._opt_state, self._scaler_state,
-         loss, out) = self._train_step_fn(
-            params, buffers, self._opt_state, self._scaler_state,
-            inputs, labels, lr, key)
-        set_params(self.network, new_params)
+
+        accumulating = (not update) or self._accum_grads is not None
+        if not accumulating:
+            # Fast path: fused grad+apply, donated state.
+            if self._train_step_fn is None:
+                self._train_step_fn = self._build_train_step()
+            (new_params, new_buffers, self._opt_state, self._scaler_state,
+             loss, out) = self._train_step_fn(
+                params, buffers, self._opt_state, self._scaler_state,
+                inputs, labels, lr, key)
+            set_params(self.network, new_params)
+            set_buffers(self.network, new_buffers)
+            self._step_count += 1
+            return np.asarray(loss)
+
+        # Accumulation path (update=False micro-batches, then update=True).
+        if self._grad_step_fn is None:
+            self._grad_step_fn = self._build_grad_step()
+        grads, new_buffers, loss, found_inf = self._grad_step_fn(
+            params, buffers, self._scaler_state, inputs, labels, key)
         set_buffers(self.network, new_buffers)
-        self._step_count += 1
+        if self._accum_grads is None:
+            self._accum_grads, self._accum_count = grads, 1
+            self._accum_found_inf = found_inf
+        else:
+            self._accum_grads = jax.tree_util.tree_map(
+                jnp.add, self._accum_grads, grads)
+            self._accum_count += 1
+            self._accum_found_inf = jnp.logical_or(
+                self._accum_found_inf, found_inf)
+        if update:
+            self._flush_accumulated()
         return np.asarray(loss)
+
+    def _flush_accumulated(self) -> None:
+        """Apply any pending accumulated gradients (end of an accumulation
+        window, or a partial window at epoch/train end)."""
+        if self._accum_grads is None:
+            return
+        if self._apply_step_fn is None:
+            self._apply_step_fn = self._build_apply_step()
+        params = get_params(self.network)
+        lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
+        denom = jnp.asarray(float(self._accum_count), jnp.float32)
+        new_params, self._opt_state, self._scaler_state = \
+            self._apply_step_fn(params, self._opt_state,
+                                self._scaler_state, self._accum_grads,
+                                lr, denom, self._accum_found_inf)
+        set_params(self.network, new_params)
+        self._accum_grads = None
+        self._accum_count = 0
+        self._accum_found_inf = None
+        self._step_count += 1
 
     def eval_batch(self, inputs, labels=None):
         inputs = tuple(jnp.asarray(x) for x in _as_tuple(inputs))
@@ -269,7 +378,8 @@ class Model:
             for step, batch in enumerate(loader):
                 cbks.on_train_batch_begin(step)
                 inputs, labels = self._split_batch(batch)
-                loss = self.train_batch(inputs, labels)
+                update = (step + 1) % max(1, accumulate_grad_batches) == 0
+                loss = self.train_batch(inputs, labels, update=update)
                 logs["loss"] = loss
                 logs["lr"] = self._optimizer.get_lr()
                 cbks.on_train_batch_end(step, logs)
@@ -277,6 +387,10 @@ class Model:
                 if num_iters is not None and iters_done >= num_iters:
                     self.stop_training = True
                     break
+            # Partial accumulation window at epoch end: apply it rather than
+            # leaking micro-batch grads into the next epoch (or dropping them
+            # at train end).
+            self._flush_accumulated()
             cbks.on_epoch_end(epoch, logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_logs = self.evaluate(eval_loader, verbose=0,

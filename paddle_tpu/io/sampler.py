@@ -126,7 +126,11 @@ class DistributedBatchSampler(BatchSampler):
             rng = np.random.default_rng(self.epoch)
             indices = rng.permutation(n)
             self.epoch += 1
-        indices = np.concatenate([indices, indices[: self.total_size - n]])
+        if self.total_size > n:
+            # Wrap-around padding (repeat as often as needed so every rank
+            # gets exactly num_samples indices even when nranks > n).
+            reps = -(-self.total_size // n)
+            indices = np.tile(indices, reps)[: self.total_size]
         local = indices[self.local_rank:self.total_size:self.nranks].tolist()
         batch = []
         for idx in local:

@@ -471,9 +471,17 @@ def pad(x, pad_width, mode: str = "constant", value: float = 0.0,
     else:
         assert len(pad_width) % 2 == 0
         n_spatial = len(pad_width) // 2
-        widths = [(0, 0)] * (x.ndim - n_spatial)
-        spatial = [(pad_width[2 * i], pad_width[2 * i + 1]) for i in range(n_spatial)]
-        widths = widths + spatial
+        # Flat form pads the spatial dims, minor-most first: pad[0:2] is
+        # (left, right) on W, pad[2:4] (top, bottom) on H, … For channels-
+        # last formats the spatial dims sit between batch and channel.
+        channels_last = data_format.endswith("C") and x.ndim > 2
+        if channels_last:
+            spatial_dims = list(range(x.ndim - 2, x.ndim - 2 - n_spatial, -1))
+        else:
+            spatial_dims = list(range(x.ndim - 1, x.ndim - 1 - n_spatial, -1))
+        widths = [(0, 0)] * x.ndim
+        for i, dim in enumerate(spatial_dims):
+            widths[dim] = (pad_width[2 * i], pad_width[2 * i + 1])
     kw = {"constant_values": value} if mode == "constant" else {}
     jmode = {"constant": "constant", "reflect": "reflect",
              "replicate": "edge", "circular": "wrap"}[mode]
@@ -508,7 +516,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if is_causal:
-        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool))
+        # Bottom-right aligned for sq != sk (KV-cache decode), matching
+        # flash-attention semantics and ops.flash_attention.reference.
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), sk - sq)
         scores = jnp.where(mask, scores, -jnp.inf)
     if attn_mask is not None:
         if attn_mask.dtype == jnp.bool_:

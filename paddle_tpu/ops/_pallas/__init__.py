@@ -1,0 +1,7 @@
+"""Pallas TPU kernels — the hand-written hot ops.
+
+The reference vendors CUTLASS flash-attention and hand-fused CUDA kernels
+(``paddle/phi/kernels/gpu/flash_attn_kernel.cu``, ``fluid/operators/fused/``).
+Here the equivalents are Pallas kernels tiled for the MXU; everything else is
+left to XLA fusion.
+"""

@@ -1,0 +1,326 @@
+"""Flash attention as Pallas TPU kernels (fwd + bwd).
+
+Online-softmax blocked attention (Dao et al.) tiled for the MXU: 128-row
+query blocks stream over 128-row key/value blocks held in VMEM, keeping the
+full [S, S] score matrix out of HBM. Backward recomputes probabilities from
+the saved logsumexp (no O(S^2) residuals), split into a dq kernel (grid over
+query blocks) and a dk/dv kernel (grid over key blocks) so each output is
+accumulated by exactly one program — no atomics.
+
+Reference parity: ``paddle/phi/kernels/gpu/flash_attn_kernel.cu:324``
+(FlashAttnKernel → vendored CUTLASS flash-attn). Layout in/out is paddle's
+[batch, seq, heads, head_dim]; internally [batch*heads, seq, head_dim].
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention_pallas"]
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+LANES = 128  # minor-dim tile width; lse/delta are broadcast across it
+NEG_INF = -1e30
+
+
+def _causal_mask(s, qi, kj, block_q, block_k, offset):
+    """Bottom-right-aligned causal mask (flash-attn semantics for sq != sk:
+    query i attends keys <= i + sk - sq)."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, dimension=0)
+    k_pos = kj * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, dimension=1)
+    return jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_q, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    bq, d = q.shape
+
+    num_k = seq_k // block_k
+    offset = seq_k - seq_q
+    if causal:
+        # Only key blocks intersecting the causal band of this query block.
+        limit = jax.lax.div((qi + 1) * block_q + offset + block_k - 1,
+                            block_k)
+        limit = jnp.clip(limit, 0, num_k)
+    else:
+        limit = num_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, qi, j, block_q, block_k, offset)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l, acc
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, limit, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    # lse broadcast across the 128-lane minor dim (TPU tiling: the last two
+    # block dims must be (8k, 128); same layout as jax's reference kernel).
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape[1:])
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq] fp32)."""
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    grid = (bh, sq // block_q)
+    kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k, seq_q=sq,
+                             seq_k=sk)
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
+        ],
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * sq * sk * d // (2 if causal else 1),
+            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+            transcendentals=bh * sq * sk // block_k,
+        ),
+    )(q, k, v)
+    return o, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_q, block_k, seq_q, seq_k):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, 0:1]        # [bq, 1]
+    delta = delta_ref[0][:, 0:1]    # [bq, 1]
+    bq, d = q.shape
+
+    num_k = seq_k // block_k
+    offset = seq_k - seq_q
+    if causal:
+        limit = jnp.clip(
+            jax.lax.div((qi + 1) * block_q + offset + block_k - 1, block_k),
+            0, num_k)
+    else:
+        limit = num_k
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, j, block_q, block_k, offset)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, limit, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                    seq_q, seq_k):
+    kj = pl.program_id(1)
+    kb = k_ref[0].astype(jnp.float32)  # [bk, d]
+    vb = v_ref[0].astype(jnp.float32)
+    bk, d = kb.shape
+
+    num_q = seq_q // block_q
+    offset = seq_k - seq_q
+    if causal:
+        # First query block whose causal band reaches this key block.
+        start = jnp.clip(jax.lax.div(kj * block_k - offset, block_q),
+                         0, num_q)
+    else:
+        start = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0:1]
+        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0:1]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            s = _causal_mask(s, i, kj, block_q, block_k, offset)
+        p = jnp.exp(s - lse)
+        dv = dv + jax.lax.dot_general(
+            p, dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            dob, vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk = dk + jax.lax.dot_general(
+            ds, qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, num_q, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)  # [BH, Sq]
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq, LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq,
+                          seq_k=sk),
+        grid=(bh, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_q=sq,
+                          seq_k=sk),
+        grid=(bh, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, sq, LANES), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+    )(k, v, q, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom_vjp wrapper, [B, S, H, D] public layout
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k)
+    return dq, dk, dv
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def supported_shapes(query, key) -> bool:
+    """True when the kernels handle these shapes (caller falls back else)."""
+    sq, sk = query.shape[1], key.shape[1]
+    d = query.shape[3]
+    return sq % 128 == 0 and sk % 128 == 0 and d in (64, 128, 256)
+
+
+def flash_attention_pallas(query, key, value, causal: bool = False,
+                           scale: Optional[float] = None,
+                           block_q: int = DEFAULT_BLOCK_Q,
+                           block_k: int = DEFAULT_BLOCK_K):
+    """[B, S, H, D] flash attention via Pallas. Differentiable."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    if sq % min(block_q, sq) or sk % min(block_k, sk):
+        raise ValueError(
+            f"flash_attention_pallas needs seq lengths divisible by the "
+            f"block sizes; got sq={sq}, sk={sk} (use supported_shapes())")
+    hk = key.shape[2]
+    if hk != h:  # grouped-query: broadcast kv heads
+        rep = h // hk
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def to_bhsd(x, s):
+        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+    q = to_bhsd(query, sq)
+    k = to_bhsd(key, sk)
+    v = to_bhsd(value, sk)
+    o = _flash_bhsd(q, k, v, float(scale), bool(causal), block_q, block_k)
+    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -44,7 +44,7 @@ def reference_attention(q, k, v, causal: bool = False,
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _use_pallas(q) -> bool:
+def _use_pallas(q, k) -> bool:
     if not flags.flag("use_pallas_kernels"):
         return False
     try:
@@ -54,9 +54,10 @@ def _use_pallas(q) -> bool:
         platform = jax.default_backend()
     if platform not in ("tpu", "axon"):
         return False
-    b, s, h, d = q.shape
-    # MXU-friendly shapes only; else reference path.
-    return s % 128 == 0 and d in (64, 128, 256)
+    # MXU-friendly shapes only (both seq lens tile-divisible); else the
+    # reference path — the kernel would silently drop tail keys otherwise.
+    from ._pallas.flash_attention import supported_shapes
+    return supported_shapes(q, k)
 
 
 def flash_attention(query, key, value, dropout: float = 0.0,
@@ -71,7 +72,7 @@ def flash_attention(query, key, value, dropout: float = 0.0,
         out = reference_attention(query, key, value, causal, scale)
         from ..nn.functional import dropout as F_dropout
         return F_dropout(out, dropout, training=True)
-    if _use_pallas(query):
+    if _use_pallas(query, key):
         from ._pallas.flash_attention import flash_attention_pallas
         return flash_attention_pallas(query, key, value, causal=causal,
                                       scale=scale)
