@@ -1,0 +1,162 @@
+"""Context parallelism: ring attention + Ulysses (sequence all-to-all).
+
+The reference snapshot has NO long-context CP (SURVEY §5: only the 'sep'
+topology axis, batched p2p, and FlashAttention exist as building blocks);
+the TPU build makes CP first-class:
+
+- **Ring attention** (`ring_attention`): queries stay put, key/value blocks
+  rotate around the ICI ring via ``lax.ppermute`` (one neighbor hop per
+  step — the pattern bidirectional ICI is built for). Each step computes a
+  blockwise attention against the resident kv block and merges with the
+  flash-attention online-softmax rule, so memory is O(S/N) per chip and the
+  permute overlaps with the block compute. Causal blocks strictly above the
+  diagonal contribute zero work for XLA to schedule (their products are
+  masked; the collective schedule stays uniform — the SPMD idiom).
+- **Ulysses** (`ulysses_attention`): all-to-all converts sequence sharding
+  to head sharding, runs dense/flash attention on full sequences for the
+  local heads, and converts back (two a2a hops; better for small N and many
+  heads, ref DeepSpeed-Ulysses).
+
+Both run inside partial-manual ``jax.shard_map`` over the ``sep`` axis only,
+so TP ('mp') and DP axes continue to be handled by GSPMD around them.
+Layout: [batch, seq, heads, head_dim] (paddle flash_attn layout).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["ring_attention", "ulysses_attention", "SEP_AXIS"]
+
+SEP_AXIS = "sep"
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, scale, causal, q_off, k_off):
+    """One q-block vs one kv-block, returning unnormalized flash partials.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]. Returns (acc [B,Sq,H,D] f32,
+    m [B,Sq,H] f32 rowmax, l [B,Sq,H] f32 rowsum)."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal is not None:
+        sq, sk = q.shape[1], k.shape[1]
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        k_pos = k_off + lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        allowed = (q_pos >= k_pos)[None, None]
+        s = jnp.where(allowed, s, NEG_INF)
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[..., None]) * allowed
+    else:
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # [B,H,Sq] -> [B,Sq,H]
+    return acc, m.transpose(0, 2, 1), l.transpose(0, 2, 1)
+
+
+def _merge(m, l, acc, m_b, l_b, acc_b):
+    m_new = jnp.maximum(m, m_b)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(m_b - m_new)
+    l_new = alpha * l + beta * l_b
+    acc_new = alpha[..., None] * acc + beta[..., None] * acc_b
+    return m_new, l_new, acc_new
+
+
+def ring_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
+                   causal: bool = False, scale: Optional[float] = None,
+                   remat: bool = True):
+    """[B, S, H, D] attention with S sharded over `axis` (ICI ring CP).
+
+    Inputs/outputs are GLOBAL arrays; the seq dim is sharded over the sep
+    axis inside. Equivalent to full (flash) attention over the global
+    sequence."""
+    if mesh is None:
+        from .topology import get_hybrid_mesh
+        mesh = get_hybrid_mesh()
+    n = mesh.shape[axis]
+    b, s_global, h, d = query.shape
+    scale_ = scale if scale is not None else 1.0 / math.sqrt(d)
+    if n == 1:
+        from ..ops.flash_attention import flash_attention
+        return flash_attention(query, key, value, causal=causal, scale=scale)
+    s_local = s_global // n
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def fn(q, k, v):
+        rank = lax.axis_index(axis)
+        q_off = rank * s_local
+
+        def step_fn(carry, i):
+            k_blk, v_blk, m, l, acc = carry
+            src = (rank - i) % n  # which global kv block is resident now
+            k_off = src * s_local
+            blk = functools.partial(
+                _block_attn, scale=scale_, causal=causal if causal else None)
+            if remat:
+                blk = jax.checkpoint(blk)
+            acc_b, m_b, l_b = blk(q, k_blk, v_blk, q_off=q_off, k_off=k_off)
+            m, l, acc = _merge(m, l, acc, m_b, l_b, acc_b)
+            k_blk = lax.ppermute(k_blk, axis, perm)
+            v_blk = lax.ppermute(v_blk, axis, perm)
+            return (k_blk, v_blk, m, l, acc), None
+
+        m0 = jnp.full((b, s_local, h), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, s_local, h), jnp.float32)
+        a0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+        m0, l0, a0 = (lax.pcast(x, (axis,), to="varying")
+                      for x in (m0, l0, a0))
+        (_, _, m, l, acc), _ = lax.scan(
+            step_fn, (k, v, m0, l0, a0), jnp.arange(n))
+        out = acc / jnp.maximum(l, 1e-30)[..., None]
+        return out.astype(query.dtype)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis},
+                         check_vma=True)(query, key, value)
+
+
+def ulysses_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
+                      causal: bool = False, scale: Optional[float] = None):
+    """[B, S, H, D] attention, S sharded over `axis`: all-to-all to head
+    sharding, full-sequence attention on local heads, all-to-all back
+    (DeepSpeed-Ulysses; needs heads % axis_size == 0)."""
+    if mesh is None:
+        from .topology import get_hybrid_mesh
+        mesh = get_hybrid_mesh()
+    n = mesh.shape[axis]
+    from ..ops.flash_attention import flash_attention
+    if n == 1:
+        return flash_attention(query, key, value, causal=causal, scale=scale)
+    if query.shape[2] % n:
+        raise ValueError(f"heads {query.shape[2]} not divisible by "
+                         f"{axis}={n}")
+
+    def fn(q, k, v):
+        # local [B, S/N, H, D] -> [B, S, H/N, D]
+        def to_heads(x):
+            return lax.all_to_all(x, axis, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+        def to_seq(x):
+            return lax.all_to_all(x, axis, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+        q, k, v = to_heads(q), to_heads(k), to_heads(v)
+        out = flash_attention(q, k, v, causal=causal, scale=scale)
+        return to_seq(out)
+
+    spec = P(None, axis, None, None)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={axis},
+                         check_vma=True)(query, key, value)

@@ -1,0 +1,286 @@
+"""Compiled SPMD pipeline-parallel schedule.
+
+Reference design: ``fleet/meta_parallel/pipeline_parallel.py:387``
+(forward_backward_pipeline) — an imperative host loop issuing eager NCCL
+send/recv per microbatch (1F1B), with ``PipelineParallelWithInterleave``
+(:822) for virtual stages.
+
+TPU-native design: the schedule is a *single compiled program*. The pipeline
+trunk (homogeneous stages) runs inside ``jax.shard_map`` manual over the
+``pp`` mesh axis (other axes stay GSPMD-auto, so TP/DP/FSDP compose
+untouched): a ``lax.scan`` over ``n_micro + S - 1`` ticks where every tick
+each device applies ITS stage's block to its current microbatch and
+``ppermute``s the activation to the next stage over the ICI ring. Backward is
+``jax.grad`` of the scan — XLA derives the reverse pipeline (the 1F1B
+cooldown) automatically; per-stage ``jax.checkpoint`` gives the 1F1B
+activation-memory profile (each in-flight microbatch saves only its stage
+input). Bubble ticks compute on clipped dummy microbatches and contribute
+zero gradient (standard for compiled pipelines).
+
+Heterogeneous head/tail layers (embedding before the trunk, final norm/head
+after) run OUTSIDE the manual region under plain GSPMD, replicated over pp —
+the idiom used by production TPU pipelining (praxis/MaxText), where only the
+repeated-block trunk is pipelined. A PipelineLayer whose stages cannot be
+made homogeneous falls back to a non-pipelined microbatch-accumulation step
+(correct, not pp-scaled).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..framework.functional import functional_call
+from ..nn.layer import Layer
+
+__all__ = ["spmd_pipeline", "make_pipeline_train_step", "analyze_pipeline"]
+
+PP_AXIS = "pp"
+
+
+# ---------------------------------------------------------------------------
+# Core engine: homogeneous-stage GPipe/1F1B scan over the pp axis.
+# ---------------------------------------------------------------------------
+
+def spmd_pipeline(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                  stacked_params: Any, x_mb: jax.Array, mesh,
+                  pp_axis: str = PP_AXIS, remat: bool = True) -> jax.Array:
+    """Run ``n_micro`` microbatches through ``S`` pipeline stages.
+
+    stage_fn(stage_params, x) -> y with y.shape == x.shape.
+    stacked_params: pytree whose leaves have a leading stage dim [S, ...].
+    x_mb: [n_micro, mb, ...] inputs (outputs of the pre-trunk layers).
+    Returns y_mb [n_micro, mb, ...]: the last stage's outputs, identical to
+    sequentially applying stages 0..S-1 to each microbatch.
+    """
+    S = mesh.shape[pp_axis]
+    n_micro = x_mb.shape[0]
+    total_ticks = n_micro + S - 1
+    body = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def fn(sp, xs):
+        # Manual over pp: sp leaves arrive as [1, ...] (this stage's slice).
+        sp_local = jax.tree_util.tree_map(lambda a: a[0], sp)
+        stage = lax.axis_index(pp_axis)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            recv, outbuf = carry
+            idx = jnp.clip(t, 0, n_micro - 1)
+            x_in = jnp.where(stage == 0, xs[idx], recv)
+            y = body(sp_local, x_in)
+            # Last stage finishes microbatch (t - S + 1) at tick t.
+            oidx = jnp.clip(t - (S - 1), 0, n_micro - 1)
+            collect = jnp.logical_and(t >= S - 1, stage == S - 1)
+            outbuf = jnp.where(
+                collect, lax.dynamic_update_index_in_dim(outbuf, y, oidx, 0),
+                outbuf)
+            send = lax.ppermute(y, pp_axis, perm)
+            return (send, outbuf), None
+
+        # Carry values vary per pp rank — mark the invariant zeros as varying
+        # so the scan carry types stay fixed.
+        init = (lax.pcast(jnp.zeros_like(xs[0]), (pp_axis,), to="varying"),
+                lax.pcast(jnp.zeros_like(xs), (pp_axis,), to="varying"))
+        (_, outbuf), _ = lax.scan(tick, init, jnp.arange(total_ticks))
+        # Valid only on the last stage; replicate across pp so downstream
+        # (GSPMD-auto) layers see a consistent value.
+        outbuf = lax.psum(
+            jnp.where(stage == S - 1, outbuf, jnp.zeros_like(outbuf)),
+            pp_axis)
+        return outbuf
+
+    pspec = jax.tree_util.tree_map(lambda _: P(pp_axis), stacked_params)
+    # check_vma=True is required for partial-manual shard_map (only the pp
+    # axis is manual; dp/mp/… stay GSPMD-automatic so TP/FSDP compose).
+    return jax.shard_map(
+        fn, mesh=mesh, in_specs=(pspec, P()), out_specs=P(),
+        axis_names={pp_axis}, check_vma=True)(stacked_params, x_mb)
+
+
+# ---------------------------------------------------------------------------
+# PipelineLayer analysis: pre / homogeneous core / post split.
+# ---------------------------------------------------------------------------
+
+class PipelineAnalysis:
+    def __init__(self, pre, cores, post, template, homogeneous):
+        self.pre = pre            # [(global_idx, layer, fwd)]
+        self.cores = cores        # per stage: [(global_idx, layer, fwd)]
+        self.post = post
+        self.template = template  # stage-0 core [(local_j, layer, fwd)]
+        self.homogeneous = homogeneous
+
+
+def _param_struct(layer: Layer):
+    return tuple(sorted((name, tuple(ref.shape), str(ref.dtype))
+                        for name, ref in layer.named_parameters()))
+
+
+def analyze_pipeline(pl, n_stages: int) -> PipelineAnalysis:
+    """Find the pipelineable trunk: the longest contiguous run of
+    identically-structured layers (same class + param shapes — the repeated
+    transformer block), trimmed to a multiple of n_stages. Everything before
+    runs as 'pre', everything after as 'post' (both outside the manual
+    pipeline region, GSPMD-replicated over pp — praxis/MaxText-style, only
+    the repeated trunk is pipelined). Tied/shared layers are never
+    pipelined."""
+    built = pl._built
+    shared_ids = {id(l) for l in pl.shared_layers().values()}
+
+    def sig_of(entry):
+        layer, _ = entry
+        if not isinstance(layer, Layer) or id(layer) in shared_ids:
+            return None
+        return (type(layer).__name__, _param_struct(layer))
+
+    sigs = [sig_of(e) for e in built]
+    best = (0, 0)  # (start, length) of the longest equal-signature run
+    i = 0
+    while i < len(sigs):
+        if sigs[i] is None:
+            i += 1
+            continue
+        j = i
+        while j < len(sigs) and sigs[j] == sigs[i]:
+            j += 1
+        if j - i > best[1]:
+            best = (i, j - i)
+        i = j
+    start, length = best
+    per_stage = length // n_stages if n_stages > 0 else 0
+    if n_stages <= 1 or per_stage < 1:
+        return PipelineAnalysis([(i, *built[i]) for i in range(len(built))],
+                                [], [], [], False)
+    trunk_len = per_stage * n_stages
+    # Run-length remainder stays in 'pre' (only full multiples of n_stages
+    # rotate through the stage ring).
+    t0 = start + (length - trunk_len)
+    pre = [(i, *built[i]) for i in range(t0)]
+    post = [(i, *built[i]) for i in range(t0 + trunk_len, len(built))]
+    cores = [[(t0 + s * per_stage + j, *built[t0 + s * per_stage + j])
+              for j in range(per_stage)] for s in range(n_stages)]
+    template = [(j, l, f) for j, (_, l, f) in enumerate(cores[0])]
+    return PipelineAnalysis(pre, cores, post, template, True)
+
+
+def _layer_params(full: Dict[str, jax.Array], prefix: str) -> Dict[str, jax.Array]:
+    plen = len(prefix) + 1
+    return {k[plen:]: v for k, v in full.items() if k.startswith(prefix + ".")}
+
+
+def _apply_layers(layers, full_params, x, prefix_of, training: bool):
+    """Run [(global_idx, layer, fwd)] sequentially with substituted params."""
+    for gidx, layer, fwd in layers:
+        if isinstance(layer, Layer):
+            sub = _layer_params(full_params, prefix_of(layer, gidx))
+            if fwd is not None:
+                with _substituted(layer, sub):
+                    x = fwd(layer, x)
+            else:
+                x = functional_call(layer, sub, x, training=training)
+        else:
+            x = fwd(layer, x) if fwd is not None else layer(x)
+    return x
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def _substituted(layer: Layer, params: Dict[str, jax.Array]):
+    from ..framework.functional import _swapped_state
+    with _swapped_state(layer, params, None):
+        yield
+
+
+# ---------------------------------------------------------------------------
+# Train step factory (used by fleet PipelineParallel.train_batch).
+# ---------------------------------------------------------------------------
+
+def make_pipeline_train_step(pl, opt, hcg=None, n_microbatch: int = 1,
+                             schedule: str = "1F1B"):
+    """Build step(params, opt_state, inputs, labels, lr) ->
+    (new_params, new_opt_state, mean_loss) running the pipeline schedule."""
+    from .topology import get_hybrid_mesh
+    mesh = hcg.mesh if hcg is not None and hasattr(hcg, "mesh") \
+        else get_hybrid_mesh()
+    S = mesh.shape.get(PP_AXIS, 1) if mesh is not None else 1
+    analysis = analyze_pipeline(pl, pl.total_stages) if S > 1 else None
+    remat = schedule.upper() != "FTHENB" or pl.recompute_interval > 0
+
+    # Map shared layer objects to their registered prefix (first position).
+    first_prefix: Dict[int, str] = {}
+    for i, (layer, _) in enumerate(pl._built):
+        if isinstance(layer, Layer) and id(layer) not in first_prefix:
+            first_prefix[id(layer)] = str(i)
+
+    def prefix_of(layer, gidx):
+        return first_prefix.get(id(layer), str(gidx))
+
+    use_pipeline = (S > 1 and analysis is not None and analysis.homogeneous
+                    and n_microbatch >= 1)
+
+    def _stage_fn(stage_params, x):
+        # stage_params: {f"{j}.{rel}": arr} for this stage's core layers.
+        for j, layer, fwd in analysis.template:
+            sub = _layer_params(stage_params, str(j))
+            if fwd is not None:
+                with _substituted(layer, sub):
+                    x = fwd(layer, x)
+            else:
+                x = functional_call(layer, sub, x, training=True)
+        return x
+
+    def _stacked(full_params):
+        out: Dict[str, jax.Array] = {}
+        for j, _, _ in analysis.template:
+            core0_gidx, layer, _ = analysis.cores[0][j]
+            rels = _layer_params(full_params, str(core0_gidx)).keys() \
+                if isinstance(layer, Layer) else []
+            for rel in rels:
+                leaves = [full_params[f"{core[j][0]}.{rel}"]
+                          for core in analysis.cores]
+                out[f"{j}.{rel}"] = jnp.stack(leaves)
+        return out
+
+    def loss_of(params, inputs, labels):
+        bsz = inputs.shape[0]
+        if use_pipeline:
+            mb = bsz // n_microbatch
+            x = _apply_layers(analysis.pre, params, inputs, prefix_of, True)
+            x_mb = x.reshape((n_microbatch, mb) + x.shape[1:])
+            stacked = _stacked(params)
+            y_mb = spmd_pipeline(_stage_fn, stacked, x_mb, mesh,
+                                 remat=remat)
+            y = y_mb.reshape((bsz,) + y_mb.shape[2:])
+            out = _apply_layers(analysis.post, params, y, prefix_of, True)
+        else:
+            # Fallback: full model under GSPMD (no pp scaling), still
+            # microbatch-correct since loss is a mean.
+            out = inputs
+            for i, (layer, fwd) in enumerate(pl._built):
+                if isinstance(layer, Layer):
+                    sub = _layer_params(params, prefix_of(layer, i))
+                    if fwd is not None:
+                        with _substituted(layer, sub):
+                            out = fwd(layer, out)
+                    else:
+                        out = functional_call(layer, sub, out, training=True)
+                else:
+                    out = fwd(layer, out) if fwd is not None else layer(out)
+        return jnp.mean(pl.loss_fn(out, labels))
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, inputs, labels, lr):
+        loss, grads = jax.value_and_grad(loss_of)(params, inputs, labels)
+        new_params, new_state = opt.apply_gradients(params, grads, opt_state,
+                                                    lr)
+        return new_params, new_state, loss
+
+    return step

@@ -154,9 +154,6 @@ class TrainStep:
         self.loss_fn = loss_fn
         self.data_axes = data_axes
 
-        from ..distributed.topology import set_hybrid_mesh
-        set_hybrid_mesh(mesh)
-
         params = get_params(model, trainable_only=True)
         specs = infer_param_specs(params, model.named_param_specs(), mesh,
                                   fsdp_axis)
@@ -231,8 +228,18 @@ class TrainStep:
         batch = jax.tree_util.tree_map(place, batch)
         self._step_count += 1
         key = jax.random.fold_in(self._base_key, self._step_count)
-        loss, self.params, self.opt_state, self.buffers = self._compiled(
-            self.params, self.opt_state, self.buffers, batch, lr, key)
+        # Trace-time consumers (sharding constraints, CP attention) resolve
+        # the mesh via get_hybrid_mesh(); install THIS step's mesh for the
+        # call only, so concurrent TrainSteps on different meshes don't
+        # corrupt each other.
+        from ..distributed.topology import get_hybrid_mesh, set_hybrid_mesh
+        prev_mesh = get_hybrid_mesh()
+        set_hybrid_mesh(self.mesh)
+        try:
+            loss, self.params, self.opt_state, self.buffers = self._compiled(
+                self.params, self.opt_state, self.buffers, batch, lr, key)
+        finally:
+            set_hybrid_mesh(prev_mesh)
         sched = self.optimizer.lr_scheduler
         if sched is not None:
             sched.step()

@@ -117,10 +117,14 @@ class LRSchedulerCallback(Callback):
     def __init__(self, by_step: bool = False, by_epoch: bool = True):
         self.by_step = by_step
         self.by_epoch = by_epoch and not by_step
+        self._last_step_count = None
 
     def _sched(self):
         opt = getattr(self.model, "_optimizer", None)
         return opt.lr_scheduler if opt is not None else None
+
+    def on_train_begin(self, logs=None):
+        self._last_step_count = getattr(self.model, "_step_count", None)
 
     def on_train_batch_end(self, step, logs=None):
         sched = self._sched()
@@ -129,7 +133,7 @@ class LRSchedulerCallback(Callback):
             # gradient accumulation only batches that applied an update
             # advance the schedule.
             count = getattr(self.model, "_step_count", None)
-            if count is None or count != getattr(self, "_last_step_count", None):
+            if count is None or count != self._last_step_count:
                 sched.step()
                 self._last_step_count = count
 

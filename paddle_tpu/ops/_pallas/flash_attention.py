@@ -1,15 +1,20 @@
 """Flash attention as Pallas TPU kernels (fwd + bwd).
 
-Online-softmax blocked attention (Dao et al.) tiled for the MXU: 128-row
-query blocks stream over 128-row key/value blocks held in VMEM, keeping the
-full [S, S] score matrix out of HBM. Backward recomputes probabilities from
-the saved logsumexp (no O(S^2) residuals), split into a dq kernel (grid over
-query blocks) and a dk/dv kernel (grid over key blocks) so each output is
-accumulated by exactly one program — no atomics.
+Online-softmax blocked attention (Dao et al.) tiled for the MXU. The key/
+value sequence is STREAMED through VMEM via a third grid axis (TPU grids
+iterate sequentially per core, so the online-softmax state lives in VMEM
+scratch across the inner key-block steps) — VMEM usage is O(block) however
+long the sequence, which is the point of flash attention. Backward
+recomputes probabilities from the saved logsumexp (no O(S^2) residuals),
+split into a dq kernel (inner loop over key blocks) and a dk/dv kernel
+(inner loop over query blocks) so each output is accumulated by exactly one
+program — no atomics.
 
 Reference parity: ``paddle/phi/kernels/gpu/flash_attn_kernel.cu:324``
 (FlashAttnKernel → vendored CUTLASS flash-attn). Layout in/out is paddle's
 [batch, seq, heads, head_dim]; internally [batch*heads, seq, head_dim].
+Causal masking is bottom-right aligned (query i attends keys <= i + sk - sq),
+matching flash-attn decode semantics for sq != sk.
 """
 
 from __future__ import annotations
@@ -23,7 +28,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["flash_attention_pallas"]
+__all__ = ["flash_attention_pallas", "supported_shapes"]
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
@@ -32,8 +37,8 @@ NEG_INF = -1e30
 
 
 def _causal_mask(s, qi, kj, block_q, block_k, offset):
-    """Bottom-right-aligned causal mask (flash-attn semantics for sq != sk:
-    query i attends keys <= i + sk - sq)."""
+    """Bottom-right-aligned causal mask (query i attends keys <= i + offset,
+    offset = sk - sq)."""
     q_pos = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, dimension=0)
     k_pos = kj * block_k + jax.lax.broadcasted_iota(
@@ -41,62 +46,64 @@ def _causal_mask(s, qi, kj, block_q, block_k, offset):
     return jnp.where(q_pos + offset >= k_pos, s, NEG_INF)
 
 
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
 # ---------------------------------------------------------------------------
-# Forward
+# Forward: grid (bh, num_q_blocks, num_k_blocks), k innermost (streamed).
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_q, block_k, seq_q, seq_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_k, seq_q, seq_k):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    bq, d = q.shape
-
-    num_k = seq_k // block_k
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
     offset = seq_k - seq_q
-    if causal:
-        # Only key blocks intersecting the causal band of this query block.
-        limit = jax.lax.div((qi + 1) * block_q + offset + block_k - 1,
-                            block_k)
-        limit = jnp.clip(limit, 0, num_k)
-    else:
-        limit = num_k
 
-    def body(j, carry):
-        m, l, acc = carry
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # Causal: key blocks fully above the diagonal contribute nothing.
+    in_band = jnp.asarray(True) if not causal else \
+        kj * block_k <= (qi + 1) * block_q - 1 + offset
+
+    @pl.when(in_band)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        s = _dot(q, kb, ((1,), (1,))) * scale  # [bq, bk]
         if causal:
-            s = _causal_mask(s, qi, j, block_q, block_k, offset)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        m_prev = m_scr[...]
+        l_prev = l_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * alpha + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + _dot(p, vb, ((1,), (0,)))
 
-    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, limit, body, (m0, l0, acc0))
-    l = jnp.maximum(l, 1e-30)
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
-    # lse broadcast across the 128-lane minor dim (TPU tiling: the last two
-    # block dims must be (8k, 128); same layout as jax's reference kernel).
-    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), lse_ref.shape[1:])
+    @pl.when(kj == nk - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(m_scr[...][:, :1] + jnp.log(l[:, :1]),
+                                      lse_ref.shape[1:])
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
-    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq] fp32)."""
+    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, Sq, LANES] fp32)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
-    grid = (bh, sq // block_q)
+    grid = (bh, sq // block_q, sk // block_k)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              block_q=block_q, block_k=block_k, seq_q=sq,
                              seq_k=sk)
@@ -104,116 +111,112 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         kern,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, sq, LANES), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
+            pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
+        ],
         cost_estimate=pl.CostEstimate(
             flops=4 * bh * sq * sk * d // (2 if causal else 1),
             bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
-            transcendentals=bh * sq * sk // block_k,
+            transcendentals=bh * sq * sk,
         ),
     )(q, k, v)
     return o, lse
 
 
 # ---------------------------------------------------------------------------
-# Backward
+# Backward dq: grid (bh, num_q_blocks, num_k_blocks), k streamed.
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_q, block_k, seq_q, seq_k):
+                   dq_scr, *, scale, causal, block_q, block_k, seq_q, seq_k):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, 0:1]        # [bq, 1]
-    delta = delta_ref[0][:, 0:1]    # [bq, 1]
-    bq, d = q.shape
-
-    num_k = seq_k // block_k
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
     offset = seq_k - seq_q
-    if causal:
-        limit = jnp.clip(
-            jax.lax.div((qi + 1) * block_q + offset + block_k - 1, block_k),
-            0, num_k)
-    else:
-        limit = num_k
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    in_band = jnp.asarray(True) if not causal else \
+        kj * block_k <= (qi + 1) * block_q - 1 + offset
+
+    @pl.when(in_band)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]
+        delta = delta_ref[0][:, 0:1]
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        s = _dot(q, kb, ((1,), (1,))) * scale
         if causal:
-            s = _causal_mask(s, qi, j, block_q, block_k, offset)
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
         p = jnp.exp(s - lse)
-        dp = jax.lax.dot_general(
-            do, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dp = _dot(do, vb, ((1,), (1,)))
         ds = p * (dp - delta) * scale
-        return dq + jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dq_scr[...] = dq_scr[...] + _dot(ds, kb, ((1,), (0,)))
 
-    dq = jax.lax.fori_loop(0, limit, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(kj == nk - 1)
+    def _finish():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
+
+# ---------------------------------------------------------------------------
+# Backward dk/dv: grid (bh, num_k_blocks, num_q_blocks), q streamed.
+# ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, block_k,
-                    seq_q, seq_k):
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
+                    block_q, block_k, seq_q, seq_k):
     kj = pl.program_id(1)
-    kb = k_ref[0].astype(jnp.float32)  # [bk, d]
-    vb = v_ref[0].astype(jnp.float32)
-    bk, d = kb.shape
-
-    num_q = seq_q // block_q
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
     offset = seq_k - seq_q
-    if causal:
-        # First query block whose causal band reaches this key block.
-        start = jnp.clip(jax.lax.div(kj * block_k - offset, block_q),
-                         0, num_q)
-    else:
-        start = 0
 
-    def body(i, carry):
-        dk, dv = carry
-        qb = q_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        dob = do_ref[0, pl.ds(i * block_q, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * block_q, block_q), 0:1]
-        delta = delta_ref[0, pl.ds(i * block_q, block_q), 0:1]
-        s = jax.lax.dot_general(
-            qb, kb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    in_band = jnp.asarray(True) if not causal else \
+        (qi + 1) * block_q - 1 + offset >= kj * block_k
+
+    @pl.when(in_band)
+    def _step():
+        kb = k_ref[0].astype(jnp.float32)
+        vb = v_ref[0].astype(jnp.float32)
+        qb = q_ref[0].astype(jnp.float32)
+        dob = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, 0:1]
+        delta = delta_ref[0][:, 0:1]
+        s = _dot(qb, kb, ((1,), (1,))) * scale  # [bq, bk]
         if causal:
-            s = _causal_mask(s, i, kj, block_q, block_k, offset)
+            s = _causal_mask(s, qi, kj, block_q, block_k, offset)
         p = jnp.exp(s - lse)
-        dv = dv + jax.lax.dot_general(
-            p, dob, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(
-            dob, vb, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)
+        dv_scr[...] = dv_scr[...] + _dot(p, dob, ((0,), (0,)))
+        dp = _dot(dob, vb, ((1,), (1,)))
         ds = p * (dp - delta) * scale
-        dk = dk + jax.lax.dot_general(
-            ds, qb, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return dk, dv
+        dk_scr[...] = dk_scr[...] + _dot(ds, qb, ((0,), (0,)))
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, num_q, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
@@ -229,39 +232,44 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=sq,
                           seq_k=sk),
-        grid=(bh, sq // block_q),
+        grid=(bh, sq // block_q, sk // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_q=sq,
                           seq_k=sk),
-        grid=(bh, sk // block_k),
+        grid=(bh, sk // block_k, sq // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, sq, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, sq, LANES), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, sq, LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
         ],
     )(k, v, q, do, lse, delta)
     return dq, dk, dv

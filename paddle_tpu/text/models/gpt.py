@@ -48,6 +48,8 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     sequence_parallel: bool = False
     recompute: bool = False
+    # Long-context CP over the 'sep' mesh axis: None | 'ring' | 'ulysses'.
+    context_parallel: Optional[str] = None
 
     @property
     def ffn_size(self) -> int:
@@ -64,6 +66,12 @@ def gpt_tiny(**overrides) -> "GPTConfig":
     return GPTConfig(**{**dict(vocab_size=1024, hidden_size=128, num_layers=2,
                                num_heads=4, max_position_embeddings=256),
                         **overrides})
+
+
+def _cp_active() -> bool:
+    from ...distributed.topology import get_hybrid_mesh
+    mesh = get_hybrid_mesh()
+    return mesh is not None and mesh.shape.get("sep", 1) > 1
 
 
 def _init_attr(cfg: GPTConfig, spec=None) -> ParamAttr:
@@ -93,7 +101,21 @@ class GPTAttention(nn.Layer):
         # Keep heads sharded over mp: heads dim = mp * local_heads.
         qkv = _constrain(qkv, P(None, None, None, MP_AXIS, None))
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        if self.cfg.use_flash_attention:
+        if self.cfg.context_parallel and _cp_active():
+            from ...distributed.context_parallel import (ring_attention,
+                                                         ulysses_attention)
+            if self.cfg.context_parallel not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"context_parallel={self.cfg.context_parallel!r}; "
+                    "expected 'ring' or 'ulysses'")
+            if self.cfg.attention_dropout > 0.0 and self.training:
+                raise NotImplementedError(
+                    "attention_dropout > 0 is not supported with context "
+                    "parallelism (probs are never materialized globally)")
+            cp = (ring_attention if self.cfg.context_parallel == "ring"
+                  else ulysses_attention)
+            out = cp(q, k, v, causal=True)
+        elif self.cfg.use_flash_attention:
             out = flash_attention(q, k, v, dropout=self.cfg.attention_dropout,
                                   causal=True, training=self.training)
         else:
@@ -139,6 +161,9 @@ class GPTBlock(nn.Layer):
             from ...distributed.fleet.utils.sequence_parallel_utils import \
                 sequence_parallel_constraint
             x = sequence_parallel_constraint(x)
+        if self.cfg.context_parallel and _cp_active():
+            # Keep activations sequence-sharded over sep between blocks.
+            x = _constrain(x, P(None, "sep", None))
         x = x + self.attn(self.ln_1(x))
         x = x + self.mlp(self.ln_2(x))
         return x
