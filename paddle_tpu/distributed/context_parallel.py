@@ -141,6 +141,22 @@ def ulysses_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
     if query.shape[2] % n:
         raise ValueError(f"heads {query.shape[2]} not divisible by "
                          f"{axis}={n}")
+    hk = key.shape[2]
+    if value.shape[2] != hk:
+        raise ValueError(f"key has {hk} heads but value has "
+                         f"{value.shape[2]}")
+    if query.shape[2] % hk:
+        raise ValueError(f"query heads {query.shape[2]} must be a multiple "
+                         f"of kv heads {hk} (grouped-query)")
+    if hk % n:
+        # Grouped-query kv: repeat kv heads just enough that the head
+        # all-to-all splits evenly (flash_attention broadcasts the rest
+        # locally after the a2a, so a minimal repeat saves ICI bandwidth).
+        rep = n // math.gcd(hk, n)
+        if (query.shape[2] // hk) % rep:
+            rep = query.shape[2] // hk  # full broadcast fallback
+        key = jnp.repeat(key, rep, axis=2)
+        value = jnp.repeat(value, rep, axis=2)
 
     def fn(q, k, v):
         # local [B, S/N, H, D] -> [B, S, H/N, D]

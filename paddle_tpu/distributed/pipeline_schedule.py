@@ -211,7 +211,11 @@ def make_pipeline_train_step(pl, opt, hcg=None, n_microbatch: int = 1,
     mesh = hcg.mesh if hcg is not None and hasattr(hcg, "mesh") \
         else get_hybrid_mesh()
     S = mesh.shape.get(PP_AXIS, 1) if mesh is not None else 1
-    analysis = analyze_pipeline(pl, pl.total_stages) if S > 1 else None
+    # Partition over the MESH's pp extent (the physical pipeline): stacked
+    # params get leading dim S, matching spmd_pipeline's shard over the pp
+    # axis. pl.total_stages may request virtual stages (VPP) — honored by
+    # the interleaved schedule, warned about otherwise below.
+    analysis = analyze_pipeline(pl, S) if S > 1 else None
     remat = schedule.upper() != "FTHENB" or pl.recompute_interval > 0
 
     # Map shared layer objects to their registered prefix (first position).
@@ -225,6 +229,15 @@ def make_pipeline_train_step(pl, opt, hcg=None, n_microbatch: int = 1,
 
     use_pipeline = (S > 1 and analysis is not None and analysis.homogeneous
                     and n_microbatch >= 1)
+    if use_pipeline and pl.total_stages != S:
+        # The trunk is partitioned over the mesh's S physical stages (always
+        # correct); virtual-stage interleaving (VPP bubble reduction) is a
+        # schedule refinement the 1F1B scan does not yet apply.
+        import warnings
+        warnings.warn(
+            f"PipelineLayer requested total_stages={pl.total_stages} "
+            f"(num_virtual_pipeline_stages>1?) but mesh pp={S}; running the "
+            f"correct {S}-stage schedule without interleaving.")
 
     def _stage_fn(stage_params, x):
         # stage_params: {f"{j}.{rel}": arr} for this stage's core layers.

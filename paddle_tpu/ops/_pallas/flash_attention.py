@@ -83,7 +83,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         m_prev = m_scr[...]
         l_prev = l_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)
+        # Zero out fully-masked entries: rows with no valid keys have
+        # s == m_new == NEG_INF and exp(0) would silently average V.
+        p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
         alpha = jnp.exp(m_prev - m_new)
         m_scr[...] = m_new
         l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
@@ -166,7 +168,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = _dot(q, kb, ((1,), (1,))) * scale
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
-        p = jnp.exp(s - lse)
+        p = jnp.exp(s - lse) * (s > NEG_INF / 2)
         dp = _dot(do, vb, ((1,), (1,)))
         ds = p * (dp - delta) * scale
         dq_scr[...] = dq_scr[...] + _dot(ds, kb, ((1,), (0,)))
@@ -207,7 +209,7 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         s = _dot(qb, kb, ((1,), (1,))) * scale  # [bq, bk]
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
-        p = jnp.exp(s - lse)
+        p = jnp.exp(s - lse) * (s > NEG_INF / 2)
         dv_scr[...] = dv_scr[...] + _dot(p, dob, ((0,), (0,)))
         dp = _dot(dob, vb, ((1,), (1,)))
         ds = p * (dp - delta) * scale

@@ -5,6 +5,8 @@ runs it for real); module-level semantics are checked against the jnp
 reference and finite differences.
 """
 
+import contextlib
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -21,6 +23,27 @@ def _rand_qkv(b=2, s=128, h=2, d=64, dtype=jnp.float32, seed=0):
     return mk(), mk(), mk()
 
 
+@contextlib.contextmanager
+def interpreted_pallas():
+    """Run paddle_tpu's Pallas kernels in interpreter mode on CPU."""
+    from paddle_tpu.ops._pallas import flash_attention as fa
+    import jax.experimental.pallas as pl
+
+    orig = pl.pallas_call
+
+    def interp_call(*args, **kwargs):
+        kwargs.setdefault("interpret", True)
+        return orig(*args, **kwargs)
+
+    pl.pallas_call = interp_call
+    fa.pl.pallas_call = interp_call
+    try:
+        yield fa
+    finally:
+        pl.pallas_call = orig
+        fa.pl.pallas_call = orig
+
+
 def test_reference_attention_matches_naive():
     q, k, v = _rand_qkv()
     out = reference_attention(q, k, v)
@@ -33,20 +56,7 @@ def test_reference_attention_matches_naive():
 
 @pytest.mark.parametrize("causal", [False, True])
 def test_pallas_kernel_interpret_matches_reference(causal):
-    from paddle_tpu.ops._pallas import flash_attention as fa
-    import jax.experimental.pallas as pl
-
-    # Run the pallas kernels in interpreter mode on CPU.
-    orig = pl.pallas_call
-    import functools
-
-    def interp_call(*args, **kwargs):
-        kwargs.setdefault("interpret", True)
-        return orig(*args, **kwargs)
-
-    pl.pallas_call = interp_call
-    fa.pl.pallas_call = interp_call
-    try:
+    with interpreted_pallas() as fa:
         q, k, v = _rand_qkv(b=1, s=256, h=2, d=64)
         out = fa.flash_attention_pallas(q, k, v, causal=causal)
         ref = reference_attention(q, k, v, causal=causal)
@@ -60,9 +70,6 @@ def test_pallas_kernel_interpret_matches_reference(causal):
         gr = jax.grad(g, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gp, gr):
             np.testing.assert_allclose(a, b, atol=5e-4)
-    finally:
-        pl.pallas_call = orig
-        fa.pl.pallas_call = orig
 
 
 @pytest.mark.parametrize("causal", [False, True])
@@ -79,6 +86,32 @@ def test_flash_attention_module_grad(causal):
     numeric = (f(q + eps * direction) - f(q - eps * direction)) / (2 * eps)
     analytic = jnp.sum(g * direction)
     np.testing.assert_allclose(numeric, analytic, rtol=2e-2)
+
+
+def test_pallas_causal_fully_masked_rows_zero():
+    """sq > sk causal: rows with no valid keys must output 0, not mean(V)
+    (the bottom-right alignment masks every key for query rows
+    i < sq - sk)."""
+    with interpreted_pallas() as fa:
+        rng = np.random.default_rng(0)
+        b, sq, sk, h, d = 1, 256, 128, 2, 64
+        q = jnp.asarray(rng.standard_normal((b, sq, h, d)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((b, sk, h, d)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((b, sk, h, d)), jnp.float32)
+        out = fa.flash_attention_pallas(q, k, v, causal=True)
+        # Rows 0..sq-sk-1 attend to nothing.
+        np.testing.assert_allclose(out[:, :sq - sk], 0.0, atol=1e-6)
+        # Remaining rows match reference attention with the aligned mask.
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q[:, sq - sk:], k) / np.sqrt(d)
+        mask = np.tril(np.ones((sk, sk), bool))
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        ref = jnp.einsum("bhqk,bkhd->bqhd",
+                         jax.nn.softmax(scores, axis=-1), v)
+        np.testing.assert_allclose(out[:, sq - sk:], ref, atol=2e-5)
+        # Gradients through fully-masked rows must be zero, not NaN.
+        g = jax.grad(lambda q: jnp.sum(
+            fa.flash_attention_pallas(q, k, v, causal=True)))(q)
+        assert np.isfinite(np.asarray(g)).all()
 
 
 def test_flash_attn_unpadded_roundtrip():
