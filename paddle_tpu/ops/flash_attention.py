@@ -29,9 +29,15 @@ __all__ = ["flash_attention", "flash_attn_unpadded", "reference_attention"]
 def reference_attention(q, k, v, causal: bool = False,
                         scale: Optional[float] = None,
                         bias: Optional[jax.Array] = None):
-    """jnp reference, [B,S,H,D] layout, fp32 softmax."""
+    """jnp reference, [B,S,H,D] layout, fp32 softmax. Handles grouped-query
+    kv (fewer kv heads) and rows with no valid keys (output 0, matching the
+    Pallas kernel)."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
+    if k.shape[2] != h:
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
@@ -40,7 +46,13 @@ def reference_attention(q, k, v, causal: bool = False,
     if causal:
         mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), sk - sq)
         scores = jnp.where(mask[None, None], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    # Masked-row-safe softmax: fully-masked rows (all -inf) produce 0, not
+    # NaN — matching the Pallas kernels' handling.
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.where(jnp.isfinite(scores),
+                  jnp.exp(scores - jnp.where(jnp.isfinite(m), m, 0.0)), 0.0)
+    probs = (e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True),
+                             1e-30)).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 

@@ -108,8 +108,12 @@ def mv(x, vec):
 
 def histogram(x, bins: int = 100, min: float = 0.0, max: float = 0.0):
     if min == 0.0 and max == 0.0:
-        min, max = float(jnp.min(x)), float(jnp.max(x))
-    hist, _ = jnp.histogram(x, bins=bins, range=(min, max))
+        # paddle semantics: zero min/max means use the data range. Keep the
+        # bounds traced so the op stays jittable.
+        lo, hi = jnp.min(x), jnp.max(x)
+    else:
+        lo, hi = min, max
+    hist, _ = jnp.histogram(x, bins=bins, range=(lo, hi))
     return hist
 
 

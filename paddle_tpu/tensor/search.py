@@ -49,7 +49,11 @@ def searchsorted(sorted_sequence, values, out_int32: bool = False,
     return out.astype(jnp.int32 if out_int32 else jnp.int64)
 
 
-bucketize = searchsorted
+def bucketize(x, sorted_sequence, out_int32: bool = False,
+              right: bool = False):
+    """paddle.bucketize: indices of the buckets x's values fall into —
+    searchsorted with the operand order swapped."""
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
 
 
 def nonzero(x, as_tuple: bool = False):

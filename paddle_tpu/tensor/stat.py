@@ -52,6 +52,29 @@ def kthvalue(x, k: int, axis: int = -1, keepdim: bool = False):
 
 
 def mode(x, axis: int = -1, keepdim: bool = False):
-    import jax.scipy.stats as jss
-    m, _ = jss.mode(x, axis=axis, keepdims=keepdim)
-    return m
+    """paddle.mode parity: (values, indices) of the most frequent element
+    along ``axis`` (ties -> the smallest value; index = its last
+    occurrence, matching torch/paddle)."""
+    from jax import lax
+    xm = jnp.moveaxis(x, axis, -1)
+    # Sort-based run-length counting: O(n log n), O(n) memory.
+    xs = jnp.sort(xm, axis=-1)
+    n = xs.shape[-1]
+    j = jnp.broadcast_to(jnp.arange(n), xs.shape)
+    new_run = jnp.concatenate(
+        [jnp.ones_like(xs[..., :1], bool), xs[..., 1:] != xs[..., :-1]], -1)
+    first = lax.cummax(jnp.where(new_run, j, 0), axis=xs.ndim - 1)
+    run_last = jnp.concatenate(
+        [new_run[..., 1:], jnp.ones_like(xs[..., :1], bool)], -1)
+    last = jnp.flip(lax.cummin(jnp.flip(jnp.where(run_last, j, n - 1), -1),
+                               axis=xs.ndim - 1), -1)
+    count = last - first + 1
+    # argmax returns the FIRST max -> the smallest value (ascending sort).
+    p = jnp.argmax(count, axis=-1)
+    m = jnp.take_along_axis(xs, p[..., None], -1)
+    idx = jnp.max(jnp.where(xm == m, jnp.arange(n), -1), axis=-1)
+    vals = jnp.squeeze(m, -1)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)

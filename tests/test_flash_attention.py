@@ -114,6 +114,22 @@ def test_pallas_causal_fully_masked_rows_zero():
         assert np.isfinite(np.asarray(g)).all()
 
 
+def test_reference_attention_masked_rows_and_gqa():
+    """The jnp fallback must match the Pallas kernel's semantics: zero (not
+    NaN) output for fully-masked rows, and grouped-query kv broadcast."""
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 8, 4, 16)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 4, 2, 16)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 4, 2, 16)), jnp.float32)
+    out = reference_attention(q, k, v, causal=True)  # sq=8 > sk=4, kv 2 heads
+    assert out.shape == (1, 8, 4, 16)
+    np.testing.assert_allclose(out[:, :4], 0.0, atol=1e-6)  # no valid keys
+    assert np.isfinite(np.asarray(out)).all()
+    g = jax.grad(lambda q: jnp.sum(
+        reference_attention(q, k, v, causal=True)))(q)
+    assert np.isfinite(np.asarray(g)).all()
+
+
 def test_flash_attn_unpadded_roundtrip():
     h, d = 2, 32
     lens = [3, 7, 5]
