@@ -5,18 +5,23 @@ Prints ONE JSON line:
 
 Workload: BASELINE config 4's per-chip slice — a GPT decoder LM trained with
 AdamW, bf16 compute + fp32 master weights (AMP O2), flash-attention Pallas
-kernel, remat on every block. The reference publishes no numbers
-(BASELINE.md), so ``vs_baseline`` reports measured MFU / 0.40 — 0.40 MFU
-being the strong H100+NCCL Megatron-class utilization the north star asks us
-to match per chip (raw FLOPs differ per accelerator; utilization is the
-comparable quantity).
+kernel. The reference publishes no numbers (BASELINE.md), so
+``vs_baseline`` reports measured MFU / 0.40 — 0.40 MFU being the strong
+H100+NCCL Megatron-class utilization the north star asks us to match per
+chip (raw FLOPs differ per accelerator; utilization is the comparable
+quantity).
+
+Remat is OFF by default: the 254M bench model's activations fit v5e HBM at
+this batch, and blanket block remat costs ~25% step time (see PERF.md).
+Set BENCH_REMAT=1 to measure the memory-constrained configuration.
 
 Env overrides: BENCH_LAYERS, BENCH_HIDDEN, BENCH_HEADS, BENCH_SEQ,
-BENCH_BATCH, BENCH_STEPS.
+BENCH_BATCH, BENCH_STEPS, BENCH_REMAT.
 """
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import time
@@ -40,13 +45,14 @@ def main():
     seq = int(os.environ.get("BENCH_SEQ", 128 if small else 1024))
     batch = int(os.environ.get("BENCH_BATCH", 2 if small else 8))
     steps = int(os.environ.get("BENCH_STEPS", 2 if small else 10))
+    remat = os.environ.get("BENCH_REMAT") == "1"
     vocab = 512 if small else 50304
 
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
                     num_heads=heads, max_position_embeddings=seq,
                     hidden_dropout=0.0, attention_dropout=0.0,
-                    recompute=True)
+                    recompute=remat)
     model = GPTForCausalLM(cfg)
     model.train()
     # AMP O2: bf16 params/compute, fp32 master weights in the optimizer.
@@ -60,7 +66,7 @@ def main():
     def loss_fn(p, ids, labels):
         return functional_call(model, p, ids, labels, training=True)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
     def step(p, st, ids, labels):
         loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
         new_p, new_st = opt.apply_gradients(p, grads, st, 1e-4)

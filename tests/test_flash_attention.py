@@ -73,6 +73,35 @@ def test_pallas_kernel_interpret_matches_reference(causal):
 
 
 @pytest.mark.parametrize("causal", [False, True])
+def test_pallas_kernel_interpret_bf16(causal):
+    """The production dtype: bf16 inputs, MXU-native dots, fp32 accumulation.
+    Exercises the p.astype/ds.astype mixed-precision casts (no-ops under the
+    fp32 tests above) and the slim [BH, 1, Sq] lse layout under them."""
+    with interpreted_pallas() as fa:
+        q, k, v = _rand_qkv(b=1, s=256, h=2, d=64, dtype=jnp.bfloat16)
+        out = fa.flash_attention_pallas(q, k, v, causal=causal)
+        assert out.dtype == jnp.bfloat16
+        ref = reference_attention(q.astype(jnp.float32),
+                                  k.astype(jnp.float32),
+                                  v.astype(jnp.float32), causal=causal)
+        np.testing.assert_allclose(out.astype(jnp.float32), ref,
+                                   atol=2e-2, rtol=2e-2)
+
+        f = lambda q, k, v: jnp.sum(
+            fa.flash_attention_pallas(q, k, v, causal=causal)
+            .astype(jnp.float32))
+        g = lambda q, k, v: jnp.sum(
+            reference_attention(q, k, v, causal=causal).astype(jnp.float32))
+        gp = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(g, argnums=(0, 1, 2))(
+            *(t.astype(jnp.float32) for t in (q, k, v)))
+        for a, b in zip(gp, gr):
+            assert jnp.all(jnp.isfinite(a.astype(jnp.float32)))
+            np.testing.assert_allclose(a.astype(jnp.float32), b,
+                                       atol=5e-2, rtol=5e-2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
 def test_flash_attention_module_grad(causal):
     q, k, v = _rand_qkv(b=1, s=64, h=2, d=32)
 
