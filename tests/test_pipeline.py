@@ -178,3 +178,114 @@ def test_fleet_pipeline_parallel_wrapper():
     l1 = pp.train_batch((x, y), opt)
     assert np.isfinite(l0) and np.isfinite(l1)
     assert l1 < l0
+
+
+# ---------------------------------------------------------------------------
+# Interleaved virtual stages (VPP) — ref PipelineParallelWithInterleave.
+# ---------------------------------------------------------------------------
+
+def test_spmd_pipeline_interleaved_matches_sequential():
+    S, V, n_micro, mb, d = 4, 2, 8, 2, 8
+    mesh = create_hybrid_mesh(pp=S, dp=2)
+    rng = np.random.default_rng(2)
+    w = jnp.asarray(rng.standard_normal((S, V, d, d)) * 0.3, jnp.float32)
+    x_mb = jnp.asarray(rng.standard_normal((n_micro, mb, d)), jnp.float32)
+
+    def stage_fn(sp, x):
+        return jnp.tanh(x @ sp)
+
+    y = spmd_pipeline(stage_fn, w, x_mb, mesh, num_chunks=V)
+    ref = x_mb
+    for l in range(S * V):  # virtual stage l lives on device l%S, chunk l//S
+        ref = jnp.tanh(ref @ w[l % S, l // S])
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-6)
+
+    def loss_pipe(w):
+        return jnp.mean(
+            spmd_pipeline(stage_fn, w, x_mb, mesh, num_chunks=V) ** 2)
+
+    def loss_seq(w):
+        y = x_mb
+        for l in range(S * V):
+            y = jnp.tanh(y @ w[l % S, l // S])
+        return jnp.mean(y ** 2)
+
+    gp = jax.grad(loss_pipe)(w)
+    gs = jax.grad(loss_seq)(w)
+    np.testing.assert_allclose(gp, gs, rtol=1e-4, atol=1e-6)
+
+
+def test_spmd_pipeline_interleaved_rejects_few_microbatches():
+    mesh = create_hybrid_mesh(pp=4, dp=2)
+    x_mb = jnp.zeros((2, 2, 8), jnp.float32)  # n_micro=2 < pp=4
+    with pytest.raises(ValueError, match="n_micro"):
+        spmd_pipeline(lambda sp, x: x @ sp, jnp.zeros((4, 2, 8, 8)),
+                      x_mb, mesh, num_chunks=2)
+
+
+def test_vpp_training_matches_single_device():
+    def build():
+        paddle.seed(5)
+        descs = [LayerDesc(nn.Linear, 16, 16) for _ in range(8)]
+        return PipelineLayer(
+            layers=descs, num_stages=4, num_virtual_pipeline_stages=2,
+            loss_fn=lambda o, l: jnp.mean((o - l) ** 2))
+
+    vpp = _train(build(), dict(pp=4, dp=2), n_micro=4)
+    single = _train(build(), dict(dp=1, devices=jax.devices()[:1]),
+                    n_micro=4)
+    np.testing.assert_allclose(vpp, single, rtol=2e-4)
+
+
+# ---------------------------------------------------------------------------
+# Heterogeneous stages — lax.switch dispatch (no homogeneous trunk).
+# ---------------------------------------------------------------------------
+
+class _Block(nn.Layer):
+    """Residual block — structurally distinct from plain Linear."""
+
+    def __init__(self, d):
+        super().__init__()
+        self.a = nn.Linear(d, d)
+        self.b = nn.Linear(d, d)
+
+    def forward(self, x):
+        return x + self.b(jnp.tanh(self.a(x)))
+
+
+def _make_het_pl(seed=7, d=16):
+    paddle.seed(seed)
+    descs = [LayerDesc(nn.Linear, d, d), LayerDesc(_Block, d),
+             LayerDesc(nn.LayerNorm, d), LayerDesc(_Block, d),
+             LayerDesc(nn.Linear, d, d), LayerDesc(_Block, d),
+             LayerDesc(nn.LayerNorm, d), LayerDesc(nn.Linear, d, d)]
+    return PipelineLayer(layers=descs, num_stages=4,
+                         loss_fn=lambda o, l: jnp.mean((o - l) ** 2))
+
+
+def test_het_pipeline_training_matches_single_device():
+    het = _train(_make_het_pl(), dict(pp=4, dp=2), n_micro=4)
+    single = _train(_make_het_pl(), dict(dp=1, devices=jax.devices()[:1]),
+                    n_micro=4)
+    assert het[-1] < het[0]
+    np.testing.assert_allclose(het, single, rtol=2e-4)
+
+
+def test_het_pipeline_shape_mismatch_warns_and_falls_back():
+    paddle.seed(9)
+    descs = [LayerDesc(nn.Linear, 16, 32), LayerDesc(_Block, 32),
+             LayerDesc(nn.Linear, 32, 16), LayerDesc(nn.LayerNorm, 16)]
+    pl = PipelineLayer(layers=descs, num_stages=4,
+                       loss_fn=lambda o, l: jnp.mean((o - l) ** 2))
+    mesh = create_hybrid_mesh(pp=4, dp=2)
+    set_hybrid_mesh(mesh)
+    opt = AdamW(learning_rate=1e-2)
+    step = make_pipeline_train_step(pl, opt, n_microbatch=4)
+    params = get_params(pl)
+    opt_state = opt.init(params)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((8, 16)),
+                    jnp.float32)
+    with pytest.warns(UserWarning, match="falling back"):
+        params, opt_state, loss = step(params, opt_state, x, x,
+                                       jnp.float32(1e-2))
+    assert np.isfinite(float(loss))
