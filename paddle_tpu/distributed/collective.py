@@ -79,9 +79,26 @@ class Group:
 
     @property
     def rank(self) -> int:
-        """Host-side rank (only meaningful multi-controller or inside trace
-        via axis_rank)."""
-        return 0
+        """Host-side rank of this *process* within the group: the mesh
+        coordinate of the process's first local device along the group axes,
+        flattened. Single-controller (all devices local) this is 0 — use
+        ``axis_rank`` inside a trace for per-device rank. Multi-controller
+        this is the true process rank along the group axes."""
+        first_local = None
+        for d in self.mesh.devices.flat:
+            if d.process_index == jax.process_index():
+                first_local = d
+                break
+        if first_local is None:
+            return 0
+        idx = np.argwhere(self.mesh.devices == first_local)
+        if idx.size == 0:
+            return 0
+        coord = dict(zip(self.mesh.axis_names, idx[0]))
+        rank = 0
+        for a in self.axes:
+            rank = rank * self.mesh.shape[a] + int(coord[a])
+        return rank
 
     def process_ids(self):
         return list(range(self.nranks))

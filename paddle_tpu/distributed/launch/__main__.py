@@ -1,0 +1,36 @@
+"""CLI: ``python -m paddle_tpu.distributed.launch [opts] script.py [args]``.
+
+Ref ``python/paddle/distributed/launch/main.py`` (collective mode).
+"""
+
+import argparse
+import sys
+
+from . import LaunchConfig, launch
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Spawn N trainer processes with the paddle env contract "
+                    "(PADDLE_TRAINER_ID/..., coordinator via PADDLE_MASTER).")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="trainer processes on this node")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--node_rank", type=int, default=0)
+    p.add_argument("--master", default=None,
+                   help="coordinator host:port (auto on single node)")
+    p.add_argument("--log_dir", default=None,
+                   help="write per-rank workerlog.N files here")
+    p.add_argument("training_script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+
+    cfg = LaunchConfig(nproc_per_node=args.nproc_per_node,
+                       nnodes=args.nnodes, node_rank=args.node_rank,
+                       master=args.master, log_dir=args.log_dir)
+    sys.exit(launch(cfg, args.training_script, args.script_args))
+
+
+if __name__ == "__main__":
+    main()

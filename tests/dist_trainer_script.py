@@ -1,0 +1,85 @@
+"""Multi-process trainer used by test_launch.py (ref test_dist_base.py:962's
+model file pattern): trains a small MLP data-parallel over ALL devices in the
+cluster and prints per-step losses as JSON on rank 0.
+
+Each process runs this script with the launcher's env contract; devices are
+4 virtual CPUs per process so 1-proc x 8 and 2-proc x 4 form the same
+8-device world.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEVS = int(os.environ.get("TEST_LOCAL_DEVICES", "4"))
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                           f" --xla_force_host_platform_device_count={DEVS}")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu import nn, optimizer  # noqa: E402
+from paddle_tpu.distributed.parallel import shard_batch  # noqa: E402
+from paddle_tpu.framework.functional import (functional_call,  # noqa: E402
+                                             get_params)
+from paddle_tpu.framework.sharded import make_sharded_train_step  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+
+def main():
+    env = dist.init_parallel_env()
+    world_devices = jax.device_count()
+    assert world_devices == 8, f"expected 8 global devices, got {world_devices}"
+
+    mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("dp",))
+    from paddle_tpu.distributed.topology import set_hybrid_mesh
+    set_hybrid_mesh(mesh)
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 4))
+    opt = optimizer.AdamW(learning_rate=1e-2)
+
+    def loss_fn(model, params, batch):
+        x, y = batch
+        out = functional_call(model, params, x, training=True)
+        return jnp.mean((out - y) ** 2)
+
+    ts = make_sharded_train_step(model, opt, loss_fn, mesh=mesh,
+                                 fsdp_axis=None, data_axes=("dp",))
+
+    rng = np.random.default_rng(42)  # same data stream on every process
+    losses = []
+    for _ in range(4):
+        x = rng.standard_normal((16, 16)).astype(np.float32)
+        y = rng.standard_normal((16, 4)).astype(np.float32)
+        batch = shard_batch((x, y), mesh=mesh, axes=("dp",))
+        loss = ts.step(batch)
+        losses.append(float(loss))
+
+    # Exercise the collective/group surface across real process boundaries:
+    # Group.rank must be the mesh coordinate of this process's first local
+    # device (device-unit rank), not a hardcoded 0.
+    g = dist.collective.world_group()
+    assert g.nranks == 8
+    rank = g.rank
+    flat = list(mesh.devices.flat)
+    expected = flat.index(next(d for d in flat
+                               if d.process_index == jax.process_index()))
+    assert rank == expected, (rank, expected)
+
+    if jax.process_index() == 0:
+        print("LOSSES " + json.dumps({"losses": losses, "rank": rank,
+                                      "world": env.world_size}))
+
+
+if __name__ == "__main__":
+    main()
