@@ -9,3 +9,6 @@ from .collective import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
 from .parallel import DataParallel, shard_batch, replicate, scale_loss  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
+from . import launch  # noqa: F401

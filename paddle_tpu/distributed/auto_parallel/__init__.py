@@ -1,0 +1,314 @@
+"""Semi-automatic parallelism API: ProcessMesh / shard_tensor / shard_op /
+Engine.
+
+Reference design: ``python/paddle/distributed/auto_parallel/`` —
+``ProcessMesh`` (``process_mesh.py:71``), ``shard_tensor``/``shard_op``
+(``interface.py:29/119``) attach DistAttr annotations to tensors/ops, and the
+static ``Engine`` (``static/engine.py:55``) runs completion (sharding
+propagation), partitions the program per rank, and inserts reshard comms.
+
+TPU-native design: this *is* GSPMD. A ``ProcessMesh`` wraps a
+``jax.sharding.Mesh``; ``shard_tensor`` is ``jax.device_put`` with a
+``NamedSharding`` (outside jit) or a sharding constraint (inside jit);
+``shard_op`` wraps a callable with input/output constraints; and the whole
+completion/partition/reshard pipeline of the reference collapses into XLA's
+SPMD propagation pass — annotate a few tensors, the compiler completes the
+rest and inserts the collectives. ``Engine`` is a thin prepare/fit facade
+over a jitted sharded train step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["ProcessMesh", "get_current_process_mesh", "shard_tensor",
+           "shard_op", "Engine"]
+
+_current_process_mesh: List["ProcessMesh"] = []
+
+
+class ProcessMesh:
+    """Cartesian topology of logical processes (ref process_mesh.py:71).
+
+    ``mesh`` is an n-d array of process ids; on TPU each logical process id
+    indexes ``jax.devices()`` (one device per logical process — the
+    reference's one-GPU-per-process picture). Usable as a context manager to
+    set the current mesh for un-annotated ``shard_tensor`` calls, like the
+    reference's ``with ProcessMesh(...)`` scoping.
+    """
+
+    def __init__(self, mesh=None, dim_names: Optional[Sequence[str]] = None,
+                 shape=None, process_ids=None):
+        if mesh is None:
+            if shape is None or process_ids is None:
+                raise ValueError("need mesh, or shape + process_ids")
+            mesh = np.asarray(process_ids).reshape(shape)
+        mesh = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(mesh.ndim)]
+        if len(dim_names) != mesh.ndim:
+            raise ValueError(f"{len(dim_names)} dim_names for "
+                             f"{mesh.ndim}-d mesh")
+        self._mesh = mesh
+        self._dim_names = list(dim_names)
+        devs = np.asarray(jax.devices(), dtype=object)
+        if mesh.size > devs.size:
+            raise ValueError(f"mesh references {mesh.size} processes but "
+                             f"only {devs.size} devices exist")
+        dev_arr = np.empty(mesh.shape, dtype=object)
+        for idx in np.ndindex(*mesh.shape):
+            dev_arr[idx] = devs[int(mesh[idx])]
+        self._jax_mesh = Mesh(dev_arr, axis_names=tuple(dim_names))
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh.ndim
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(p) for p in self._mesh.flatten()]
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self) -> np.ndarray:
+        return self._mesh
+
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh.shape[self._dim_names.index(dim_name)]
+
+    def __enter__(self):
+        _current_process_mesh.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _current_process_mesh.pop()
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh, other._mesh)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def get_current_process_mesh() -> Optional[ProcessMesh]:
+    return _current_process_mesh[-1] if _current_process_mesh else None
+
+
+def _as_spec(shard_spec, ndim: int) -> P:
+    if shard_spec is None:
+        return P()
+    if len(shard_spec) != ndim:
+        raise ValueError(f"shard_spec {shard_spec} has {len(shard_spec)} "
+                         f"entries for a {ndim}-d tensor")
+    return P(*shard_spec)
+
+
+def _resolve_mesh(process_mesh: Optional[ProcessMesh]) -> ProcessMesh:
+    pm = process_mesh or get_current_process_mesh()
+    if pm is None:
+        raise RuntimeError(
+            "no process_mesh given and no current ProcessMesh scope active")
+    return pm
+
+
+def shard_tensor(x, process_mesh: Optional[ProcessMesh] = None,
+                 shard_spec: Optional[Sequence[Optional[str]]] = None):
+    """Shard ``x`` over the mesh (ref interface.py:29): ``shard_spec[i]`` is
+    the mesh dim name tensor dim i is split along (None = not split).
+
+    Outside a trace this *places* the array (``jax.device_put`` with a
+    NamedSharding — immediately materialized sharded); inside jit it becomes
+    a sharding constraint the SPMD partitioner honors and propagates from.
+    """
+    pm = _resolve_mesh(process_mesh)
+    spec = _as_spec(shard_spec, np.ndim(x))
+    sharding = NamedSharding(pm.jax_mesh, spec)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, sharding)
+    return jax.device_put(jnp.asarray(x), sharding)
+
+
+def shard_op(op: Callable, process_mesh: Optional[ProcessMesh] = None,
+             in_shard_specs: Optional[Sequence] = None,
+             out_shard_specs: Optional[Sequence] = None) -> Callable:
+    """Wrap ``op`` so its inputs/outputs carry sharding constraints
+    (ref interface.py:119). Specs align with the op's positional args /
+    flat outputs; None entries mean replicated."""
+    pm = _resolve_mesh(process_mesh)
+
+    def constrain(val, spec):
+        if not isinstance(val, (jax.Array, jax.core.Tracer, np.ndarray)):
+            return val
+        s = _as_spec(spec, np.ndim(val))
+        return jax.lax.with_sharding_constraint(
+            jnp.asarray(val), NamedSharding(pm.jax_mesh, s))
+
+    @functools.wraps(op)
+    def wrapped(*args, **kwargs):
+        if in_shard_specs is not None:
+            args = tuple(
+                constrain(a, sp) for a, sp in
+                zip(args, list(in_shard_specs) +
+                    [None] * (len(args) - len(in_shard_specs))))
+        out = op(*args, **kwargs)
+        if out_shard_specs is not None:
+            flat, tree = jax.tree_util.tree_flatten(out)
+            specs = list(out_shard_specs) + [None] * (len(flat) - len(out_shard_specs))
+            flat = [constrain(v, sp) for v, sp in zip(flat, specs)]
+            out = jax.tree_util.tree_unflatten(tree, flat)
+        return out
+
+    return wrapped
+
+
+class Engine:
+    """Auto-parallel training/eval facade (ref static/engine.py:55).
+
+    ``prepare`` captures model/loss/optimizer; ``fit``/``evaluate``/
+    ``predict`` run jitted steps in which parameter placement comes from
+    ``shard_tensor`` annotations (or stays replicated) and XLA completes
+    every intermediate sharding — the reference's completion+partitioner+
+    resharder pipeline, done by the compiler.
+    """
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None, process_mesh: Optional[ProcessMesh] = None):
+        self._model = model
+        self._loss = loss
+        self._optimizer = optimizer
+        self._metrics = metrics or []
+        self._strategy = strategy
+        self._pm = process_mesh
+        self._params = None
+        self._opt_state = None
+        self._train_step = None
+        self._eval_step = None
+
+    # -- internals ---------------------------------------------------------
+
+    def _functional_loss(self, params, batch, training):
+        from ...framework.functional import functional_call
+        x, y = batch
+        out = functional_call(self._model, params, x, training=training)
+        loss = self._loss(out, y)
+        return jnp.mean(loss), out
+
+    def _ensure_prepared(self, sample_batch):
+        if self._train_step is not None:
+            return
+        from ...framework.functional import get_params
+        self._params = get_params(self._model)
+        if self._pm is not None:
+            # Respect existing shard_tensor placements; replicate the rest.
+            mesh = self._pm.jax_mesh
+            placed = {}
+            for k, v in self._params.items():
+                if isinstance(v, jax.Array) and hasattr(v, "sharding") and \
+                        isinstance(v.sharding, NamedSharding) and \
+                        v.sharding.mesh == mesh:
+                    placed[k] = v
+                else:
+                    placed[k] = jax.device_put(v, NamedSharding(mesh, P()))
+            self._params = placed
+        if self._optimizer is not None:
+            self._opt_state = self._optimizer.init(self._params)
+
+        opt = self._optimizer
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def train_step(params, opt_state, batch, lr):
+            (loss, _), grads = jax.value_and_grad(
+                lambda p: self._functional_loss(p, batch, True),
+                has_aux=True)(params)
+            new_p, new_s = opt.apply_gradients(params, grads, opt_state, lr)
+            return new_p, new_s, loss
+
+        @jax.jit
+        def eval_step(params, batch):
+            loss, out = self._functional_loss(params, batch, False)
+            return loss, out
+
+        self._train_step = train_step
+        self._eval_step = eval_step
+
+    def _batches(self, data, batch_size):
+        if hasattr(data, "__iter__") and not hasattr(data, "__getitem__"):
+            yield from data
+            return
+        n = len(data)
+        for i in range(0, n - batch_size + 1, batch_size):
+            items = [data[j] for j in range(i, i + batch_size)]
+            xs = np.stack([it[0] for it in items])
+            ys = np.stack([it[1] for it in items])
+            yield xs, ys
+
+    def _place_batch(self, batch):
+        if self._pm is None:
+            return jax.tree_util.tree_map(jnp.asarray, batch)
+        mesh = self._pm.jax_mesh
+        dim0 = self._pm.dim_names[0]
+        def put(a):
+            a = jnp.asarray(a)
+            spec = P(dim0) if a.shape and a.shape[0] % \
+                self._pm.get_dim_size(dim0) == 0 else P()
+            return jax.device_put(a, NamedSharding(mesh, spec))
+        return jax.tree_util.tree_map(put, batch)
+
+    # -- public surface (ref engine: fit/evaluate/predict) -----------------
+
+    def fit(self, train_data, epochs: int = 1, batch_size: int = 32,
+            lr: float = 1e-3, log_freq: int = 0) -> List[float]:
+        history = []
+        for _ in range(epochs):
+            for batch in self._batches(train_data, batch_size):
+                batch = self._place_batch(batch)
+                self._ensure_prepared(batch)
+                self._params, self._opt_state, loss = self._train_step(
+                    self._params, self._opt_state, batch, jnp.float32(lr))
+                history.append(float(loss))
+        return history
+
+    def evaluate(self, eval_data, batch_size: int = 32) -> Dict[str, float]:
+        losses = []
+        for batch in self._batches(eval_data, batch_size):
+            batch = self._place_batch(batch)
+            self._ensure_prepared(batch)
+            loss, _ = self._eval_step(self._params, batch)
+            losses.append(float(loss))
+        return {"loss": float(np.mean(losses)) if losses else float("nan")}
+
+    def predict(self, x):
+        from ...framework.functional import functional_call
+        if self._params is None:
+            from ...framework.functional import get_params
+            self._params = get_params(self._model)
+        return functional_call(self._model, self._params, jnp.asarray(x),
+                               training=False)
+
+    @property
+    def main_program(self):  # static-graph parity hook
+        return self._train_step
+
+    @property
+    def parameters(self):
+        return self._params
