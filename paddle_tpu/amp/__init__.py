@@ -1,3 +1,4 @@
 from .auto_cast import (auto_cast, amp_guard, get_amp_state, AmpState,  # noqa: F401
                         white_list, black_list, decorate)
 from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+from . import debugging  # noqa: F401

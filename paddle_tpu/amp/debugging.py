@@ -1,0 +1,89 @@
+"""NaN/Inf debugging, wired to the ``check_nan_inf`` flags.
+
+Reference design: ``FLAGS_check_nan_inf`` + ``FLAGS_check_nan_inf_level``
+(``paddle/phi/core/flags.cc:74``) make every op scan its outputs
+(``paddle/fluid/eager/nan_inf_utils.h:38``); the Python surface is
+``paddle.amp.debugging.check_numerics``.
+
+TPU-native design: per-op scanning would defeat XLA fusion, so checks attach
+at the *step boundary* (loss, grads, named activations) via
+``jax.debug.callback`` — host callbacks XLA schedules inside the compiled
+step. Level semantics follow the reference (flags.cc:95):
+  0 — raise on the first tensor holding NaN/Inf (message names the tensor);
+  1 — print every offending tensor, continue training;
+  2 — additionally flag values overflowing float16 range;
+  3 — print stats for every checked tensor.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from ..core import flags
+
+__all__ = ["check_numerics", "check_numerics_tree", "enabled"]
+
+_FP16_MAX = 65504.0
+
+
+def enabled() -> bool:
+    return bool(flags.flag("check_nan_inf"))
+
+
+def _host_check(name: str, where: str, level: int, x) -> None:
+    a = np.asarray(x)
+    if not np.issubdtype(a.dtype, np.floating):
+        return
+    n_nan = int(np.isnan(a).sum())
+    n_inf = int(np.isinf(a).sum())
+    if n_nan or n_inf:
+        msg = (f"[check_nan_inf] {where}: tensor {name!r} contains "
+               f"{n_nan} NaN / {n_inf} Inf (shape {tuple(a.shape)}, "
+               f"dtype {a.dtype})")
+        if level == 0:
+            raise FloatingPointError(msg)
+        print(msg, file=sys.stderr)
+        return
+    finite = a[np.isfinite(a)]
+    if level >= 2 and finite.size and \
+            float(np.abs(finite).max()) > _FP16_MAX:
+        print(f"[check_nan_inf] {where}: tensor {name!r} exceeds float16 "
+              f"range (max abs {float(np.abs(finite).max()):.4g})",
+              file=sys.stderr)
+    elif level >= 3 and finite.size:
+        print(f"[check_nan_inf] {where}: {name!r} min={finite.min():.4g} "
+              f"max={finite.max():.4g} mean={finite.mean():.4g}",
+              file=sys.stderr)
+
+
+def check_numerics(x, name: str = "tensor", where: str = "step",
+                   force: bool = False):
+    """Attach a NaN/Inf check to ``x`` (works under jit). Returns ``x``.
+    No-op unless ``check_nan_inf`` is set (or ``force``). Parity:
+    paddle.amp.debugging.check_numerics."""
+    if not (force or enabled()):
+        return x
+    level = int(flags.flag("check_nan_inf_level"))
+    jax.debug.callback(functools.partial(_host_check, name, where, level), x)
+    return x
+
+
+def check_numerics_tree(tree: Any, where: str = "step",
+                        force: bool = False) -> Any:
+    """Check every floating leaf of a pytree, naming leaves by their path."""
+    if not (force or enabled()):
+        return tree
+    level = int(flags.flag("check_nan_inf_level"))
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in flat:
+        if hasattr(leaf, "dtype") and \
+                jax.numpy.issubdtype(leaf.dtype, jax.numpy.floating):
+            name = jax.tree_util.keystr(path) or "leaf"
+            jax.debug.callback(
+                functools.partial(_host_check, name, where, level), leaf)
+    return tree

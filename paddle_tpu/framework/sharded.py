@@ -198,6 +198,10 @@ class TrainStep:
 
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
+            from ..amp import debugging as _dbg
+            if _dbg.enabled():  # FLAGS_check_nan_inf (ref nan_inf_utils.h:38)
+                _dbg.check_numerics(loss, "loss", where="train_step")
+                _dbg.check_numerics_tree(grads, where="train_step/grads")
             new_params, new_state = optimizer.apply_gradients(
                 params, grads, opt_state, lr)
             return loss, new_params, new_state, new_buffers

@@ -154,6 +154,11 @@ class Model:
             (trainable, frozen, grads, total, out, new_buf,
              found_inf) = grads_of(params, buffers, scaler_state, inputs,
                                    labels, key)
+            from ..amp import debugging as _dbg
+            if _dbg.enabled():  # FLAGS_check_nan_inf (ref nan_inf_utils.h:38)
+                _dbg.check_numerics(total, "loss", where="Model.train_batch")
+                _dbg.check_numerics_tree(grads,
+                                         where="Model.train_batch/grads")
             if use_scaler:
                 new_scaler_state = scaler.update_state(scaler_state, found_inf)
             else:

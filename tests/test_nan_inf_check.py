@@ -1,0 +1,87 @@
+"""NaN/Inf flag wiring tests (ref FLAGS_check_nan_inf, phi/core/flags.cc:74;
+per-op scan nan_inf_utils.h:38 — here attached at step boundaries)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.amp import debugging
+from paddle_tpu.core import flags
+
+
+@pytest.fixture
+def nan_check_on():
+    flags.set_flags({"check_nan_inf": True, "check_nan_inf_level": 0})
+    yield
+    flags.set_flags({"check_nan_inf": False, "check_nan_inf_level": 0})
+
+
+def test_check_numerics_raises_with_name(nan_check_on):
+    @jax.jit
+    def f(x):
+        y = jnp.log(x)
+        return debugging.check_numerics(y, "log_out") * 2
+
+    with pytest.raises(Exception, match="log_out"):
+        jax.block_until_ready(f(jnp.asarray([-1.0, 2.0])))
+
+
+def test_check_numerics_noop_when_flag_off():
+    @jax.jit
+    def f(x):
+        return debugging.check_numerics(jnp.log(x), "log_out")
+
+    out = f(jnp.asarray([-1.0, 2.0]))  # NaN flows through silently
+    assert np.isnan(np.asarray(out)[0])
+
+
+def test_check_numerics_level1_warns_not_raises(nan_check_on, capsys):
+    flags.set_flags({"check_nan_inf_level": 1})
+
+    @jax.jit
+    def f(x):
+        return debugging.check_numerics(jnp.log(x), "log_out")
+
+    out = jax.block_until_ready(f(jnp.asarray([-1.0, 2.0])))
+    assert np.isnan(np.asarray(out)[0])
+    err = capsys.readouterr().err
+    assert "log_out" in err and "NaN" in err
+
+
+def test_train_step_nan_raises_with_offending_name(nan_check_on):
+    """A NaN forward (inf lr-scale injected via weights) must fail the
+    sharded train step and name the offending tensor."""
+    from paddle_tpu.framework.functional import functional_call, get_params
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 4))
+    # Poison a weight so the loss is NaN.
+    model[0].weight = model[0].weight.at[0, 0].set(jnp.nan)
+
+    def loss_fn(m, p, batch):
+        x, y = batch
+        out = functional_call(m, p, x, training=True)
+        return jnp.mean((out - y) ** 2)
+
+    ts = make_sharded_train_step(model, AdamW(learning_rate=1e-2), loss_fn,
+                                 fsdp_axis=None, data_axes=())
+    x = np.ones((2, 4), np.float32)
+    with pytest.raises(Exception, match="loss"):
+        jax.block_until_ready(ts.step((x, x)))
+
+
+def test_tree_check_names_offending_grad(nan_check_on):
+    grads = {"layer0.weight": jnp.ones((2, 2)),
+             "layer1.weight": jnp.asarray([[jnp.inf, 1.0]])}
+
+    @jax.jit
+    def f(g):
+        return debugging.check_numerics_tree(g, where="grads")
+
+    with pytest.raises(Exception, match="layer1"):
+        jax.block_until_ready(f(grads))
