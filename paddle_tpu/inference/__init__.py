@@ -1,0 +1,204 @@
+"""Inference predictor API.
+
+Reference design: ``paddle_infer::CreatePredictor(config)`` →
+``AnalysisPredictor`` (``paddle/fluid/inference/api/analysis_predictor.h:94``)
+— load saved program+params, run the Analyzer IR pass pipeline (fusion,
+mixed precision, memory optim per ``api/paddle_pass_builder.cc``), then
+execute per-run: copy inputs → executor → fetch outputs through named
+handles.
+
+TPU-native design: the saved model is a serialized StableHLO export
+(``paddle_tpu.jit.save``); "analysis passes" are XLA's compilation (fusion /
+layout / memory optimization happen in the compiler, so the pass-pipeline
+surface reduces to compile options), and the per-run path is an AOT-compiled
+executable call. The named-handle copy_from_cpu/run/copy_to_cpu protocol is
+kept verbatim so reference users can port serving code unchanged.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PredictorBenchmark"]
+
+
+class Config:
+    """ref: paddle_infer.Config (api/paddle_analysis_config.h). Holds the
+    model path + execution options; GPU/TensorRT/MKLDNN toggles are accepted
+    for API compatibility and mapped to their TPU/XLA meaning (or ignored
+    where XLA always does the optimization)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # jit.save writes <path>.pdmodel/<path>.pdiparams; accept either the
+        # bare prefix or the .pdmodel path.
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+        self._ir_optim = True
+        self._memory_optim = True
+        self._device = "tpu"
+        self._precision = None  # None = saved dtype; "bf16" casts params
+        self._cpu_threads = 1
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        if prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self._prefix = prog_file
+
+    def model_dir(self) -> Optional[str]:
+        return self._prefix
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._ir_optim = flag  # XLA always optimizes; kept for parity
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._memory_optim = flag
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self._device = "accelerator"  # any accelerator == default backend
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._cpu_threads = n
+
+    def enable_low_precision(self, dtype: str = "bf16"):
+        """TPU analog of enable_use_gpu(precision=half)/TensorRT fp16."""
+        self._precision = dtype
+
+    def summary(self) -> str:
+        return (f"Config(prefix={self._prefix!r}, device={self._device}, "
+                f"precision={self._precision or 'saved'})")
+
+
+class Tensor:
+    """Named input/output handle (ref: paddle_infer.Tensor /
+    ZeroCopyTensor). copy_from_cpu stages a host array; copy_to_cpu
+    materializes the device result."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape: Sequence[int]):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"output {self.name!r} not populated — "
+                               "call predictor.run() first")
+        return np.asarray(self._value)
+
+    @property
+    def shape(self):
+        return None if self._value is None else tuple(self._value.shape)
+
+
+class Predictor:
+    """ref AnalysisPredictor: named-handle run protocol over the AOT
+    executable."""
+
+    def __init__(self, config: Config):
+        from ..jit import load as jit_load
+        if not config.model_dir():
+            raise ValueError("Config has no model path")
+        self._config = config
+        self._translated = jit_load(config.model_dir())
+        n_in = self._n_model_inputs()
+        self._input_names = [f"x{i}" for i in range(n_in)]
+        self._inputs: Dict[str, Tensor] = {n: Tensor(n)
+                                           for n in self._input_names}
+        self._outputs: Dict[str, Tensor] = {}
+        self._output_names: List[str] = []
+
+    def _n_model_inputs(self) -> int:
+        # Exported calling convention: (params_tree, buffers_tree, *xs).
+        exported = self._translated._exported
+        tree = exported.in_tree
+        # in_tree is ((args...), kwargs); args = (params, buffers, *xs)
+        n_args = tree.num_leaves  # leaves include params/buffers
+        n_pb = (len(jax.tree_util.tree_leaves(self._translated._params)) +
+                len(jax.tree_util.tree_leaves(self._translated._buffers)))
+        # Remaining leaves are the example inputs.
+        return max(1, n_args - n_pb)
+
+    # -- handle protocol ---------------------------------------------------
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute. Either pass arrays positionally (returns outputs like
+        the reference's predictor.run(inputs) overload) or stage them via
+        get_input_handle(...).copy_from_cpu(...) first."""
+        if inputs is not None:
+            for n, a in zip(self._input_names, inputs):
+                self._inputs[n].copy_from_cpu(a)
+        xs = []
+        for n in self._input_names:
+            v = self._inputs[n]._value
+            if v is None:
+                raise RuntimeError(f"input {n!r} not set")
+            xs.append(jnp.asarray(v))
+        out = self._translated(*xs)
+        flat = jax.tree_util.tree_leaves(out)
+        self._output_names = [f"out{i}" for i in range(len(flat))]
+        self._outputs = {}
+        for n, v in zip(self._output_names, flat):
+            t = Tensor(n)
+            t.copy_from_cpu(np.asarray(v))
+            self._outputs[n] = t
+        if inputs is not None:
+            return [self._outputs[n].copy_to_cpu()
+                    for n in self._output_names]
+        return True
+
+    def clear_intermediate_tensor(self):
+        pass  # XLA manages buffers
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    """ref: paddle_infer::CreatePredictor."""
+    return Predictor(config)
+
+
+class PredictorBenchmark:
+    """Latency micro-bench (ref fluid/inference/utils/benchmark.h)."""
+
+    def __init__(self, predictor: Predictor):
+        self.predictor = predictor
+
+    def run(self, inputs: Sequence[np.ndarray], warmup: int = 2,
+            repeat: int = 10) -> Dict[str, float]:
+        for _ in range(warmup):
+            self.predictor.run(list(inputs))
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            out = self.predictor.run(list(inputs))
+        dt = (time.perf_counter() - t0) / repeat
+        return {"latency_ms": dt * 1e3, "qps": (1.0 / dt) if dt else 0.0}

@@ -1,0 +1,58 @@
+"""Inference predictor tests (ref AnalysisPredictor round-trip:
+save → Config → create_predictor → named handles → run)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import (Config, PredictorBenchmark,
+                                  create_predictor)
+
+
+def _save_model(tmp_path, seed=0):
+    paddle.seed(seed)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    model.eval()
+    path = str(tmp_path / "infer_model")
+    paddle.jit.save(model, path, input_spec=[((2, 8), "float32")])
+    return model, path
+
+
+def test_predictor_roundtrip_matches_layer(tmp_path):
+    model, path = _save_model(tmp_path)
+    x = np.random.default_rng(0).standard_normal((2, 8)).astype(np.float32)
+    ref = np.asarray(model(x))
+
+    config = Config(path)
+    pred = create_predictor(config)
+    assert pred.get_input_names() == ["x0"]
+    pred.get_input_handle("x0").copy_from_cpu(x)
+    pred.run()
+    names = pred.get_output_names()
+    assert names == ["out0"]
+    out = pred.get_output_handle("out0").copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_positional_run_and_pdmodel_path(tmp_path):
+    model, path = _save_model(tmp_path, seed=1)
+    x = np.random.default_rng(1).standard_normal((2, 8)).astype(np.float32)
+    config = Config(path + ".pdmodel")  # file path accepted like the ref
+    pred = create_predictor(config)
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], np.asarray(model(x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_benchmark(tmp_path):
+    _, path = _save_model(tmp_path, seed=2)
+    pred = create_predictor(Config(path))
+    x = np.zeros((2, 8), np.float32)
+    stats = PredictorBenchmark(pred).run([x], warmup=1, repeat=3)
+    assert stats["latency_ms"] > 0 and stats["qps"] > 0
+
+
+def test_predictor_errors():
+    with pytest.raises(ValueError, match="model path"):
+        create_predictor(Config())
