@@ -179,10 +179,14 @@ def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
     dn = lax.conv_dimension_numbers(
         x.shape, weight.shape,
         ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "OIHW", "NHWC"))
+    # No preferred_element_type here: the TPU MXU accumulates bf16 convs in
+    # fp32 internally anyway, and requesting an f32 output makes the conv
+    # VJP call conv_general_dilated with mixed (bf16 lhs, f32 cotangent)
+    # dtypes, which lax rejects.
     out = lax.conv_general_dilated(
         x, weight, window_strides=stride, padding=pad,
-        rhs_dilation=dilation, dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32 if x.dtype == jnp.bfloat16 else None)
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
     out = out.astype(x.dtype)
     if bias is not None:
         if data_format == "NCHW":

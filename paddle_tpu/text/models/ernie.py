@@ -1,0 +1,200 @@
+"""ERNIE model family (BASELINE config 5: ERNIE-3.0 pipeline parallel pp=4).
+
+ERNIE is a BERT-shaped bidirectional encoder with an extra *task-type*
+embedding table (ERNIE 2.0/3.0 continual multi-task pretraining) and, for
+pretraining, a tied-embedding MLM head plus a sentence-order head. The
+reference ships ERNIE through PaddleNLP on top of the fleet stack; here the
+model is built from the same Layer/TransformerEncoder primitives as our
+BERT and exposes ``ernie_pipeline_descs`` — the LayerDesc list that drops
+into ``PipelineLayer`` for the pp=4 workload (ref
+fleet/meta_parallel/parallel_layers/pp_layers.py partitioning).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ... import nn
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer import ParamAttr
+
+__all__ = ["ErnieConfig", "Ernie", "ErnieForPretraining", "ernie_base",
+           "ernie_tiny", "ernie_pipeline_descs"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+    hidden_dropout: float = 0.1
+    attention_dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+
+
+def ernie_base(**overrides) -> ErnieConfig:
+    """ernie-3.0-base-zh dimensions."""
+    return ErnieConfig(**overrides)
+
+
+def ernie_tiny(**overrides) -> ErnieConfig:
+    return ErnieConfig(**{**dict(vocab_size=1024, hidden_size=128,
+                                 num_layers=2, num_heads=4,
+                                 intermediate_size=512,
+                                 max_position_embeddings=128), **overrides})
+
+
+def _attr(cfg: ErnieConfig) -> ParamAttr:
+    return ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=_attr(cfg))
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            weight_attr=_attr(cfg))
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size, weight_attr=_attr(cfg))
+        if cfg.use_task_id:
+            self.task_type_embeddings = nn.Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size,
+                weight_attr=_attr(cfg))
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.hidden_dropout)
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None):
+        s = input_ids.shape[1]
+        pos = jnp.arange(s)[None, :]
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + self.token_type_embeddings(token_type_ids)
+        if self.cfg.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = jnp.zeros_like(input_ids)
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+def _encoder_layer(cfg: ErnieConfig) -> nn.TransformerEncoderLayer:
+    return nn.TransformerEncoderLayer(
+        cfg.hidden_size, cfg.num_heads, cfg.intermediate_size,
+        dropout=cfg.hidden_dropout, activation="gelu",
+        attn_dropout=cfg.attention_dropout, weight_attr=_attr(cfg))
+
+
+class Ernie(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = ErnieEmbeddings(cfg)
+        self.encoder = nn.TransformerEncoder(lambda: _encoder_layer(cfg),
+                                             cfg.num_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                weight_attr=_attr(cfg))
+
+    def forward(self, input_ids, token_type_ids=None, task_type_ids=None,
+                attention_mask=None):
+        x = self.embeddings(input_ids, token_type_ids, task_type_ids)
+        mask = None
+        if attention_mask is not None:
+            mask = (1.0 - attention_mask[:, None, None, :].astype(x.dtype)) \
+                * -1e9
+        x = self.encoder(x, src_mask=mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """Tied-embedding MLM + sentence-order prediction heads."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.ernie = Ernie(cfg)
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                       weight_attr=_attr(cfg))
+        self.mlm_norm = nn.LayerNorm(cfg.hidden_size,
+                                     epsilon=cfg.layer_norm_epsilon)
+        self.mlm_bias = self.create_parameter((cfg.vocab_size,), is_bias=True)
+        self.sop_head = nn.Linear(cfg.hidden_size, 2, weight_attr=_attr(cfg))
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, sop_labels=None):
+        seq, pooled = self.ernie(input_ids, token_type_ids, None,
+                                 attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        logits = jnp.matmul(
+            h, self.ernie.embeddings.word_embeddings.weight.T) + self.mlm_bias
+        sop_logits = self.sop_head(pooled)
+        if masked_lm_labels is None:
+            return logits, sop_logits
+        loss = F.cross_entropy(logits, masked_lm_labels, ignore_index=-100,
+                               reduction="mean")
+        if sop_labels is not None:
+            loss = loss + F.cross_entropy(sop_logits, sop_labels.reshape(-1),
+                                          reduction="mean")
+        return loss
+
+
+class _ErniePipeEmbed(nn.Layer):
+    """Stage-0 head for the pipeline: ids -> embedded activations."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.embeddings = ErnieEmbeddings(cfg)
+
+    def forward(self, input_ids):
+        return self.embeddings(input_ids)
+
+
+class _ErniePipeBlock(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.block = _encoder_layer(cfg)
+
+    def forward(self, x):
+        return self.block(x)
+
+
+class _ErniePipeHead(nn.Layer):
+    """Final norm + untied MLM projection (pipeline stages cannot tie to the
+    stage-0 embedding without a shared-param group; ref SharedLayerDesc)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                   weight_attr=_attr(cfg))
+        self.norm = nn.LayerNorm(cfg.hidden_size,
+                                 epsilon=cfg.layer_norm_epsilon)
+        self.proj = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                              weight_attr=_attr(cfg))
+
+    def forward(self, x):
+        return self.proj(self.norm(F.gelu(self.transform(x))))
+
+
+def ernie_pipeline_descs(cfg: ErnieConfig):
+    """LayerDesc list for PipelineLayer (BASELINE config 5: pp=4).
+    Embedding head + num_layers homogeneous encoder blocks + MLM tail; the
+    pipeline analyzer keeps head/tail outside the pipelined trunk."""
+    from ...distributed.fleet.meta_parallel.pp_layers import LayerDesc
+    descs = [LayerDesc(_ErniePipeEmbed, cfg)]
+    descs += [LayerDesc(_ErniePipeBlock, cfg) for _ in range(cfg.num_layers)]
+    descs.append(LayerDesc(_ErniePipeHead, cfg))
+    return descs
