@@ -43,6 +43,11 @@ from .hapi.model import Model  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
 from . import inference  # noqa: F401
+from . import sparse  # noqa: F401
+from . import distribution  # noqa: F401
+from . import geometric  # noqa: F401
+from . import audio  # noqa: F401
+from . import quantization  # noqa: F401
 from . import incubate  # noqa: F401
 from . import text  # noqa: F401
 

@@ -1,0 +1,130 @@
+"""paddle.audio.features parity: Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC layers (ref audio/features/layers.py:24/106/206/309).
+
+TPU-native: STFT is framing (gather) + windowed rFFT — jnp.fft lowers to the
+XLA FFT op; the mel/DCT projections are matmuls on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax.numpy as jnp
+
+from .. import nn
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _stft(x, n_fft: int, hop_length: int, win_length: int, window,
+          center: bool, pad_mode: str):
+    """x: [..., T] -> complex [..., n_fft//2+1, frames]."""
+    if center:
+        pad = [(0, 0)] * (x.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+        x = jnp.pad(x, pad, mode=pad_mode)
+    T = x.shape[-1]
+    n_frames = 1 + (T - n_fft) // hop_length
+    starts = jnp.arange(n_frames) * hop_length
+    idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+    frames = x[..., idx]                       # [..., frames, n_fft]
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        window = jnp.pad(window, (lpad, n_fft - win_length - lpad))
+    frames = frames * window
+    spec = jnp.fft.rfft(frames, n=n_fft, axis=-1)  # [..., frames, bins]
+    return jnp.moveaxis(spec, -1, -2)              # [..., bins, frames]
+
+
+class Spectrogram(nn.Layer):
+    """ref features/layers.py:24."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.register_buffer(
+            "window", AF.get_window(window, self.win_length, dtype=dtype))
+
+    def forward(self, x):
+        spec = _stft(x, self.n_fft, self.hop_length, self.win_length,
+                     self.window, self.center, self.pad_mode)
+        return jnp.abs(spec) ** self.power
+
+
+class MelSpectrogram(nn.Layer):
+    """ref features/layers.py:106."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.register_buffer(
+            "fbank", AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                             htk, norm, dtype))
+
+    def forward(self, x):
+        spec = self.spectrogram(x)             # [..., bins, frames]
+        return jnp.matmul(self.fbank, spec)    # [..., n_mels, frames]
+
+
+class LogMelSpectrogram(nn.Layer):
+    """ref features/layers.py:206."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self.mel = MelSpectrogram(sr, n_fft, hop_length, win_length, window,
+                                  power, center, pad_mode, n_mels, f_min,
+                                  f_max, htk, norm, dtype)
+        self.ref_value, self.amin, self.top_db = ref_value, amin, top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self.mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(nn.Layer):
+    """ref features/layers.py:309."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, dtype: str = "float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr, n_fft, hop_length, win_length,
+                                         window, power, center, pad_mode,
+                                         n_mels, f_min, f_max, htk, norm,
+                                         ref_value, amin, top_db, dtype)
+        self.register_buffer("dct", AF.create_dct(n_mfcc, n_mels,
+                                                  dtype=dtype))
+
+    def forward(self, x):
+        mel = self.log_mel(x)                          # [..., n_mels, frames]
+        return jnp.matmul(self.dct.T, mel)             # [..., n_mfcc, frames]

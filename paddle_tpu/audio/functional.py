@@ -1,0 +1,199 @@
+"""paddle.audio.functional parity: mel/fbank/dct/window math.
+
+Reference: ``python/paddle/audio/functional/functional.py`` (hz_to_mel :22,
+mel_to_hz :78, mel_frequencies :123, fft_frequencies :163,
+compute_fbank_matrix :186, power_to_db :259, create_dct :303) and
+window.py's get_window registry. All are closed-form array math — on TPU
+they trace straight into XLA (the fbank/dct matrices are constants folded
+at compile time when shapes are static).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """ref functional.py:22 — Slaney by default, HTK formula optional."""
+    freq = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + freq / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (freq - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(freq >= min_log_hz,
+                     min_log_mel + jnp.log(freq / min_log_hz) / logstep,
+                     mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    """ref functional.py:78."""
+    mel = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (mel / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * mel
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return jnp.where(mel >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (mel - min_log_mel)),
+                     freqs)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32"):
+    """ref functional.py:123."""
+    lo = hz_to_mel(f_min, htk)
+    hi = hz_to_mel(f_max, htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return mel_to_hz(mels, htk).astype(jnp.dtype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    """ref functional.py:163."""
+    return jnp.linspace(0.0, sr / 2.0, 1 + n_fft // 2).astype(
+        jnp.dtype(dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype="float32"):
+    """Triangular mel filter bank [n_mels, 1 + n_fft//2]
+    (ref functional.py:186)."""
+    f_max = f_max if f_max is not None else float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2: n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / jnp.maximum(
+            jnp.sum(jnp.abs(weights) ** norm, axis=1,
+                    keepdims=True) ** (1.0 / norm), 1e-10)
+    return weights.astype(jnp.dtype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """ref functional.py:259."""
+    spect = jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, spect))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return log_spec
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype="float32"):
+    """DCT-II basis [n_mels, n_mfcc] (ref functional.py:303)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    basis = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm is None:
+        basis = basis * 2.0
+    elif norm == "ortho":
+        scale = jnp.where(k == 0, math.sqrt(1.0 / (4 * n_mels)),
+                          math.sqrt(1.0 / (2 * n_mels)))
+        basis = basis * 2.0 * scale
+    else:
+        raise ValueError(f"unsupported norm {norm!r}")
+    return basis.astype(jnp.dtype(dtype))
+
+
+_WINDOWS = {}
+
+
+def _register(name):
+    def deco(fn):
+        _WINDOWS[name] = fn
+        return fn
+    return deco
+
+
+def _extend(M: int, sym: bool):
+    return (M + 1, True) if not sym else (M, False)
+
+
+def _truncate(w, trunc: bool):
+    return w[:-1] if trunc else w
+
+
+@_register("hann")
+def _hann(M: int, sym: bool = True):
+    M2, trunc = _extend(M, sym)
+    n = jnp.arange(M2)
+    return _truncate(0.5 - 0.5 * jnp.cos(2 * math.pi * n / (M2 - 1)), trunc)
+
+
+@_register("hamming")
+def _hamming(M: int, sym: bool = True):
+    M2, trunc = _extend(M, sym)
+    n = jnp.arange(M2)
+    return _truncate(0.54 - 0.46 * jnp.cos(2 * math.pi * n / (M2 - 1)),
+                     trunc)
+
+
+@_register("blackman")
+def _blackman(M: int, sym: bool = True):
+    M2, trunc = _extend(M, sym)
+    n = jnp.arange(M2)
+    w = (0.42 - 0.5 * jnp.cos(2 * math.pi * n / (M2 - 1))
+         + 0.08 * jnp.cos(4 * math.pi * n / (M2 - 1)))
+    return _truncate(w, trunc)
+
+
+@_register("bartlett")
+def _bartlett(M: int, sym: bool = True):
+    M2, trunc = _extend(M, sym)
+    n = jnp.arange(M2)
+    w = 2.0 / (M2 - 1) * ((M2 - 1) / 2.0 - jnp.abs(n - (M2 - 1) / 2.0))
+    return _truncate(w, trunc)
+
+
+@_register("cosine")
+def _cosine(M: int, sym: bool = True):
+    M2, trunc = _extend(M, sym)
+    n = jnp.arange(M2)
+    return _truncate(jnp.sin(math.pi / M2 * (n + 0.5)), trunc)
+
+
+@_register("gaussian")
+def _gaussian(M: int, std: float = 7.0, sym: bool = True):
+    M2, trunc = _extend(M, sym)
+    n = jnp.arange(M2) - (M2 - 1) / 2.0
+    return _truncate(jnp.exp(-(n ** 2) / (2 * std ** 2)), trunc)
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype="float32"):
+    """ref window.py get_window: name or (name, param) tuple."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    fn = _WINDOWS.get(name)
+    if fn is None:
+        raise ValueError(f"unknown window {name!r} "
+                         f"(available: {sorted(_WINDOWS)})")
+    return fn(win_length, *args, sym=not fftbins).astype(jnp.dtype(dtype))

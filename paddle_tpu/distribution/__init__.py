@@ -1,0 +1,617 @@
+"""paddle.distribution parity: probability distributions + kl_divergence.
+
+Reference design: ``python/paddle/distribution/`` — a ``Distribution`` base
+(distribution.py) with sample/rsample/log_prob/entropy/kl surface, concrete
+families (normal.py, uniform.py, bernoulli.py, categorical.py, beta.py,
+dirichlet.py, exponential.py, geometric.py, gumbel.py, laplace.py,
+lognormal.py, multinomial.py, cauchy.py), a transform stack
+(transform.py/transformed_distribution.py), and a double-dispatch KL
+registry (kl.py register_kl).
+
+TPU-native design: samplers are functional over explicit PRNG keys
+(threefry) with an ambient-key fallback for paddle's stateful call style;
+densities/entropies are jnp expressions (jit/vmap/grad-compatible).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.random import next_key as _next_rng_key
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Beta", "Dirichlet", "Exponential", "Geometric", "Gumbel",
+           "Laplace", "LogNormal", "Multinomial", "Cauchy", "Independent",
+           "TransformedDistribution", "kl_divergence", "register_kl",
+           "AffineTransform", "ExpTransform", "SigmoidTransform"]
+
+
+def _key(seed: Optional[int] = None):
+    if seed is not None and seed != 0:
+        return jax.random.key(seed)
+    return _next_rng_key()
+
+
+class Distribution:
+    """ref distribution.py Distribution."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    # paddle surface: sample(shape) draws without grad, rsample with.
+    def sample(self, shape=(), seed: Optional[int] = None):
+        return jax.lax.stop_gradient(self.rsample(shape, seed))
+
+    def rsample(self, shape=(), seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return jnp.exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution"):
+        return kl_divergence(self, other)
+
+
+def _bshape(*args):
+    return jnp.broadcast_shapes(*(jnp.shape(a) for a in args))
+
+
+class Normal(Distribution):
+    """ref normal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(self.scale ** 2, self.batch_shape)
+
+    def rsample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        eps = jax.random.normal(_key(seed), shape)
+        return self.loc + eps * self.scale
+
+    def log_prob(self, value):
+        var = self.scale ** 2
+        return (-((value - self.loc) ** 2) / (2 * var)
+                - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape)
+
+    def cdf(self, value):
+        return 0.5 * (1 + jax.scipy.special.erf(
+            (value - self.loc) / (self.scale * math.sqrt(2))))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        self._base = Normal(loc, scale)
+        super().__init__(self._base.batch_shape)
+
+    @property
+    def mean(self):
+        return jnp.exp(self.loc + self.scale ** 2 / 2)
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return (jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2)
+
+    def rsample(self, shape=(), seed=None):
+        return jnp.exp(self._base.rsample(shape, seed))
+
+    def log_prob(self, value):
+        return self._base.log_prob(jnp.log(value)) - jnp.log(value)
+
+    def entropy(self):
+        return self._base.entropy() + self.loc
+
+
+class Uniform(Distribution):
+    """ref uniform.py — [low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = jnp.asarray(low, jnp.float32)
+        self.high = jnp.asarray(high, jnp.float32)
+        super().__init__(_bshape(self.low, self.high))
+
+    def rsample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_key(seed), shape)
+        return self.low + u * (self.high - self.low)
+
+    def log_prob(self, value):
+        inside = (value >= self.low) & (value < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return jnp.where(inside, lp, -jnp.inf)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.high - self.low),
+                                self.batch_shape)
+
+
+class Bernoulli(Distribution):
+    """ref bernoulli.py — probs parameterization."""
+
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return self.probs
+
+    @property
+    def variance(self):
+        return self.probs * (1 - self.probs)
+
+    def sample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        return jax.random.bernoulli(
+            _key(seed), self.probs, shape).astype(jnp.float32)
+
+    def rsample(self, shape=(), seed=None, temperature: float = 1.0):
+        """Gumbel-softmax relaxation (the reference's rsample uses the same
+        reparameterization)."""
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_key(seed), shape, minval=1e-6, maxval=1 - 1e-6)
+        logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        noise = jnp.log(u) - jnp.log1p(-u)
+        return jax.nn.sigmoid((logits + noise) / temperature)
+
+    def log_prob(self, value):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return value * jnp.log(p) + (1 - value) * jnp.log1p(-p)
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return -(p * jnp.log(p) + (1 - p) * jnp.log1p(-p))
+
+
+class Categorical(Distribution):
+    """ref categorical.py — logits parameterization."""
+
+    def __init__(self, logits, name=None):
+        self.logits = jnp.asarray(logits, jnp.float32)
+        super().__init__(jnp.shape(self.logits)[:-1])
+
+    @property
+    def probs(self):
+        return jax.nn.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=(), seed=None):
+        return jax.random.categorical(_key(seed), self.logits,
+                                      shape=tuple(shape) + self.batch_shape)
+
+    rsample = sample  # discrete: no reparameterization (matches reference)
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        value = jnp.asarray(value, jnp.int32)
+        return jnp.take_along_axis(logp, value[..., None], axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+
+class Multinomial(Distribution):
+    """ref multinomial.py — total_count trials over probs."""
+
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(jnp.shape(self.probs)[:-1],
+                         jnp.shape(self.probs)[-1:])
+
+    def sample(self, shape=(), seed=None):
+        k = self.probs.shape[-1]
+        draws = jax.random.categorical(
+            _key(seed), jnp.log(self.probs),
+            shape=(self.total_count,) + tuple(shape) + self.batch_shape)
+        return jax.nn.one_hot(draws, k).sum(axis=0)
+
+    def log_prob(self, value):
+        value = jnp.asarray(value, jnp.float32)
+        logp = jnp.log(self.probs)
+        coeff = (jax.scipy.special.gammaln(self.total_count + 1.0)
+                 - jnp.sum(jax.scipy.special.gammaln(value + 1.0), axis=-1))
+        return coeff + jnp.sum(value * logp, axis=-1)
+
+
+class Exponential(Distribution):
+    """ref exponential.py — rate parameterization."""
+
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(rate, jnp.float32)
+        super().__init__(jnp.shape(self.rate))
+
+    @property
+    def mean(self):
+        return 1.0 / self.rate
+
+    @property
+    def variance(self):
+        return 1.0 / self.rate ** 2
+
+    def rsample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        return jax.random.exponential(_key(seed), shape) / self.rate
+
+    def log_prob(self, value):
+        return jnp.where(value >= 0, jnp.log(self.rate) - self.rate * value,
+                         -jnp.inf)
+
+    def entropy(self):
+        return jnp.broadcast_to(1.0 - jnp.log(self.rate), self.batch_shape)
+
+
+class Geometric(Distribution):
+    """ref geometric.py — failures-before-first-success, support {0,1,...}."""
+
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(probs, jnp.float32)
+        super().__init__(jnp.shape(self.probs))
+
+    @property
+    def mean(self):
+        return (1 - self.probs) / self.probs
+
+    def sample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        u = jax.random.uniform(_key(seed), shape, minval=1e-7, maxval=1.0)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-self.probs))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        return value * jnp.log1p(-self.probs) + jnp.log(self.probs)
+
+    def entropy(self):
+        p = self.probs
+        return (-(1 - p) * jnp.log1p(-p) - p * jnp.log(p)) / p
+
+
+class Gumbel(Distribution):
+    """ref gumbel.py."""
+
+    _EULER = 0.57721566490153286060
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return self.loc + self.scale * self._EULER
+
+    def rsample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        g = jax.random.gumbel(_key(seed), shape)
+        return self.loc + self.scale * g
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -(z + jnp.exp(-z)) - jnp.log(self.scale)
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(self.scale) + 1 + self._EULER,
+                                self.batch_shape)
+
+
+class Laplace(Distribution):
+    """ref laplace.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    @property
+    def mean(self):
+        return jnp.broadcast_to(self.loc, self.batch_shape)
+
+    @property
+    def variance(self):
+        return jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape)
+
+    def rsample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        return jax.random.laplace(_key(seed), shape) * self.scale + self.loc
+
+    def log_prob(self, value):
+        return (-jnp.abs(value - self.loc) / self.scale
+                - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                self.batch_shape)
+
+
+class Cauchy(Distribution):
+    """ref cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+        super().__init__(_bshape(self.loc, self.scale))
+
+    def rsample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        return jax.random.cauchy(_key(seed), shape) * self.scale + self.loc
+
+    def log_prob(self, value):
+        z = (value - self.loc) / self.scale
+        return -jnp.log(math.pi * self.scale * (1 + z ** 2))
+
+    def entropy(self):
+        return jnp.broadcast_to(jnp.log(4 * math.pi * self.scale),
+                                self.batch_shape)
+
+
+class Beta(Distribution):
+    """ref beta.py."""
+
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = jnp.asarray(alpha, jnp.float32)
+        self.beta = jnp.asarray(beta, jnp.float32)
+        super().__init__(_bshape(self.alpha, self.beta))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    def rsample(self, shape=(), seed=None):
+        shape = tuple(shape) + self.batch_shape
+        return jax.random.beta(_key(seed), self.alpha, self.beta, shape)
+
+    def log_prob(self, value):
+        lbeta = (jax.scipy.special.gammaln(self.alpha)
+                 + jax.scipy.special.gammaln(self.beta)
+                 - jax.scipy.special.gammaln(self.alpha + self.beta))
+        return ((self.alpha - 1) * jnp.log(value)
+                + (self.beta - 1) * jnp.log1p(-value) - lbeta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                + (a + b - 2) * dg(a + b))
+
+
+class Dirichlet(Distribution):
+    """ref dirichlet.py."""
+
+    def __init__(self, concentration, name=None):
+        self.concentration = jnp.asarray(concentration, jnp.float32)
+        super().__init__(jnp.shape(self.concentration)[:-1],
+                         jnp.shape(self.concentration)[-1:])
+
+    @property
+    def mean(self):
+        return self.concentration / jnp.sum(self.concentration, -1,
+                                            keepdims=True)
+
+    def rsample(self, shape=(), seed=None):
+        return jax.random.dirichlet(_key(seed), self.concentration,
+                                    tuple(shape) + self.batch_shape)
+
+    def log_prob(self, value):
+        a = self.concentration
+        lnorm = (jnp.sum(jax.scipy.special.gammaln(a), axis=-1)
+                 - jax.scipy.special.gammaln(jnp.sum(a, axis=-1)))
+        return jnp.sum((a - 1) * jnp.log(value), axis=-1) - lnorm
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, axis=-1)
+        k = a.shape[-1]
+        dg = jax.scipy.special.digamma
+        lnorm = (jnp.sum(jax.scipy.special.gammaln(a), axis=-1)
+                 - jax.scipy.special.gammaln(a0))
+        return (lnorm + (a0 - k) * dg(a0)
+                - jnp.sum((a - 1) * dg(a), axis=-1))
+
+
+class Independent(Distribution):
+    """ref independent.py — reinterpret batch dims as event dims."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        b = base.batch_shape
+        super().__init__(b[: len(b) - self.rank],
+                         b[len(b) - self.rank:] + base.event_shape)
+
+    def rsample(self, shape=(), seed=None):
+        return self.base.rsample(shape, seed)
+
+    sample = lambda self, shape=(), seed=None: self.base.sample(shape, seed)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+        return jnp.sum(lp, axis=tuple(range(-self.rank, 0)))
+
+    def entropy(self):
+        e = self.base.entropy()
+        return jnp.sum(e, axis=tuple(range(-self.rank, 0)))
+
+
+# -- transforms -------------------------------------------------------------
+
+class Transform:
+    def forward(self, x):
+        raise NotImplementedError
+
+    def inverse(self, y):
+        raise NotImplementedError
+
+    def forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = jnp.asarray(loc, jnp.float32)
+        self.scale = jnp.asarray(scale, jnp.float32)
+
+    def forward(self, x):
+        return self.loc + self.scale * x
+
+    def inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), jnp.shape(x))
+
+
+class ExpTransform(Transform):
+    def forward(self, x):
+        return jnp.exp(x)
+
+    def inverse(self, y):
+        return jnp.log(y)
+
+    def forward_log_det_jacobian(self, x):
+        return x
+
+
+class SigmoidTransform(Transform):
+    def forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TransformedDistribution(Distribution):
+    """ref transformed_distribution.py."""
+
+    def __init__(self, base: Distribution, transforms: Sequence[Transform]):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def rsample(self, shape=(), seed=None):
+        x = self.base.rsample(shape, seed)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = jnp.zeros(jnp.shape(value))
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            lp = lp - t.forward_log_det_jacobian(x)
+            y = x
+        return lp + self.base.log_prob(y)
+
+
+# -- KL registry (ref kl.py register_kl double dispatch) --------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return 0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return jnp.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    pp = jnp.clip(p.probs, 1e-7, 1 - 1e-7)
+    qp = jnp.clip(q.probs, 1e-7, 1 - 1e-7)
+    return (pp * (jnp.log(pp) - jnp.log(qp))
+            + (1 - pp) * (jnp.log1p(-pp) - jnp.log1p(-qp)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    logp = jax.nn.log_softmax(p.logits, axis=-1)
+    logq = jax.nn.log_softmax(q.logits, axis=-1)
+    return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    ratio = q.rate / p.rate
+    return jnp.log(p.rate) - jnp.log(q.rate) + ratio - 1
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    delta = jnp.abs(p.loc - q.loc) / q.scale
+    return (-jnp.log(scale_ratio) + scale_ratio * jnp.exp(
+        -jnp.abs(p.loc - q.loc) / p.scale) + delta - 1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    dg = jax.scipy.special.digamma
+    gl = jax.scipy.special.gammaln
+    pa, pb, qa, qb = p.alpha, p.beta, q.alpha, q.beta
+    return ((gl(qa) + gl(qb) - gl(qa + qb))
+            - (gl(pa) + gl(pb) - gl(pa + pb))
+            + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
+            + (qa + qb - pa - pb) * dg(pa + pb))

@@ -7,7 +7,7 @@ from typing import List, Optional, Sequence, Union
 
 import numpy as np
 
-__all__ = ["Metric", "Accuracy", "Precision", "Recall"]
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
 
 
 class Metric:
@@ -108,3 +108,50 @@ class Recall(Metric):
     def accumulate(self):
         denom = self.tp + self.fn
         return float(self.tp) / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """Bucketed ROC-AUC (ref metrics.py Auc: histogram of positive/negative
+    scores over num_thresholds buckets, trapezoid integration)."""
+
+    def __init__(self, curve: str = "ROC", num_thresholds: int = 4095,
+                 name=None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.curve = curve
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        """preds: [N, 2] class probabilities (or [N] positive scores)."""
+        preds = np.asarray(preds)
+        labels = np.asarray(labels).reshape(-1)
+        pos_score = preds[:, 1] if preds.ndim == 2 else preds.reshape(-1)
+        idx = np.clip((pos_score * self.num_thresholds).astype(np.int64), 0,
+                      self.num_thresholds)
+        np.add.at(self._stat_pos, idx, labels == 1)
+        np.add.at(self._stat_neg, idx, labels == 0)
+
+    def accumulate(self):
+        tot_pos = tot_neg = auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_neg - tot_neg) * (new_pos + tot_pos) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc / denom) if denom else 0.0
+
+
+def accuracy(input, label, k: int = 1):
+    """Functional top-k accuracy (ref paddle.metric.accuracy)."""
+    pred = np.asarray(input)
+    lab = np.asarray(label)
+    if lab.ndim == pred.ndim and lab.shape[-1] == 1:
+        lab = lab[..., 0]
+    top = np.argsort(-pred, axis=-1)[..., :k]
+    correct = (top == lab[..., None]).any(axis=-1)
+    return float(correct.mean())

@@ -1,0 +1,247 @@
+"""paddle.quantization parity: QAT / PTQ over a QuantConfig.
+
+Reference design: ``python/paddle/quantization/`` — ``QuantConfig``
+(config.py:60) maps layers/types to quanter factories, ``QAT``
+(qat.py:23) rewrites the model with fake-quant wrappers for
+quantization-aware training, ``PTQ`` (ptq.py:24) inserts observers and
+``convert``s to a quantized inference model; observers/quanters under
+``observers/`` and ``quanters/``.
+
+TPU-native design: fake-quant is a straight-through-estimator
+``jax.custom_vjp`` (round+clamp forward, identity gradient) — it fuses into
+the surrounding XLA program; observers are running-stat buffers updated
+through the compiled step.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..nn.layer import Layer
+from .. import nn
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
+           "AbsmaxObserver", "quant_dequant", "QuantedLinear",
+           "QuantedConv2D"]
+
+
+# ---------------------------------------------------------------------------
+# Fake quantization with straight-through estimator.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def quant_dequant(x, scale, bit_length: int = 8):
+    """Simulated quantization: round(x/scale * qmax) clamped, then rescaled.
+    Gradient is straight-through (identity within range)."""
+    qmax = float(2 ** (bit_length - 1) - 1)  # symmetric, like the ref
+    s = jnp.maximum(scale, 1e-9)             # fake_quantize_abs_max kernel
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+def _qdq_fwd(x, scale, bit_length):
+    return quant_dequant(x, scale, bit_length), (x, scale)
+
+
+def _qdq_bwd(bit_length, res, g):
+    x, scale = res
+    in_range = (jnp.abs(x) <= jnp.maximum(scale, 1e-9)).astype(g.dtype)
+    return g * in_range, jnp.zeros_like(scale)
+
+
+quant_dequant.defvjp(_qdq_fwd, _qdq_bwd)
+
+
+class FakeQuanterWithAbsMax(Layer):
+    """QAT weight/activation quanter (ref quanters/abs_max.py): scale =
+    running abs-max, fake-quant with STE."""
+
+    def __init__(self, bit_length: int = 8, moving_rate: float = 0.9):
+        super().__init__()
+        self.bit_length = bit_length
+        self.moving_rate = moving_rate
+        self.register_buffer("scale", jnp.asarray(1.0, jnp.float32))
+
+    def forward(self, x):
+        cur = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        if self.training:
+            new_scale = (self.moving_rate * self.scale
+                         + (1 - self.moving_rate) * cur)
+            self.scale = new_scale
+        else:
+            new_scale = self.scale
+        return quant_dequant(x, new_scale.astype(x.dtype), self.bit_length)
+
+
+class AbsmaxObserver(Layer):
+    """PTQ observer (ref observers/abs_max.py): records abs-max, no
+    fake-quant during calibration."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.register_buffer("max_value", jnp.asarray(0.0, jnp.float32))
+
+    def forward(self, x):
+        self.max_value = jnp.maximum(self.max_value,
+                                     jnp.max(jnp.abs(x)).astype(jnp.float32))
+        return x
+
+    def scale(self):
+        return self.max_value
+
+
+# ---------------------------------------------------------------------------
+# Quanted layer wrappers.
+# ---------------------------------------------------------------------------
+
+class QuantedLinear(Layer):
+    """Linear with weight + activation fake-quant (ref nn quant wrappers)."""
+
+    def __init__(self, layer: nn.Linear, weight_quanter: Layer,
+                 act_quanter: Optional[Layer]):
+        super().__init__()
+        self.inner = layer
+        self.weight_quanter = weight_quanter
+        self.act_quanter = act_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.weight_quanter(self.inner.weight)
+        from ..nn import functional as F
+        return F.linear(x, w, getattr(self.inner, "bias", None))
+
+
+class QuantedConv2D(Layer):
+    def __init__(self, layer, weight_quanter: Layer,
+                 act_quanter: Optional[Layer]):
+        super().__init__()
+        self.inner = layer
+        self.weight_quanter = weight_quanter
+        self.act_quanter = act_quanter
+
+    def forward(self, x):
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        from ..nn import functional as F
+        w = self.weight_quanter(self.inner.weight)
+        return F.conv2d(x, w, getattr(self.inner, "bias", None),
+                        stride=self.inner._stride,
+                        padding=self.inner._padding,
+                        dilation=self.inner._dilation,
+                        groups=self.inner._groups)
+
+
+_WRAPPERS: Dict[type, type] = {}
+
+
+def _wrapper_for(layer) -> Optional[type]:
+    if isinstance(layer, nn.Linear):
+        return QuantedLinear
+    if isinstance(layer, nn.Conv2D):
+        return QuantedConv2D
+    return _WRAPPERS.get(type(layer))
+
+
+# ---------------------------------------------------------------------------
+# Config + QAT/PTQ drivers.
+# ---------------------------------------------------------------------------
+
+class QuantConfig:
+    """ref config.py:60 — which layers get quantized and how."""
+
+    def __init__(self, activation: Optional[Callable] = None,
+                 weight: Optional[Callable] = None):
+        self._default_act = activation
+        self._default_weight = weight
+        self._layer_cfg: Dict[int, tuple] = {}
+        self._type_cfg: Dict[type, tuple] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, (list, tuple)) else [layer]
+        for l in layers:
+            self._layer_cfg[id(l)] = (activation, weight)
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, (list, tuple)) \
+            else [layer_type]
+        for t in types:
+            self._type_cfg[t] = (activation, weight)
+
+    def _factories_for(self, layer):
+        if id(layer) in self._layer_cfg:
+            return self._layer_cfg[id(layer)]
+        for t, cfg in self._type_cfg.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self._default_act, self._default_weight)
+
+
+def _rewrite(model: Layer, config: QuantConfig, make_quanters,
+             require_config: bool) -> Layer:
+    """Replace each quantizable registered sublayer with its wrapper, in
+    place (sublayers live in Layer._sub_layers). ``require_config``: QAT
+    quantizes only configured layers (ref qat.py consults QuantConfig);
+    PTQ observes every quantizable layer by default."""
+    for holder in model.sublayers(include_self=True):
+        for name, child in list(holder._sub_layers.items()):
+            wrapper = _wrapper_for(child)
+            if wrapper is None:
+                continue
+            act_f, w_f = config._factories_for(child)
+            if require_config and act_f is None and w_f is None:
+                continue
+            act_q, w_q = make_quanters(act_f, w_f)
+            holder._sub_layers[name] = wrapper(child, w_q, act_q)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (ref qat.py:23)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        def mk(act_f, w_f):
+            w = w_f() if w_f is not None else FakeQuanterWithAbsMax()
+            a = act_f() if act_f is not None else None
+            return a, w
+        return _rewrite(model, self.config, mk, require_config=True)
+
+
+class PTQ:
+    """Post-training quantization driver (ref ptq.py:24): quantize inserts
+    observers; run calibration batches; convert freezes scales into
+    fake-quant wrappers."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = True) -> Layer:
+        def mk(act_f, w_f):
+            a = act_f() if act_f is not None else AbsmaxObserver()
+            w = w_f() if w_f is not None else AbsmaxObserver()
+            return a, w
+        return _rewrite(model, self.config, mk, require_config=False)
+
+    def convert(self, model: Layer, inplace: bool = True) -> Layer:
+        """Swap observers for fixed-scale fake quanters."""
+        for holder in model.sublayers(include_self=True):
+            for name, child in list(holder._sub_layers.items()):
+                if isinstance(child, (QuantedLinear, QuantedConv2D)):
+                    for attr in ("weight_quanter", "act_quanter"):
+                        obs = getattr(child, attr)
+                        if isinstance(obs, AbsmaxObserver):
+                            fq = FakeQuanterWithAbsMax(obs.quant_bits,
+                                                       moving_rate=1.0)
+                            fq.scale = obs.scale()
+                            fq.eval()
+                            setattr(child, attr, fq)
+        return model

@@ -1,0 +1,346 @@
+"""paddle.sparse parity: COO/CSR tensors + op set.
+
+Reference design: ``python/paddle/sparse/`` (creation.py sparse_coo_tensor
+:72 / sparse_csr_tensor :187; unary.py/binary.py op wrappers over phi sparse
+kernels, ``paddle/phi/kernels/sparse/``) with dedicated C++ tensor types
+(``phi/core/sparse_coo_tensor.h`` / ``sparse_csr_tensor.h``).
+
+TPU-native design: the storage types are jax.experimental.sparse's BCOO/BCSR
+(XLA-compilable, differentiable); this module wraps them in paddle-shaped
+``SparseCooTensor``/``SparseCsrTensor`` facades and provides the reference's
+functional surface. Unary ops apply to the stored values (preserving the
+sparsity pattern, exactly like the reference's sparse unary kernels — all
+listed ops are zero-preserving); binary/matmul route through BCOO dot.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from . import nn  # noqa: F401
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape",
+    # unary
+    "sin", "tan", "asin", "atan", "sinh", "asinh", "atanh", "tanh", "square",
+    "sqrt", "log1p", "abs", "neg", "pow", "expm1", "cast", "rad2deg",
+    "deg2rad", "coalesce", "isnan", "transpose", "sum", "reshape",
+    # binary / multiary
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul", "mv",
+    "addmm",
+]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (ref phi/core/sparse_coo_tensor.h) over BCOO."""
+
+    format = "coo"
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._t = bcoo
+
+    # paddle Tensor-ish surface
+    @property
+    def shape(self):
+        return tuple(self._t.shape)
+
+    @property
+    def dtype(self):
+        return self._t.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._t.nse)
+
+    def indices(self) -> jax.Array:
+        return self._t.indices.T  # paddle layout: [sparse_dim, nnz]
+
+    def values(self) -> jax.Array:
+        return self._t.data
+
+    def to_dense(self) -> jax.Array:
+        return self._t.todense()
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        if len(self.shape) != 2:
+            raise ValueError("CSR conversion requires a 2-D tensor")
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._t))
+
+    def coalesce(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._t.sum_duplicates(remove_zeros=False))
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (ref phi/core/sparse_csr_tensor.h) over BCSR."""
+
+    format = "csr"
+
+    def __init__(self, bcsr: jsparse.BCSR):
+        self._t = bcsr
+
+    @property
+    def shape(self):
+        return tuple(self._t.shape)
+
+    @property
+    def dtype(self):
+        return self._t.dtype
+
+    @property
+    def nnz(self) -> int:
+        return int(self._t.nse)
+
+    def crows(self) -> jax.Array:
+        return self._t.indptr
+
+    def cols(self) -> jax.Array:
+        return self._t.indices
+
+    def values(self) -> jax.Array:
+        return self._t.data
+
+    def to_dense(self) -> jax.Array:
+        return self._t.todense()
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) -> SparseCooTensor:
+        return SparseCooTensor(self._t.to_bcoo())
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient: bool = True):
+    """ref sparse/creation.py:72 — indices [sparse_dim, nnz], values [nnz]."""
+    indices = jnp.asarray(indices, jnp.int32)
+    values = jnp.asarray(values)
+    if dtype is not None:
+        from ..core import dtypes
+        values = values.astype(dtypes.to_dtype(dtype))
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(indices.max(axis=1)))
+        shape = shape + values.shape[1:]
+    t = jsparse.BCOO((values, indices.T), shape=tuple(shape))
+    return SparseCooTensor(t)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient: bool = True):
+    """ref sparse/creation.py:187."""
+    crows = jnp.asarray(crows, jnp.int32)
+    cols = jnp.asarray(cols, jnp.int32)
+    values = jnp.asarray(values)
+    if dtype is not None:
+        from ..core import dtypes
+        values = values.astype(dtypes.to_dtype(dtype))
+    t = jsparse.BCSR((values, cols, crows), shape=tuple(shape))
+    return SparseCsrTensor(t)
+
+
+def _unwrap(x):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return x._t
+    return x
+
+
+def _rewrap(t):
+    if isinstance(t, jsparse.BCOO):
+        return SparseCooTensor(t)
+    if isinstance(t, jsparse.BCSR):
+        return SparseCsrTensor(t)
+    return t
+
+
+def _map_values(x, fn):
+    """Apply a zero-preserving elementwise fn to the stored values."""
+    t = _unwrap(x)
+    if isinstance(t, jsparse.BCOO):
+        return SparseCooTensor(jsparse.BCOO((fn(t.data), t.indices),
+                                            shape=t.shape))
+    if isinstance(t, jsparse.BCSR):
+        return SparseCsrTensor(jsparse.BCSR((fn(t.data), t.indices, t.indptr),
+                                            shape=t.shape))
+    return fn(t)  # dense passthrough, like the reference's dense overloads
+
+
+def _make_unary(name, fn):
+    def op(x, factor=None):
+        if factor is not None:  # pow
+            return _map_values(x, lambda v: fn(v, factor))
+        return _map_values(x, fn)
+    op.__name__ = name
+    op.__doc__ = f"ref sparse/unary.py {name}: zero-preserving elementwise."
+    return op
+
+
+sin = _make_unary("sin", jnp.sin)
+tan = _make_unary("tan", jnp.tan)
+asin = _make_unary("asin", jnp.arcsin)
+atan = _make_unary("atan", jnp.arctan)
+sinh = _make_unary("sinh", jnp.sinh)
+asinh = _make_unary("asinh", jnp.arcsinh)
+atanh = _make_unary("atanh", jnp.arctanh)
+tanh = _make_unary("tanh", jnp.tanh)
+square = _make_unary("square", jnp.square)
+sqrt = _make_unary("sqrt", jnp.sqrt)
+log1p = _make_unary("log1p", jnp.log1p)
+abs = _make_unary("abs", jnp.abs)
+neg = _make_unary("neg", jnp.negative)
+expm1 = _make_unary("expm1", jnp.expm1)
+rad2deg = _make_unary("rad2deg", jnp.rad2deg)
+deg2rad = _make_unary("deg2rad", jnp.deg2rad)
+isnan = _make_unary("isnan", jnp.isnan)
+
+
+def pow(x, factor):
+    return _map_values(x, lambda v: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core import dtypes
+    t = _unwrap(x)
+    data = t.data if value_dtype is None else \
+        t.data.astype(dtypes.to_dtype(value_dtype))
+    if isinstance(t, jsparse.BCOO):
+        idx = t.indices if index_dtype is None else \
+            t.indices.astype(dtypes.to_dtype(index_dtype))
+        return SparseCooTensor(jsparse.BCOO((data, idx), shape=t.shape))
+    idx = t.indices if index_dtype is None else \
+        t.indices.astype(dtypes.to_dtype(index_dtype))
+    ptr = t.indptr if index_dtype is None else \
+        t.indptr.astype(dtypes.to_dtype(index_dtype))
+    return SparseCsrTensor(jsparse.BCSR((data, idx, ptr), shape=t.shape))
+
+
+def coalesce(x):
+    return SparseCooTensor(_unwrap(x).sum_duplicates(remove_zeros=False))
+
+
+def transpose(x, perm):
+    t = _unwrap(x)
+    if isinstance(t, jsparse.BCSR):
+        t = t.to_bcoo()
+    return SparseCooTensor(t.transpose(tuple(perm)))
+
+
+def reshape(x, shape):
+    t = _unwrap(x)
+    if isinstance(t, jsparse.BCSR):
+        t = t.to_bcoo()
+    return SparseCooTensor(t.reshape(tuple(int(s) for s in shape)))
+
+
+def sum(x, axis=None, dtype=None, keepdim: bool = False):
+    t = _unwrap(x)
+    dense = t.todense() if hasattr(t, "todense") else t
+    out = jnp.sum(dense, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        from ..core import dtypes
+        out = out.astype(dtypes.to_dtype(dtype))
+    return out
+
+
+def is_same_shape(x, y) -> bool:
+    sx = x.shape if not isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else x.shape
+    return tuple(sx) == tuple(y.shape)
+
+
+# -- binary -----------------------------------------------------------------
+
+def _binary(x, y, fn):
+    tx, ty = _unwrap(x), _unwrap(y)
+    both_sparse = isinstance(tx, (jsparse.BCOO, jsparse.BCSR)) and \
+        isinstance(ty, (jsparse.BCOO, jsparse.BCSR))
+    if both_sparse:
+        dx = tx.todense()
+        dy = ty.todense()
+        dense = fn(dx, dy)
+        return SparseCooTensor(jsparse.BCOO.fromdense(dense))
+    dx = tx.todense() if hasattr(tx, "todense") else tx
+    dy = ty.todense() if hasattr(ty, "todense") else ty
+    return fn(dx, dy)
+
+
+def add(x, y):
+    tx, ty = _unwrap(x), _unwrap(y)
+    if isinstance(tx, jsparse.BCOO) and isinstance(ty, jsparse.BCOO):
+        # Pattern-union add without densifying: concatenate then coalesce.
+        data = jnp.concatenate([tx.data, ty.data])
+        idx = jnp.concatenate([tx.indices, ty.indices])
+        return SparseCooTensor(
+            jsparse.BCOO((data, idx), shape=tx.shape)
+            .sum_duplicates(remove_zeros=False))
+    return _binary(x, y, jnp.add)
+
+
+def subtract(x, y):
+    return add(x, neg(y) if isinstance(y, (SparseCooTensor, SparseCsrTensor))
+               else -jnp.asarray(y))
+
+
+def multiply(x, y):
+    if not isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        return _map_values(x, lambda v: v * y) if np.ndim(y) == 0 else \
+            _binary(x, y, jnp.multiply)
+    return _binary(x, y, jnp.multiply)
+
+
+def divide(x, y):
+    if not isinstance(y, (SparseCooTensor, SparseCsrTensor)) and \
+            np.ndim(y) == 0:
+        return _map_values(x, lambda v: v / y)
+    return _binary(x, y, jnp.divide)
+
+
+def matmul(x, y):
+    """Sparse @ dense (spmm) or sparse @ sparse (ref sparse/binary.py:34)."""
+    tx, ty = _unwrap(x), _unwrap(y)
+    if isinstance(tx, jsparse.BCSR):
+        tx = tx.to_bcoo()
+    if isinstance(ty, (jsparse.BCOO, jsparse.BCSR)):
+        ty = ty.todense() if isinstance(ty, jsparse.BCSR) else ty.todense()
+    out = tx @ ty
+    return out
+
+
+def masked_matmul(x, y, mask):
+    """Dense @ dense with output sampled at mask's sparsity (SDDMM,
+    ref sparse/binary.py:105)."""
+    tm = _unwrap(mask)
+    if isinstance(tm, jsparse.BCSR):
+        tm = tm.to_bcoo()
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    rows = tm.indices[:, 0]
+    cols = tm.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", x[rows, :], y[:, cols].T)
+    return SparseCooTensor(jsparse.BCOO((vals, tm.indices), shape=tm.shape))
+
+
+def mv(x, vec):
+    tx = _unwrap(x)
+    if isinstance(tx, jsparse.BCSR):
+        tx = tx.to_bcoo()
+    return tx @ jnp.asarray(vec)
+
+
+def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0):
+    """ref sparse/multiary.py:22 — beta*input + alpha*(x @ y)."""
+    prod = matmul(x, y)
+    dense_in = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else jnp.asarray(input)
+    return beta * dense_in + alpha * (
+        prod.to_dense() if isinstance(prod, (SparseCooTensor,
+                                             SparseCsrTensor)) else prod)

@@ -1,0 +1,55 @@
+"""paddle.sparse.nn parity: layers operating on sparse tensors.
+
+Reference: ``python/paddle/sparse/nn/`` (activation layers + sparse conv).
+The activation layers preserve the sparsity pattern (zero-preserving ops on
+stored values); ``Linear``/``matmul``-style compute routes through BCOO.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class _ValueActivation:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x):
+        from . import _map_values
+        return _map_values(x, self._fn)
+
+
+class ReLU(_ValueActivation):
+    def __init__(self):
+        super().__init__(lambda v: jnp.maximum(v, 0))
+
+
+class LeakyReLU(_ValueActivation):
+    def __init__(self, negative_slope: float = 0.01):
+        super().__init__(lambda v: jnp.where(v >= 0, v, negative_slope * v))
+
+
+class Softmax:
+    """Row-wise softmax over stored values per row (ref sparse softmax:
+    softmax over the non-zero entries of each row)."""
+
+    def __init__(self, axis: int = -1):
+        if axis != -1:
+            raise NotImplementedError("sparse softmax supports axis=-1")
+
+    def __call__(self, x):
+        from . import SparseCooTensor, _unwrap
+        from jax.experimental import sparse as jsparse
+        import jax
+
+        t = _unwrap(x)
+        if isinstance(t, jsparse.BCSR):
+            t = t.to_bcoo()
+        rows = t.indices[:, 0]
+        n_rows = t.shape[0]
+        vals = t.data
+        row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
+        e = jnp.exp(vals - row_max[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+        out = e / denom[rows]
+        return SparseCooTensor(jsparse.BCOO((out, t.indices), shape=t.shape))
