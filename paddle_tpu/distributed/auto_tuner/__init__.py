@@ -1,0 +1,278 @@
+"""Parallel-config auto-tuner.
+
+Reference design: ``python/paddle/distributed/auto_tuner/`` — ``AutoTuner``
+(tuner.py:19) iterates candidate dp/mp/pp/sharding/micro-batch configs from
+a ``GridSearch`` (search.py:38) with registered prune rules (prune.py:48
+prune_by_mp — divisibility and card-count checks), launching a trial run
+per config and ranking them in a ``HistoryRecorder`` (recorder.py:22).
+
+TPU-native design: a candidate is a *mesh shape* (degrees over the named
+axes) + micro-batch; trials compile-and-time a jitted step on the actual
+device set (or a virtual CPU mesh), with OOM/compile failures recorded as
+pruned-at-runtime. The trial harness is pluggable — the default builds a
+hybrid mesh and calls a user model_fn, mirroring the reference's
+launch-a-run-per-config loop without needing subprocesses (XLA compiles in
+process)."""
+
+from __future__ import annotations
+
+import csv
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["AutoTuner", "GridSearch", "HistoryRecorder", "default_candidates",
+           "prune_by_mp", "prune_by_pp", "prune_by_num_devices"]
+
+
+# ---------------------------------------------------------------------------
+# Prune rules (ref prune.py — registered checks on a candidate config).
+# ---------------------------------------------------------------------------
+
+_PRUNE_RULES: List[Callable] = []
+
+
+def register_prune(fn):
+    _PRUNE_RULES.append(fn)
+    return fn
+
+
+@register_prune
+def prune_by_num_devices(tuner_cfg: Dict, cur_cfg: Dict) -> bool:
+    """Degrees must multiply to the device count (ref prune_by_num_gpus)."""
+    n = tuner_cfg.get("num_devices")
+    if not n:
+        return False
+    prod = (cur_cfg.get("dp_degree", 1) * cur_cfg.get("mp_degree", 1)
+            * cur_cfg.get("pp_degree", 1)
+            * cur_cfg.get("sharding_degree", 1)
+            * cur_cfg.get("sep_degree", 1))
+    return prod != n
+
+
+@register_prune
+def prune_by_mp(tuner_cfg: Dict, cur_cfg: Dict) -> bool:
+    """mp must divide hidden size and head count (ref prune.py:48)."""
+    mp = cur_cfg.get("mp_degree", 1)
+    if mp <= 1:
+        return False
+    hidden = tuner_cfg.get("hidden_size")
+    heads = tuner_cfg.get("num_heads")
+    vocab = tuner_cfg.get("vocab_size")
+    if hidden and hidden % mp:
+        return True
+    if heads and heads % mp:
+        return True
+    if vocab and vocab % mp:
+        return True
+    return False
+
+
+@register_prune
+def prune_by_pp(tuner_cfg: Dict, cur_cfg: Dict) -> bool:
+    """pp must divide layer count; micro-batches must cover the stages
+    (ref prune.py:85)."""
+    pp = cur_cfg.get("pp_degree", 1)
+    if pp <= 1:
+        return False
+    layers = tuner_cfg.get("num_layers")
+    if layers and layers % pp:
+        return True
+    gbs = tuner_cfg.get("global_batch_size")
+    mbs = cur_cfg.get("micro_batch_size")
+    if gbs and mbs:
+        dp = cur_cfg.get("dp_degree", 1) * cur_cfg.get("sharding_degree", 1)
+        if gbs % (dp * mbs):
+            return True
+        if gbs // (dp * mbs) < pp:  # fewer microbatches than stages
+            return True
+    return False
+
+
+@register_prune
+def prune_by_mbs(tuner_cfg: Dict, cur_cfg: Dict) -> bool:
+    """micro_batch must divide the per-dp-rank batch (ref prune.py:116)."""
+    gbs = tuner_cfg.get("global_batch_size")
+    mbs = cur_cfg.get("micro_batch_size")
+    if not (gbs and mbs):
+        return False
+    dp = cur_cfg.get("dp_degree", 1) * cur_cfg.get("sharding_degree", 1)
+    local = gbs // dp if dp and gbs % dp == 0 else None
+    return local is None or local % mbs != 0
+
+
+# ---------------------------------------------------------------------------
+# Search + recorder (ref search.py GridSearch / recorder.py HistoryRecorder).
+# ---------------------------------------------------------------------------
+
+def default_candidates(tuner_cfg: Dict) -> Dict[str, List]:
+    """Power-of-two degree grids bounded by the device count
+    (the reference builds the same from tuner_cfg 'auto' entries)."""
+    n = tuner_cfg.get("num_devices", 1)
+    pows = [d for d in (1, 2, 4, 8, 16, 32, 64) if d <= n]
+    return {
+        "dp_degree": tuner_cfg.get("dp_degree", pows),
+        "mp_degree": tuner_cfg.get("mp_degree", pows),
+        "pp_degree": tuner_cfg.get("pp_degree", [1]),
+        "sharding_degree": tuner_cfg.get("sharding_degree", [1]),
+        "micro_batch_size": tuner_cfg.get(
+            "micro_batch_size", [tuner_cfg.get("global_batch_size", 1)]),
+    }
+
+
+class GridSearch:
+    """Exhaustive product of the candidate lists, pruned (ref search.py:38)."""
+
+    def __init__(self, tuner_cfg: Dict):
+        self.tuner_cfg = tuner_cfg
+        cands = default_candidates(tuner_cfg)
+        keys = list(cands)
+        self.all_cfgs = []
+        for combo in itertools.product(*(cands[k] for k in keys)):
+            cfg = dict(zip(keys, combo))
+            if not any(rule(tuner_cfg, cfg) for rule in _PRUNE_RULES):
+                self.all_cfgs.append(cfg)
+        self.idx = 0
+
+    def search_once(self) -> Optional[Dict]:
+        if self.idx >= len(self.all_cfgs):
+            return None
+        cfg = self.all_cfgs[self.idx]
+        self.idx += 1
+        return cfg
+
+
+class HistoryRecorder:
+    """ref recorder.py:22 — per-trial records, sortable, csv round-trip."""
+
+    def __init__(self):
+        self.history: List[Dict] = []
+
+    def add_cfg(self, **kwargs):
+        self.history.append(dict(kwargs))
+
+    def sort_metric(self, direction: str = "Maximize",
+                    metric_name: str = "throughput"):
+        ok = [h for h in self.history if h.get(metric_name) is not None]
+        bad = [h for h in self.history if h.get(metric_name) is None]
+        ok.sort(key=lambda h: h[metric_name],
+                reverse=(direction == "Maximize"))
+        self.history = ok + bad
+
+    def get_best(self, metric: str = "throughput",
+                 direction: str = "Maximize") -> Tuple[Optional[Dict], bool]:
+        self.sort_metric(direction, metric)
+        if not self.history or self.history[0].get(metric) is None:
+            return None, True
+        return self.history[0], False
+
+    def store_history(self, path: str = "./history.csv"):
+        if not self.history:
+            return
+        keys = sorted({k for h in self.history for k in h})
+        with open(path, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=keys)
+            w.writeheader()
+            for h in self.history:
+                w.writerow(h)
+
+    def load_history(self, path: str = "./history.csv"):
+        try:
+            with open(path, newline="") as f:
+                return list(csv.DictReader(f)), False
+        except OSError:
+            return [], True
+
+    def clean_history(self):
+        self.history = []
+
+
+# ---------------------------------------------------------------------------
+# Tuner (ref tuner.py AutoTuner).
+# ---------------------------------------------------------------------------
+
+class AutoTuner:
+    """Iterate pruned candidates, run trials, rank by metric.
+
+    trial_fn(cfg) -> float metric (e.g. tokens/sec); raise to mark the
+    config infeasible (OOM / compile failure) — recorded with metric None,
+    like the reference's error-logged runs.
+    """
+
+    def __init__(self, tuner_cfg: Dict,
+                 trial_fn: Optional[Callable[[Dict], float]] = None):
+        self.tuner_cfg = dict(tuner_cfg)
+        self.algo = GridSearch(self.tuner_cfg)
+        self.recorder = HistoryRecorder()
+        self.trial_fn = trial_fn or make_timed_trial(self.tuner_cfg)
+        self.cur_task_id = 0
+
+    def search_once(self) -> Optional[Dict]:
+        return self.algo.search_once()
+
+    def run_trial(self, cfg: Dict) -> Optional[float]:
+        self.cur_task_id += 1
+        t0 = time.perf_counter()
+        try:
+            metric = float(self.trial_fn(cfg))
+            err = None
+        except Exception as e:  # infeasible config — record, keep searching
+            metric, err = None, str(e)[:200]
+        self.recorder.add_cfg(job_id=self.cur_task_id, **cfg,
+                              throughput=metric, error=err,
+                              trial_seconds=round(
+                                  time.perf_counter() - t0, 2))
+        return metric
+
+    def tune(self, max_trials: Optional[int] = None) -> Optional[Dict]:
+        """Run up to max_trials candidates; returns the best config row."""
+        n = 0
+        while max_trials is None or n < max_trials:
+            cfg = self.search_once()
+            if cfg is None:
+                break
+            self.run_trial(cfg)
+            n += 1
+        best, empty = self.recorder.get_best()
+        return None if empty else best
+
+
+def make_timed_trial(tuner_cfg: Dict) -> Callable[[Dict], float]:
+    """Default trial: build a hybrid mesh for the candidate degrees, jit the
+    model_fn's train step, time a few steps, return examples/sec.
+
+    tuner_cfg needs: model_fn() -> (step_fn, state, args) after mesh setup,
+    or step_builder(cfg) -> callable returning a metric directly.
+    """
+    def trial(cfg: Dict) -> float:
+        import jax
+        from ..topology import create_hybrid_mesh, set_hybrid_mesh
+
+        builder = tuner_cfg.get("step_builder")
+        if builder is not None:
+            return builder(cfg)
+        model_fn = tuner_cfg.get("model_fn")
+        if model_fn is None:
+            raise ValueError("tuner_cfg needs model_fn or step_builder")
+        mesh = create_hybrid_mesh(
+            dp=cfg.get("dp_degree", 1), mp=cfg.get("mp_degree", 1),
+            pp=cfg.get("pp_degree", 1),
+            sharding=cfg.get("sharding_degree", 1))
+        set_hybrid_mesh(mesh)
+        try:
+            step_fn, state, args = model_fn(mesh, cfg)
+            state = step_fn(state, *args)          # compile + warmup
+            reps = int(tuner_cfg.get("trial_steps", 3))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                state = step_fn(state, *args)
+            jax.block_until_ready(state)
+            dt = (time.perf_counter() - t0) / reps
+            examples = tuner_cfg.get("global_batch_size", 1)
+            return examples / dt
+        finally:
+            set_hybrid_mesh(None)
+
+    return trial
