@@ -71,7 +71,7 @@ def test_tuner_finds_runnable_config():
 
     tuner_cfg = {"num_devices": 8, "hidden_size": d, "num_heads": 4,
                  "num_layers": 2, "global_batch_size": 8,
-                 "micro_batch_size": [8],
+                 "micro_batch_size": [1],
                  "dp_degree": [1, 2, 4, 8], "mp_degree": [1, 2, 4, 8],
                  "model_fn": model_fn, "trial_steps": 2}
     tuner = AutoTuner(tuner_cfg)
