@@ -1,0 +1,163 @@
+"""Elastic training / fault tolerance.
+
+Reference design: ``ElasticManager``
+(``python/paddle/distributed/fleet/elastic/manager.py:126``) — registers pod
+liveness in etcd (TTL 60s), watches node join/leave, rewrites
+``PADDLE_TRAINER_ENDPOINTS``, and kills/relaunches local trainers; exit
+codes ``ELASTIC_EXIT_CODE=101`` / ``ELASTIC_AUTO_PARALLEL_EXIT_CODE=102``;
+levels FAULT_TOLERANCE (restart in place) and ELASTIC (rescale np).
+
+TPU-native design: TPU pods are gang-scheduled — a failed host means the
+*slice* restarts, so the dominant mode is FAULT_TOLERANCE: detect failure,
+relaunch the local pod (trainers re-rendezvous through the coordinator),
+resume from the latest checkpoint. Liveness rides a filesystem heartbeat
+store (pluggable — any shared-dir/etcd-like KV satisfies the 3-method
+interface) instead of a hard etcd dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["ElasticLevel", "ElasticStatus", "FileHeartbeatStore",
+           "ElasticManager", "ELASTIC_EXIT_CODE",
+           "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+ELASTIC_TTL = 60.0
+
+
+class ElasticLevel:
+    FAULT_TOLERANCE = 1
+    ELASTIC = 2
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    RESTARTING = "restarting"
+    ABORTED = "aborted"
+
+
+class FileHeartbeatStore:
+    """etcd-stand-in liveness registry over a shared directory: one JSON
+    heartbeat file per pod, stale == dead (ref manager.py etcd lease+TTL)."""
+
+    def __init__(self, directory: str, ttl: float = ELASTIC_TTL):
+        self.directory = directory
+        self.ttl = ttl
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, pod_id: str) -> str:
+        return os.path.join(self.directory, f"pod.{pod_id}.json")
+
+    def beat(self, pod_id: str, info: Optional[Dict] = None) -> None:
+        tmp = self._path(pod_id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"time": time.time(), "info": info or {}}, f)
+        os.replace(tmp, self._path(pod_id))
+
+    def alive_pods(self) -> List[str]:
+        now = time.time()
+        out = []
+        for name in os.listdir(self.directory):
+            if not (name.startswith("pod.") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, name)) as f:
+                    rec = json.load(f)
+                if now - rec.get("time", 0) <= self.ttl:
+                    out.append(name[len("pod."):-len(".json")])
+            except (OSError, ValueError):
+                continue
+        return sorted(out)
+
+    def leave(self, pod_id: str) -> None:
+        try:
+            os.remove(self._path(pod_id))
+        except OSError:
+            pass
+
+
+class ElasticManager:
+    """Watch a launcher Pod; on trainer failure relaunch it (fault
+    tolerance) up to ``max_restarts``; keep the pod's liveness registered;
+    detect peer-count changes (elastic scale events).
+
+    ``pod_factory`` rebuilds a fresh Pod (the reference rebuilds Containers
+    with refreshed PADDLE_TRAINER_ENDPOINTS each restart).
+    """
+
+    def __init__(self, pod_factory: Callable[[], "object"],
+                 pod_id: str = "0",
+                 store: Optional[FileHeartbeatStore] = None,
+                 max_restarts: int = 3,
+                 elastic_level: int = ElasticLevel.FAULT_TOLERANCE,
+                 heartbeat_interval: float = 5.0,
+                 min_np: int = 1, max_np: Optional[int] = None):
+        self.pod_factory = pod_factory
+        self.pod_id = str(pod_id)
+        self.store = store
+        self.max_restarts = max_restarts
+        self.elastic_level = elastic_level
+        self.heartbeat_interval = heartbeat_interval
+        self.min_np = min_np
+        self.max_np = max_np
+        self.restarts = 0
+        self.history: List[Dict] = []
+
+    # -- liveness ----------------------------------------------------------
+
+    def register(self, info: Optional[Dict] = None) -> None:
+        if self.store is not None:
+            self.store.beat(self.pod_id, info)
+
+    def world_changed(self, last_seen: List[str]) -> bool:
+        if self.store is None:
+            return False
+        return self.store.alive_pods() != last_seen
+
+    # -- watch loop (ref ControllerBase.watch + manager watch :122) --------
+
+    def run(self, poll_interval: float = 0.2) -> int:
+        """Deploy + watch the pod; restart on failure until exit 0,
+        restart budget exhausted, or abort. Returns the final exit code."""
+        while True:
+            pod = self.pod_factory()
+            pod.deploy()
+            self.register({"restarts": self.restarts})
+            rc = self._watch_one(pod, poll_interval)
+            self.history.append({"restarts": self.restarts, "rc": rc})
+            if rc == 0:
+                if self.store is not None:
+                    self.store.leave(self.pod_id)
+                return 0
+            if rc == ELASTIC_AUTO_PARALLEL_EXIT_CODE:
+                # Reference semantics: re-tune/re-shard then relaunch;
+                # relaunch without counting against the budget.
+                continue
+            self.restarts += 1
+            if self.restarts > self.max_restarts:
+                if self.store is not None:
+                    self.store.leave(self.pod_id)
+                return rc
+
+    def _watch_one(self, pod, poll_interval: float) -> int:
+        last_beat = 0.0
+        while True:
+            codes = [c.poll() for c in pod.containers]
+            bad = [rc for rc in codes if rc not in (None, 0)]
+            if bad:
+                pod.stop()
+                return bad[0]
+            if all(rc == 0 for rc in codes):
+                return 0
+            now = time.time()
+            if self.store is not None and \
+                    now - last_beat >= self.heartbeat_interval:
+                self.register({"restarts": self.restarts})
+                last_beat = now
+            time.sleep(poll_interval)

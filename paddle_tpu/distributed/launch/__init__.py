@@ -153,7 +153,16 @@ def build_pod(cfg: LaunchConfig, training_script: str,
 
 
 def launch(cfg: LaunchConfig, training_script: str,
-           script_args: Sequence[str] = ()) -> int:
+           script_args: Sequence[str] = (),
+           max_restarts: int = 0, elastic_dir: Optional[str] = None) -> int:
+    if max_restarts > 0 or elastic_dir:
+        from ..fleet.elastic import ElasticManager, FileHeartbeatStore
+        store = FileHeartbeatStore(elastic_dir) if elastic_dir else None
+        mgr = ElasticManager(
+            pod_factory=lambda: build_pod(cfg, training_script, script_args),
+            pod_id=str(cfg.node_rank), store=store,
+            max_restarts=max_restarts)
+        return mgr.run()
     pod = build_pod(cfg, training_script, script_args)
     pod.deploy()
     return pod.watch()

@@ -22,6 +22,12 @@ def main(argv=None):
                    help="coordinator host:port (auto on single node)")
     p.add_argument("--log_dir", default=None,
                    help="write per-rank workerlog.N files here")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="fault tolerance: relaunch failed trainers up to "
+                        "N times (ref --elastic_level)")
+    p.add_argument("--elastic_dir", default=None,
+                   help="shared dir for pod liveness heartbeats "
+                        "(ref --elastic_server etcd://)")
     p.add_argument("training_script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     args = p.parse_args(argv)
@@ -29,7 +35,9 @@ def main(argv=None):
     cfg = LaunchConfig(nproc_per_node=args.nproc_per_node,
                        nnodes=args.nnodes, node_rank=args.node_rank,
                        master=args.master, log_dir=args.log_dir)
-    sys.exit(launch(cfg, args.training_script, args.script_args))
+    sys.exit(launch(cfg, args.training_script, args.script_args,
+                    max_restarts=args.max_restarts,
+                    elastic_dir=args.elastic_dir))
 
 
 if __name__ == "__main__":

@@ -1,0 +1,67 @@
+"""Elastic / fault tolerance tests (ref ElasticManager, manager.py:126):
+heartbeat liveness, crash -> relaunch -> success, restart budget."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
+                                                  ElasticManager,
+                                                  FileHeartbeatStore)
+from paddle_tpu.distributed.launch import LaunchConfig, launch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_heartbeat_store(tmp_path):
+    store = FileHeartbeatStore(str(tmp_path), ttl=0.5)
+    store.beat("0", {"x": 1})
+    store.beat("1")
+    assert store.alive_pods() == ["0", "1"]
+    time.sleep(0.6)
+    store.beat("1")
+    assert store.alive_pods() == ["1"]  # pod 0 heartbeat went stale
+    store.leave("1")
+    assert store.alive_pods() == []
+
+
+def test_crash_then_relaunch_succeeds(tmp_path):
+    """Trainer crashes on its first run (marker file absent), succeeds on
+    relaunch — the ElasticManager's fault-tolerance loop must return 0."""
+    marker = tmp_path / "ran_once"
+    script = tmp_path / "flaky.py"
+    script.write_text(
+        "import os, sys\n"
+        f"m = {str(marker)!r}\n"
+        "if not os.path.exists(m):\n"
+        "    open(m, 'w').close()\n"
+        "    sys.exit(1)\n"
+        "print('recovered')\n")
+    cfg = LaunchConfig(nproc_per_node=2, log_dir=str(tmp_path / "logs"))
+    rc = launch(cfg, str(script), max_restarts=2,
+                elastic_dir=str(tmp_path / "hb"))
+    assert rc == 0
+    # liveness record cleaned up after completion
+    assert FileHeartbeatStore(str(tmp_path / "hb")).alive_pods() == []
+
+
+def test_restart_budget_exhausted(tmp_path):
+    script = tmp_path / "always_fails.py"
+    script.write_text("import sys; sys.exit(7)\n")
+    cfg = LaunchConfig(nproc_per_node=1, log_dir=str(tmp_path / "logs"))
+    calls = {"n": 0}
+
+    from paddle_tpu.distributed.launch import build_pod
+
+    def factory():
+        calls["n"] += 1
+        return build_pod(cfg, str(script), ())
+
+    mgr = ElasticManager(factory, max_restarts=2)
+    rc = mgr.run(poll_interval=0.05)
+    assert rc == 7
+    assert calls["n"] == 3  # initial + 2 restarts
+    assert len(mgr.history) == 3
