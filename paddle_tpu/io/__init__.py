@@ -4,3 +4,4 @@ from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401
                       BatchSampler, DistributedBatchSampler,
                       WeightedRandomSampler)
 from .dataloader import DataLoader, default_collate_fn  # noqa: F401
+from .worker import get_worker_info  # noqa: F401

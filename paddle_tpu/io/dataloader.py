@@ -5,11 +5,13 @@ DataLoader; multiprocess workers ``io/dataloader/worker.py``; C++
 ``LoDTensorBlockingQueue`` feed thread ``io/dataloader/dataloader_iter.py:114``)
 for the TPU host model:
 
-- Worker threads (not processes: batch assembly is numpy, which releases the
-  GIL) pull index batches from the sampler and collate.
-- A bounded blocking queue decouples producers from the training loop — the
-  C++-accelerated queue from paddle_tpu.native is used when built, else a
-  Python ``queue.Queue`` (same semantics).
+- Default workers are threads (batch assembly is numpy, which releases the
+  GIL) pulling index batches from the sampler and collating.
+- ``use_shared_memory=True`` switches to subprocess workers shipping batches
+  through the native C++ shared-memory ring queue
+  (``paddle_tpu/native/shm_queue.cpp``) — the analog of the reference's
+  subprocess workers + ``LoDTensorBlockingQueue`` + shm tensor transport,
+  for datasets whose per-sample work holds the GIL (decode, tokenize).
 - ``prefetch_to_device`` overlaps host→HBM transfer with the current step:
   the next batch is ``jax.device_put`` while the step runs (the analog of the
   reference's GPU feed thread + pinned memory path).
@@ -17,6 +19,7 @@ for the TPU host model:
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Any, Callable, Iterator, List, Optional
@@ -51,11 +54,10 @@ def default_collate_fn(batch: List[Any]):
 
 
 def _make_queue(capacity: int):
-    try:
-        from ..native import BlockingQueue  # C++-backed when built
-        return BlockingQueue(capacity)
-    except Exception:
-        return queue.Queue(maxsize=capacity)
+    # In-process handoff: plain queue.Queue passes object references with no
+    # serialization. The native shm queue (paddle_tpu.native.ShmQueue) is for
+    # the multiprocess path, where one pickle per batch is unavoidable.
+    return queue.Queue(maxsize=capacity)
 
 
 class _Sentinel:
@@ -80,6 +82,8 @@ class DataLoader:
         self.prefetch_factor = max(1, int(prefetch_factor))
         self.timeout = timeout
         self.prefetch_to_device = prefetch_to_device
+        self.use_shared_memory = use_shared_memory
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -184,9 +188,95 @@ class DataLoader:
             for _ in range(self.num_workers):
                 index_q.put(None)
 
+    def _batches_multiprocess(self) -> Iterator[Any]:
+        """Subprocess workers + native shm queue (ref worker.py _worker_loop)."""
+        assert not self._iterable_mode
+        import multiprocessing as mp
+
+        from ..native import QueueTimeout, ShmQueue
+        from .worker import WorkerDone, WorkerError, worker_loop
+
+        batches = list(self.batch_sampler)
+        n_batches = len(batches)
+        if n_batches == 0:
+            return
+        n_workers = min(self.num_workers, n_batches)
+        q = ShmQueue(capacity=max(64 << 20,
+                                  n_workers * self.prefetch_factor * (8 << 20)))
+        base_seed = int(np.random.randint(0, 2**31 - 1))
+        method = os.environ.get(
+            "PADDLE_TPU_WORKER_START_METHOD",
+            "fork" if hasattr(os, "fork") else "spawn")
+        ctx = mp.get_context(method)
+        # Producers run at most `window` batches ahead of the consumed
+        # position, which bounds the reorder buffer below to `window`
+        # entries even when one slow batch holds up the head of the line.
+        window = n_workers * self.prefetch_factor
+        procs = [
+            ctx.Process(
+                target=worker_loop,
+                args=(self.dataset, self.collate_fn, batches, wid, n_workers,
+                      q.name, base_seed, self.worker_init_fn, window),
+                daemon=True)
+            for wid in range(n_workers)
+        ]
+        for p in procs:
+            p.start()
+        results = {}
+        done = set()
+        next_idx = 0
+        deadline_slack = self.timeout
+        try:
+            while next_idx < n_batches:
+                while next_idx in results:
+                    yield results.pop(next_idx)
+                    next_idx += 1
+                    q.set_progress(next_idx)
+                if next_idx >= n_batches:
+                    break
+                try:
+                    item = q.get(timeout=min(5.0, deadline_slack))
+                except QueueTimeout:
+                    dead = [p for p in procs if not p.is_alive()
+                            and p.exitcode not in (0, None)]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker (pid {dead[0].pid}) exited "
+                            f"unexpectedly with code {dead[0].exitcode}")
+                    deadline_slack -= 5.0
+                    if deadline_slack <= 0:
+                        raise QueueTimeout(
+                            f"DataLoader timed out after {self.timeout}s "
+                            f"waiting for batch {next_idx}")
+                    continue
+                deadline_slack = self.timeout
+                if isinstance(item, WorkerDone):
+                    done.add(item.worker_id)
+                    if len(done) == n_workers and next_idx < n_batches \
+                            and not results and q.qsize() == 0:
+                        raise RuntimeError("DataLoader workers exited early")
+                    continue
+                i, data = item
+                if isinstance(data, WorkerError):
+                    raise RuntimeError(
+                        f"DataLoader worker failed on batch {i}:\n"
+                        f"{data.message}")
+                results[i] = data
+        finally:
+            q.shutdown()
+            for p in procs:
+                p.join(timeout=5.0)
+                if p.is_alive():
+                    p.terminate()
+            q.close()
+
     def __iter__(self) -> Iterator[Any]:
-        source = self._batches_sync() if self.num_workers == 0 \
-            else self._batches_threaded()
+        if self.num_workers == 0:
+            source = self._batches_sync()
+        elif self.use_shared_memory and not self._iterable_mode:
+            source = self._batches_multiprocess()
+        else:
+            source = self._batches_threaded()
         if not self.prefetch_to_device:
             yield from source
             return

@@ -1,0 +1,104 @@
+"""Multiprocess DataLoader worker.
+
+Reference parity: ``python/paddle/io/dataloader/worker.py`` (``_worker_loop``)
+— subprocess workers that index the dataset, collate, and ship batches back
+over shared memory (ref ``core._array_to_share_memory_tensor`` path,
+``use_shared_memory=True``). Here transport is the native
+:class:`paddle_tpu.native.ShmQueue` (POSIX shm ring, robust pshared mutex)
+so a batch crosses the process boundary with one pickle + one ring copy,
+and a dead worker can never wedge the trainer (robust-mutex recovery).
+
+Work assignment is static round-robin by worker id — the consumer reorders
+by batch index, so no index feed queue is needed (the reference's
+``_IndexQueue`` collapses away).
+"""
+
+from __future__ import annotations
+
+import os
+import traceback
+
+
+class WorkerError:
+    """Pickled marker carrying a worker-side exception traceback."""
+
+    def __init__(self, batch_index: int, exc: BaseException):
+        self.batch_index = batch_index
+        self.message = "".join(
+            traceback.format_exception(type(exc), exc, exc.__traceback__))
+
+
+class WorkerDone:
+    """Pickled marker: worker finished its slice."""
+
+    def __init__(self, worker_id: int):
+        self.worker_id = worker_id
+
+
+class WorkerInfo:
+    """Visible to dataset code inside a worker (ref get_worker_info())."""
+
+    def __init__(self, id: int, num_workers: int, seed: int):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+
+
+_worker_info: WorkerInfo | None = None
+
+
+def get_worker_info() -> WorkerInfo | None:
+    """Inside a worker process, returns its WorkerInfo; None in the trainer.
+
+    Ref: ``python/paddle/io/dataloader/worker.py`` ``get_worker_info``.
+    """
+    return _worker_info
+
+
+def worker_loop(dataset, collate_fn, batches, worker_id: int,
+                num_workers: int, queue_name: str, base_seed: int,
+                worker_init_fn=None, prefetch_window: int = 0) -> None:
+    """Entry point run in each spawned worker process.
+
+    Blocking on a full ring or on the pacing window is normal flow control
+    (the trainer may pause minutes for eval/checkpoint), so puts use a long
+    timeout; if it still expires, the trainer is gone or wedged and the
+    worker exits quietly — the trainer's own ``DataLoader.timeout`` is the
+    user-visible failure signal.
+    """
+    global _worker_info
+    # Workers must never touch the TPU/accelerator runtime.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ..native import QueueClosed, QueueTimeout, ShmQueue
+
+    _worker_info = WorkerInfo(worker_id, num_workers, base_seed + worker_id)
+    try:
+        import numpy as _np
+        _np.random.seed(base_seed + worker_id)
+    except Exception:
+        pass
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+
+    _STALL = 3600.0  # generous: covers long trainer pauses, not a hang
+    q = ShmQueue(name=queue_name, owner=False)
+    try:
+        for i in range(worker_id, len(batches), num_workers):
+            if prefetch_window and i >= prefetch_window:
+                # Run at most `prefetch_window` batches ahead of the
+                # trainer's published consume position.
+                q.wait_progress(i - prefetch_window + 1, timeout=_STALL)
+            try:
+                data = collate_fn([dataset[j] for j in batches[i]])
+            except BaseException as e:  # ship the traceback to the trainer
+                q.put((i, WorkerError(i, e)), timeout=_STALL)
+                return
+            q.put((i, data), timeout=_STALL)
+        q.put(WorkerDone(worker_id), timeout=_STALL)
+    except (QueueClosed, QueueTimeout):
+        pass  # consumer went away (or wedged longer than _STALL)
+    finally:
+        q.close()
+        # Forked workers inherit the trainer's accelerator runtime state;
+        # skip Python finalization (atexit / PJRT teardown) entirely.
+        os._exit(0)

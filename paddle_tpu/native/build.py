@@ -1,0 +1,33 @@
+"""Lazy build of the native runtime library.
+
+The reference ships its native runtime prebuilt (paddle/fluid/pybind →
+libpaddle.so); here the native pieces are small enough to compile on first
+import with the baked-in toolchain and cache next to the sources. Rebuilds
+when any .cpp is newer than the cached .so.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["shm_queue.cpp"]
+_LIB = os.path.join(_HERE, "libpaddle_tpu_native.so")
+_lock = threading.Lock()
+
+
+def lib_path() -> str:
+    """Return the path to the built shared library, compiling if stale."""
+    with _lock:
+        srcs = [os.path.join(_HERE, s) for s in _SOURCES]
+        if os.path.exists(_LIB) and all(
+                os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in srcs):
+            return _LIB
+        tmp = _LIB + ".tmp"
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+               "-o", tmp, *srcs, "-lpthread", "-lrt"]
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _LIB)  # atomic: concurrent importers see old or new
+        return _LIB

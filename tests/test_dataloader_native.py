@@ -1,0 +1,173 @@
+"""Multiprocess DataLoader + native shm queue tests.
+
+Ref test model: test/legacy_test/test_multiprocess_dataloader_static.py and
+test_multiprocess_dataloader_exception.py — batch parity vs single-process,
+exception propagation, and dead-worker detection.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, get_worker_info
+from paddle_tpu.io.dataset import Dataset
+from paddle_tpu.native import QueueClosed, QueueTimeout, ShmQueue
+
+
+class ArrayDataset(Dataset):
+    def __init__(self, n=64, dim=8):
+        self.x = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+        self.y = np.arange(n, dtype=np.int64)
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+
+class FailingDataset(ArrayDataset):
+    def __getitem__(self, i):
+        if i == 37:
+            raise ValueError("poisoned sample 37")
+        return super().__getitem__(i)
+
+
+class DyingDataset(ArrayDataset):
+    """Worker process hard-dies on one sample (simulates OOM-kill)."""
+
+    def __getitem__(self, i):
+        if i == 21:
+            os._exit(3)
+        return super().__getitem__(i)
+
+
+class SlowHeadDataset(ArrayDataset):
+    """First batch is slow — exercises producer pacing + reorder buffer."""
+
+    def __getitem__(self, i):
+        if i == 0:
+            import time
+            time.sleep(1.5)
+        return super().__getitem__(i)
+
+
+class WorkerInfoDataset(ArrayDataset):
+    def __getitem__(self, i):
+        info = get_worker_info()
+        assert info is not None and 0 <= info.id < info.num_workers
+        return super().__getitem__(i)
+
+
+def _producer(name, n):
+    q = ShmQueue(name=name, owner=False)
+    for i in range(n):
+        q.put((i, np.full((4,), i, dtype=np.int32)))
+    q.close()
+
+
+class TestShmQueue:
+    def test_bytes_roundtrip_and_wrap(self):
+        q = ShmQueue(capacity=1 << 12)  # small: force ring wraparound
+        for rec in range(50):
+            payload = bytes([rec % 256]) * (200 + rec * 7)
+            q.push_bytes(payload)
+            assert q.pop_bytes() == payload
+        q.close()
+
+    def test_backpressure_timeout(self):
+        q = ShmQueue(capacity=1 << 12)
+        q.push_bytes(b"x" * 3000)
+        with pytest.raises(QueueTimeout):
+            q.push_bytes(b"y" * 3000, timeout=0.2)
+        q.close()
+
+    def test_record_larger_than_capacity_rejected(self):
+        q = ShmQueue(capacity=1 << 12)
+        with pytest.raises(ValueError):
+            q.push_bytes(b"z" * (1 << 13))
+        q.close()
+
+    def test_shutdown_wakes_consumer(self):
+        q = ShmQueue(capacity=1 << 16)
+        q.shutdown()
+        with pytest.raises(QueueClosed):
+            q.get(timeout=5.0)
+        q.close()
+
+    def test_cross_process_transport(self):
+        q = ShmQueue(capacity=1 << 20)
+        ctx = mp.get_context("fork")
+        p = ctx.Process(target=_producer, args=(q.name, 10))
+        p.start()
+        got = sorted(q.get(timeout=30.0)[0] for _ in range(10))
+        p.join()
+        assert got == list(range(10))
+        q.close()
+
+
+class TestMultiprocessDataLoader:
+    def test_parity_with_single_process(self):
+        ds = ArrayDataset(n=64)
+        ref = list(DataLoader(ds, batch_size=8, num_workers=0))
+        mpl = list(DataLoader(ds, batch_size=8, num_workers=3,
+                              use_shared_memory=True))
+        assert len(ref) == len(mpl) == 8
+        for (rx, ry), (mx, my) in zip(ref, mpl):
+            np.testing.assert_array_equal(rx, mx)
+            np.testing.assert_array_equal(ry, my)
+
+    def test_drop_last_and_odd_sizes(self):
+        ds = ArrayDataset(n=30)
+        out = list(DataLoader(ds, batch_size=8, num_workers=2,
+                              use_shared_memory=True, drop_last=False))
+        assert [len(b[1]) for b in out] == [8, 8, 8, 6]
+
+    def test_worker_exception_propagates(self):
+        ds = FailingDataset(n=64)
+        loader = DataLoader(ds, batch_size=8, num_workers=2,
+                            use_shared_memory=True)
+        with pytest.raises(RuntimeError, match="poisoned sample 37"):
+            list(loader)
+
+    def test_dead_worker_detected(self):
+        ds = DyingDataset(n=64)
+        loader = DataLoader(ds, batch_size=8, num_workers=2,
+                            use_shared_memory=True, timeout=30.0)
+        with pytest.raises(RuntimeError, match="exited"):
+            list(loader)
+
+    def test_early_abandon_cleans_up(self):
+        ds = ArrayDataset(n=64)
+        loader = DataLoader(ds, batch_size=8, num_workers=2,
+                            use_shared_memory=True)
+        it = iter(loader)
+        next(it)
+        it.close()  # generator close runs the finally: shutdown + join
+
+    def test_slow_head_batch_keeps_order(self):
+        ds = SlowHeadDataset(n=64)
+        out = list(DataLoader(ds, batch_size=8, num_workers=4,
+                              use_shared_memory=True, prefetch_factor=1))
+        ref = list(DataLoader(ds, batch_size=8, num_workers=0))
+        for (rx, _), (mx, _) in zip(ref, out):
+            np.testing.assert_array_equal(rx, mx)
+
+    def test_progress_marker_roundtrip(self):
+        q = ShmQueue(capacity=1 << 16)
+        assert q.get_progress() == 0
+        q.set_progress(7)
+        assert q.get_progress() == 7
+        q.wait_progress(5, timeout=1.0)  # already satisfied
+        with pytest.raises(QueueTimeout):
+            q.wait_progress(8, timeout=0.2)
+        q.close()
+
+    def test_worker_info_visible(self):
+        ds = WorkerInfoDataset(n=32)
+        out = list(DataLoader(ds, batch_size=8, num_workers=2,
+                              use_shared_memory=True))
+        assert len(out) == 4
+        assert get_worker_info() is None  # trainer process
