@@ -46,14 +46,23 @@ def backward(model: Layer, loss_fn: Callable[[], jax.Array] = None, *,
     """
     fn = loss_closure if loss_closure is not None else (lambda _m: loss_fn())
     params = get_params(model, trainable_only=True)
+    from ..framework.functional import _swapped_state, get_buffers, set_buffers
+    buffers0 = get_buffers(model)
 
     def loss_of_params(p):
-        # Substitute params, then let the closure run the model.
-        from ..framework.functional import _swapped_state
-        with _swapped_state(model, p, None):
-            return fn(model)
+        # Substitute params, then let the closure run the model. Buffer
+        # writes during the forward (BatchNorm running stats) are traced
+        # values; capture them as an aux output and restore the originals
+        # on exit so no tracer persists in the Layer tree.
+        with _swapped_state(model, p, dict(buffers0)):
+            loss = fn(model)
+            new_buffers = get_buffers(model)
+        return loss, new_buffers
 
-    loss, grads = jax.value_and_grad(loss_of_params)(params)
+    (loss, new_buffers), grads = jax.value_and_grad(
+        loss_of_params, has_aux=True)(params)
+    if new_buffers:
+        set_buffers(model, new_buffers)
     refs = dict(model.named_parameters())
     for name, g in grads.items():
         ref = refs[name]

@@ -82,6 +82,18 @@ def worker_loop(dataset, collate_fn, batches, worker_id: int,
 
     _STALL = 3600.0  # generous: covers long trainer pauses, not a hang
     q = ShmQueue(name=queue_name, owner=False)
+    exit_code = 0
+
+    def ship_error(i, exc):
+        # Best-effort: if even the (small) error record can't be shipped,
+        # die with a nonzero code so the trainer's dead-worker check fires
+        # instead of a silent stall.
+        nonlocal exit_code
+        try:
+            q.put((i, WorkerError(i, exc)), timeout=30.0)
+        except BaseException:
+            exit_code = 1
+
     try:
         for i in range(worker_id, len(batches), num_workers):
             if prefetch_window and i >= prefetch_window:
@@ -91,14 +103,22 @@ def worker_loop(dataset, collate_fn, batches, worker_id: int,
             try:
                 data = collate_fn([dataset[j] for j in batches[i]])
             except BaseException as e:  # ship the traceback to the trainer
-                q.put((i, WorkerError(i, e)), timeout=_STALL)
+                ship_error(i, e)
                 return
-            q.put((i, data), timeout=_STALL)
+            try:
+                q.put((i, data), timeout=_STALL)
+            except (QueueClosed, QueueTimeout):
+                return  # consumer went away (or wedged longer than _STALL)
+            except BaseException as e:  # unpicklable / oversized batch
+                ship_error(i, e)
+                return
         q.put(WorkerDone(worker_id), timeout=_STALL)
     except (QueueClosed, QueueTimeout):
         pass  # consumer went away (or wedged longer than _STALL)
+    except BaseException:
+        exit_code = 1
     finally:
         q.close()
         # Forked workers inherit the trainer's accelerator runtime state;
         # skip Python finalization (atexit / PJRT teardown) entirely.
-        os._exit(0)
+        os._exit(exit_code)

@@ -31,7 +31,13 @@ _lib = None
 def load_library() -> ctypes.CDLL:
     global _lib
     if _lib is None:
-        lib = ctypes.CDLL(lib_path())
+        try:
+            lib = ctypes.CDLL(lib_path())
+        except OSError:
+            # A stale/wrong-arch cached .so (e.g. built on another host)
+            # loads as ELF garbage; force a rebuild once before giving up.
+            from .build import rebuild
+            lib = ctypes.CDLL(rebuild())
         lib.sq_create.restype = ctypes.c_void_p
         lib.sq_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,
                                   ctypes.c_int]
@@ -65,6 +71,10 @@ class QueueTimeout(Exception):
     """push/pop timed out."""
 
 
+class QueueCorrupted(Exception):
+    """A peer died mid-commit; ring contents can no longer be trusted."""
+
+
 class ShmQueue:
     """Cross-process bounded byte-record queue in POSIX shared memory.
 
@@ -95,6 +105,8 @@ class ShmQueue:
         if rc == -3:
             raise ValueError(
                 f"record of {len(data)} bytes exceeds queue capacity")
+        if rc == -5:
+            raise QueueCorrupted()
 
     def pop_bytes(self, timeout: float = 120.0) -> bytes:
         # Size the buffer off the next record; retry if a different (larger)
@@ -111,6 +123,8 @@ class ShmQueue:
                 raise QueueTimeout(f"pop timed out after {timeout}s")
             if rc == -2:
                 raise QueueClosed()
+            if rc == -5:
+                raise QueueCorrupted()
             if rc == -4:
                 buf_len = max(self._lib.sq_peek_size(self._h), buf_len * 2)
 
@@ -141,6 +155,8 @@ class ShmQueue:
                 f"progress wait (>= {min_value}) timed out after {timeout}s")
         if rc == -2:
             raise QueueClosed()
+        if rc == -5:
+            raise QueueCorrupted()
 
     def shutdown(self) -> None:
         """Close for writing and wake all waiters (consumers drain)."""

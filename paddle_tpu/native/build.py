@@ -25,9 +25,19 @@ def lib_path() -> str:
         if os.path.exists(_LIB) and all(
                 os.path.getmtime(_LIB) >= os.path.getmtime(s) for s in srcs):
             return _LIB
-        tmp = _LIB + ".tmp"
-        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-               "-o", tmp, *srcs, "-lpthread", "-lrt"]
-        subprocess.run(cmd, check=True, capture_output=True, text=True)
-        os.replace(tmp, _LIB)  # atomic: concurrent importers see old or new
-        return _LIB
+        return _compile(srcs)
+
+
+def rebuild() -> str:
+    """Unconditional recompile (used when a cached .so fails to load)."""
+    with _lock:
+        return _compile([os.path.join(_HERE, s) for s in _SOURCES])
+
+
+def _compile(srcs) -> str:
+    tmp = _LIB + ".tmp"
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+           "-o", tmp, *srcs, "-lpthread", "-lrt"]
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    os.replace(tmp, _LIB)  # atomic: concurrent importers see old or new
+    return _LIB

@@ -37,6 +37,8 @@ struct Header {
   uint64_t n_records;
   uint64_t user_seq;      // consumer progress marker (producer pacing)
   int32_t closed;
+  int32_t poisoned;       // a peer died mid-commit; contents untrustworthy
+  int32_t in_commit;      // set around header-field commits (crash fencing)
   pthread_mutex_t mu;
   pthread_cond_t not_empty;
   pthread_cond_t not_full;
@@ -62,23 +64,25 @@ void timespec_in(struct timespec* ts, long timeout_ms) {
   }
 }
 
-// Copy len bytes into the ring at tail (wrapping).
-void ring_write(Header* h, const char* src, uint64_t len) {
-  uint64_t t = h->tail;
-  uint64_t first = len < h->capacity - t ? len : h->capacity - t;
-  memcpy(h->data + t, src, first);
+// Offset-based ring copies that do NOT touch header bookkeeping: data is
+// staged first, and head/tail/used/n_records are committed afterwards in
+// one small fenced window (see in_commit). A producer killed mid-memcpy
+// then leaves the header fully consistent — the staged bytes are simply
+// unaccounted and get overwritten.
+uint64_t ring_write_at(Header* h, uint64_t pos, const char* src,
+                       uint64_t len) {
+  uint64_t first = len < h->capacity - pos ? len : h->capacity - pos;
+  memcpy(h->data + pos, src, first);
   if (len > first) memcpy(h->data, src + first, len - first);
-  h->tail = (t + len) % h->capacity;
-  h->used += len;
+  return (pos + len) % h->capacity;
 }
 
-void ring_read(Header* h, char* dst, uint64_t len) {
-  uint64_t r = h->head;
-  uint64_t first = len < h->capacity - r ? len : h->capacity - r;
-  memcpy(dst, h->data + r, first);
+uint64_t ring_read_at(const Header* h, uint64_t pos, char* dst,
+                      uint64_t len) {
+  uint64_t first = len < h->capacity - pos ? len : h->capacity - pos;
+  memcpy(dst, h->data + pos, first);
   if (len > first) memcpy(dst + first, h->data, len - first);
-  h->head = (r + len) % h->capacity;
-  h->used -= len;
+  return (pos + len) % h->capacity;
 }
 
 }  // namespace
@@ -141,6 +145,13 @@ static int lock_robust(Header* h) {
   int rc = pthread_mutex_lock(&h->mu);
   if (rc == EOWNERDEAD) {  // a worker died holding the lock
     pthread_mutex_consistent(&h->mu);
+    if (h->in_commit) {
+      // Death landed inside a header commit: bookkeeping may be torn.
+      // Poison rather than serve misaligned records.
+      h->poisoned = 1;
+      pthread_cond_broadcast(&h->not_empty);
+      pthread_cond_broadcast(&h->not_full);
+    }
     return 0;
   }
   return rc;
@@ -155,12 +166,18 @@ static int timedwait_robust(pthread_cond_t* cv, Header* h,
   int rc = pthread_cond_timedwait(cv, &h->mu, ts);
   if (rc == EOWNERDEAD) {
     pthread_mutex_consistent(&h->mu);
+    if (h->in_commit) {
+      h->poisoned = 1;
+      pthread_cond_broadcast(&h->not_empty);
+      pthread_cond_broadcast(&h->not_full);
+    }
     return 0;
   }
   return rc;
 }
 
-// Push one record. Returns 0 ok, -1 timeout, -2 closed, -3 too large.
+// Push one record. Returns 0 ok, -1 timeout, -2 closed, -3 too large,
+// -5 poisoned.
 int sq_push(void* handle, const char* buf, uint64_t len, long timeout_ms) {
   Header* h = ((Handle*)handle)->h;
   uint64_t need = len + sizeof(uint64_t);
@@ -168,32 +185,40 @@ int sq_push(void* handle, const char* buf, uint64_t len, long timeout_ms) {
   struct timespec ts;
   timespec_in(&ts, timeout_ms);
   if (lock_robust(h) != 0) return -1;
-  while (h->capacity - h->used < need && !h->closed) {
+  while (h->capacity - h->used < need && !h->closed && !h->poisoned) {
     if (timedwait_robust(&h->not_full, h, &ts) == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
     }
   }
-  if (h->closed) {
+  if (h->poisoned || h->closed) {
+    int out = h->poisoned ? -5 : -2;
     pthread_mutex_unlock(&h->mu);
-    return -2;
+    return out;
   }
-  ring_write(h, (const char*)&len, sizeof(uint64_t));
-  ring_write(h, buf, len);
+  // Stage bytes first (crash here leaves the header consistent), then
+  // commit the bookkeeping inside the in_commit fence.
+  uint64_t pos = ring_write_at(h, h->tail, (const char*)&len,
+                               sizeof(uint64_t));
+  ring_write_at(h, pos, buf, len);
+  h->in_commit = 1;
+  h->tail = (h->tail + need) % h->capacity;
+  h->used += need;
   h->n_records += 1;
+  h->in_commit = 0;
   pthread_cond_signal(&h->not_empty);
   pthread_mutex_unlock(&h->mu);
   return 0;
 }
 
 // Pop one record into buf (maxlen bytes). Returns record size, -1 timeout,
-// -2 closed+empty, -4 buffer too small (record left in place).
+// -2 closed+empty, -4 buffer too small (record left in place), -5 poisoned.
 int64_t sq_pop(void* handle, char* buf, uint64_t maxlen, long timeout_ms) {
   Header* h = ((Handle*)handle)->h;
   struct timespec ts;
   timespec_in(&ts, timeout_ms);
   if (lock_robust(h) != 0) return -1;
-  while (h->n_records == 0) {
+  while (h->n_records == 0 && !h->poisoned) {
     if (h->closed) {
       pthread_mutex_unlock(&h->mu);
       return -2;
@@ -203,18 +228,24 @@ int64_t sq_pop(void* handle, char* buf, uint64_t maxlen, long timeout_ms) {
       return -1;
     }
   }
+  if (h->poisoned) {
+    pthread_mutex_unlock(&h->mu);
+    return -5;
+  }
   uint64_t len;
-  // Peek the length without consuming (so -4 can retry with a bigger buf).
-  uint64_t save_head = h->head, save_used = h->used;
-  ring_read(h, (char*)&len, sizeof(uint64_t));
+  // Read without consuming (so -4 can retry with a bigger buf); the
+  // header fields are only committed once the payload copy is done.
+  uint64_t pos = ring_read_at(h, h->head, (char*)&len, sizeof(uint64_t));
   if (len > maxlen) {
-    h->head = save_head;
-    h->used = save_used;
     pthread_mutex_unlock(&h->mu);
     return -4;
   }
-  ring_read(h, buf, len);
+  ring_read_at(h, pos, buf, len);
+  h->in_commit = 1;
+  h->head = (h->head + len + sizeof(uint64_t)) % h->capacity;
+  h->used -= len + sizeof(uint64_t);
   h->n_records -= 1;
+  h->in_commit = 0;
   // Broadcast, not signal: with several producers and variable-length
   // records, a single wakeup can keep landing on one whose record still
   // doesn't fit, starving a producer whose smaller record would.
@@ -245,20 +276,20 @@ uint64_t sq_get_useq(void* handle) {
   return v;
 }
 
-// Block until user_seq >= min_val (or closed / timeout).
-// Returns 0 ok, -1 timeout, -2 closed.
+// Block until user_seq >= min_val (or closed / poisoned / timeout).
+// Returns 0 ok, -1 timeout, -2 closed, -5 poisoned.
 int sq_wait_useq(void* handle, uint64_t min_val, long timeout_ms) {
   Header* h = ((Handle*)handle)->h;
   struct timespec ts;
   timespec_in(&ts, timeout_ms);
   if (lock_robust(h) != 0) return -1;
-  while (h->user_seq < min_val && !h->closed) {
+  while (h->user_seq < min_val && !h->closed && !h->poisoned) {
     if (timedwait_robust(&h->not_full, h, &ts) == ETIMEDOUT) {
       pthread_mutex_unlock(&h->mu);
       return -1;
     }
   }
-  int out = h->closed ? -2 : 0;
+  int out = h->poisoned ? -5 : (h->closed ? -2 : 0);
   pthread_mutex_unlock(&h->mu);
   return out;
 }
@@ -268,12 +299,9 @@ int64_t sq_peek_size(void* handle) {
   Header* h = ((Handle*)handle)->h;
   if (lock_robust(h) != 0) return -1;
   int64_t out = -1;
-  if (h->n_records > 0) {
-    uint64_t save_head = h->head, save_used = h->used;
+  if (h->n_records > 0 && !h->poisoned) {
     uint64_t len;
-    ring_read(h, (char*)&len, sizeof(uint64_t));
-    h->head = save_head;
-    h->used = save_used;
+    ring_read_at(h, h->head, (char*)&len, sizeof(uint64_t));
     out = (int64_t)len;
   }
   pthread_mutex_unlock(&h->mu);

@@ -231,6 +231,21 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0,
     return summed / (k[0] * k[1])
 
 
+def _adaptive_pool_matrix(in_size: int, out_size: int, dtype):
+    """[out, in] averaging matrix with torch/paddle adaptive windows
+    (row i averages input [floor(i*in/out), ceil((i+1)*in/out))).
+
+    Shapes are static at trace time, so the matrix is a compile-time
+    constant and the pool lowers to a single MXU-friendly contraction."""
+    import numpy as _np
+    m = _np.zeros((out_size, in_size), dtype=_np.float32)
+    for i in range(out_size):
+        lo = (i * in_size) // out_size
+        hi = -(-((i + 1) * in_size) // out_size)  # ceil div
+        m[i, lo:hi] = 1.0 / (hi - lo)
+    return jnp.asarray(m, dtype=dtype)
+
+
 def adaptive_avg_pool2d(x, output_size, data_format: str = "NCHW"):
     oh, ow = _pair(output_size)
     if data_format == "NCHW":
@@ -238,13 +253,16 @@ def adaptive_avg_pool2d(x, output_size, data_format: str = "NCHW"):
         if h % oh == 0 and w % ow == 0:
             x = x.reshape(n, c, oh, h // oh, ow, w // ow)
             return x.mean(axis=(3, 5))
-    else:
-        n, h, w, c = x.shape
-        if h % oh == 0 and w % ow == 0:
-            x = x.reshape(n, oh, h // oh, ow, w // ow, c)
-            return x.mean(axis=(2, 4))
-    raise NotImplementedError(
-        "adaptive_avg_pool2d requires output_size to divide input size")
+        ah = _adaptive_pool_matrix(h, oh, x.dtype)
+        aw = _adaptive_pool_matrix(w, ow, x.dtype)
+        return jnp.einsum("nchw,ph,qw->ncpq", x, ah, aw)
+    n, h, w, c = x.shape
+    if h % oh == 0 and w % ow == 0:
+        x = x.reshape(n, oh, h // oh, ow, w // ow, c)
+        return x.mean(axis=(2, 4))
+    ah = _adaptive_pool_matrix(h, oh, x.dtype)
+    aw = _adaptive_pool_matrix(w, ow, x.dtype)
+    return jnp.einsum("nhwc,ph,qw->npqc", x, ah, aw)
 
 
 # ---------------------------------------------------------------------------

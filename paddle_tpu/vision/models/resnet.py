@@ -8,7 +8,9 @@ from typing import List, Optional, Type, Union
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152"]
+           "resnet152", "resnext50_32x4d", "resnext50_64x4d",
+           "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+           "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2"]
 
 
 class BasicBlock(nn.Layer):
@@ -142,3 +144,37 @@ def resnet101(pretrained: bool = False, **kwargs):
 
 def resnet152(pretrained: bool = False, **kwargs):
     return _resnet(BottleneckBlock, 152, **kwargs)
+
+
+# ResNeXt: grouped 3x3 bottlenecks (ref resnet.py resnext* factories).
+def resnext50_32x4d(pretrained: bool = False, **kwargs):
+    return _resnet(BottleneckBlock, 50, groups=32, width=4, **kwargs)
+
+
+def resnext50_64x4d(pretrained: bool = False, **kwargs):
+    return _resnet(BottleneckBlock, 50, groups=64, width=4, **kwargs)
+
+
+def resnext101_32x4d(pretrained: bool = False, **kwargs):
+    return _resnet(BottleneckBlock, 101, groups=32, width=4, **kwargs)
+
+
+def resnext101_64x4d(pretrained: bool = False, **kwargs):
+    return _resnet(BottleneckBlock, 101, groups=64, width=4, **kwargs)
+
+
+def resnext152_32x4d(pretrained: bool = False, **kwargs):
+    return _resnet(BottleneckBlock, 152, groups=32, width=4, **kwargs)
+
+
+def resnext152_64x4d(pretrained: bool = False, **kwargs):
+    return _resnet(BottleneckBlock, 152, groups=64, width=4, **kwargs)
+
+
+# Wide ResNet: 2x bottleneck width (ref resnet.py wide_resnet*_2).
+def wide_resnet50_2(pretrained: bool = False, **kwargs):
+    return _resnet(BottleneckBlock, 50, width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained: bool = False, **kwargs):
+    return _resnet(BottleneckBlock, 101, width=128, **kwargs)

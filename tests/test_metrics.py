@@ -1,0 +1,71 @@
+"""Metric correctness vs brute-force numpy references.
+
+Ref test model: test/legacy_test/test_metrics.py (Accuracy/Precision/
+Recall/Auc checked against hand-rolled numpy)."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_accuracy_topk():
+    rng = np.random.default_rng(0)
+    pred = rng.normal(size=(64, 10)).astype(np.float32)
+    label = rng.integers(0, 10, size=(64, 1))
+    m = paddle.metric.Accuracy(topk=(1, 5))
+    m.update(m.compute(pred, label))
+    top5 = np.argsort(-pred, axis=-1)[:, :5]
+    want1 = float((top5[:, 0] == label[:, 0]).mean())
+    want5 = float((top5 == label).any(axis=1).mean())
+    got1, got5 = m.accumulate()
+    assert abs(got1 - want1) < 1e-6 and abs(got5 - want5) < 1e-6
+    assert m.name() == ["acc_top1", "acc_top5"]
+
+
+def test_precision_recall_binary():
+    rng = np.random.default_rng(1)
+    m_p = paddle.metric.Precision()
+    m_r = paddle.metric.Recall()
+    tp = fp = fn = 0
+    for _ in range(3):  # accumulation across batches
+        scores = rng.uniform(size=32).astype(np.float32)
+        labels = rng.integers(0, 2, size=32)
+        m_p.update(scores, labels)
+        m_r.update(scores, labels)
+        hard = scores > 0.5
+        tp += int((hard & (labels == 1)).sum())
+        fp += int((hard & (labels == 0)).sum())
+        fn += int((~hard & (labels == 1)).sum())
+    assert abs(m_p.accumulate() - tp / (tp + fp)) < 1e-9
+    assert abs(m_r.accumulate() - tp / (tp + fn)) < 1e-9
+
+
+def test_precision_recall_empty_denominator():
+    assert paddle.metric.Precision().accumulate() == 0.0
+    assert paddle.metric.Recall().accumulate() == 0.0
+
+
+def test_auc_matches_pairwise_definition():
+    rng = np.random.default_rng(2)
+    scores = rng.uniform(size=200).astype(np.float64)
+    labels = rng.integers(0, 2, size=200)
+    m = paddle.metric.Auc(num_thresholds=4095)
+    # two-column prob input across two update calls
+    probs = np.stack([1 - scores, scores], axis=1)
+    m.update(probs[:100], labels[:100])
+    m.update(probs[100:], labels[100:])
+    pos = scores[labels == 1]
+    neg = scores[labels == 0]
+    pairs = (pos[:, None] > neg[None, :]).sum() \
+        + 0.5 * (pos[:, None] == neg[None, :]).sum()
+    want = pairs / (len(pos) * len(neg))
+    # bucketed estimator: within a bucket-width tolerance
+    assert abs(m.accumulate() - want) < 2e-3
+
+
+def test_metric_reset():
+    m = paddle.metric.Precision()
+    m.update(np.array([0.9]), np.array([1]))
+    assert m.accumulate() == 1.0
+    m.reset()
+    assert m.accumulate() == 0.0
