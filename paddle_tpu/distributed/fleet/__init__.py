@@ -1,7 +1,10 @@
 from .strategy import DistributedStrategy  # noqa: F401
 from .fleet import (init, distributed_model, distributed_optimizer,  # noqa: F401
                     get_hybrid_communicate_group, worker_index, worker_num,
-                    is_first_worker)
+                    is_first_worker, is_server, is_worker, run_server,
+                    init_server, stop_worker, barrier_worker, get_ps_client)
+from .role_maker import (PaddleCloudRoleMaker,  # noqa: F401
+                         UserDefinedRoleMaker, Role)
 from . import meta_parallel  # noqa: F401
 from . import utils  # noqa: F401
 from .layers import mpu  # noqa: F401

@@ -19,17 +19,40 @@ from .strategy import DistributedStrategy
 
 __all__ = ["init", "distributed_model", "distributed_optimizer",
            "get_hybrid_communicate_group", "worker_index", "worker_num",
-           "is_first_worker"]
+           "is_first_worker", "is_server", "is_worker", "run_server",
+           "init_server", "stop_worker", "barrier_worker", "get_ps_client"]
 
 _hcg: Optional[HybridCommunicateGroup] = None
 _strategy: Optional[DistributedStrategy] = None
+_role_maker = None
+_ps_client = None
 
 
 def init(role_maker=None, is_collective: bool = True,
          strategy: Optional[DistributedStrategy] = None,
          devices=None) -> None:
-    """fleet.init parity: build the hybrid mesh from strategy degrees."""
-    global _hcg, _strategy
+    """fleet.init parity: build the hybrid mesh from strategy degrees.
+
+    With ``is_collective=False`` (or a non-collective role maker), enters
+    parameter-server mode (ref fleet.py:169 PS branch): the process's role
+    comes from the role maker / PaddleCloud env contract, and no device
+    mesh is built — servers host tables, workers get a PS client.
+    """
+    global _hcg, _strategy, _role_maker, _ps_client
+    if role_maker is not None and not getattr(role_maker, "_is_collective",
+                                              True):
+        is_collective = False
+    if not is_collective:
+        from .role_maker import PaddleCloudRoleMaker
+        _role_maker = role_maker or PaddleCloudRoleMaker()
+        _strategy = strategy or DistributedStrategy()
+        _hcg = None  # re-init may switch modes; drop stale collective state
+        return
+    # Collective (re-)init: drop stale PS-mode state symmetrically.
+    _role_maker = None
+    if _ps_client is not None:
+        _ps_client.close()
+        _ps_client = None
     init_parallel_env()
     strategy = strategy or DistributedStrategy()
     _strategy = strategy
@@ -54,14 +77,20 @@ def fleet_initialized() -> bool:
 
 
 def worker_index() -> int:
+    if _role_maker is not None:
+        return _role_maker.worker_index()
     return get_rank()
 
 
 def worker_num() -> int:
+    if _role_maker is not None:
+        return _role_maker.worker_num()
     return get_world_size()
 
 
 def is_first_worker() -> bool:
+    if _role_maker is not None:
+        return _role_maker.is_first_worker()
     return get_rank() == 0
 
 
@@ -80,6 +109,59 @@ def distributed_model(model):
             _hcg.get_sep_parallel_world_size() > 1:
         return TensorParallel(model, _hcg, _strategy)
     return DataParallel(model)
+
+
+# -- parameter-server mode (ref fleet.py is_server/run_server/stop_worker) --
+
+def is_server() -> bool:
+    return _role_maker is not None and _role_maker.is_server()
+
+
+def is_worker() -> bool:
+    return _role_maker is None or _role_maker.is_worker()
+
+
+def init_server(*model_paths) -> None:
+    """No-op placeholder for load-at-startup parity; tables are created
+    lazily by workers (create_sparse_table is idempotent)."""
+
+
+def run_server() -> None:
+    """Serve this process's PS shard; blocks until a worker stops it."""
+    assert is_server(), "run_server() called on a non-PSERVER role"
+    from ..ps import run_server as _serve
+    _serve(_role_maker.current_endpoint())
+
+
+def get_ps_client():
+    """The worker's connection to all PS shards (created on first use)."""
+    global _ps_client
+    assert _role_maker is not None, \
+        "call fleet.init(role_maker, is_collective=False) first"
+    if _ps_client is None:
+        from ..ps import PSClient
+        _ps_client = PSClient(_role_maker.server_endpoints(),
+                              worker_id=_role_maker.worker_index(),
+                              n_workers=_role_maker.worker_num())
+    return _ps_client
+
+
+def barrier_worker() -> None:
+    if _role_maker is not None and _role_maker.is_worker():
+        get_ps_client().barrier("fleet_worker_barrier")
+
+
+def stop_worker() -> None:
+    """Last call on workers: all workers rendezvous, then worker 0 stops the
+    servers (ref stop_worker) — without the barrier a fast worker 0 would
+    kill servers mid-step under slower workers in async mode."""
+    global _ps_client
+    if _ps_client is not None:
+        _ps_client.barrier("fleet_stop_worker")
+        if _role_maker.worker_index() == 0:
+            _ps_client.stop_servers()
+        _ps_client.close()
+        _ps_client = None
 
 
 def distributed_optimizer(optimizer, strategy=None):

@@ -1,0 +1,90 @@
+"""Role makers for PS mode.
+
+Ref: ``python/paddle/distributed/fleet/base/role_maker.py`` —
+``PaddleCloudRoleMaker`` derives the process's role (PSERVER vs TRAINER),
+its endpoint, and the cluster layout from the PaddleCloud env-var contract.
+The same contract is honored here:
+
+- ``TRAINING_ROLE`` / ``PADDLE_TRAINING_ROLE``: "PSERVER" or "TRAINER"
+- ``PADDLE_PSERVERS_IP_PORT_LIST``: comma-separated server endpoints
+- ``POD_IP`` + ``PADDLE_PORT``: this server's endpoint (PSERVER role)
+- ``PADDLE_TRAINERS_NUM`` / ``PADDLE_TRAINER_ID``: worker layout
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective: bool = False, **kwargs):
+        self._is_collective = is_collective
+        env = os.environ
+        role = env.get("TRAINING_ROLE",
+                       env.get("PADDLE_TRAINING_ROLE", "TRAINER")).upper()
+        self._role = Role.SERVER if role == "PSERVER" else Role.WORKER
+        eps = env.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+        self._server_endpoints: List[str] = \
+            [e for e in eps.split(",") if e] if eps else []
+        self._worker_num = int(env.get("PADDLE_TRAINERS_NUM", "1"))
+        self._worker_index = int(env.get("PADDLE_TRAINER_ID", "0"))
+        if self._role == Role.SERVER:
+            ip = env.get("POD_IP", "127.0.0.1")
+            port = env.get("PADDLE_PORT", "0")
+            self._cur_endpoint = f"{ip}:{port}"
+        else:
+            self._cur_endpoint = ""
+
+    def _is_worker(self) -> bool:
+        return self._role == Role.WORKER
+
+    def _is_server(self) -> bool:
+        return self._role == Role.SERVER
+
+    def _worker_num_(self) -> int:
+        return self._worker_num
+
+    # public accessors (named as the reference's RoleMakerBase surface)
+    def is_worker(self) -> bool:
+        return self._is_worker()
+
+    def is_server(self) -> bool:
+        return self._is_server()
+
+    def worker_num(self) -> int:
+        return self._worker_num
+
+    def worker_index(self) -> int:
+        return self._worker_index
+
+    def server_endpoints(self) -> List[str]:
+        return self._server_endpoints
+
+    def current_endpoint(self) -> str:
+        return self._cur_endpoint
+
+    def is_first_worker(self) -> bool:
+        return self._is_worker() and self._worker_index == 0
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit layout (ref role_maker.UserDefinedRoleMaker) — for tests and
+    programmatic launch."""
+
+    def __init__(self, *, role: int, worker_num: int, worker_index: int = 0,
+                 server_endpoints: Optional[List[str]] = None,
+                 current_endpoint: str = ""):
+        self._is_collective = False
+        self._role = role
+        self._worker_num = worker_num
+        self._worker_index = worker_index
+        self._server_endpoints = list(server_endpoints or [])
+        self._cur_endpoint = current_endpoint

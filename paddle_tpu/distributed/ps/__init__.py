@@ -1,0 +1,21 @@
+"""Parameter-server mode — host-resident sharded KV tables.
+
+Reference parity: the brpc-based PS runtime (``paddle/fluid/distributed/ps/``
+— ``table/memory_sparse_table.cc`` sparse KV shards, ``sparse_sgd_rule.cc``
+server-side optimizers, ``service/brpc_ps_server.cc`` RPC surface) and the
+Python fleet PS mode (``fleet.init(role_maker, is_collective=False)`` →
+``is_server``/``run_server``/``stop_worker``).
+
+TPU-native redesign: the dense model trains on TPU through the collective
+path; PS mode exists for the *embedding-dominated* regime ("100B features")
+where tables exceed HBM. Tables live in host RAM, sharded across plain TCP
+server processes (length-prefixed pickle protocol — brpc/protobuf collapses
+to the stdlib); trainers pull rows by id, run the dense math on TPU, and
+push per-row gradients back, applied server-side with SGD/AdaGrad rules
+(async-SGD semantics, plus a barrier for BSP). Row ownership is
+``id % n_servers``, the reference's default hash routing.
+"""
+
+from .table import DenseTable, SparseTable  # noqa: F401
+from .server import ParameterServer, run_server  # noqa: F401
+from .client import PSClient, PSEmbedding  # noqa: F401

@@ -1,0 +1,202 @@
+"""PS client: id-routed pull/push against the server shard set.
+
+Ref: ``paddle/fluid/distributed/ps/service/brpc_ps_client.cc`` (route by
+feature id, scatter pulls, merge pushes) and the worker half of
+``python/paddle/distributed/fleet`` PS mode.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from .server import recv_msg, send_msg
+
+__all__ = ["PSClient", "PSEmbedding"]
+
+
+class PSClient:
+    def __init__(self, endpoints: Sequence[str], worker_id: int = 0,
+                 n_workers: int = 1, connect_timeout: float = 30.0):
+        if not endpoints:
+            raise ValueError(
+                "PSClient needs at least one server endpoint — in PS mode "
+                "set PADDLE_PSERVERS_IP_PORT_LIST (host:port,host:port,...)")
+        self.endpoints = list(endpoints)
+        self._sparse_dims: Dict[str, int] = {}
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self._socks: List[socket.socket] = []
+        for ep in self.endpoints:
+            host, port = ep.rsplit(":", 1)
+            deadline = time.monotonic() + connect_timeout
+            while True:
+                try:
+                    s = socket.create_connection((host, int(port)),
+                                                 timeout=connect_timeout)
+                    s.settimeout(600.0)
+                    self._socks.append(s)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.2)  # server may still be binding
+
+    @property
+    def n_servers(self) -> int:
+        return len(self.endpoints)
+
+    def _send(self, server: int, op: str, **args) -> None:
+        send_msg(self._socks[server], (op, args))
+
+    def _recv(self, server: int):
+        reply = recv_msg(self._socks[server])
+        if isinstance(reply, Exception):
+            raise reply
+        return reply
+
+    def _call(self, server: int, op: str, **args):
+        self._send(server, op, **args)
+        return self._recv(server)
+
+    def _call_all(self, op: str, **args):
+        # Scatter then gather: the shard requests are independent, so
+        # pipeline them on the per-shard sockets instead of serial
+        # round-trips (the reference client scatters concurrently).
+        for i in range(self.n_servers):
+            self._send(i, op, **args)
+        return [self._recv(i) for i in range(self.n_servers)]
+
+    # -- table management --------------------------------------------------
+
+    def create_sparse_table(self, name: str, dim: int, rule: str = "sgd",
+                            lr: float = 0.01, init: str = "uniform",
+                            init_range: float = 0.0, seed: int = 0) -> None:
+        self._call_all("create_sparse", name=name, dim=dim, rule=rule, lr=lr,
+                       init=init, init_range=init_range, seed=seed)
+        self._sparse_dims[name] = dim
+
+    def create_dense_table(self, name: str, shape, rule: str = "sgd",
+                           lr: float = 0.01, init: str = "zeros",
+                           seed: int = 0) -> None:
+        # Dense blocks are owned by a single shard chosen by name hash.
+        owner = self._dense_owner(name)
+        self._call(owner, "create_dense", name=name, shape=tuple(shape),
+                   rule=rule, lr=lr, init=init, seed=seed)
+
+    def _dense_owner(self, name: str) -> int:
+        return sum(name.encode()) % self.n_servers
+
+    # -- sparse ------------------------------------------------------------
+
+    def pull_sparse(self, name: str, ids) -> np.ndarray:
+        """Gather rows for `ids` (any shape); returns [*ids.shape, dim]."""
+        ids = np.asarray(ids)
+        flat = ids.reshape(-1).astype(np.int64)
+        if flat.size == 0:
+            dim = self._sparse_dims.get(name) or \
+                self._call(0, "table_dim", name=name)
+            return np.zeros((*ids.shape, dim), dtype=np.float32)
+        owners = flat % self.n_servers
+        shards = []  # scatter all shard requests, then gather replies
+        for s in range(self.n_servers):
+            (where,) = np.nonzero(owners == s)
+            if where.size:
+                self._send(s, "pull_sparse", name=name,
+                           ids=flat[where].tolist())
+                shards.append((s, where))
+        dim = None
+        result = None
+        for s, where in shards:
+            rows = self._recv(s)
+            if result is None:
+                dim = rows.shape[1]
+                result = np.empty((flat.size, dim), dtype=np.float32)
+            result[where] = rows
+        return result.reshape(*ids.shape, dim)
+
+    def push_sparse(self, name: str, ids, grads: np.ndarray) -> None:
+        flat = np.asarray(ids).reshape(-1).astype(np.int64)
+        if flat.size == 0:
+            return
+        g = np.asarray(grads, dtype=np.float32).reshape(flat.size, -1)
+        owners = flat % self.n_servers
+        shards = []
+        for s in range(self.n_servers):
+            (where,) = np.nonzero(owners == s)
+            if where.size:
+                self._send(s, "push_sparse", name=name,
+                           ids=flat[where].tolist(), grads=g[where])
+                shards.append(s)
+        for s in shards:
+            self._recv(s)
+
+    def sparse_table_size(self, name: str) -> int:
+        return sum(self._call_all("table_size", name=name))
+
+    # -- dense -------------------------------------------------------------
+
+    def pull_dense(self, name: str) -> np.ndarray:
+        return self._call(self._dense_owner(name), "pull_dense", name=name)
+
+    def push_dense(self, name: str, grad: np.ndarray) -> None:
+        self._call(self._dense_owner(name), "push_dense", name=name,
+                   grad=np.asarray(grad, dtype=np.float32))
+
+    # -- coordination ------------------------------------------------------
+
+    def barrier(self, tag: str = "step") -> None:
+        """BSP barrier across all workers (served by shard 0)."""
+        self._call(0, "barrier", tag=tag, n=self.n_workers)
+
+    def save(self, name: str, path_prefix: str) -> None:
+        for s in range(self.n_servers):
+            self._call(s, "save", name=name,
+                       path=f"{path_prefix}.shard{s}.npy")
+
+    def load(self, name: str, path_prefix: str) -> None:
+        for s in range(self.n_servers):
+            self._call(s, "load", name=name,
+                       path=f"{path_prefix}.shard{s}.npy")
+
+    def stop_servers(self) -> None:
+        for s in range(self.n_servers):
+            try:
+                self._call(s, "stop")
+            except (ConnectionError, OSError):
+                pass
+
+    def close(self) -> None:
+        for s in self._socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+class PSEmbedding:
+    """Worker-side facade over one sparse table: lookup on host, compute on
+    TPU, push row grads (the reference's distributed lookup_table op pair).
+
+    Usage inside a train step:
+        emb = PSEmbedding(client, "emb", dim=64, lr=0.1)
+        rows = emb.lookup(ids)                       # np [B, dim] -> device
+        loss, g_rows = value_and_grad(step)(rows)    # dense math on TPU
+        emb.push_grads(ids, g_rows)
+    """
+
+    def __init__(self, client: PSClient, name: str, dim: int,
+                 rule: str = "sgd", lr: float = 0.01, seed: int = 0):
+        self.client = client
+        self.name = name
+        self.dim = dim
+        client.create_sparse_table(name, dim, rule=rule, lr=lr, seed=seed)
+
+    def lookup(self, ids) -> np.ndarray:
+        return self.client.pull_sparse(self.name, ids)
+
+    def push_grads(self, ids, grads) -> None:
+        self.client.push_sparse(self.name, ids, np.asarray(grads))

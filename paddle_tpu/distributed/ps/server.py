@@ -1,0 +1,173 @@
+"""Parameter server process.
+
+Ref: ``paddle/fluid/distributed/ps/service/brpc_ps_server.cc`` — the RPC
+dispatch surface (create/pull/push/save/load/barrier/stop). Transport here
+is stdlib TCP with length-prefixed pickle frames; concurrency is a thread
+per connection (row updates lock per table).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from .table import DenseTable, SparseTable
+
+__all__ = ["ParameterServer", "run_server", "send_msg", "recv_msg"]
+
+
+def send_msg(sock: socket.socket, obj) -> None:
+    data = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def recv_msg(sock: socket.socket):
+    hdr = _recv_exact(sock, 8)
+    (n,) = struct.unpack("<Q", hdr)
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+class _Barrier:
+    """Named counting barrier for BSP sync across workers."""
+
+    def __init__(self):
+        self._mu = threading.Condition()
+        self._counts: Dict[str, int] = {}
+        self._gen: Dict[str, int] = {}
+
+    def wait(self, tag: str, n: int) -> None:
+        with self._mu:
+            gen = self._gen.get(tag, 0)
+            self._counts[tag] = self._counts.get(tag, 0) + 1
+            if self._counts[tag] >= n:
+                self._counts[tag] = 0
+                self._gen[tag] = gen + 1
+                self._mu.notify_all()
+                return
+            while self._gen.get(tag, 0) == gen:
+                self._mu.wait(timeout=120.0)
+
+
+class ParameterServer:
+    """One PS shard. Serves until `stop` (or the owning process exits)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.tables: Dict[str, object] = {}
+        self.barrier = _Barrier()
+        self._stop = threading.Event()
+        ps = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        op, args = recv_msg(self.request)
+                        try:
+                            reply = ps._dispatch(op, args)
+                        except Exception as e:  # ship to client, keep serving
+                            reply = e
+                        send_msg(self.request, reply)
+                        if op == "stop":
+                            return
+                except (ConnectionError, EOFError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server((host, port), Handler)
+        self.endpoint = "%s:%d" % self._srv.server_address
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch(self, op: str, a: dict):
+        if op == "ping":
+            return "pong"
+        if op == "create_sparse":
+            if a["name"] not in self.tables:  # idempotent across workers
+                self.tables[a["name"]] = SparseTable(
+                    a["dim"], a.get("rule", "sgd"), a.get("lr", 0.01),
+                    a.get("init", "uniform"), a.get("init_range", 0.0),
+                    a.get("seed", 0))
+            return "ok"
+        if op == "create_dense":
+            if a["name"] not in self.tables:
+                self.tables[a["name"]] = DenseTable(
+                    a["shape"], a.get("rule", "sgd"), a.get("lr", 0.01),
+                    a.get("init", "zeros"), a.get("seed", 0))
+            return "ok"
+        if op == "pull_sparse":
+            return self.tables[a["name"]].pull(a["ids"])
+        if op == "push_sparse":
+            self.tables[a["name"]].push(a["ids"], a["grads"])
+            return "ok"
+        if op == "pull_dense":
+            return self.tables[a["name"]].pull()
+        if op == "push_dense":
+            self.tables[a["name"]].push(a["grad"])
+            return "ok"
+        if op == "barrier":
+            self.barrier.wait(a["tag"], a["n"])
+            return "ok"
+        if op == "table_size":
+            return len(self.tables[a["name"]])
+        if op == "table_dim":
+            return self.tables[a["name"]].dim
+        if op == "save":
+            t = self.tables[a["name"]]
+            np.save(a["path"], np.array([t.state_dict()], dtype=object),
+                    allow_pickle=True)
+            return "ok"
+        if op == "load":
+            t = self.tables[a["name"]]
+            sd = np.load(a["path"], allow_pickle=True)[0]
+            t.load_state_dict(sd)
+            return "ok"
+        if op == "stop":
+            self._stop.set()
+            threading.Thread(target=self._srv.shutdown, daemon=True).start()
+            return "ok"
+        raise ValueError(f"unknown PS op {op!r}")
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def serve_forever(self):
+        self._srv.serve_forever(poll_interval=0.2)
+
+    def serve_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t
+
+    def stop(self):
+        self._stop.set()
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+def run_server(endpoint: str) -> None:
+    """Blocking entry for a PS process (ref fleet.run_server()).
+
+    `endpoint` is "host:port"; serves until a client sends `stop`.
+    """
+    host, port = endpoint.rsplit(":", 1)
+    srv = ParameterServer(host, int(port))
+    srv.serve_forever()

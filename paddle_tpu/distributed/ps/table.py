@@ -1,0 +1,131 @@
+"""Server-side tables and update rules.
+
+Ref: ``paddle/fluid/distributed/ps/table/`` — ``memory_sparse_table.cc``
+(hash KV shard, lazy row init), ``memory_dense_table.cc`` and
+``sparse_sgd_rule.cc`` (SGD/AdaGrad applied on the server).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+__all__ = ["DenseTable", "SparseTable"]
+
+
+class _Rule:
+    """Server-side update rule (ref sparse_sgd_rule.cc)."""
+
+    def __init__(self, kind: str, lr: float, eps: float = 1e-8):
+        if kind not in ("sgd", "adagrad"):
+            raise ValueError(f"unknown update rule {kind!r}")
+        self.kind = kind
+        self.lr = lr
+        self.eps = eps
+
+    def apply(self, w: np.ndarray, g: np.ndarray,
+              state: Optional[np.ndarray]) -> Optional[np.ndarray]:
+        """Update `w` in place; returns the new accumulator state."""
+        if self.kind == "sgd":
+            w -= self.lr * g
+            return None
+        state = (state if state is not None else np.zeros_like(w)) + g * g
+        w -= self.lr * g / (np.sqrt(state) + self.eps)
+        return state
+
+
+class DenseTable:
+    """A dense parameter block owned by one server."""
+
+    def __init__(self, shape, rule: str = "sgd", lr: float = 0.01,
+                 init: str = "zeros", seed: int = 0):
+        rng = np.random.default_rng(seed)
+        if init == "zeros":
+            self.value = np.zeros(shape, dtype=np.float32)
+        elif init == "uniform":
+            bound = 1.0 / np.sqrt(shape[-1])
+            self.value = rng.uniform(-bound, bound, shape).astype(np.float32)
+        else:
+            raise ValueError(f"unknown init {init!r}")
+        self._rule = _Rule(rule, lr)
+        self._state: Optional[np.ndarray] = None
+        self._mu = threading.Lock()
+
+    def pull(self) -> np.ndarray:
+        with self._mu:
+            return self.value.copy()
+
+    def push(self, grad: np.ndarray) -> None:
+        with self._mu:
+            self._state = self._rule.apply(self.value, grad, self._state)
+
+
+class SparseTable:
+    """Hash-KV embedding shard: id -> row, lazily initialized.
+
+    Row init is deterministic in (seed, id) so a re-created server yields
+    identical untrained rows (ref memory_sparse_table lazy feature init).
+    """
+
+    def __init__(self, dim: int, rule: str = "sgd", lr: float = 0.01,
+                 init: str = "uniform", init_range: float = 0.0,
+                 seed: int = 0):
+        self.dim = dim
+        self.init = init
+        self.init_range = init_range or 1.0 / np.sqrt(dim)
+        self.seed = seed
+        self._rows: Dict[int, np.ndarray] = {}
+        self._state: Dict[int, np.ndarray] = {}
+        self._rule = _Rule(rule, lr)
+        self._mu = threading.Lock()
+
+    def _init_row(self, fid: int) -> np.ndarray:
+        if self.init == "zeros":
+            return np.zeros(self.dim, dtype=np.float32)
+        rng = np.random.default_rng((self.seed, fid))
+        return rng.uniform(-self.init_range, self.init_range,
+                           self.dim).astype(np.float32)
+
+    def pull(self, ids) -> np.ndarray:
+        with self._mu:
+            out = np.empty((len(ids), self.dim), dtype=np.float32)
+            for k, fid in enumerate(ids):
+                row = self._rows.get(fid)
+                if row is None:
+                    row = self._rows[fid] = self._init_row(int(fid))
+                out[k] = row
+            return out
+
+    def push(self, ids, grads: np.ndarray) -> None:
+        with self._mu:
+            # Duplicate ids in one push accumulate (ref: merge-by-id before
+            # the update rule).
+            merged: Dict[int, np.ndarray] = {}
+            for k, fid in enumerate(ids):
+                fid = int(fid)
+                if fid in merged:
+                    merged[fid] = merged[fid] + grads[k]
+                else:
+                    merged[fid] = grads[k]
+            for fid, g in merged.items():
+                row = self._rows.get(fid)
+                if row is None:
+                    row = self._rows[fid] = self._init_row(fid)
+                new_state = self._rule.apply(row, g, self._state.get(fid))
+                if new_state is not None:
+                    self._state[fid] = new_state
+
+    def __len__(self):
+        with self._mu:
+            return len(self._rows)
+
+    def state_dict(self):
+        with self._mu:
+            return {"rows": dict(self._rows), "state": dict(self._state)}
+
+    def load_state_dict(self, sd):
+        with self._mu:
+            self._rows = dict(sd["rows"])
+            self._state = dict(sd["state"])

@@ -1,0 +1,190 @@
+"""Parameter-server mode tests.
+
+Ref test model: test/legacy_test/test_dist_fleet_ps*.py — servers + workers
+as separate processes, embedding pull/push, and convergence of an
+embedding-dominated model trained through the PS path.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (ParameterServer, PSClient, PSEmbedding,
+                                       SparseTable)
+
+
+@pytest.fixture
+def cluster():
+    """Two in-process PS shards + a connected client."""
+    servers = [ParameterServer(), ParameterServer()]
+    for s in servers:
+        s.serve_in_thread()
+    client = PSClient([s.endpoint for s in servers], worker_id=0, n_workers=1)
+    yield servers, client
+    client.close()
+    for s in servers:
+        s.stop()
+
+
+class TestSparseTable:
+    def test_lazy_deterministic_init(self):
+        t1 = SparseTable(dim=4, seed=7)
+        t2 = SparseTable(dim=4, seed=7)
+        np.testing.assert_array_equal(t1.pull([3, 9]), t2.pull([3, 9]))
+        assert len(t1) == 2
+
+    def test_sgd_update(self):
+        t = SparseTable(dim=2, rule="sgd", lr=0.5, init="zeros")
+        t.push([1], np.array([[1.0, -2.0]], dtype=np.float32))
+        np.testing.assert_allclose(t.pull([1]), [[-0.5, 1.0]])
+
+    def test_duplicate_ids_accumulate(self):
+        t = SparseTable(dim=1, rule="sgd", lr=1.0, init="zeros")
+        t.push([5, 5], np.array([[1.0], [2.0]], dtype=np.float32))
+        np.testing.assert_allclose(t.pull([5]), [[-3.0]])
+
+    def test_adagrad_update(self):
+        t = SparseTable(dim=1, rule="adagrad", lr=1.0, init="zeros")
+        t.push([0], np.array([[2.0]], dtype=np.float32))
+        # G = 4; w -= 1.0 * 2 / (sqrt(4)+eps) = -1.0
+        np.testing.assert_allclose(t.pull([0]), [[-1.0]], atol=1e-6)
+
+
+class TestClientRouting:
+    def test_pull_push_roundtrip_across_shards(self, cluster):
+        _, client = cluster
+        client.create_sparse_table("emb", dim=3, rule="sgd", lr=1.0,
+                                   init="zeros")
+        ids = np.array([0, 1, 2, 3, 7, 10])  # mixed parity → both shards
+        rows = client.pull_sparse("emb", ids)
+        assert rows.shape == (6, 3)
+        np.testing.assert_array_equal(rows, 0)
+        g = np.ones((6, 3), dtype=np.float32)
+        client.push_sparse("emb", ids, g)
+        np.testing.assert_allclose(client.pull_sparse("emb", ids), -g)
+        # untouched id is still at init
+        np.testing.assert_array_equal(client.pull_sparse("emb", [20]),
+                                      np.zeros((1, 3)))
+
+    def test_empty_ids(self, cluster):
+        _, client = cluster
+        client.create_sparse_table("empty", dim=5, init="zeros")
+        rows = client.pull_sparse("empty", [])
+        assert rows.shape == (0, 5)
+        client.push_sparse("empty", [], np.zeros((0, 5)))  # no-op, no error
+
+    def test_nested_id_shapes(self, cluster):
+        _, client = cluster
+        client.create_sparse_table("e2", dim=2, init="zeros")
+        rows = client.pull_sparse("e2", np.arange(12).reshape(3, 4))
+        assert rows.shape == (3, 4, 2)
+
+    def test_dense_table(self, cluster):
+        _, client = cluster
+        client.create_dense_table("w", (2, 2), rule="sgd", lr=0.1,
+                                  init="zeros")
+        client.push_dense("w", np.ones((2, 2)))
+        np.testing.assert_allclose(client.pull_dense("w"), -0.1 * np.ones((2, 2)))
+
+    def test_table_size_and_save_load(self, cluster, tmp_path):
+        _, client = cluster
+        client.create_sparse_table("e3", dim=2)
+        client.pull_sparse("e3", [1, 2, 3, 4, 5])
+        assert client.sparse_table_size("e3") == 5
+        client.push_sparse("e3", [1], np.ones((1, 2), dtype=np.float32))
+        want = client.pull_sparse("e3", [1])
+        prefix = str(tmp_path / "emb")
+        client.save("e3", prefix)
+        client.push_sparse("e3", [1], np.ones((1, 2), dtype=np.float32))
+        client.load("e3", prefix)
+        np.testing.assert_array_equal(client.pull_sparse("e3", [1]), want)
+
+    def test_server_error_propagates(self, cluster):
+        _, client = cluster
+        with pytest.raises(KeyError):
+            client.pull_sparse("never_created", [1])
+
+
+def _ps_server_proc(port, ready):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["TRAINING_ROLE"] = "PSERVER"
+    os.environ["POD_IP"] = "127.0.0.1"
+    os.environ["PADDLE_PORT"] = str(port)
+    from paddle_tpu.distributed import fleet
+    fleet.init(fleet.PaddleCloudRoleMaker(), is_collective=False)
+    assert fleet.is_server()
+    ready.set()
+    fleet.run_server()
+    os._exit(0)
+
+
+def _ps_worker_proc(worker_id, n_workers, endpoints, losses_q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["TRAINING_ROLE"] = "TRAINER"
+    os.environ["PADDLE_TRAINERS_NUM"] = str(n_workers)
+    os.environ["PADDLE_TRAINER_ID"] = str(worker_id)
+    os.environ["PADDLE_PSERVERS_IP_PORT_LIST"] = ",".join(endpoints)
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed import fleet
+
+    fleet.init(fleet.PaddleCloudRoleMaker(), is_collective=False)
+    assert fleet.is_worker() and not fleet.is_server()
+    client = fleet.get_ps_client()
+    emb = PSEmbedding(client, "emb", dim=8, rule="sgd", lr=0.3,
+                      seed=3)
+
+    # Tiny matrix-factorization-ish task: predict y = <e[i], target>
+    rng = np.random.default_rng(worker_id)
+    target = np.linspace(-1, 1, 8).astype(np.float32)
+
+    def loss_fn(rows, y):
+        pred = rows @ jnp.asarray(target)
+        return jnp.mean((pred - y) ** 2)
+
+    grad_fn = jax.value_and_grad(loss_fn)
+    losses = []
+    for step in range(30):
+        ids = rng.integers(0, 64, size=16)
+        y = jnp.asarray((ids % 5).astype(np.float32))
+        rows = jnp.asarray(emb.lookup(ids))
+        loss, g_rows = grad_fn(rows, y)
+        emb.push_grads(ids, np.asarray(g_rows))
+        losses.append(float(loss))
+        client.barrier("step%d" % step)
+    losses_q.put((worker_id, losses[0], losses[-1]))
+    losses_q.close()
+    losses_q.join_thread()  # flush before the hard exit below
+    fleet.stop_worker()
+    os._exit(0)
+
+
+def test_ps_training_multiprocess():
+    """2 server procs + 2 trainer procs; loss decreases on both workers."""
+    ctx = mp.get_context("fork")
+    from paddle_tpu.distributed.launch import free_port
+    ports = [free_port(), free_port()]
+    endpoints = [f"127.0.0.1:{p}" for p in ports]
+    readies = [ctx.Event() for _ in ports]
+    servers = [ctx.Process(target=_ps_server_proc, args=(p, r), daemon=True)
+               for p, r in zip(ports, readies)]
+    for s in servers:
+        s.start()
+    for r in readies:
+        assert r.wait(30)
+    q = ctx.Queue()
+    workers = [ctx.Process(target=_ps_worker_proc,
+                           args=(w, 2, endpoints, q), daemon=True)
+               for w in range(2)]
+    for w in workers:
+        w.start()
+    results = [q.get(timeout=120) for _ in range(2)]
+    for w in workers:
+        w.join(timeout=30)
+        assert w.exitcode == 0
+    for s in servers:
+        s.join(timeout=30)  # stop_worker (worker 0) stops the servers
+    for wid, first, last in results:
+        assert last < first * 0.5, (wid, first, last)
