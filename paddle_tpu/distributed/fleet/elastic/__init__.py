@@ -22,6 +22,8 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
+from ....profiler.monitor import stat_add
+
 __all__ = ["ElasticLevel", "ElasticStatus", "FileHeartbeatStore",
            "ElasticManager", "ELASTIC_EXIT_CODE",
            "ELASTIC_AUTO_PARALLEL_EXIT_CODE"]
@@ -144,6 +146,7 @@ class ElasticManager:
                 if self.store is not None:
                     self.store.leave(self.pod_id)
                 return rc
+            stat_add("elastic.restarts")  # counts actual relaunches only
 
     def _watch_one(self, pod, poll_interval: float) -> int:
         last_beat = 0.0

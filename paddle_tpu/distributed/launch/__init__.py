@@ -147,6 +147,8 @@ def build_pod(cfg: LaunchConfig, training_script: str,
         if cfg.log_dir:
             os.makedirs(cfg.log_dir, exist_ok=True)
             log_path = os.path.join(cfg.log_dir, f"workerlog.{rank}")
+            # rank-aware get_logger() in the trainee tees here too
+            env["PADDLE_LOG_DIR"] = cfg.log_dir
         containers.append(Container(rank=rank, local_rank=lr, cmd=cmd,
                                     env=env, log_path=log_path))
     return Pod(containers)

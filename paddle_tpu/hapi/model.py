@@ -31,6 +31,7 @@ from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..nn.layer import Layer
 from ..optimizer.optimizer import Optimizer
+from ..profiler.monitor import stat_add
 from .callbacks import config_callbacks
 
 __all__ = ["Model"]
@@ -256,6 +257,7 @@ class Model:
         """One optimizer step on a batch; returns loss (ref train_batch :817)."""
         assert self._optimizer is not None and self._loss is not None, \
             "call prepare(optimizer, loss) first"
+        stat_add("model.train_batches")
         inputs = tuple(jnp.asarray(x) for x in _as_tuple(inputs))
         labels = tuple(jnp.asarray(y) for y in _as_tuple(labels))
         self._ensure_state()

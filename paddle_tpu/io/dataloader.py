@@ -27,6 +27,7 @@ from typing import Any, Callable, Iterator, List, Optional
 import jax
 import numpy as np
 
+from ..profiler.monitor import stat_add
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -270,6 +271,12 @@ class DataLoader:
                     p.terminate()
             q.close()
 
+    @staticmethod
+    def _counted(source: Iterator[Any]) -> Iterator[Any]:
+        for batch in source:
+            stat_add("dataloader.batches")
+            yield batch
+
     def __iter__(self) -> Iterator[Any]:
         if self.num_workers == 0:
             source = self._batches_sync()
@@ -277,6 +284,7 @@ class DataLoader:
             source = self._batches_multiprocess()
         else:
             source = self._batches_threaded()
+        source = self._counted(source)
         if not self.prefetch_to_device:
             yield from source
             return

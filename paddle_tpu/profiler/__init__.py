@@ -21,7 +21,10 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
-           "export_chrome_tracing", "load_profiler_result", "SummaryView"]
+           "export_chrome_tracing", "load_profiler_result", "SummaryView",
+           "monitor"]
+
+from . import monitor  # noqa: E402,F401  (stat registry + rank logger)
 
 
 class ProfilerState(Enum):

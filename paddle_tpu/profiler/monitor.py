@@ -1,0 +1,164 @@
+"""Monitor counters — process-wide stat registry.
+
+Ref: ``paddle/fluid/platform/monitor.h`` (``MonitorRegistrar``/``StatValue``
+with the STAT_ADD/STAT_GET macro surface) and the per-rank log convention of
+``distributed/launch``. Counters are cheap thread-safe host-side tallies for
+runtime observability (queue bytes, batches, restarts, step counts); they
+never enter traced code — inside ``jit`` use the profiler, not counters.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+import threading
+import time
+from typing import Dict, Union
+
+__all__ = ["stat", "stat_add", "stat_set", "stat_get", "stats_snapshot",
+           "stats_reset", "get_logger"]
+
+_Number = Union[int, float]
+
+
+class StatValue:
+    __slots__ = ("name", "_value", "_mu")
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: _Number = 0
+        self._mu = threading.Lock()
+
+    def add(self, n: _Number = 1) -> None:
+        with self._mu:
+            self._value += n
+
+    def set(self, v: _Number) -> None:
+        with self._mu:
+            self._value = v
+
+    def get(self) -> _Number:
+        with self._mu:
+            return self._value
+
+    def reset(self) -> None:
+        self.set(0)
+
+
+class _Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._stats: Dict[str, StatValue] = {}
+
+    def get(self, name: str) -> StatValue:
+        with self._mu:
+            s = self._stats.get(name)
+            if s is None:
+                s = self._stats[name] = StatValue(name)
+            return s
+
+    def snapshot(self) -> Dict[str, _Number]:
+        with self._mu:
+            return {k: v.get() for k, v in sorted(self._stats.items())}
+
+    def reset(self) -> None:
+        with self._mu:
+            for v in self._stats.values():
+                v.reset()
+
+
+_registry = _Registry()
+
+
+def stat(name: str) -> StatValue:
+    """The named counter (created on first use)."""
+    return _registry.get(name)
+
+
+def stat_add(name: str, n: _Number = 1) -> None:
+    _registry.get(name).add(n)
+
+
+def stat_set(name: str, v: _Number) -> None:
+    _registry.get(name).set(v)
+
+
+def stat_get(name: str) -> _Number:
+    return _registry.get(name).get()
+
+
+def stats_snapshot() -> Dict[str, _Number]:
+    return _registry.snapshot()
+
+
+def stats_reset() -> None:
+    _registry.reset()
+
+
+# -- rank-aware logging (ref fleet/utils/log_util.py LoggerFactory) ---------
+
+_loggers: Dict[str, logging.Logger] = {}
+_loggers_mu = threading.Lock()
+
+
+def get_logger(name: str = "paddle_tpu", level: int = logging.INFO):
+    """Per-process logger tagged with the trainer rank; when the launcher
+    set PADDLE_LOG_DIR the stream also tees into ``<dir>/<name>.rank<N>.log``
+    (stdout already lands in the launcher's workerlog.N).
+
+    Calling again with a different `level` re-levels the cached logger."""
+    with _loggers_mu:
+        cached = _loggers.get(name)
+        if cached is not None:
+            cached.setLevel(level)
+            return cached
+        rank = os.environ.get("PADDLE_TRAINER_ID", "0")
+        logger = logging.getLogger(name)
+        logger.setLevel(level)
+        logger.propagate = False
+        fmt = logging.Formatter(
+            f"%(asctime)s [rank {rank}] %(levelname)s %(name)s: %(message)s")
+        if not logger.handlers:  # logging.getLogger returns a singleton
+            h = logging.StreamHandler(sys.stderr)
+            h.setFormatter(fmt)
+            logger.addHandler(h)
+            log_dir = os.environ.get("PADDLE_LOG_DIR")
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                fh = logging.FileHandler(
+                    os.path.join(log_dir, f"{name}.rank{rank}.log"))
+                fh.setFormatter(fmt)
+                logger.addHandler(fh)
+        _loggers[name] = logger
+        return logger
+
+
+class StatsReporter:
+    """Periodic counter dump (one line per interval) for long jobs."""
+
+    def __init__(self, interval: float = 60.0, logger=None):
+        self.interval = interval
+        self.logger = logger or get_logger("paddle_tpu.monitor")
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self  # idempotent
+        self._stop.clear()  # restartable after stop()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                snap = stats_snapshot()
+                if snap:
+                    self.logger.info("stats %s", snap)
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=2.0)
+            self._thread = None
