@@ -169,6 +169,46 @@ def distributed_optimizer(optimizer, strategy=None):
     (ref HybridParallelOptimizer hybrid_parallel_optimizer.py:251). In the
     mesh world, grad reductions are emitted by XLA from shardings, so the
     wrapper only needs to keep the API and the global-norm semantics (norm
-    contributions cross shards automatically inside pjit)."""
-    from .meta_optimizers import HybridParallelOptimizer
-    return HybridParallelOptimizer(optimizer, _hcg, _strategy or DistributedStrategy())
+    contributions cross shards automatically inside pjit).
+
+    Strategy meta-optimizer passes (ref fleet/meta_optimizers/__init__.py
+    selection): ``lars``/``dgc`` swap a Momentum-family optimizer for the
+    Lars/DGCMomentum rule; ``gradient_merge`` wraps with k-step
+    accumulation. Order matches the reference: rule swap first, then merge.
+    """
+    from .meta_optimizers import (DGCMomentum, GradientMergeOptimizer,
+                                  HybridParallelOptimizer)
+    strategy = strategy or _strategy or DistributedStrategy()
+    from ...optimizer.optimizer import Lars, Momentum, SGD
+    if getattr(strategy, "lars", False) and \
+            isinstance(optimizer, (SGD, Momentum)):
+        cfg = getattr(strategy, "lars_configs", {})
+        optimizer = Lars(
+            learning_rate=optimizer._learning_rate,
+            momentum=getattr(optimizer, "momentum", 0.9),
+            parameters=optimizer._param_refs,
+            grad_clip=optimizer.grad_clip,
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            # LARS has its own decay inside the rule; honor the user's if set
+            lars_weight_decay=optimizer.weight_decay
+            or cfg.get("lars_weight_decay", 0.0005),
+            exclude_from_weight_decay=cfg.get("exclude_from_weight_decay",
+                                              ()))
+    elif getattr(strategy, "dgc", False) and \
+            isinstance(optimizer, (SGD, Momentum)):
+        cfg = getattr(strategy, "dgc_configs", {})
+        sparsity = cfg.get("sparsity", [0.999])
+        optimizer = DGCMomentum(
+            learning_rate=optimizer._learning_rate,
+            momentum=getattr(optimizer, "momentum", 0.9),
+            parameters=optimizer._param_refs,
+            grad_clip=optimizer.grad_clip,
+            weight_decay=optimizer.weight_decay,
+            sparsity=sparsity[0] if isinstance(sparsity, (list, tuple))
+            else sparsity,
+            rampup_begin_step=cfg.get("rampup_begin_step", 0))
+    if getattr(strategy, "gradient_merge", False):
+        k = getattr(strategy, "gradient_merge_configs", {}).get("k_steps", 1)
+        if k > 1:
+            optimizer = GradientMergeOptimizer(optimizer, k_steps=k)
+    return HybridParallelOptimizer(optimizer, _hcg, strategy)

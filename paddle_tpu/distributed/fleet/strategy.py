@@ -53,6 +53,12 @@ class DistributedStrategy:
         self.fuse_all_reduce_ops = True  # XLA does this natively
         self.lamb = False
         self.lars = False
+        self.lars_configs: Dict[str, Any] = {
+            "lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+            "exclude_from_weight_decay": []}
+        self.dgc = False
+        self.dgc_configs: Dict[str, Any] = {
+            "rampup_begin_step": 0, "sparsity": [0.999]}
 
     def _set_hybrid(self, cfg: Dict[str, Any]):
         for k, v in cfg.items():

@@ -1,3 +1,3 @@
 from . import lr  # noqa: F401
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW,  # noqa: F401
-                        Adagrad, RMSProp, Lamb)
+                        Adagrad, RMSProp, Lamb, Lars)

@@ -32,7 +32,7 @@ from ..nn.layer import ParamRef
 from .lr import LRScheduler
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adagrad",
-           "RMSProp", "Lamb"]
+           "RMSProp", "Lamb", "Lars"]
 
 Params = Dict[str, jax.Array]
 Grads = Dict[str, jax.Array]
@@ -87,14 +87,25 @@ class Optimizer:
         dict via caller. Implemented by subclasses through _update()."""
         raise NotImplementedError
 
+    def _init_full_param_state(self, p: jax.Array) -> Dict[str, jax.Array]:
+        """Per-param state incl. the fp32 master copy when needed — the one
+        true init used both at init() and for late-appearing params."""
+        st = self._init_param_state(p)
+        if self._needs_master(p):
+            st["master"] = _f32(p)
+        return st
+
     def init(self, params: Params) -> State:
-        pstates = {}
-        for name, p in params.items():
-            st = self._init_param_state(p)
-            if self._needs_master(p):
-                st["master"] = _f32(p)
-            pstates[name] = st
+        pstates = {name: self._init_full_param_state(p)
+                   for name, p in params.items()}
         return {"step": jnp.zeros((), jnp.int32), "param_states": pstates}
+
+    def _ensure_param_state(self, state: State, name: str,
+                            p: jax.Array) -> None:
+        """Lazily add state for a late-appearing param. Wrapper optimizers
+        override to extend their own state and delegate inward."""
+        if name not in state["param_states"]:
+            state["param_states"][name] = self._init_full_param_state(p)
 
     def apply_gradients(self, params: Params, grads: Grads, state: State,
                         lr: Optional[jax.Array] = None) -> (Params, State):
@@ -142,9 +153,8 @@ class Optimizer:
         if self._eager_state is None:
             self._eager_state = self.init(
                 {r.name: r.value for r in self._refs() if r.trainable})
-        missing = [n for n in params if n not in self._eager_state["param_states"]]
-        for n in missing:
-            self._eager_state["param_states"][n] = self._init_param_state(params[n])
+        for n, p in params.items():
+            self._ensure_param_state(self._eager_state, n, p)
         new_params, self._eager_state = self.apply_gradients(
             params, grads, self._eager_state)
         for r in refs:
@@ -371,3 +381,41 @@ class Lamb(Optimizer):
         st = dict(st)
         st["moment1"], st["moment2"] = m, v
         return p32 - lr * ratio * update, st
+
+
+class Lars(Optimizer):
+    """LARS momentum (ref: paddle LarsMomentumOptimizer /
+    fleet meta_optimizers lars_optimizer.py): layer-wise adaptive rate
+    scaling for large-batch SGD —
+    local_lr = lr * coeff * ||w|| / (||g|| + wd * ||w|| + eps)."""
+
+    def __init__(self, learning_rate=0.001, momentum: float = 0.9,
+                 lars_coeff: float = 0.001, lars_weight_decay: float = 0.0005,
+                 parameters=None, grad_clip=None, epsilon: float = 1e-9,
+                 exclude_from_weight_decay=(), multi_precision=True):
+        super().__init__(learning_rate, parameters, 0.0, grad_clip,
+                         multi_precision)
+        self.momentum = momentum
+        self.lars_coeff = lars_coeff
+        self.lars_weight_decay = lars_weight_decay
+        self.epsilon = epsilon
+        self.exclude_from_weight_decay = tuple(exclude_from_weight_decay)
+
+    def _init_param_state(self, p):
+        return {"velocity": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        wd = self.lars_weight_decay
+        if any(tag in name for tag in self.exclude_from_weight_decay):
+            wd = 0.0
+        w_norm = jnp.linalg.norm(p32)
+        g_norm = jnp.linalg.norm(g32)
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            lr * self.lars_coeff * w_norm
+            / (g_norm + wd * w_norm + self.epsilon),
+            lr)
+        v = self.momentum * st["velocity"] + local_lr * (g32 + wd * p32)
+        st = dict(st)
+        st["velocity"] = v
+        return p32 - v, st

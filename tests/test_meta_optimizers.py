@@ -1,0 +1,255 @@
+"""Gradient merge, LARS, DGC meta-optimizer tests.
+
+Ref models: test/legacy_test/test_momentum_op.py (lars), dgc tests under
+test/legacy_test/test_dgc_*, and gradient-merge pass tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.fleet import DistributedStrategy
+from paddle_tpu.distributed.fleet.meta_optimizers import (DGCMomentum,
+                                                          GradientMergeOptimizer)
+from paddle_tpu.optimizer import SGD, Lars, Momentum
+
+
+def _params():
+    return {"w": jnp.asarray(np.ones((4, 4), np.float32)),
+            "b": jnp.asarray(np.full((4,), 2.0, np.float32))}
+
+
+class TestLars:
+    def test_matches_formula(self):
+        opt = Lars(learning_rate=0.1, momentum=0.9, lars_coeff=0.001,
+                   lars_weight_decay=0.0005)
+        params = _params()
+        state = opt.init(params)
+        grads = {"w": jnp.full((4, 4), 0.5), "b": jnp.full((4,), 0.25)}
+        new_params, state = opt.apply_gradients(params, grads, state)
+        w, g = np.ones((4, 4)), np.full((4, 4), 0.5)
+        w_norm, g_norm = np.linalg.norm(w), np.linalg.norm(g)
+        local_lr = 0.1 * 0.001 * w_norm / (g_norm + 0.0005 * w_norm + 1e-9)
+        v = local_lr * (g + 0.0005 * w)
+        np.testing.assert_allclose(np.asarray(new_params["w"]), w - v,
+                                   rtol=1e-6)
+
+    def test_momentum_accumulates(self):
+        opt = Lars(learning_rate=0.1, momentum=0.5)
+        params = _params()
+        state = opt.init(params)
+        grads = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+        p1, state = opt.apply_gradients(params, grads, state)
+        p2, state = opt.apply_gradients(p1, grads, state)
+        # second step moves further (velocity carries over)
+        d1 = np.abs(np.asarray(params["w"] - p1["w"])).mean()
+        d2 = np.abs(np.asarray(p1["w"] - p2["w"])).mean()
+        assert d2 > d1
+
+    def test_exclude_from_weight_decay(self):
+        opt = Lars(learning_rate=0.1, lars_weight_decay=0.5,
+                   exclude_from_weight_decay=("b",))
+        params = _params()
+        state = opt.init(params)
+        zero_g = {"w": jnp.zeros((4, 4)), "b": jnp.zeros((4,))}
+        new_params, _ = opt.apply_gradients(params, zero_g, state)
+        # b excluded: zero grad + no decay => unchanged
+        np.testing.assert_array_equal(np.asarray(new_params["b"]),
+                                      np.asarray(params["b"]))
+
+
+class TestGradientMerge:
+    def test_applies_only_on_kth_step(self):
+        inner = SGD(learning_rate=1.0)
+        opt = GradientMergeOptimizer(inner, k_steps=3)
+        params = _params()
+        state = opt.init(params)
+        g = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+        p = params
+        for i in range(2):
+            p, state = opt.apply_gradients(p, g, state)
+            np.testing.assert_array_equal(np.asarray(p["w"]),
+                                          np.asarray(params["w"]))
+        p, state = opt.apply_gradients(p, g, state)
+        # merged avg grad = 1.0, lr=1 → w goes 1 -> 0
+        np.testing.assert_allclose(np.asarray(p["w"]), 0.0, atol=1e-6)
+        assert int(state["count"]) == 0  # reset after apply
+
+    def test_equivalent_to_big_batch(self):
+        """k merged micro-grads == one step on their mean."""
+        rng = np.random.default_rng(0)
+        micro = [{"w": jnp.asarray(rng.normal(size=(4, 4)).astype(np.float32)),
+                  "b": jnp.asarray(rng.normal(size=(4,)).astype(np.float32))}
+                 for _ in range(4)]
+        mean_g = {n: sum(m[n] for m in micro) / 4 for n in ("w", "b")}
+
+        merged_opt = GradientMergeOptimizer(SGD(learning_rate=0.5), k_steps=4)
+        p, s = _params(), merged_opt.init(_params())
+        for g in micro:
+            p, s = merged_opt.apply_gradients(p, g, s)
+
+        ref_opt = SGD(learning_rate=0.5)
+        p_ref, s_ref = ref_opt.apply_gradients(_params(), mean_g,
+                                               ref_opt.init(_params()))
+        for n in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(p[n]),
+                                       np.asarray(p_ref[n]), rtol=1e-6)
+
+    def test_works_under_jit(self):
+        opt = GradientMergeOptimizer(SGD(learning_rate=1.0), k_steps=2)
+        params = _params()
+        state = opt.init(params)
+
+        @jax.jit
+        def step(p, s, g):
+            return opt.apply_gradients(p, g, s)
+
+        g = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+        p, state = step(params, state, g)
+        np.testing.assert_array_equal(np.asarray(p["w"]), 1.0)  # skipped
+        p, state = step(p, state, g)
+        np.testing.assert_allclose(np.asarray(p["w"]), 0.0, atol=1e-6)
+
+
+class TestDGC:
+    def test_sparsified_update_keeps_topk_and_residual(self):
+        opt = DGCMomentum(learning_rate=1.0, momentum=0.0, sparsity=0.75)
+        params = {"w": jnp.zeros((16,), jnp.float32)}
+        state = opt.init(params)
+        g = jnp.asarray(np.arange(16, dtype=np.float32))  # top-25% = 12..15
+        new_params, state = opt.apply_gradients(params, {"w": g}, state)
+        w = np.asarray(new_params["w"])
+        assert (w[12:] != 0).all()
+        assert (w[:12] == 0).all()
+        # residual holds what wasn't sent
+        v = np.asarray(state["v"]["w"])
+        assert (v[:12] == np.arange(12)).all() and (v[12:] == 0).all()
+
+    def test_residual_eventually_flushes(self):
+        """A small persistent gradient component is not lost, just delayed."""
+        opt = DGCMomentum(learning_rate=0.1, momentum=0.0, sparsity=0.5)
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        state = opt.init(params)
+        g = jnp.asarray(np.array([1.0, 0.01, 0.01, 0.01], np.float32))
+        p = params
+        for _ in range(50):
+            p, state = opt.apply_gradients(p, {"w": g}, state)
+        w = np.asarray(p["w"])
+        assert (w < 0).all()  # every coordinate eventually received updates
+
+    def test_rampup_sends_dense_before_begin(self):
+        opt = DGCMomentum(learning_rate=1.0, momentum=0.0, sparsity=0.75,
+                          rampup_begin_step=5)
+        params = {"w": jnp.zeros((16,), jnp.float32)}
+        state = opt.init(params)
+        g = jnp.asarray(np.arange(1, 17, dtype=np.float32))
+        new_params, state = opt.apply_gradients(params, {"w": g}, state)
+        assert (np.asarray(new_params["w"]) != 0).all()  # dense step
+
+
+class TestWrapperStateDict:
+    def test_gradient_merge_checkpoint_roundtrip(self):
+        from paddle_tpu.nn.layer import ParamRef
+        import paddle_tpu.nn as nn
+        lin = nn.Linear(4, 4)
+        opt = GradientMergeOptimizer(
+            SGD(learning_rate=1.0, parameters=lin.parameters()), k_steps=3)
+        for r in lin.parameters():
+            r.grad = jnp.ones(r.value.shape)
+        opt.step()  # count=1, accumulated, not applied
+        sd = opt.state_dict()
+        assert any("gm_acc" in k for k in sd)
+        assert int(sd["gm_count"]) == 1
+
+        opt2 = GradientMergeOptimizer(
+            SGD(learning_rate=1.0, parameters=lin.parameters()), k_steps=3)
+        opt2.set_state_dict(sd)
+        assert int(opt2._eager_state["count"]) == 1
+        np.testing.assert_array_equal(
+            np.asarray(list(opt2._eager_state["acc"].values())[0]), 1.0)
+
+    def test_dgc_checkpoint_roundtrip(self):
+        opt = DGCMomentum(learning_rate=1.0, momentum=0.9, sparsity=0.5)
+        params = {"w": jnp.zeros((4,), jnp.float32)}
+        state = opt.init(params)
+        g = jnp.asarray(np.array([1.0, 0.1, 0.2, 0.3], np.float32))
+        _, state = opt.apply_gradients(params, {"w": g}, state)
+        opt._eager_state = state
+        sd = opt.state_dict()
+        opt2 = DGCMomentum(learning_rate=1.0, momentum=0.9, sparsity=0.5)
+        opt2.set_state_dict(sd)
+        np.testing.assert_array_equal(
+            np.asarray(opt2._eager_state["v"]["w"]),
+            np.asarray(state["v"]["w"]))
+
+
+class TestMissingParamSafety:
+    def test_gradient_merge_handles_absent_param(self):
+        opt = GradientMergeOptimizer(SGD(learning_rate=1.0), k_steps=2)
+        p_full = _params()
+        state = opt.init(p_full)
+        g_full = {"w": jnp.ones((4, 4)), "b": jnp.ones((4,))}
+        _, state = opt.apply_gradients(p_full, g_full, state)
+        # second call: "b" absent entirely (conditionally-used layer)
+        p_w = {"w": p_full["w"]}
+        new_p, state = opt.apply_gradients(p_w, {"w": jnp.ones((4, 4))},
+                                           state)
+        # w applied (avg of 2 ones = 1, lr 1 → 0); b's accumulation retained
+        np.testing.assert_allclose(np.asarray(new_p["w"]), 0.0, atol=1e-6)
+        np.testing.assert_array_equal(
+            np.asarray(state["acc"]["b"]), 1.0)
+
+
+class TestDGCMomentumMasking:
+    def test_sent_coordinates_clear_momentum(self):
+        opt = DGCMomentum(learning_rate=1.0, momentum=0.9, sparsity=0.75)
+        params = {"w": jnp.zeros((16,), jnp.float32)}
+        state = opt.init(params)
+        g = jnp.asarray(np.arange(16, dtype=np.float32))
+        _, state = opt.apply_gradients(params, {"w": g}, state)
+        u = np.asarray(state["u"]["w"])
+        assert (u[12:] == 0).all()   # sent coords: momentum cleared
+        assert (u[:12] == np.arange(12)).all()  # unsent keep momentum
+
+
+class TestStrategyWiring:
+    def test_grad_clip_and_decay_propagate(self):
+        from paddle_tpu.distributed import fleet
+        import paddle_tpu.nn as nn
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        strategy = DistributedStrategy()
+        strategy.dgc = True
+        opt = fleet.distributed_optimizer(
+            Momentum(learning_rate=0.1, momentum=0.9, grad_clip=clip,
+                     weight_decay=1e-4), strategy=strategy)
+        assert opt.inner_opt._sgd.grad_clip is clip
+        assert opt.inner_opt.weight_decay == 1e-4
+
+        strategy2 = DistributedStrategy()
+        strategy2.lars = True
+        opt2 = fleet.distributed_optimizer(
+            Momentum(learning_rate=0.1, grad_clip=clip, weight_decay=0.02),
+            strategy=strategy2)
+        assert opt2.inner_opt.grad_clip is clip
+        assert opt2.inner_opt.lars_weight_decay == 0.02
+    def test_distributed_optimizer_applies_passes(self):
+        from paddle_tpu.distributed import fleet
+        strategy = DistributedStrategy()
+        strategy.lars = True
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 2}
+        opt = fleet.distributed_optimizer(
+            Momentum(learning_rate=0.1, momentum=0.9), strategy=strategy)
+        inner = opt.inner_opt
+        assert isinstance(inner, GradientMergeOptimizer)
+        assert isinstance(inner._inner_opt, Lars)
+
+    def test_dgc_wiring(self):
+        from paddle_tpu.distributed import fleet
+        strategy = DistributedStrategy()
+        strategy.dgc = True
+        strategy.dgc_configs = {"rampup_begin_step": 2, "sparsity": [0.9]}
+        opt = fleet.distributed_optimizer(
+            Momentum(learning_rate=0.1), strategy=strategy)
+        assert isinstance(opt.inner_opt, DGCMomentum)
+        assert opt.inner_opt.sparsity == 0.9
