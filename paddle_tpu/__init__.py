@@ -49,6 +49,7 @@ from . import geometric  # noqa: F401
 from . import audio  # noqa: F401
 from . import quantization  # noqa: F401
 from . import incubate  # noqa: F401
+from . import fft  # noqa: F401
 from . import text  # noqa: F401
 
 # paddle.Tensor alias: a Tensor IS a jax.Array.

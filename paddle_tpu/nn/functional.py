@@ -33,6 +33,23 @@ __all__ = [
     "l1_loss", "nll_loss", "smooth_l1_loss", "softmax_with_cross_entropy",
     "one_hot", "pad", "interpolate", "scaled_dot_product_attention",
     "label_smooth", "cosine_similarity", "normalize", "kl_div",
+    # activations (2nd wave)
+    "celu", "hardshrink", "hardtanh", "softshrink", "softsign", "tanhshrink",
+    "thresholded_relu", "log_sigmoid", "maxout", "prelu", "rrelu",
+    "gumbel_softmax",
+    # losses (2nd wave)
+    "binary_cross_entropy", "log_loss", "margin_ranking_loss",
+    "soft_margin_loss", "triplet_margin_loss", "cosine_embedding_loss",
+    "hinge_embedding_loss", "poisson_nll_loss",
+    "multi_label_soft_margin_loss", "square_error_cost", "ctc_loss",
+    # convs/pools (2nd wave)
+    "conv3d", "conv2d_transpose", "conv3d_transpose", "max_pool3d",
+    "avg_pool3d", "max_pool2d_with_index", "max_unpool2d",
+    # norms (2nd wave)
+    "instance_norm", "local_response_norm",
+    # geometry (2nd wave)
+    "grid_sample", "affine_grid", "pixel_shuffle", "channel_shuffle",
+    "unfold", "fold",
 ]
 
 
@@ -214,7 +231,15 @@ def _pool2d(x, kernel_size, stride, padding, data_format, init, op, norm=None):
     return out
 
 
-def max_pool2d(x, kernel_size, stride=None, padding=0, data_format: str = "NCHW"):
+def max_pool2d(x, kernel_size, stride=None, padding=0,
+               return_mask: bool = False, data_format: str = "NCHW"):
+    if isinstance(return_mask, str):
+        # compat: callers of the pre-return_mask signature passed
+        # data_format as the 5th positional arg
+        data_format, return_mask = return_mask, False
+    if return_mask:
+        assert data_format == "NCHW"
+        return max_pool2d_with_index(x, kernel_size, stride, padding)
     return _pool2d(x, kernel_size, stride, padding, data_format,
                    -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min,
                    lax.max)
@@ -552,3 +577,626 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         probs = dropout(probs, dropout_p, training=True)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
     return jnp.einsum("bhsd->bshd", out)
+
+
+# ---------------------------------------------------------------------------
+# Activations — 2nd wave (ref python/paddle/nn/functional/activation.py)
+# ---------------------------------------------------------------------------
+
+def celu(x, alpha: float = 1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(
+        0, alpha * (jnp.exp(x / alpha) - 1))
+
+
+def hardshrink(x, threshold: float = 0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardtanh(x, min: float = -1.0, max: float = 1.0):
+    return jnp.clip(x, min, max)
+
+
+def softshrink(x, threshold: float = 0.5):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold: float = 1.0):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def maxout(x, groups: int, axis: int = 1):
+    """Max over `groups`-way splits of the channel axis (ref maxout op)."""
+    c = x.shape[axis]
+    assert c % groups == 0, "channels must divide groups"
+    shape = list(x.shape)
+    shape[axis:axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def prelu(x, weight, data_format: str = "NCHW"):
+    """weight: scalar or per-channel; channel axis from data_format."""
+    w = jnp.asarray(weight)
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 2:
+        if data_format.endswith("C"):
+            w = w.reshape((1,) * (x.ndim - 1) + (-1,))
+        else:
+            w = w.reshape((1, -1) + (1,) * (x.ndim - 2))
+    return jnp.where(x >= 0, x, w * x)
+
+
+def rrelu(x, lower: float = 1. / 8., upper: float = 1. / 3.,
+          training: bool = True):
+    """Randomized leaky ReLU; eval mode uses the mean slope (ref rrelu)."""
+    if training:
+        from ..core.random import default_generator
+        key = default_generator().next_key()
+        slope = jax.random.uniform(key, x.shape, minval=lower, maxval=upper,
+                                   dtype=x.dtype)
+    else:
+        slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)
+
+
+def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
+                   axis: int = -1):
+    """ref paddle.nn.functional.gumbel_softmax — Gumbel noise + softmax,
+    straight-through when hard=True."""
+    from ..core.random import default_generator
+    key = default_generator().next_key()
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis)
+        y_hard = jnp.moveaxis(
+            jax.nn.one_hot(idx, y.shape[axis], dtype=y.dtype), -1, axis)
+        # straight-through: forward y_hard, backward through soft y
+        y = jax.lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Losses — 2nd wave (ref python/paddle/nn/functional/loss.py)
+# ---------------------------------------------------------------------------
+
+def _reduce(loss, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "none":
+        return loss
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction: str = "mean"):
+    """BCE over probabilities (ref loss.py binary_cross_entropy)."""
+    eps = 1e-12
+    loss = -(label * jnp.log(input + eps)
+             + (1 - label) * jnp.log(1 - input + eps))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+def log_loss(input, label, epsilon: float = 1e-4):
+    return -label * jnp.log(input + epsilon) \
+        - (1 - label) * jnp.log(1 - input + epsilon)
+
+
+def margin_ranking_loss(input, other, label, margin: float = 0.0,
+                        reduction: str = "mean"):
+    loss = jnp.maximum(0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+def soft_margin_loss(input, label, reduction: str = "mean"):
+    loss = jnp.log1p(jnp.exp(-label * input))
+    return _reduce(loss, reduction)
+
+
+def triplet_margin_loss(input, positive, negative, margin: float = 1.0,
+                        p: float = 2.0, epsilon: float = 1e-6,
+                        swap: bool = False, reduction: str = "mean"):
+    def dist(a, b):
+        return jnp.power(
+            jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p), axis=-1),
+            1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jnp.maximum(0, d_pos - d_neg + margin), reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin: float = 0.0,
+                          reduction: str = "mean"):
+    cos = cosine_similarity(input1, input2, axis=-1)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin: float = 1.0,
+                         reduction: str = "mean"):
+    loss = jnp.where(label == 1, input, jnp.maximum(0, margin - input))
+    return _reduce(loss, reduction)
+
+
+def poisson_nll_loss(input, label, log_input: bool = True,
+                     full: bool = False, epsilon: float = 1e-8,
+                     reduction: str = "mean"):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = label * jnp.log(label + epsilon) - label \
+            + 0.5 * jnp.log(2 * math.pi * (label + epsilon))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction: str = "mean"):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths,
+             blank: int = 0, reduction: str = "mean",
+             norm_by_times: bool = False):
+    """CTC loss (ref warpctc op / paddle.nn.functional.ctc_loss).
+
+    log_probs: [T, B, C] *unnormalized* logits — per the paddle contract
+    ("softmax with CTC": warpctc applies softmax internally), a log_softmax
+    is applied here. labels: [B, L] int targets. Forward algorithm over the
+    extended label sequence in the log semiring, as a lax.scan over time —
+    the TPU-native replacement for the warp-ctc CUDA kernel.
+    """
+    log_probs = jax.nn.log_softmax(log_probs, axis=-1)
+    T, B, C = log_probs.shape
+    L = labels.shape[1]
+    S = 2 * L + 1
+    # extended labels: blank, l1, blank, l2, ... blank
+    ext = jnp.full((B, S), blank, dtype=labels.dtype)
+    ext = ext.at[:, 1::2].set(labels)
+    NEG = -1e30
+
+    # transition allowances: from s-1 always; from s-2 if ext[s] != blank
+    # and ext[s] != ext[s-2]
+    can_skip = jnp.zeros((B, S), dtype=bool)
+    can_skip = can_skip.at[:, 2:].set(
+        (ext[:, 2:] != blank) & (ext[:, 2:] != ext[:, :-2]))
+
+    alpha0 = jnp.full((B, S), NEG)
+    alpha0 = alpha0.at[:, 0].set(log_probs[0, jnp.arange(B), ext[:, 0]])
+    alpha0 = alpha0.at[:, 1].set(
+        jnp.where(L > 0, log_probs[0, jnp.arange(B), ext[:, 1]], NEG))
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        return m + jnp.log(jnp.exp(a - m) + jnp.exp(b - m))
+
+    def step(alpha, lp_t):
+        # lp_t: [B, C] log-probs at time t
+        emit = jnp.take_along_axis(lp_t, ext, axis=1)  # [B, S]
+        stay = alpha
+        prev1 = jnp.concatenate([jnp.full((B, 1), NEG), alpha[:, :-1]],
+                                axis=1)
+        prev2 = jnp.concatenate([jnp.full((B, 2), NEG), alpha[:, :-2]],
+                                axis=1)
+        prev2 = jnp.where(can_skip, prev2, NEG)
+        new_alpha = lse(lse(stay, prev1), prev2) + emit
+        return new_alpha, new_alpha
+
+    _, alphas = jax.lax.scan(step, alpha0, log_probs[1:])
+    alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # [T, B, S]
+
+    # gather alpha at each sequence's last frame, positions S_b-1, S_b-2
+    t_idx = jnp.clip(input_lengths - 1, 0, T - 1)
+    last = alphas[t_idx, jnp.arange(B)]  # [B, S]
+    s_last = 2 * label_lengths  # index of final blank
+    a_blank = jnp.take_along_axis(last, s_last[:, None], axis=1)[:, 0]
+    a_label = jnp.take_along_axis(
+        last, jnp.clip(s_last - 1, 0, S - 1)[:, None], axis=1)[:, 0]
+    a_label = jnp.where(label_lengths > 0, a_label, NEG)
+    nll = -lse(a_blank, a_label)
+    if norm_by_times:
+        nll = nll / jnp.maximum(input_lengths, 1)
+    return _reduce(nll, reduction)
+
+
+# ---------------------------------------------------------------------------
+# Convolution / pooling — 2nd wave (ref phi conv3d/conv_transpose/pool3d)
+# ---------------------------------------------------------------------------
+
+def _ntuple(v, n):
+    if isinstance(v, (tuple, list)):
+        assert len(v) == n
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCDHW"):
+    """weight layout [out_c, in_c/groups, kd, kh, kw]."""
+    stride = _ntuple(stride, 3)
+    dilation = _ntuple(dilation, 3)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pd, ph, pw = _ntuple(padding, 3)
+        pad = [(pd, pd), (ph, ph), (pw, pw)]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NCDHW", "OIDHW", "NCDHW") if data_format == "NCDHW"
+        else ("NDHWC", "OIDHW", "NDHWC"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=pad,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups).astype(x.dtype)
+    if bias is not None:
+        shape = (1, -1, 1, 1, 1) if data_format == "NCDHW" else (1, 1, 1, 1, -1)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, spatial, fmt):
+    """Shared transposed-conv core — the gradient-of-conv formulation as a
+    fractionally-strided conv (lhs_dilation): insert stride-1 zeros between
+    inputs, flip the kernel spatially, swap in/out channels.
+    weight layout [in_c, out_c/groups, *k] (paddle);
+    out_size = (in-1)*s - 2*p + d*(k-1) + output_padding + 1."""
+    assert fmt in ("NCHW", "NCDHW"), "channels-first only"
+    stride = _ntuple(stride, spatial)
+    dilation = _ntuple(dilation, spatial)
+    pads = _ntuple(padding, spatial)
+    opads = _ntuple(output_padding, spatial)
+    if groups != 1:
+        # grouped transpose = per-group transpose, concatenated on channels
+        xs = jnp.split(x, groups, axis=1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [_conv_transpose(xg, wg, None, stride, padding,
+                                output_padding, dilation, 1, spatial, fmt)
+                for xg, wg in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=1)
+    else:
+        w = jnp.flip(weight, axis=tuple(range(2, 2 + spatial)))
+        w = jnp.swapaxes(w, 0, 1)  # [out_c, in_c, *k]
+        k = w.shape[2:]
+        pad_cfg = [
+            (dilation[i] * (k[i] - 1) - pads[i],
+             dilation[i] * (k[i] - 1) - pads[i] + opads[i])
+            for i in range(spatial)
+        ]
+        spec = (fmt, "OIHW" if spatial == 2 else "OIDHW", fmt)
+        dn = lax.conv_dimension_numbers(x.shape, w.shape, spec)
+        out = lax.conv_general_dilated(
+            x, w, window_strides=(1,) * spatial, padding=pad_cfg,
+            lhs_dilation=stride, rhs_dilation=dilation,
+            dimension_numbers=dn).astype(x.dtype)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+def _output_padding_from_size(x, weight, stride, padding, dilation,
+                              output_size, spatial):
+    """Derive output_padding so out == output_size (paddle allows either)."""
+    stride = _ntuple(stride, spatial)
+    pads = _ntuple(padding, spatial)
+    dilation = _ntuple(dilation, spatial)
+    sizes = tuple(int(s) for s in output_size[-spatial:])
+    ops = []
+    for i in range(spatial):
+        in_sz = x.shape[2 + i]
+        k = weight.shape[2 + i]
+        base = (in_sz - 1) * stride[i] - 2 * pads[i] \
+            + dilation[i] * (k - 1) + 1
+        op = sizes[i] - base
+        if not 0 <= op < stride[i] + dilation[i]:
+            raise ValueError(
+                f"output_size {sizes[i]} unreachable on dim {i}: base "
+                f"size {base}, stride {stride[i]}")
+        ops.append(op)
+    return tuple(ops)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups: int = 1,
+                     output_size=None, data_format: str = "NCHW"):
+    if output_size is not None:
+        output_padding = _output_padding_from_size(
+            x, weight, stride, padding, dilation, output_size, 2)
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups: int = 1,
+                     output_size=None, data_format: str = "NCDHW"):
+    if output_size is not None:
+        output_padding = _output_padding_from_size(
+            x, weight, stride, padding, dilation, output_size, 3)
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format)
+
+
+def _pool3d(x, kernel_size, stride, padding, init, op):
+    k = _ntuple(kernel_size, 3)
+    s = _ntuple(stride if stride is not None else kernel_size, 3)
+    pd, ph, pw = _ntuple(padding, 3)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = ((0, 0), (0, 0), (pd, pd), (ph, ph), (pw, pw))
+    return lax.reduce_window(x, init, op, window, strides, pads)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NCDHW"):
+    assert data_format == "NCDHW"
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
+        else jnp.iinfo(x.dtype).min
+    return _pool3d(x, kernel_size, stride, padding, init, lax.max)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NCDHW", exclusive: bool = True):
+    assert data_format == "NCDHW"
+    k = _ntuple(kernel_size, 3)
+    summed = _pool3d(x, kernel_size, stride, padding, 0.0, lax.add)
+    if exclusive and _ntuple(padding, 3) != (0, 0, 0):
+        ones = jnp.ones(x.shape, dtype=x.dtype)
+        counts = _pool3d(ones, kernel_size, stride, padding, 0.0, lax.add)
+        return summed / counts
+    return summed / (k[0] * k[1] * k[2])
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0):
+    """(pooled, mask) where mask holds flat H*W argmax indices
+    (ref phi max_pool2d_with_index kernel)."""
+    n, c, h, w = x.shape
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    ph, pw = _pair(padding)
+    patches = lax.conv_general_dilated_patches(
+        x, k, s, [(ph, ph), (pw, pw)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    oh, ow = patches.shape[2], patches.shape[3]
+    patches = patches.reshape(n, c, k[0] * k[1], oh, ow)
+    # padded positions contain 0; use -inf there so they never win argmax
+    # for all-negative windows we must mask them explicitly
+    dh = jnp.arange(k[0] * k[1]) // k[1]
+    dw = jnp.arange(k[0] * k[1]) % k[1]
+    row = (jnp.arange(oh) * s[0])[None, :, None] - ph \
+        + dh[:, None, None]            # [k, OH, 1]
+    col = (jnp.arange(ow) * s[1])[None, None, :] - pw \
+        + dw[:, None, None]            # [k, 1, OW]
+    valid = (row >= 0) & (row < h) & (col >= 0) & (col < w)  # [k, OH, OW]
+    patches = jnp.where(valid[None, None], patches, -jnp.inf)
+    arg = jnp.argmax(patches, axis=2)  # [N, C, OH, OW]
+    pooled = jnp.max(patches, axis=2).astype(x.dtype)
+    rows = jnp.take_along_axis(
+        jnp.broadcast_to(row[None, None], (n, c, k[0] * k[1], oh, ow)),
+        arg[:, :, None], axis=2)[:, :, 0]
+    cols = jnp.take_along_axis(
+        jnp.broadcast_to(col[None, None], (n, c, k[0] * k[1], oh, ow)),
+        arg[:, :, None], axis=2)[:, :, 0]
+    mask = rows * w + cols
+    return pooled, mask
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format: str = "NCHW"):
+    """Scatter pooled values back to their argmax positions
+    (ref phi unpool kernel; `indices` = flat H*W positions)."""
+    assert data_format == "NCHW"
+    n, c, oh, ow = x.shape
+    k = _pair(kernel_size)
+    s = _pair(stride if stride is not None else kernel_size)
+    ph, pw = _pair(padding)
+    if output_size is None:
+        out_h = (oh - 1) * s[0] - 2 * ph + k[0]
+        out_w = (ow - 1) * s[1] - 2 * pw + k[1]
+    else:
+        out_h, out_w = output_size[-2], output_size[-1]
+    idx = indices.reshape(n, c, -1)
+    vals = x.reshape(n, c, -1)
+    bi = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    out = jnp.zeros((n, c, out_h * out_w), dtype=x.dtype)
+    out = out.at[bi, ci, idx].set(vals)
+    return out.reshape(n, c, out_h, out_w)
+
+
+# ---------------------------------------------------------------------------
+# Norms — 2nd wave
+# ---------------------------------------------------------------------------
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, eps: float = 1e-5, momentum: float = 0.9,
+                  data_format: str = "NCHW"):
+    """Normalize each (N, C) slice over its spatial dims (ref phi
+    instance_norm kernel; running stats unused at compute time, kept for
+    signature parity)."""
+    assert data_format in ("NCHW", "NCL", "NCDHW")
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + eps)
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out.astype(x.dtype)
+
+
+def local_response_norm(x, size: int = 5, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0,
+                        data_format: str = "NCHW"):
+    """Cross-channel LRN (ref phi lrn kernel / AlexNet)."""
+    assert data_format == "NCHW"
+    sq = jnp.square(x)
+    half_lo = (size - 1) // 2
+    half_hi = size - 1 - half_lo
+    summed = lax.reduce_window(
+        sq, 0.0, lax.add, (1, size, 1, 1), (1, 1, 1, 1),
+        ((0, 0), (half_lo, half_hi), (0, 0), (0, 0)))
+    div = jnp.power(k + alpha * summed / size, beta)
+    return (x / div).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Geometry — 2nd wave (ref phi grid_sample/affine_grid/pixel_shuffle/fold)
+# ---------------------------------------------------------------------------
+
+def grid_sample(x, grid, mode: str = "bilinear",
+                padding_mode: str = "zeros", align_corners: bool = True):
+    """x: [N, C, H, W]; grid: [N, Hg, Wg, 2] with (x, y) in [-1, 1]."""
+    n, c, h, w = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1) / 2 * (size - 1)
+        return ((coord + 1) * size - 1) / 2
+
+    ix = unnormalize(gx, w)
+    iy = unnormalize(gy, h)
+    if padding_mode == "border":
+        ix = jnp.clip(ix, 0, w - 1)
+        iy = jnp.clip(iy, 0, h - 1)
+    elif padding_mode == "reflection":
+        def reflect(coord, size):
+            if align_corners:
+                span = size - 1
+                t = jnp.mod(jnp.abs(coord), 2 * span) if span > 0 else coord
+                return span - jnp.abs(t - span) if span > 0 else coord * 0
+            span = size
+            t = jnp.mod(jnp.abs(coord + 0.5), 2 * span)
+            return jnp.clip(span - jnp.abs(t - span) - 0.5, 0, size - 1)
+        ix = reflect(ix, w)
+        iy = reflect(iy, h)
+
+    def gather(py, px):
+        """x[n, :, py, px] with zero padding for out-of-range."""
+        valid = (py >= 0) & (py < h) & (px >= 0) & (px < w)
+        pyc = jnp.clip(py, 0, h - 1)
+        pxc = jnp.clip(px, 0, w - 1)
+        flat = x.reshape(n, c, h * w)
+        idx = (pyc * w + pxc).reshape(n, 1, -1).astype(jnp.int32)
+        vals = jnp.take_along_axis(flat, jnp.broadcast_to(idx, (n, c, idx.shape[-1])), axis=2)
+        vals = vals.reshape(n, c, *py.shape[1:])
+        if padding_mode == "zeros":
+            vals = jnp.where(valid.reshape(n, 1, *py.shape[1:]), vals, 0.0)
+        return vals
+
+    if mode == "nearest":
+        return gather(jnp.round(iy).astype(jnp.int32),
+                      jnp.round(ix).astype(jnp.int32)).astype(x.dtype)
+    x0 = jnp.floor(ix).astype(jnp.int32)
+    y0 = jnp.floor(iy).astype(jnp.int32)
+    x1, y1 = x0 + 1, y0 + 1
+    wx = (ix - x0).reshape(n, 1, *ix.shape[1:])
+    wy = (iy - y0).reshape(n, 1, *iy.shape[1:])
+    v00 = gather(y0, x0)
+    v01 = gather(y0, x1)
+    v10 = gather(y1, x0)
+    v11 = gather(y1, x1)
+    top = v00 * (1 - wx) + v01 * wx
+    bot = v10 * (1 - wx) + v11 * wx
+    return (top * (1 - wy) + bot * wy).astype(x.dtype)
+
+
+def affine_grid(theta, out_shape, align_corners: bool = True):
+    """theta: [N, 2, 3]; out_shape: [N, C, H, W] -> grid [N, H, W, 2]."""
+    n, _, h, w = out_shape
+
+    def linspace(size):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, size)
+        step = 2.0 / size
+        return jnp.linspace(-1.0 + step / 2, 1.0 - step / 2, size)
+
+    ys = linspace(h)
+    xs = linspace(w)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)          # [H, W, 3]
+    grid = jnp.einsum("nij,hwj->nhwi", theta, base)     # [N, H, W, 2]
+    return grid
+
+
+def pixel_shuffle(x, upscale_factor: int, data_format: str = "NCHW"):
+    assert data_format == "NCHW"
+    n, c, h, w = x.shape
+    r = upscale_factor
+    oc = c // (r * r)
+    x = x.reshape(n, oc, r, r, h, w)
+    x = x.transpose(0, 1, 4, 2, 5, 3)
+    return x.reshape(n, oc, h * r, w * r)
+
+
+def channel_shuffle(x, groups: int, data_format: str = "NCHW"):
+    assert data_format == "NCHW"
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    x = x.transpose(0, 2, 1, 3, 4)
+    return x.reshape(n, c, h, w)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """im2col: [N, C, H, W] -> [N, C*kh*kw, L] (ref phi unfold kernel)."""
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    n = x.shape[0]
+    patches = jax.lax.conv_general_dilated_patches(
+        x, k, s, [(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """col2im: inverse of unfold, overlaps summed (ref phi fold kernel)."""
+    oh, ow = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    p = _pair(paddings)
+    d = _pair(dilations)
+    n, ckk, length = x.shape
+    c = ckk // (k[0] * k[1])
+    lh = (oh + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+    lw = (ow + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+    assert lh * lw == length, "output_sizes inconsistent with columns"
+    cols = x.reshape(n, c, k[0], k[1], lh, lw)
+    out = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), dtype=x.dtype)
+    for i in range(k[0]):
+        for j in range(k[1]):
+            hi = i * d[0]
+            wj = j * d[1]
+            out = out.at[:, :, hi:hi + lh * s[0]:s[0],
+                         wj:wj + lw * s[1]:s[1]].add(cols[:, :, i, j])
+    return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]

@@ -30,6 +30,16 @@ __all__ = [
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCEWithLogitsLoss",
     "SmoothL1Loss", "KLDivLoss", "MultiHeadAttention", "TransformerEncoderLayer",
     "TransformerEncoder", "Unfold",
+    # 2nd wave
+    "ELU", "SELU", "CELU", "Hardshrink", "Hardtanh", "Softshrink", "Softsign",
+    "Tanhshrink", "ThresholdedReLU", "LogSigmoid", "Maxout", "PReLU", "RReLU",
+    "Mish", "Softplus", "GLU", "LogSoftmax",
+    "BCELoss", "MarginRankingLoss", "SoftMarginLoss", "TripletMarginLoss",
+    "CosineEmbeddingLoss", "HingeEmbeddingLoss", "PoissonNLLLoss",
+    "MultiLabelSoftMarginLoss", "CTCLoss",
+    "Conv3D", "Conv2DTranspose", "Conv3DTranspose", "MaxPool3D", "AvgPool3D",
+    "MaxUnPool2D", "InstanceNorm2D", "LocalResponseNorm", "PixelShuffle",
+    "ChannelShuffle", "Fold", "Dropout2D",
 ]
 
 
@@ -271,7 +281,7 @@ class MaxPool2D(Layer):
 
     def forward(self, x):
         return F.max_pool2d(x, self.kernel_size, self.stride, self.padding,
-                            self.data_format)
+                            data_format=self.data_format)
 
 
 class AvgPool2D(Layer):
@@ -343,11 +353,7 @@ class Unfold(Layer):
         self.d = F._pair(dilations)
 
     def forward(self, x):
-        n, c, h, w = x.shape
-        patches = jax.lax.conv_general_dilated_patches(
-            x, self.k, self.s, [(self.p[0], self.p[0]), (self.p[1], self.p[1])],
-            rhs_dilation=self.d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
-        return patches.reshape(n, patches.shape[1], -1)
+        return F.unfold(x, self.k, self.s, self.p, self.d)
 
 
 # -- containers --------------------------------------------------------------
@@ -591,3 +597,357 @@ class TransformerEncoder(Layer):
         if self.norm is not None:
             out = self.norm(out)
         return out
+
+
+# -- 2nd wave: activation layers -------------------------------------------
+
+ELU = _act_layer("ELU", F.elu)
+SELU = _act_layer("SELU", F.selu)
+CELU = _act_layer("CELU", F.celu)
+Hardshrink = _act_layer("Hardshrink", F.hardshrink)
+Hardtanh = _act_layer("Hardtanh", F.hardtanh)
+Softshrink = _act_layer("Softshrink", F.softshrink)
+Softsign = _act_layer("Softsign", F.softsign)
+Tanhshrink = _act_layer("Tanhshrink", F.tanhshrink)
+ThresholdedReLU = _act_layer("ThresholdedReLU", F.thresholded_relu)
+LogSigmoid = _act_layer("LogSigmoid", F.log_sigmoid)
+Maxout = _act_layer("Maxout", F.maxout)
+Mish = _act_layer("Mish", F.mish)
+Softplus = _act_layer("Softplus", F.softplus)
+GLU = _act_layer("GLU", F.glu)
+LogSoftmax = _act_layer("LogSoftmax", F.log_softmax)
+
+
+class PReLU(Layer):
+    """Learnable leaky slope (ref nn/layer/activation.py PReLU)."""
+
+    def __init__(self, num_parameters: int = 1, init: float = 0.25,
+                 weight_attr=None, data_format: str = "NCHW"):
+        super().__init__()
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower: float = 1. / 8., upper: float = 1. / 3.):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)
+
+
+class Dropout2D(Layer):
+    """Channel-wise dropout (ref nn.Dropout2D): zeroes whole feature maps."""
+
+    def __init__(self, p: float = 0.5, data_format: str = "NCHW"):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ..core.random import next_key
+        shape = (x.shape[0], x.shape[1], 1, 1) \
+            if self.data_format == "NCHW" else \
+            (x.shape[0], 1, 1, x.shape[-1])
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(next_key(), keep, shape)
+        return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+# -- 2nd wave: loss layers --------------------------------------------------
+
+class BCELoss(Layer):
+    def __init__(self, weight=None, reduction: str = "mean"):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.binary_cross_entropy(input, label, self.weight,
+                                      self.reduction)
+
+
+class MarginRankingLoss(Layer):
+    def __init__(self, margin: float = 0.0, reduction: str = "mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, other, label):
+        return F.margin_ranking_loss(input, other, label, self.margin,
+                                     self.reduction)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction: str = "mean"):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin: float = 1.0, p: float = 2.0,
+                 epsilon: float = 1e-6, swap: bool = False,
+                 reduction: str = "mean"):
+        super().__init__()
+        self.margin, self.p, self.epsilon = margin, p, epsilon
+        self.swap, self.reduction = swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_loss(input, positive, negative, self.margin,
+                                     self.p, self.epsilon, self.swap,
+                                     self.reduction)
+
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin: float = 0.0, reduction: str = "mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        return F.cosine_embedding_loss(input1, input2, label, self.margin,
+                                       self.reduction)
+
+
+class HingeEmbeddingLoss(Layer):
+    def __init__(self, margin: float = 1.0, reduction: str = "mean"):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input, label):
+        return F.hinge_embedding_loss(input, label, self.margin,
+                                      self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input: bool = True, full: bool = False,
+                 epsilon: float = 1e-8, reduction: str = "mean"):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        return F.poisson_nll_loss(input, label, self.log_input, self.full,
+                                  self.epsilon, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction: str = "mean"):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times: bool = False):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction, norm_by_times)
+
+
+# -- 2nd wave: conv / pool / norm / geometry layers -------------------------
+
+class Conv3D(Layer):
+    """weight [out, in/g, kd, kh, kw] (ref nn/layer/conv.py Conv3D)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 padding_mode: str = "zeros", weight_attr=None,
+                 bias_attr=None, data_format: str = "NCDHW", dtype=None):
+        super().__init__(dtype=dtype)
+        kd, kh, kw = F._ntuple(kernel_size, 3)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups, self.data_format = groups, data_format
+        fan_in = in_channels // groups * kd * kh * kw
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kd, kh, kw),
+            attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in,
+                                                 negative_slope=math.sqrt(5),
+                                                 nonlinearity="leaky_relu"))
+        if bias_attr is not False:
+            bound = 1 / math.sqrt(fan_in) if fan_in > 0 else 0
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self.stride, self.padding,
+                        self.dilation, self.groups, self.data_format)
+
+
+class _ConvTransposeBase(Layer):
+    """weight [in, out/g, *k] (paddle transposed-conv layout)."""
+
+    def __init__(self, spatial, in_channels, out_channels, kernel_size,
+                 stride, padding, output_padding, dilation, groups,
+                 weight_attr, bias_attr, data_format, dtype):
+        super().__init__(dtype=dtype)
+        ks = F._ntuple(kernel_size, spatial)
+        self.spatial = spatial
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation = output_padding, dilation
+        self.groups, self.data_format = groups, data_format
+        fan_in = in_channels // groups * int(np.prod(ks))
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, *ks), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in,
+                                                 negative_slope=math.sqrt(5),
+                                                 nonlinearity="leaky_relu"))
+        if bias_attr is not False:
+            bound = 1 / math.sqrt(fan_in) if fan_in > 0 else 0
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        fn = F.conv2d_transpose if self.spatial == 2 else F.conv3d_transpose
+        return fn(x, self.weight, self.bias, self.stride, self.padding,
+                  self.output_padding, self.dilation, self.groups,
+                  data_format=self.data_format)
+
+
+class Conv2DTranspose(_ConvTransposeBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups: int = 1,
+                 weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW", dtype=None):
+        super().__init__(2, in_channels, out_channels, kernel_size, stride,
+                         padding, output_padding, dilation, groups,
+                         weight_attr, bias_attr, data_format, dtype)
+
+
+class Conv3DTranspose(_ConvTransposeBase):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, dilation=1, groups: int = 1,
+                 weight_attr=None, bias_attr=None,
+                 data_format: str = "NCDHW", dtype=None):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride,
+                         padding, output_padding, dilation, groups,
+                         weight_attr, bias_attr, data_format, dtype)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCDHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AvgPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 data_format: str = "NCDHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.exclusive, self.data_format = exclusive, data_format
+
+    def forward(self, x):
+        return F.avg_pool3d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format, self.exclusive)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCHW"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x, indices, output_size=None):
+        return F.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                              self.padding, output_size, self.data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features: int, epsilon: float = 1e-5,
+                 momentum: float = 0.9, weight_attr=None, bias_attr=None,
+                 data_format: str = "NCHW", dtype=None):
+        super().__init__(dtype=dtype)
+        self.epsilon = epsilon
+        if weight_attr is not False:
+            self.scale = self.create_parameter(
+                (num_features,), attr=weight_attr,
+                default_initializer=I.Constant(1.0))
+        else:
+            self.scale = None
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (num_features,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Constant(0.0))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self.epsilon)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size: int, alpha: float = 1e-4, beta: float = 0.75,
+                 k: float = 1.0, data_format: str = "NCHW"):
+        super().__init__()
+        self.size, self.alpha, self.beta, self.k = size, alpha, beta, k
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.local_response_norm(x, self.size, self.alpha, self.beta,
+                                     self.k, self.data_format)
+
+
+class PixelShuffle(Layer):
+    def __init__(self, upscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.upscale_factor = upscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_shuffle(x, self.upscale_factor, self.data_format)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.groups, self.data_format = groups, data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        self.output_sizes, self.kernel_sizes = output_sizes, kernel_sizes
+        self.strides, self.paddings, self.dilations = \
+            strides, paddings, dilations
+
+    def forward(self, x):
+        return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
+                      self.paddings, self.dilations)

@@ -15,7 +15,7 @@ __all__ = [
     "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
     "full_like", "arange", "linspace", "eye", "empty", "empty_like",
     "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
-    "numel", "tolist",
+    "numel", "tolist", "logspace", "vander", "tril_indices", "triu_indices",
 ]
 
 
@@ -125,3 +125,23 @@ def numel(x) -> int:
 
 def tolist(x):
     return np.asarray(x).tolist()
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    if dtype is not None:
+        dtype = dtypes.to_dtype(dtype)
+    return jnp.logspace(start, stop, num, base=base, dtype=dtype)
+
+
+def vander(x, n=None, increasing: bool = False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def tril_indices(row, col=None, offset: int = 0):
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])
+
+
+def triu_indices(row, col=None, offset: int = 0):
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c])

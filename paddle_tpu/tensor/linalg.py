@@ -12,6 +12,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = [
+    "cholesky_solve", "eigvals", "eigvalsh", "lu", "lu_unpack",
     "matmul", "mm", "bmm", "dot", "t", "norm", "dist", "cross", "cholesky",
     "qr", "svd", "eig", "eigh", "inv", "pinv", "det", "slogdet", "solve",
     "triangular_solve", "lstsq", "matrix_power", "matrix_rank", "mv",
@@ -119,3 +120,53 @@ def histogram(x, bins: int = 100, min: float = 0.0, max: float = 0.0):
 
 def bincount(x, weights=None, minlength: int = 0):
     return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def cholesky_solve(x, y, upper: bool = False):
+    """Solve A X = B given the Cholesky factor `y` of A (ref
+    paddle.linalg.cholesky_solve; `x` is B)."""
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO: str = "L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def lu(x, pivot: bool = True):
+    """LU factorization (ref paddle.linalg.lu): returns (LU, pivots) with
+    LU packing L (unit lower) and U, pivots 1-based as in the reference."""
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, piv + 1
+
+
+def lu_unpack(lu_data, pivots, unpack_ludata: bool = True,
+              unpack_pivots: bool = True):
+    """Unpack lu() output into (P, L, U), batched like the reference
+    (ref paddle.linalg.lu_unpack)."""
+    n = lu_data.shape[-2]
+    m = lu_data.shape[-1]
+    k = min(n, m)
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(n, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+
+    def perm_one(piv0):
+        perm = jnp.arange(n)
+
+        def swap(perm, i):
+            j = piv0[i]
+            pi, pj = perm[i], perm[j]
+            return perm.at[i].set(pj).at[j].set(pi), None
+
+        perm, _ = jax.lax.scan(swap, perm, jnp.arange(piv0.shape[-1]))
+        return perm
+
+    piv0 = pivots - 1  # back to 0-based LAPACK ipiv
+    batch = piv0.shape[:-1]
+    perms = jax.vmap(perm_one)(piv0.reshape(-1, piv0.shape[-1]))
+    P = jnp.eye(n, dtype=lu_data.dtype)[perms]          # [B, n, n] rows=perm
+    P = jnp.swapaxes(P, -1, -2).reshape(*batch, n, n)
+    return P, L, U

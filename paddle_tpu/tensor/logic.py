@@ -5,6 +5,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 __all__ = [
+    "is_empty",
     "equal", "not_equal", "greater_than", "greater_equal", "less_than",
     "less_equal", "logical_and", "logical_or", "logical_not", "logical_xor",
     "equal_all", "allclose", "isclose", "is_tensor", "bitwise_and",
@@ -52,3 +53,9 @@ def all(x, axis=None, keepdim: bool = False):
 
 def any(x, axis=None, keepdim: bool = False):
     return jnp.any(x, axis=axis, keepdims=keepdim)
+
+
+def is_empty(x):
+    """True if the tensor has zero elements (ref paddle.is_empty)."""
+    import numpy as _np
+    return jnp.asarray(int(_np.prod(x.shape)) == 0)

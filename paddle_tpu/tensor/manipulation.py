@@ -2,10 +2,13 @@
 
 from __future__ import annotations
 
+import builtins
+
 from typing import List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import dtype as dtypes
 
@@ -17,6 +20,8 @@ __all__ = [
     "put_along_axis", "slice", "strided_slice", "cast", "repeat_interleave",
     "unbind", "moveaxis", "swapaxes", "as_complex", "as_real", "unique",
     "masked_fill", "index_put", "rot90", "atleast_1d", "atleast_2d", "atleast_3d",
+    "diagonal", "diag_embed", "fill_diagonal", "index_add", "index_fill",
+    "reverse", "crop", "unique_consecutive",
 ]
 
 
@@ -228,3 +233,110 @@ def atleast_2d(*xs):
 
 def atleast_3d(*xs):
     return jnp.atleast_3d(*xs)
+
+
+def diagonal(x, offset: int = 0, axis1: int = 0, axis2: int = 1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diag_embed(x, offset: int = 0, dim1: int = -2, dim2: int = -1):
+    """Batched diagonal embedding (ref paddle.diag_embed): the last dim of
+    `x` becomes the (offset) diagonal of a new [..., n, n] matrix pair at
+    (dim1, dim2)."""
+    n = x.shape[-1] + builtins.abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), dtype=x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + builtins.max(0, -offset)
+    cols = idx + builtins.max(0, offset)
+    out = base.at[..., rows, cols].set(x)
+    # move the two new trailing axes to (dim1, dim2)
+    nd = out.ndim
+    dim1 = dim1 % nd
+    dim2 = dim2 % nd
+    order = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
+    # insert positions (dim1 < dim2 after normalization per paddle contract)
+    lo, hi = builtins.min(dim1, dim2), builtins.max(dim1, dim2)
+    order.insert(lo, nd - 2)
+    order.insert(hi, nd - 1)
+    return out.transpose(order)
+
+
+def fill_diagonal(x, value, offset: int = 0, wrap: bool = False):
+    """Return a copy with the main diagonal filled (functional: JAX arrays
+    are immutable, so this is fill_diagonal_(x, v) returning the result).
+    ``wrap=True`` restarts the diagonal below the gap for tall 2-D
+    matrices (numpy/paddle semantics)."""
+    h, w = x.shape[-2], x.shape[-1]
+    if wrap and x.ndim == 2 and offset == 0 and h > w:
+        flat_idx = jnp.arange(0, h * w, w + 1)
+        return x.reshape(-1).at[flat_idx].set(value).reshape(h, w)
+    idx = jnp.arange(builtins.min(h - builtins.max(0, -offset),
+                                  w - builtins.max(0, offset)))
+    rows = idx + builtins.max(0, -offset)
+    cols = idx + builtins.max(0, offset)
+    return x.at[..., rows, cols].set(value)
+
+
+def index_add(x, index, axis: int, value):
+    """x with `value` rows added at `index` along `axis`
+    (ref paddle.index_add)."""
+    x = jnp.moveaxis(x, axis, 0)
+    value = jnp.moveaxis(jnp.asarray(value, x.dtype), axis, 0)
+    out = x.at[index].add(value)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_fill(x, index, axis: int, value):
+    x = jnp.moveaxis(x, axis, 0)
+    out = x.at[index].set(value)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def reverse(x, axis):
+    """Alias of flip (the reference keeps both names)."""
+    return jnp.flip(x, axis=axis)
+
+
+def crop(x, shape=None, offsets=None):
+    """Static crop (ref paddle.crop): take `shape` starting at `offsets`."""
+    if shape is None:
+        return x
+    offsets = offsets or [0] * x.ndim
+    slices = tuple(
+        builtins.slice(o, None if s == -1 else o + s)
+        for o, s in zip(offsets, shape))
+    return x[slices]
+
+
+def unique_consecutive(x, return_inverse: bool = False,
+                       return_counts: bool = False, axis=None):
+    """Collapse consecutive duplicates (ref paddle.unique_consecutive).
+
+    Host-side (numpy) implementation: the output shape is data-dependent,
+    so this op cannot run under jit — same contract as `unique`'s
+    dynamic-shape modes in the reference.
+    """
+    a = np.asarray(x)
+    if axis is None:
+        a = a.reshape(-1)
+        keep = np.empty(a.shape[0], dtype=bool)
+        keep[:1] = True
+        keep[1:] = a[1:] != a[:-1]
+    else:
+        moved = np.moveaxis(a, axis, 0)
+        keep = np.empty(moved.shape[0], dtype=bool)
+        keep[:1] = True
+        keep[1:] = np.any(
+            moved[1:].reshape(moved.shape[0] - 1, -1)
+            != moved[:-1].reshape(moved.shape[0] - 1, -1), axis=1)
+        a = moved
+    (positions,) = np.nonzero(keep)
+    out = a[keep] if axis is None else np.moveaxis(a[keep], 0, axis)
+    results = [jnp.asarray(out)]
+    if return_inverse:
+        inverse = np.cumsum(keep) - 1
+        results.append(jnp.asarray(inverse))
+    if return_counts:
+        counts = np.diff(np.append(positions, len(keep)))
+        results.append(jnp.asarray(counts))
+    return results[0] if len(results) == 1 else tuple(results)

@@ -18,6 +18,9 @@ __all__ = [
     "kron", "nan_to_num", "amax", "amin", "diff", "angle", "frac", "rad2deg",
     "deg2rad", "gcd", "lcm", "heaviside", "digamma", "lgamma", "multiplex",
     "stanh", "atan2", "logit", "scale", "increment",
+    "acosh", "asinh", "atanh", "conj", "real", "imag", "complex",
+    "i0", "i0e", "i1", "i1e", "polygamma", "nextafter", "remainder",
+    "cummax", "cummin", "renorm", "add_n", "copysign", "ldexp", "hypot",
 ]
 
 add = jnp.add
@@ -184,3 +187,96 @@ def scale(x, scale: float = 1.0, bias: float = 0.0,
 
 def increment(x, value: float = 1.0):
     return x + value
+
+
+acosh = jnp.arccosh
+asinh = jnp.arcsinh
+atanh = jnp.arctanh
+conj = jnp.conj
+real = jnp.real
+imag = jnp.imag
+nextafter = jnp.nextafter
+remainder = jnp.mod          # paddle remainder == python % semantics
+copysign = jnp.copysign
+ldexp = jnp.ldexp
+hypot = jnp.hypot
+
+
+def complex(real, imag):
+    """Build a complex tensor from real/imag parts (ref paddle.complex)."""
+    return jax.lax.complex(real, imag)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def polygamma(x, n: int):
+    """n-th derivative of digamma (ref paddle.polygamma; n is static)."""
+    return jax.scipy.special.polygamma(n, x)
+
+
+def _cum_extreme(x, axis, arg_fn):
+    """Shared cummax/cummin → (values, indices): one lax.scan carrying the
+    running extreme and its position (paddle returns both)."""
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    n = x.shape[axis]
+    xm = jnp.moveaxis(x, axis, 0)
+
+    def body(carry, inp):
+        best, bidx = carry
+        val, i = inp
+        better = arg_fn(val, best)
+        nbest = jnp.where(better, val, best)
+        nbidx = jnp.where(better, i, bidx)
+        return (nbest, nbidx), (nbest, nbidx)
+
+    init = (xm[0], jnp.zeros(xm.shape[1:], dtype=jnp.int32))
+    _, (vals, idxs) = jax.lax.scan(
+        body, init, (xm[1:], jnp.arange(1, n, dtype=jnp.int32)))
+    vals = jnp.concatenate([xm[:1], vals], axis=0)
+    idxs = jnp.concatenate(
+        [jnp.zeros((1,) + xm.shape[1:], jnp.int32), idxs], axis=0)
+    return jnp.moveaxis(vals, 0, axis), jnp.moveaxis(idxs, 0, axis)
+
+
+def cummax(x, axis=None):
+    return _cum_extreme(x, axis, lambda v, b: v > b)
+
+
+def cummin(x, axis=None):
+    return _cum_extreme(x, axis, lambda v, b: v < b)
+
+
+def renorm(x, p: float, axis: int, max_norm: float):
+    """Renormalize sub-tensors along `axis` to p-norm <= max_norm
+    (ref paddle.renorm)."""
+    reduce_axes = tuple(i for i in range(x.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(x) ** p, axis=reduce_axes,
+                    keepdims=True) ** (1.0 / p)
+    factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    return x * factor
+
+
+def add_n(inputs):
+    """Elementwise sum of a list of tensors (ref paddle.add_n)."""
+    if not isinstance(inputs, (list, tuple)):
+        return inputs
+    out = inputs[0]
+    for t in inputs[1:]:
+        out = out + t
+    return out

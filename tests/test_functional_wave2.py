@@ -1,0 +1,259 @@
+"""Wave-2 nn.functional ops vs the torch CPU oracle.
+
+The op harness (test_ops.py) covers elementwise ops with numpy references;
+these structural ops (transposed convs, grid_sample, fold, CTC, pooling
+with indices) are checked against torch.nn.functional — a stronger oracle
+than hand-rolled numpy, matching the reference kernels' semantics
+(ref phi conv_transpose/grid_sample/fold/warpctc kernels)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as tF
+
+import paddle_tpu.nn.functional as F
+
+rng = np.random.default_rng(0)
+
+
+def chk(got, want, tol=2e-5):
+    got, want = np.asarray(got), np.asarray(want)
+    assert got.shape == want.shape, (got.shape, want.shape)
+    np.testing.assert_allclose(got, want, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("s,p,op,d", [(1, 0, 0, 1), (2, 1, 1, 1),
+                                      (2, 0, 0, 2), (3, 2, 1, 1)])
+def test_conv2d_transpose(s, p, op, d):
+    x = rng.normal(size=(2, 4, 7, 9)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    got = F.conv2d_transpose(jnp.asarray(x), jnp.asarray(w), jnp.asarray(b),
+                             stride=s, padding=p, output_padding=op,
+                             dilation=d)
+    want = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                               torch.tensor(b), stride=s, padding=p,
+                               output_padding=op, dilation=d)
+    chk(got, want.numpy())
+
+
+def test_conv2d_transpose_groups():
+    x = rng.normal(size=(2, 4, 7, 9)).astype(np.float32)
+    w = rng.normal(size=(4, 2, 3, 3)).astype(np.float32)
+    got = F.conv2d_transpose(jnp.asarray(x), jnp.asarray(w), stride=2,
+                             padding=1, groups=2)
+    want = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w), stride=2,
+                               padding=1, groups=2)
+    chk(got, want.numpy())
+
+
+def test_conv3d_and_transpose():
+    x = rng.normal(size=(2, 3, 5, 6, 7)).astype(np.float32)
+    w = rng.normal(size=(4, 3, 3, 3, 3)).astype(np.float32)
+    chk(F.conv3d(jnp.asarray(x), jnp.asarray(w), stride=2, padding=1),
+        tF.conv3d(torch.tensor(x), torch.tensor(w), stride=2,
+                  padding=1).numpy())
+    wt = rng.normal(size=(3, 4, 3, 3, 3)).astype(np.float32)
+    chk(F.conv3d_transpose(jnp.asarray(x), jnp.asarray(wt), stride=2,
+                           padding=1, output_padding=1),
+        tF.conv_transpose3d(torch.tensor(x), torch.tensor(wt), stride=2,
+                            padding=1, output_padding=1).numpy())
+
+
+def test_pool3d():
+    x = rng.normal(size=(2, 3, 4, 6, 6)).astype(np.float32)
+    chk(F.max_pool3d(jnp.asarray(x), 2, stride=2),
+        tF.max_pool3d(torch.tensor(x), 2, stride=2).numpy())
+    chk(F.avg_pool3d(jnp.asarray(x), 2, stride=2),
+        tF.avg_pool3d(torch.tensor(x), 2, stride=2).numpy())
+
+
+def test_max_pool_with_index_and_unpool():
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    got_v, got_i = F.max_pool2d_with_index(jnp.asarray(x), 2, stride=2)
+    want_v, want_i = tF.max_pool2d(torch.tensor(x), 2, stride=2,
+                                   return_indices=True)
+    chk(got_v, want_v.numpy())
+    np.testing.assert_array_equal(np.asarray(got_i), want_i.numpy())
+    chk(F.max_unpool2d(got_v, got_i, 2, stride=2),
+        tF.max_unpool2d(want_v, want_i, 2, stride=2).numpy())
+
+
+@pytest.mark.parametrize("pm", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("ac", [True, False])
+def test_grid_sample(pm, ac):
+    x = rng.normal(size=(2, 3, 6, 8)).astype(np.float32)
+    grid = rng.uniform(-1.2, 1.2, size=(2, 5, 7, 2)).astype(np.float32)
+    got = F.grid_sample(jnp.asarray(x), jnp.asarray(grid), padding_mode=pm,
+                        align_corners=ac)
+    want = tF.grid_sample(torch.tensor(x), torch.tensor(grid),
+                          padding_mode=pm, align_corners=ac, mode="bilinear")
+    chk(got, want.numpy())
+
+
+@pytest.mark.parametrize("ac", [True, False])
+def test_affine_grid(ac):
+    theta = rng.normal(size=(2, 2, 3)).astype(np.float32)
+    got = F.affine_grid(jnp.asarray(theta), (2, 3, 5, 7), align_corners=ac)
+    want = tF.affine_grid(torch.tensor(theta), (2, 3, 5, 7),
+                          align_corners=ac)
+    chk(got, want.numpy())
+
+
+def test_unfold_fold_roundtrip():
+    x = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    got_uf = F.unfold(jnp.asarray(x), 3, strides=2, paddings=1)
+    want_uf = tF.unfold(torch.tensor(x), 3, stride=2, padding=1)
+    chk(got_uf, want_uf.numpy())
+    chk(F.fold(got_uf, (8, 8), 3, strides=2, paddings=1),
+        tF.fold(want_uf, (8, 8), 3, stride=2, padding=1).numpy())
+
+
+def test_instance_norm_and_lrn():
+    x = rng.normal(size=(2, 3, 5, 5)).astype(np.float32)
+    g = rng.normal(size=(3,)).astype(np.float32)
+    b = rng.normal(size=(3,)).astype(np.float32)
+    chk(F.instance_norm(jnp.asarray(x), weight=jnp.asarray(g),
+                        bias=jnp.asarray(b)),
+        tF.instance_norm(torch.tensor(x), weight=torch.tensor(g),
+                         bias=torch.tensor(b)).numpy())
+    chk(F.local_response_norm(jnp.asarray(x), size=3, alpha=1e-3,
+                              beta=0.75, k=1.5),
+        torch.nn.LocalResponseNorm(3, alpha=1e-3, beta=0.75,
+                                   k=1.5)(torch.tensor(x)).numpy())
+
+
+def test_ctc_loss_matches_torch():
+    T_, B_, C_ = 12, 3, 6
+    logits = rng.normal(size=(T_, B_, C_)).astype(np.float32)
+    logp = np.asarray(jax.nn.log_softmax(jnp.asarray(logits), axis=-1))
+    labels = rng.integers(1, C_, size=(B_, 5)).astype(np.int32)
+    in_len = np.array([12, 10, 8], np.int32)
+    lab_len = np.array([5, 3, 0], np.int32)  # incl. empty target
+    got = F.ctc_loss(jnp.asarray(logp), jnp.asarray(labels),
+                     jnp.asarray(in_len), jnp.asarray(lab_len),
+                     blank=0, reduction="none")
+    want = tF.ctc_loss(torch.tensor(logp),
+                       torch.tensor(labels.astype(np.int64)),
+                       torch.tensor(in_len.astype(np.int64)),
+                       torch.tensor(lab_len.astype(np.int64)),
+                       blank=0, reduction="none", zero_infinity=False)
+    chk(got, want.numpy(), tol=1e-3)
+
+
+def test_ctc_loss_takes_raw_logits():
+    """paddle contract: softmax is applied internally (warpctc)."""
+    logits = rng.normal(size=(10, 2, 5)).astype(np.float32)
+    labels = np.array([[1, 2], [3, 4]], np.int32)
+    il, ll = np.array([10, 10]), np.array([2, 2])
+    got = F.ctc_loss(jnp.asarray(logits), jnp.asarray(labels),
+                     jnp.asarray(il), jnp.asarray(ll), reduction="none")
+    want = tF.ctc_loss(torch.log_softmax(torch.tensor(logits), -1),
+                       torch.tensor(labels.astype(np.int64)),
+                       torch.tensor(il), torch.tensor(ll),
+                       blank=0, reduction="none")
+    chk(got, want.numpy(), tol=1e-3)
+
+
+def test_conv2d_transpose_output_size():
+    x = jnp.asarray(rng.normal(size=(1, 2, 5, 5)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(2, 3, 3, 3)).astype(np.float32))
+    out = F.conv2d_transpose(x, w, stride=2, padding=1,
+                             output_size=[10, 10])
+    assert out.shape == (1, 3, 10, 10)
+    with pytest.raises(ValueError, match="unreachable"):
+        F.conv2d_transpose(x, w, stride=2, padding=1, output_size=[64, 64])
+
+
+def test_max_pool2d_positional_data_format_compat():
+    x = jnp.asarray(rng.normal(size=(1, 4, 4, 2)).astype(np.float32))
+    out = F.max_pool2d(x, 2, 2, 0, "NHWC")  # old positional signature
+    assert out.shape == (1, 2, 2, 2)
+
+
+def test_lu_unpack_batched():
+    import paddle_tpu as paddle
+    a = rng.normal(size=(3, 4, 4)).astype(np.float32)
+    a = a @ a.transpose(0, 2, 1) + 4 * np.eye(4, dtype=np.float32)
+    lu_d, piv = paddle.linalg.lu(jnp.asarray(a))
+    P, L, U = paddle.linalg.lu_unpack(lu_d, piv)
+    chk(np.asarray(P) @ np.asarray(L) @ np.asarray(U), a, tol=1e-4)
+
+
+def test_fill_diagonal_wrap():
+    import paddle_tpu as paddle
+    got = paddle.fill_diagonal(jnp.zeros((6, 3)), 5.0, wrap=True)
+    want = np.zeros((6, 3))
+    np.fill_diagonal(want, 5.0, wrap=True)
+    chk(got, want)
+
+
+def test_ctc_loss_grad_is_finite():
+    logits = jnp.asarray(rng.normal(size=(6, 2, 5)).astype(np.float32))
+    labels = jnp.asarray(np.array([[1, 2], [3, 3]], np.int32))
+
+    def loss(lg):
+        lp = jax.nn.log_softmax(lg, axis=-1)
+        return F.ctc_loss(lp, labels, jnp.asarray([6, 6]),
+                          jnp.asarray([2, 2]))
+    g = jax.grad(loss)(logits)
+    assert bool(jnp.isfinite(g).all())
+
+
+def test_losses_match_torch():
+    a = rng.normal(size=(6,)).astype(np.float32)
+    b = rng.normal(size=(6,)).astype(np.float32)
+    lab = np.sign(rng.normal(size=(6,))).astype(np.float32)
+    chk(F.margin_ranking_loss(jnp.asarray(a), jnp.asarray(b),
+                              jnp.asarray(lab), 0.3),
+        tF.margin_ranking_loss(torch.tensor(a), torch.tensor(b),
+                               torch.tensor(lab), margin=0.3).numpy())
+    chk(F.soft_margin_loss(jnp.asarray(a), jnp.asarray(lab)),
+        tF.soft_margin_loss(torch.tensor(a), torch.tensor(lab)).numpy())
+    an, po, ne = [rng.normal(size=(4, 8)).astype(np.float32)
+                  for _ in range(3)]
+    chk(F.triplet_margin_loss(jnp.asarray(an), jnp.asarray(po),
+                              jnp.asarray(ne)),
+        tF.triplet_margin_loss(torch.tensor(an), torch.tensor(po),
+                               torch.tensor(ne)).numpy())
+    chk(F.hinge_embedding_loss(jnp.asarray(a), jnp.asarray(lab)),
+        tF.hinge_embedding_loss(torch.tensor(a),
+                                torch.tensor(lab)).numpy())
+    chk(F.poisson_nll_loss(jnp.asarray(a), jnp.asarray(np.abs(lab))),
+        tF.poisson_nll_loss(torch.tensor(a),
+                            torch.tensor(np.abs(lab))).numpy())
+    mi = rng.normal(size=(4, 5)).astype(np.float32)
+    ml = rng.integers(0, 2, size=(4, 5)).astype(np.float32)
+    chk(F.multi_label_soft_margin_loss(jnp.asarray(mi), jnp.asarray(ml)),
+        tF.multilabel_soft_margin_loss(torch.tensor(mi),
+                                       torch.tensor(ml)).numpy())
+    c1 = rng.normal(size=(5, 7)).astype(np.float32)
+    c2 = rng.normal(size=(5, 7)).astype(np.float32)
+    cl = np.sign(rng.normal(size=(5,))).astype(np.float32)
+    chk(F.cosine_embedding_loss(jnp.asarray(c1), jnp.asarray(c2),
+                                jnp.asarray(cl), 0.2),
+        tF.cosine_embedding_loss(torch.tensor(c1), torch.tensor(c2),
+                                 torch.tensor(cl), margin=0.2).numpy())
+
+
+def test_gumbel_softmax_properties():
+    x = jnp.asarray(rng.normal(size=(4, 10)).astype(np.float32))
+    soft = F.gumbel_softmax(x, temperature=0.5)
+    np.testing.assert_allclose(np.asarray(soft.sum(-1)), 1.0, rtol=1e-5)
+    hard = F.gumbel_softmax(x, temperature=0.5, hard=True)
+    assert set(np.unique(np.asarray(hard))) <= {0.0, 1.0}
+    np.testing.assert_allclose(np.asarray(hard.sum(-1)), 1.0, rtol=1e-5)
+
+
+def test_rrelu_modes():
+    x = jnp.asarray(rng.normal(size=(100,)).astype(np.float32))
+    ev = F.rrelu(x, training=False)
+    want = np.where(np.asarray(x) >= 0, np.asarray(x),
+                    np.asarray(x) * ((1 / 8 + 1 / 3) / 2))
+    chk(ev, want)
+    tr = np.asarray(F.rrelu(x, training=True))
+    neg = np.asarray(x) < 0
+    ratios = tr[neg] / np.asarray(x)[neg]
+    assert ((ratios > 1 / 8 - 1e-6) & (ratios < 1 / 3 + 1e-6)).all()

@@ -599,6 +599,151 @@ OPS = [
        lambda x, t: -np.take_along_axis(
            x - sps.logsumexp(x, 1, keepdims=True), t, 1),
        rtol=1e-4, atol=1e-4, grad_argnums=(0,)),
+    # ---- wave 2: math ----
+    Op("acosh", T.acosh, (_pos(3, 4, lo=1.1, hi=4.0),), np.arccosh),
+    Op("asinh", T.asinh, (A,), np.arcsinh),
+    Op("atanh", T.atanh, (SMALL,), np.arctanh),
+    Op("nextafter", T.nextafter, (A, _f32(3, 4, seed=21)), np.nextafter,
+       grad=False),
+    Op("remainder", T.remainder, (A, POSA), np.mod, grad=False),
+    Op("copysign", T.copysign, (A, _f32(3, 4, seed=22)), np.copysign,
+       grad=False),
+    Op("hypot", T.hypot, (A, _f32(3, 4, seed=23)), np.hypot),
+    Op("ldexp", T.ldexp, (A, _i32(3, 4, lo=-3, hi=3)), np.ldexp,
+       grad=False),
+    Op("i0", T.i0, (SMALL,), sps.i0, rtol=1e-4, atol=1e-4),
+    Op("i0e", T.i0e, (SMALL,), sps.i0e, rtol=1e-4, atol=1e-4),
+    Op("i1", T.i1, (SMALL,), sps.i1, rtol=1e-4, atol=1e-4),
+    Op("i1e", T.i1e, (SMALL,), sps.i1e, rtol=1e-4, atol=1e-4),
+    Op("polygamma", T.polygamma, (POSA,),
+       lambda x: sps.polygamma(1, x), kwargs={"n": 1},
+       rtol=1e-3, atol=1e-3, grad=False),
+    Op("cummax", T.cummax, (A,),
+       lambda x: (np.maximum.accumulate(x.reshape(-1)),
+                  np.array([int(np.argmax(x.reshape(-1)[:i + 1]))
+                            for i in range(x.size)])),
+       grad=False),
+    Op("cummin", T.cummin, (A,),
+       lambda x: (np.minimum.accumulate(x.reshape(-1)),
+                  np.array([int(np.argmin(x.reshape(-1)[:i + 1]))
+                            for i in range(x.size)])),
+       grad=False),
+    Op("renorm", T.renorm, (_f32(3, 4, seed=24),),
+       kwargs={"p": 2.0, "axis": 0, "max_norm": 1.0},
+       ref=lambda x: x * np.minimum(
+           1.0, 1.0 / (np.sqrt((x ** 2).sum(1, keepdims=True)) + 1e-7)),
+       rtol=1e-4, atol=1e-4),
+    Op("add_n", T.add_n, ([A, POSA, _f32(3, 4, seed=50)],),
+       lambda xs: xs[0] + xs[1] + xs[2]),
+    Op("complex", T.complex, (A, _f32(3, 4, seed=25)),
+       lambda re, im: re + 1j * im, grad=False),
+    Op("real", T.real, (A,), lambda x: np.real(x), grad=False),
+    Op("imag_of_complex",
+       lambda re, im: T.imag(T.complex(re, im)), (A, _f32(3, 4, seed=26)),
+       lambda re, im: im, grad=False),
+    Op("conj", T.conj, (A,), np.conj, grad=False),
+    # ---- wave 2: manipulation / creation ----
+    Op("diagonal", T.diagonal, (_f32(4, 4, seed=27),),
+       lambda x: np.diagonal(x), grad=False),
+    Op("diag_embed", T.diag_embed, (_f32(2, 3, seed=28),),
+       lambda x: np.stack([np.diag(r) for r in x]), grad=False),
+    Op("fill_diagonal", T.fill_diagonal, (_f32(4, 4, seed=29), 7.0),
+       lambda x, v: (lambda y: (np.fill_diagonal(y, v), y)[1])(x.copy()),
+       grad=False),
+    Op("index_add", T.index_add,
+       (_f32(5, 3, seed=30), np.array([0, 2, 0]), 0, _f32(3, 3, seed=31)),
+       lambda x, i, ax, v: (lambda y: (np.add.at(y, i, v), y)[1])(x.copy()),
+       grad=False),
+    Op("index_fill", T.index_fill,
+       (_f32(5, 3, seed=32), np.array([1, 3]), 0, 9.0),
+       lambda x, i, ax, v: (lambda y: (y.__setitem__(i, v), y)[1])(x.copy()),
+       grad=False),
+    Op("reverse", T.reverse, (A,), lambda x: x[::-1], kwargs={"axis": 0},
+       grad=False),
+    Op("crop", T.crop, (_f32(4, 5, seed=33),),
+       kwargs={"shape": [2, 3], "offsets": [1, 1]},
+       ref=lambda x: x[1:3, 1:4], grad=False),
+    Op("logspace", T.logspace, (0.0, 3.0, 7),
+       lambda a, b, n: np.logspace(a, b, n), rtol=1e-4, grad=False),
+    Op("vander", T.vander, (_pos(4, seed=34),),
+       lambda x: np.vander(x), rtol=1e-4, grad=False),
+    Op("tril_indices", T.tril_indices, (4,),
+       lambda n: np.stack(np.tril_indices(n)), grad=False),
+    Op("triu_indices", T.triu_indices, (4,),
+       lambda n: np.stack(np.triu_indices(n)), grad=False),
+    Op("unique_consecutive", T.unique_consecutive,
+       (np.array([1, 1, 2, 2, 2, 3, 1, 1]),),
+       lambda x: np.array([1, 2, 3, 1]), jit=False, grad=False),
+    # ---- wave 2: linalg ----
+    Op("eigvalsh", paddle.linalg.eigvalsh,
+       ((lambda a: a @ a.T + 3 * np.eye(4, dtype=np.float32))(
+           _f32(4, 4, seed=35)),),
+       lambda a: np.linalg.eigvalsh(a), rtol=1e-3, atol=1e-3, grad=False),
+    Op("cholesky_solve", paddle.linalg.cholesky_solve,
+       (_f32(4, 2, seed=36),
+        np.linalg.cholesky(
+            (lambda a: a @ a.T + 3 * np.eye(4))(
+                _rng(37).normal(size=(4, 4))).astype(np.float32)).astype(
+                    np.float32)),
+       lambda b, L: np.linalg.solve(L @ L.T, b),
+       rtol=1e-3, atol=1e-3, grad=False),
+    # ---- wave 2: fft ----
+    Op("fft_roundtrip", lambda x: paddle.fft.ifft(paddle.fft.fft(x)),
+       (_f32(8, seed=38),), lambda x: x.astype(np.complex64),
+       rtol=1e-4, atol=1e-4, grad=False),
+    Op("rfft", paddle.fft.rfft, (_f32(8, seed=39),),
+       lambda x: np.fft.rfft(x).astype(np.complex64),
+       rtol=1e-4, atol=1e-4, grad=False),
+    Op("fft2", paddle.fft.fft2, (_f32(4, 4, seed=40),),
+       lambda x: np.fft.fft2(x).astype(np.complex64),
+       rtol=1e-4, atol=1e-4, grad=False),
+    Op("fftshift", paddle.fft.fftshift, (_f32(5, seed=41),),
+       np.fft.fftshift, grad=False),
+    # ---- wave 2: activations ----
+    Op("celu", F.celu, (A,),
+       lambda x: np.maximum(x, 0) + np.minimum(0, np.expm1(x))),
+    Op("hardshrink", F.hardshrink, (A,),
+       lambda x: np.where(np.abs(x) > 0.5, x, 0.0), grad=False),
+    Op("hardtanh", F.hardtanh, (A,), lambda x: np.clip(x, -1, 1),
+       grad=False),
+    Op("softshrink", F.softshrink, (A,),
+       lambda x: np.where(x > 0.5, x - 0.5,
+                          np.where(x < -0.5, x + 0.5, 0.0)), grad=False),
+    Op("softsign", F.softsign, (A,), lambda x: x / (1 + np.abs(x))),
+    Op("tanhshrink", F.tanhshrink, (A,), lambda x: x - np.tanh(x)),
+    Op("thresholded_relu", F.thresholded_relu, (A,),
+       lambda x: np.where(x > 1.0, x, 0.0), grad=False),
+    Op("log_sigmoid", F.log_sigmoid, (A,),
+       lambda x: -np.log1p(np.exp(-x))),
+    Op("maxout", F.maxout, (_f32(2, 6, 3, seed=42),),
+       kwargs={"groups": 2},
+       ref=lambda x: x.reshape(2, 3, 2, 3).max(2), grad=False),
+    Op("prelu", F.prelu, (A, np.float32(0.2)),
+       lambda x, w: np.where(x >= 0, x, w * x), grad_argnums=(0,)),
+    # ---- wave 2: losses ----
+    Op("binary_cross_entropy", F.binary_cross_entropy,
+       (_pos(6, lo=0.05, hi=0.95, seed=43),
+        _i32(6, hi=2).astype(np.float32)),
+       lambda p, y: np.mean(-(y * np.log(p + 1e-12)
+                              + (1 - y) * np.log(1 - p + 1e-12))),
+       rtol=1e-4, atol=1e-4, grad_argnums=(0,)),
+    Op("square_error_cost", F.square_error_cost, (A, POSA),
+       lambda a, b: (a - b) ** 2),
+    Op("log_loss", F.log_loss,
+       (_pos(6, lo=0.05, hi=0.95, seed=44),
+        _i32(6, hi=2).astype(np.float32)),
+       lambda p, y: -(y * np.log(p + 1e-4)
+                      + (1 - y) * np.log(1 - p + 1e-4)),
+       rtol=1e-4, atol=1e-4, grad_argnums=(0,)),
+    # ---- wave 2: geometry ----
+    Op("pixel_shuffle", F.pixel_shuffle, (_f32(1, 4, 2, 2, seed=45),),
+       kwargs={"upscale_factor": 2},
+       ref=lambda x: x.reshape(1, 1, 2, 2, 2, 2).transpose(
+           0, 1, 4, 2, 5, 3).reshape(1, 1, 4, 4), grad=False),
+    Op("channel_shuffle", F.channel_shuffle, (_f32(1, 6, 2, 2, seed=46),),
+       kwargs={"groups": 2},
+       ref=lambda x: x.reshape(1, 2, 3, 2, 2).transpose(
+           0, 2, 1, 3, 4).reshape(1, 6, 2, 2), grad=False),
 ]
 
 

@@ -162,8 +162,11 @@ def _ps_worker_proc(worker_id, n_workers, endpoints, losses_q):
 
 
 def test_ps_training_multiprocess():
-    """2 server procs + 2 trainer procs; loss decreases on both workers."""
-    ctx = mp.get_context("fork")
+    """2 server procs + 2 trainer procs; loss decreases on both workers.
+
+    Spawn, not fork: the workers run JAX computations, and forking a
+    pytest process with live JAX threads can deadlock the child."""
+    ctx = mp.get_context("spawn")
     from paddle_tpu.distributed.launch import free_port
     ports = [free_port(), free_port()]
     endpoints = [f"127.0.0.1:{p}" for p in ports]
