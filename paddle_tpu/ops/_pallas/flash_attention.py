@@ -43,7 +43,7 @@ def _pick_blocks(sq: int, sk: int, d: int) -> tuple:
     if d <= 64:
         tq, tk = 512, 1024
     elif d <= 128:
-        tq, tk = 256, 512
+        tq, tk = 512, 512   # swept on-chip at seq 1024: 16.6ms vs 17.1 (256/512)
     else:
         tq, tk = 128, 256
 

@@ -139,6 +139,10 @@ def eigvalsh(x, UPLO: str = "L"):
 def lu(x, pivot: bool = True):
     """LU factorization (ref paddle.linalg.lu): returns (LU, pivots) with
     LU packing L (unit lower) and U, pivots 1-based as in the reference."""
+    if not pivot:
+        raise NotImplementedError(
+            "lu(pivot=False) is not supported: LAPACK getrf always "
+            "partial-pivots; reconstruct with lu_unpack's P instead")
     lu_mat, piv = jax.scipy.linalg.lu_factor(x)
     return lu_mat, piv + 1
 

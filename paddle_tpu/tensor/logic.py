@@ -57,5 +57,4 @@ def any(x, axis=None, keepdim: bool = False):
 
 def is_empty(x):
     """True if the tensor has zero elements (ref paddle.is_empty)."""
-    import numpy as _np
-    return jnp.asarray(int(_np.prod(x.shape)) == 0)
+    return jnp.asarray(x.size == 0)

@@ -249,15 +249,18 @@ def diag_embed(x, offset: int = 0, dim1: int = -2, dim2: int = -1):
     rows = idx + builtins.max(0, -offset)
     cols = idx + builtins.max(0, offset)
     out = base.at[..., rows, cols].set(x)
-    # move the two new trailing axes to (dim1, dim2)
+    # Move the new row axis (nd-2) to dim1 and col axis (nd-1) to dim2 —
+    # dim1 > dim2 is legal and yields the transposed placement.
     nd = out.ndim
     dim1 = dim1 % nd
     dim2 = dim2 % nd
-    order = [d for d in range(nd) if d not in (nd - 2, nd - 1)]
-    # insert positions (dim1 < dim2 after normalization per paddle contract)
-    lo, hi = builtins.min(dim1, dim2), builtins.max(dim1, dim2)
-    order.insert(lo, nd - 2)
-    order.insert(hi, nd - 1)
+    order: list = [None] * nd
+    order[dim1] = nd - 2
+    order[dim2] = nd - 1
+    rest = iter(range(nd - 2))
+    for i in range(nd):
+        if order[i] is None:
+            order[i] = next(rest)
     return out.transpose(order)
 
 

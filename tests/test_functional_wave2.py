@@ -247,6 +247,40 @@ def test_gumbel_softmax_properties():
     np.testing.assert_allclose(np.asarray(hard.sum(-1)), 1.0, rtol=1e-5)
 
 
+def test_diag_embed_dim_order():
+    import paddle_tpu as paddle
+    x = jnp.asarray(rng.normal(size=(2, 3)).astype(np.float32))
+    a = paddle.diag_embed(x, offset=1, dim1=-2, dim2=-1)
+    b = paddle.diag_embed(x, offset=1, dim1=-1, dim2=-2)
+    want_a = torch.diag_embed(torch.tensor(np.asarray(x)), offset=1,
+                              dim1=-2, dim2=-1).numpy()
+    want_b = torch.diag_embed(torch.tensor(np.asarray(x)), offset=1,
+                              dim1=-1, dim2=-2).numpy()
+    chk(a, want_a)
+    chk(b, want_b)
+
+
+def test_lu_pivot_false_raises():
+    import paddle_tpu as paddle
+    with pytest.raises(NotImplementedError):
+        paddle.linalg.lu(jnp.eye(3), pivot=False)
+
+
+def test_gumbel_rrelu_vary_under_jit():
+    """Random ops must not bake a trace-time constant key under jit."""
+    from paddle_tpu.core.random import rng_scope
+    x = jnp.asarray(rng.normal(size=(64,)).astype(np.float32))
+
+    @jax.jit
+    def f(key, x):
+        with rng_scope(key):
+            return F.gumbel_softmax(x, hard=True)
+
+    a = f(jax.random.PRNGKey(0), x)
+    b = f(jax.random.PRNGKey(1), x)
+    assert not np.array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_rrelu_modes():
     x = jnp.asarray(rng.normal(size=(100,)).astype(np.float32))
     ev = F.rrelu(x, training=False)
