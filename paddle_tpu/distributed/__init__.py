@@ -12,3 +12,9 @@ from . import checkpoint  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from . import launch  # noqa: F401
+from .store import TCPStore, get_global_store  # noqa: F401
+from .objects import (all_gather_object, broadcast_object_list,  # noqa: F401
+                      scatter_object_list, send_object, recv_object,
+                      isend_object, irecv_object, P2POp, batch_isend_irecv)
+from .spawn import spawn  # noqa: F401
+from . import rpc  # noqa: F401

@@ -1,0 +1,40 @@
+"""Thread-backed result handle shared by host p2p and rpc."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["Future"]
+
+
+class Future:
+    """Runs `runner` on a daemon thread; wait() returns its result or
+    re-raises its exception, and RAISES TimeoutError when the deadline
+    passes (a silent None would be indistinguishable from a real None)."""
+
+    def __init__(self, runner):
+        self._value = None
+        self._exc = None
+        self._done = threading.Event()
+
+        def run():
+            try:
+                self._value = runner()
+            except BaseException as e:
+                self._exc = e
+            finally:
+                self._done.set()
+        threading.Thread(target=run, daemon=True).start()
+
+    def wait(self, timeout: Optional[float] = None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("future timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    is_completed = done

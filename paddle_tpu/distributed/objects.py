@@ -1,0 +1,182 @@
+"""Host-side object collectives and process-level p2p.
+
+Ref: ``python/paddle/distributed/communication/group.py`` object collectives
+(``all_gather_object``, ``broadcast_object_list``, ``scatter_object_list``)
+and the p2p surface (``send``/``recv``/``isend``/``irecv``/``P2POp``/
+``batch_isend_irecv``).
+
+TPU-native split: *array* collectives ride XLA over ICI
+(paddle_tpu.distributed.collective); *object* collectives and host p2p are
+control-plane traffic between processes and go over the TCPStore (the
+reference routes these over its Gloo/store fallback for the same reason —
+arbitrary Python objects never touch the accelerator interconnect).
+
+``group`` may be None (the world) or a sequence of participating ranks;
+every participating rank must make the matching call. Store keys are
+deleted by their last reader, so long training loops don't grow the
+master's memory.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+from typing import Any, List, Optional, Sequence
+
+from ._futures import Future
+from .store import get_global_store
+
+__all__ = ["all_gather_object", "broadcast_object_list",
+           "scatter_object_list", "send_object", "recv_object",
+           "isend_object", "irecv_object", "P2POp", "batch_isend_irecv"]
+
+_seq: dict = {}
+_seq_mu = threading.Lock()
+
+
+def _rank() -> int:
+    return int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+
+
+def _ranks(group) -> List[int]:
+    if group is None:
+        return list(range(int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))))
+    if hasattr(group, "process_ids"):
+        return sorted(group.process_ids)
+    return sorted(int(r) for r in group)
+
+
+def _tag(kind: str, ranks: Sequence[int]) -> str:
+    """Per-(kind, group) sequence so repeated calls stay matched — every
+    participant increments its local counter on each call."""
+    key = (kind, tuple(ranks))
+    with _seq_mu:
+        _seq[key] = _seq.get(key, 0) + 1
+        return f"__{kind}/{'-'.join(map(str, ranks))}/{_seq[key]}"
+
+
+def _cleanup_if_last(store, tag: str, n_readers: int,
+                     keys: Sequence[str]) -> None:
+    if store.add(f"{tag}/done", 1) == n_readers:
+        for k in keys:
+            store.delete_key(k)
+        store.delete_key(f"{tag}/done")
+
+
+def all_gather_object(object_list: List[Any], obj: Any,
+                      group=None) -> None:
+    """Gather `obj` from every participating rank (in rank order)."""
+    ranks = _ranks(group)
+    if len(ranks) == 1:
+        object_list[:] = [obj]
+        return
+    assert _rank() in ranks, "calling rank is not in the group"
+    store = get_global_store()
+    tag = _tag("ago", ranks)
+    store.set(f"{tag}/{_rank()}", pickle.dumps(obj))
+    keys = [f"{tag}/{r}" for r in ranks]
+    object_list[:] = [pickle.loads(store.get(k)) for k in keys]
+    _cleanup_if_last(store, tag, len(ranks), keys)
+
+
+def broadcast_object_list(object_list: List[Any], src: int = 0,
+                          group=None) -> None:
+    """Broadcast the src rank's `object_list` contents to the group."""
+    ranks = _ranks(group)
+    if len(ranks) == 1:
+        return
+    assert _rank() in ranks and src in ranks
+    store = get_global_store()
+    tag = _tag("bol", ranks)
+    if _rank() == src:
+        store.set(tag, pickle.dumps(list(object_list)))
+    else:
+        object_list[:] = pickle.loads(store.get(tag))
+    _cleanup_if_last(store, tag, len(ranks), [tag])
+
+
+def scatter_object_list(out_object_list: List[Any],
+                        in_object_list: Optional[Sequence[Any]] = None,
+                        src: int = 0, group=None) -> None:
+    """Each participating rank receives its slot of in_object_list from
+    src (slots in group-rank order)."""
+    ranks = _ranks(group)
+    if len(ranks) == 1:
+        out_object_list[:] = [in_object_list[0]]
+        return
+    assert _rank() in ranks and src in ranks
+    store = get_global_store()
+    tag = _tag("sol", ranks)
+    if _rank() == src:
+        assert in_object_list is not None and \
+            len(in_object_list) == len(ranks)
+        for slot, r in enumerate(ranks):
+            store.set(f"{tag}/{r}", pickle.dumps(in_object_list[slot]))
+    # single consumer per key: pop on read
+    out_object_list[:] = [
+        pickle.loads(store.get(f"{tag}/{_rank()}", delete=True))
+    ]
+
+
+# -- host p2p ---------------------------------------------------------------
+# Tags are (src, dst, per-pair counter) so repeated sends between a pair
+# stay ordered; the receiver pops the key (single consumer).
+
+_pair_seq: dict = {}
+
+
+def _pair_tag(src: int, dst: int) -> str:
+    with _seq_mu:
+        key = (src, dst)
+        _pair_seq[key] = _pair_seq.get(key, 0) + 1
+        return f"__p2p/{src}/{dst}/{_pair_seq[key]}"
+
+
+def send_object(obj: Any, dst: int, group=None) -> None:
+    get_global_store().set(_pair_tag(_rank(), dst), pickle.dumps(obj))
+
+
+def recv_object(src: int, group=None) -> Any:
+    store = get_global_store()
+    return pickle.loads(store.get(_pair_tag(src, _rank()), delete=True))
+
+
+def isend_object(obj: Any, dst: int, group=None) -> Future:
+    tag = _pair_tag(_rank(), dst)
+    data = pickle.dumps(obj)
+    return Future(lambda: get_global_store().set(tag, data))
+
+
+def irecv_object(src: int, group=None) -> Future:
+    tag = _pair_tag(src, _rank())
+    return Future(
+        lambda: pickle.loads(get_global_store().get(tag, delete=True)))
+
+
+class P2POp:
+    """Ref communication/batch_isend_irecv P2POp: a deferred send/recv."""
+
+    def __init__(self, op, tensor_or_obj, peer: int, group=None):
+        if getattr(op, "__name__", "") not in ("isend", "irecv",
+                                               "isend_object",
+                                               "irecv_object"):
+            raise ValueError("op must be isend/irecv")
+        self.op = op
+        self.payload = tensor_or_obj
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(ops: Sequence[P2POp]) -> List[Future]:
+    """Launch a batch of p2p ops; returns their future handles.
+
+    Tags are assigned in list order on each rank, matching the reference's
+    requirement that both ranks enumerate their ops consistently."""
+    tasks = []
+    for op in ops:
+        if getattr(op.op, "__name__", "") in ("isend", "isend_object"):
+            tasks.append(isend_object(op.payload, op.peer, op.group))
+        else:
+            tasks.append(irecv_object(op.peer, op.group))
+    return tasks

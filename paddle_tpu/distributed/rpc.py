@@ -1,0 +1,183 @@
+"""paddle.distributed.rpc parity.
+
+Ref: ``python/paddle/distributed/rpc/rpc.py`` (init_rpc / rpc_sync /
+rpc_async / shutdown, WorkerInfo) over a C++ brpc agent
+(``fluid/distributed/rpc/rpc_agent.cc``). Here the agent is a thread-backed
+TCP server per process with the shared length-prefixed pickle framing; the
+name→endpoint registry lives in the TCPStore.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import socketserver
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+from .ps.server import recv_msg, send_msg
+from .store import get_global_store
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+_agent = None
+_agent_mu = threading.Lock()
+
+
+class _Agent:
+    def __init__(self, name: str, rank: int, world_size: int):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        fn, args, kwargs = recv_msg(self.request)
+                        try:
+                            reply = fn(*args, **kwargs)
+                        except Exception as e:
+                            reply = e
+                        send_msg(self.request, reply)
+                except (ConnectionError, EOFError):
+                    return
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server(("127.0.0.1", 0), Handler)
+        self.ip, self.port = self._srv.server_address
+        threading.Thread(target=self._srv.serve_forever,
+                         kwargs={"poll_interval": 0.2}, daemon=True).start()
+        self._socks: Dict[str, socket.socket] = {}
+        self._peer_locks: Dict[str, threading.Lock] = {}
+        self._sock_mu = threading.Lock()
+        self.workers: Dict[str, WorkerInfo] = {}
+        self._ready = threading.Event()
+
+        store = get_global_store()
+        info = WorkerInfo(name, rank, self.ip, self.port)
+        store.set(f"__rpc/worker/{rank}", pickle.dumps(info))
+
+    def collect_workers(self) -> None:
+        """Blocking rendezvous for all peers' endpoints. Run AFTER the
+        module-global agent is published: our server is already answering
+        peers whose handlers may call get_worker_info, so the global must
+        exist before this (slow) loop."""
+        store = get_global_store()
+        for r in range(self.world_size):
+            w: WorkerInfo = pickle.loads(store.get(f"__rpc/worker/{r}"))
+            self.workers[w.name] = w
+        self._ready.set()
+
+    def call(self, to: str, fn, args, kwargs):
+        self._ready.wait(120)
+        w = self.workers[to]
+        with self._sock_mu:
+            s = self._socks.get(to)
+            if s is None:
+                s = socket.create_connection((w.ip, w.port), timeout=120)
+                s.settimeout(600)
+                self._socks[to] = s
+            lock = self._peer_locks.setdefault(to, threading.Lock())
+        # one in-flight call per connection: concurrent rpc_async to the
+        # same peer must not interleave frames
+        with lock:
+            send_msg(s, (fn, args, kwargs))
+            reply = recv_msg(s)
+        if isinstance(reply, Exception):
+            raise reply
+        return reply
+
+    def stop(self):
+        for s in self._socks.values():
+            try:
+                s.close()
+            except OSError:
+                pass
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+from ._futures import Future as _Future  # noqa: E402  (shared handle)
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None) -> None:
+    """Start this process's RPC agent and register it (ref rpc.py init_rpc).
+
+    rank/world_size/master default to the launcher env contract."""
+    global _agent
+    with _agent_mu:
+        if _agent is not None:
+            raise RuntimeError("init_rpc already called")
+        if master_endpoint:
+            os.environ.setdefault("PADDLE_MASTER", master_endpoint)
+        rank = rank if rank is not None else \
+            int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        world_size = world_size if world_size is not None else \
+            int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        _agent = _Agent(name, rank, world_size)
+    _agent.collect_workers()
+
+
+def _require_agent() -> _Agent:
+    if _agent is None:
+        raise RuntimeError("call init_rpc() first")
+    return _agent
+
+
+def rpc_sync(to: str, fn, args: tuple = (), kwargs: Optional[dict] = None,
+             timeout: float = 600.0):
+    """Run fn(*args, **kwargs) on worker `to`; blocks for the result (or
+    raises TimeoutError after `timeout` — the remote call itself is not
+    cancelled, matching the reference's fire-and-forget timeout)."""
+    return rpc_async(to, fn, args, kwargs, timeout).wait(timeout)
+
+
+def rpc_async(to: str, fn, args: tuple = (),
+              kwargs: Optional[dict] = None,
+              timeout: float = 600.0) -> _Future:
+    agent = _require_agent()
+    return _Future(lambda: agent.call(to, fn, args, kwargs or {}))
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    agent = _require_agent()
+    if name is not None and name != agent.name:
+        agent._ready.wait(120)
+    return agent.workers[name or agent.name] if name else \
+        WorkerInfo(agent.name, agent.rank, agent.ip, agent.port)
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    agent = _require_agent()
+    agent._ready.wait(120)
+    return sorted(agent.workers.values(), key=lambda w: w.rank)
+
+
+def shutdown() -> None:
+    """Barrier across workers, then stop the local agent (ref shutdown)."""
+    global _agent
+    with _agent_mu:
+        if _agent is None:
+            return
+        get_global_store().barrier("__rpc/shutdown",
+                                   world_size=_agent.world_size)
+        _agent.stop()
+        _agent = None

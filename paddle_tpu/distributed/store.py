@@ -1,0 +1,239 @@
+"""TCPStore — cross-process KV rendezvous.
+
+Ref: ``paddle/phi/core/distributed/store/tcp_store.h:120`` (the C++ store
+every reference process group rendezvouses through) and the Python
+``create_or_get_global_tcp_store`` (``parallel.py:1089``). Protocol here is
+the same length-prefixed pickle framing as the PS service (the reference
+shares brpc the same way).
+
+Used by: object collectives, RPC name registry, host-side barrier — the
+host-side coordination layer next to the XLA-collective data plane.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .ps.server import recv_msg, send_msg
+
+__all__ = ["TCPStore", "get_global_store", "reset_global_store"]
+
+
+class _StoreState:
+    def __init__(self):
+        self.kv: Dict[str, bytes] = {}
+        self.mu = threading.Lock()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self.mu:
+            self.kv[key] = value
+
+    def add(self, key: str, amount: int) -> int:
+        with self.mu:
+            cur = int(self.kv.get(key, b"0")) + amount
+            self.kv[key] = str(cur).encode()
+            return cur
+
+    def delete(self, key: str) -> bool:
+        with self.mu:
+            return self.kv.pop(key, None) is not None
+
+
+class TCPStore:
+    """Master process hosts the state; all ranks (incl. master) are clients.
+
+    API mirrors the reference store: set/get/add/wait/delete_key plus a
+    counting barrier helper.
+    """
+
+    def __init__(self, host: str, port: int, is_master: bool,
+                 world_size: int = 1, timeout: float = 120.0):
+        self.host, self.port = host, port
+        self.world_size = world_size
+        self.timeout = timeout
+        self._srv = None
+        if is_master:
+            state = _StoreState()
+
+            class Handler(socketserver.BaseRequestHandler):
+                def handle(self):
+                    try:
+                        while True:
+                            op, a = recv_msg(self.request)
+                            try:
+                                if op == "set":
+                                    state.set(a["k"], a["v"])
+                                    reply = True
+                                elif op == "tryget":
+                                    # Non-blocking: clients poll. Server-side
+                                    # blocking would wedge the connection's
+                                    # request/reply framing past the socket
+                                    # timeout and deadlock send-vs-recv
+                                    # orderings on a shared client socket.
+                                    with state.mu:
+                                        reply = state.kv.get(a["k"])
+                                        if reply is not None and a.get("d"):
+                                            del state.kv[a["k"]]
+                                elif op == "add":
+                                    reply = state.add(a["k"], a["n"])
+                                elif op == "delete":
+                                    reply = state.delete(a["k"])
+                                elif op == "nkeys":
+                                    with state.mu:
+                                        reply = sum(
+                                            1 for k in state.kv
+                                            if k.startswith(a["p"]))
+                                else:
+                                    reply = ValueError(f"bad store op {op}")
+                            except Exception as e:
+                                reply = e
+                            send_msg(self.request, reply)
+                    except (ConnectionError, EOFError):
+                        return
+
+            class Server(socketserver.ThreadingTCPServer):
+                allow_reuse_address = True
+                daemon_threads = True
+
+            self._srv = Server((host, port), Handler)
+            self.port = self._srv.server_address[1]
+            threading.Thread(target=self._srv.serve_forever,
+                             kwargs={"poll_interval": 0.2},
+                             daemon=True).start()
+        self._sock = self._connect()
+        self._mu = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        deadline = time.monotonic() + self.timeout
+        while True:
+            try:
+                s = socket.create_connection((self.host, self.port),
+                                             timeout=self.timeout)
+                s.settimeout(self.timeout + 10)
+                return s
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+    def _call(self, op: str, **a):
+        with self._mu:
+            send_msg(self._sock, (op, a))
+            reply = recv_msg(self._sock)
+        if isinstance(reply, Exception):
+            raise reply
+        return reply
+
+    def set(self, key: str, value: bytes) -> None:
+        self._call("set", k=key, v=bytes(value))
+
+    def get(self, key: str, timeout: Optional[float] = None,
+            delete: bool = False) -> bytes:
+        """Blocking get, implemented as a client-side poll of non-blocking
+        tryget round-trips — each request/reply completes promptly, so a
+        shared connection can interleave concurrent waiters without
+        deadlocking or desyncing frames. ``delete=True`` pops atomically
+        (single-consumer p2p messages)."""
+        deadline = time.monotonic() + (timeout or self.timeout)
+        while True:
+            v = self._call("tryget", k=key, d=delete)
+            if v is not None:
+                return v
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            time.sleep(0.02)
+
+    def add(self, key: str, amount: int = 1) -> int:
+        return self._call("add", k=key, n=amount)
+
+    def wait_ge(self, key: str, value: int,
+                timeout: Optional[float] = None) -> None:
+        deadline = time.monotonic() + (timeout or self.timeout)
+        while self.add(key, 0) < value:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"TCPStore.wait({key!r} >= {value}) timed out")
+            time.sleep(0.02)
+
+    def delete_key(self, key: str) -> bool:
+        return self._call("delete", k=key)
+
+    def num_keys(self, prefix: str = "") -> int:
+        return self._call("nkeys", p=prefix)
+
+    def barrier(self, tag: str = "barrier",
+                world_size: Optional[int] = None) -> None:
+        n = world_size or self.world_size
+        self.wait_ge(f"__barrier/{tag}", (self.add(f"__barrier/{tag}", 1)
+                                          + n - 1) // n * n)
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._srv is not None:
+            self._srv.shutdown()
+            self._srv.server_close()
+            self._srv = None
+
+
+_global_store: Optional[TCPStore] = None
+
+
+def get_global_store() -> TCPStore:
+    """The process-wide store, rendezvoused from the launcher env contract
+    (PADDLE_MASTER + PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM); rank 0 hosts.
+
+    Ref: parallel.py:1089 create_or_get_global_tcp_store.
+    """
+    global _global_store
+    if _global_store is None:
+        master = os.environ.get("PADDLE_MASTER") or \
+            os.environ.get("MASTER_ADDR", "127.0.0.1:23271")
+        if ":" not in master:
+            master = f"{master}:{os.environ.get('MASTER_PORT', '23271')}"
+        host, port = master.rsplit(":", 1)
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        _global_store = TCPStore(host, int(port), is_master=(rank == 0),
+                                 world_size=world)
+    return _global_store
+
+
+def reset_global_store() -> None:
+    global _global_store
+    if _global_store is not None:
+        _global_store.close()
+        _global_store = None
+
+
+def finalize_global_store() -> None:
+    """Synchronized teardown: the master rank's process hosts the store, so
+    it must outlive every peer's final store call. All ranks rendezvous,
+    non-masters ack completion, and the master waits for every ack before
+    closing — without this, a fast master exiting kills in-flight requests
+    with connection resets."""
+    global _global_store
+    store = _global_store
+    if store is None:
+        return
+    try:
+        n = store.world_size
+        if n > 1:
+            # Bounded waits: a peer that crashed never arrives — don't hang
+            # teardown on it.
+            cur = store.add("__finalize", 1)
+            store.wait_ge("__finalize", (cur + n - 1) // n * n, timeout=30)
+            if store._srv is not None:
+                store.wait_ge("__finalize_ack", n - 1, timeout=30)
+            else:
+                store.add("__finalize_ack", 1)
+    except (OSError, TimeoutError, ConnectionError):
+        pass  # peers may already be gone; close what we have
+    reset_global_store()

@@ -1,0 +1,177 @@
+"""TCPStore, object collectives, p2p, rpc, and spawn — host-side
+distributed API across real process boundaries.
+
+Ref test models: test/legacy_test/test_tcp_store.py, the communication-API
+object-collective tests, and rpc tests under test/rpc/."""
+
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import TCPStore
+from paddle_tpu.distributed.launch import free_port
+
+
+class TestTCPStoreSingleProcess:
+    def test_set_get_add_delete(self):
+        store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+        store.set("k", b"v1")
+        assert store.get("k") == b"v1"
+        assert store.add("ctr", 3) == 3
+        assert store.add("ctr", 2) == 5
+        assert store.delete_key("k") is True
+        assert store.delete_key("k") is False
+        with pytest.raises(TimeoutError):
+            store.get("missing", timeout=0.3)
+        store.close()
+
+    def test_two_clients_share_state(self):
+        master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+        client = TCPStore("127.0.0.1", master.port, is_master=False,
+                          world_size=2)
+        master.set("shared", b"hello")
+        assert client.get("shared") == b"hello"
+        client.close()
+        master.close()
+
+
+# -- spawn + object collectives + rpc across real processes -----------------
+# Entry functions must be module-level (spawn pickles them).
+
+def _worker_objects():
+    import paddle_tpu.distributed as dist
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+
+    gathered = []
+    dist.all_gather_object(gathered, {"rank": rank, "sq": rank * rank})
+    assert [g["rank"] for g in gathered] == [0, 1]
+
+    blist = [{"value": 42, "who": 0}] if rank == 0 else [None]
+    dist.broadcast_object_list(blist, src=0)
+    assert blist[0]["value"] == 42
+
+    out = []
+    dist.scatter_object_list(out, ["for0", "for1"] if rank == 0 else None,
+                             src=0)
+    assert out[0] == f"for{rank}"
+
+    if rank == 0:
+        dist.send_object(np.arange(4), dst=1)
+        got = dist.recv_object(src=1)
+        assert got == "pong"
+    else:
+        arr = dist.recv_object(src=0)
+        np.testing.assert_array_equal(arr, np.arange(4))
+        dist.send_object("pong", dst=0)
+
+    # batch p2p: exchange greetings both directions
+    peer = 1 - rank
+    ops = [dist.P2POp(dist.isend_object, f"hi from {rank}", peer),
+           dist.P2POp(dist.irecv_object, None, peer)]
+    tasks = dist.batch_isend_irecv(ops)
+    assert tasks[1].wait(30) == f"hi from {peer}"
+    tasks[0].wait(30)
+    return rank
+
+
+def _sq(x):
+    return x * x
+
+
+def _whoami():
+    from paddle_tpu.distributed import rpc
+    return rpc.get_worker_info().name
+
+
+def _worker_rpc():
+    from paddle_tpu.distributed import rpc
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    rpc.init_rpc(name=f"worker{rank}")
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1"]
+
+    peer = f"worker{1 - rank}"
+    assert rpc.rpc_sync(peer, _sq, args=(rank + 2,)) == (rank + 2) ** 2
+    fut = rpc.rpc_async(peer, _whoami)
+    assert fut.wait(30) == peer
+    with pytest.raises(ZeroDivisionError):
+        rpc.rpc_sync(peer, divmod, args=(1, 0))
+    rpc.shutdown()
+    return "done"
+
+
+def _worker_fail():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if rank == 1:
+        raise ValueError("rank 1 exploding on purpose")
+    return "ok"
+
+
+def _worker_hard_death():
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if rank == 1:
+        os._exit(7)  # dies without reporting (simulates OOM-kill)
+    return "survivor"
+
+
+def _worker_subgroup():
+    import paddle_tpu.distributed as dist
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    if rank in (0, 1):
+        out = []
+        dist.all_gather_object(out, f"r{rank}", group=[0, 1])
+        assert out == ["r0", "r1"]
+        return "in"
+    return "out"  # rank 2 never participates; must not be required to
+
+
+def _worker_store_cleanup():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.store import get_global_store
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    for _ in range(5):
+        out = []
+        dist.all_gather_object(out, rank)
+        if rank == 0:
+            dist.send_object({"x": 1}, dst=1)
+        else:
+            dist.recv_object(src=0)
+    store = get_global_store()
+    store.barrier("after_loops")
+    # last reader deleted every payload: nothing may accumulate over steps
+    n_left = store.num_keys("__ago") + store.num_keys("__p2p")
+    assert n_left == 0, n_left
+    return "done"
+
+
+class TestSpawn:
+    def test_object_collectives_two_procs(self):
+        from paddle_tpu.distributed import spawn
+        ctx = spawn(_worker_objects, nprocs=2)
+        assert ctx.results == [0, 1]
+
+    def test_rpc_two_procs(self):
+        from paddle_tpu.distributed import spawn
+        ctx = spawn(_worker_rpc, nprocs=2)
+        assert ctx.results == ["done", "done"]
+
+    def test_child_failure_propagates(self):
+        from paddle_tpu.distributed import spawn
+        with pytest.raises(RuntimeError, match="exploding on purpose"):
+            spawn(_worker_fail, nprocs=2)
+
+    def test_silent_child_death_detected(self):
+        from paddle_tpu.distributed import spawn
+        with pytest.raises(RuntimeError, match="exit code 7"):
+            spawn(_worker_hard_death, nprocs=2)
+
+    def test_subgroup_collective(self):
+        from paddle_tpu.distributed import spawn
+        ctx = spawn(_worker_subgroup, nprocs=3)
+        assert ctx.results == ["in", "in", "out"]
+
+    def test_store_keys_cleaned_up(self):
+        from paddle_tpu.distributed import spawn
+        ctx = spawn(_worker_store_cleanup, nprocs=2)
+        assert ctx.results == ["done", "done"]
