@@ -40,6 +40,7 @@ from . import vision  # noqa: F401
 from . import distributed  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .hapi.model import Model  # noqa: F401
+from .hapi.summary import summary  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
 from . import inference  # noqa: F401

@@ -492,13 +492,15 @@ class Model:
                                    "param_states": pstates}
 
     def summary(self, input_size=None, dtype=None):
-        n, e, b = 0, 0, 0
+        if input_size is not None:
+            from .summary import summary as _summary
+            return _summary(self.network, input_size, dtypes=dtype)
+        # no input shape: parameter table only
+        n, e = 0, 0
         lines = []
         for name, ref in self.network.named_parameters():
             n += 1
             e += int(np.prod(ref.shape))
             lines.append(f"{name:60s} {str(ref.shape):20s} {str(ref.dtype)}")
-        text = "\n".join(lines)
-        total = f"\nTotal params: {e:,} ({n} tensors)"
-        print(text + total)
+        print("\n".join(lines) + f"\nTotal params: {e:,} ({n} tensors)")
         return {"total_params": e, "trainable_params": e}

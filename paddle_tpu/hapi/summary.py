@@ -1,0 +1,115 @@
+"""paddle.summary (ref: python/paddle/hapi/model_summary.py).
+
+Runs a forward pass with forward-post hooks capturing each leaf layer's
+output shape, then prints the familiar layer table and returns
+{'total_params', 'trainable_params'}.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dtype import to_dtype
+from ..nn.layer import Layer
+
+__all__ = ["summary"]
+
+
+def _leaf_layers(model: Layer):
+    for name, layer in model.named_sublayers(include_self=False):
+        if not list(layer.sublayers(include_self=False)):
+            yield name, layer
+
+
+def _n_params(layer: Layer):
+    total = trainable = 0
+    for ref in layer.parameters():
+        n = int(np.prod(ref.shape))
+        total += n
+        if ref.trainable:
+            trainable += n
+    return total, trainable
+
+
+def _shapes(out):
+    leaves = jax.tree_util.tree_leaves(out)
+    return ", ".join(str(list(x.shape)) for x in leaves
+                     if hasattr(x, "shape"))
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table for one forward pass.
+
+    ``input_size``: tuple (or list of tuples) incl. batch dim — -1 batch
+    becomes 1, matching the reference; or pass a ready ``input`` tensor.
+    """
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary() needs input_size or input")
+        sizes = [input_size] if isinstance(input_size[0], int) else \
+            list(input_size)
+        if dtypes is None:
+            dtypes_list = ["float32"] * len(sizes)
+        elif isinstance(dtypes, (list, tuple)):
+            dtypes_list = list(dtypes)
+        else:
+            dtypes_list = [dtypes] * len(sizes)
+        inputs = [
+            jnp.zeros([1 if d == -1 else d for d in size],
+                      dtype=to_dtype(dt))
+            for size, dt in zip(sizes, dtypes_list)
+        ]
+    else:
+        inputs = [input] if not isinstance(input, (list, tuple)) else \
+            list(input)
+
+    rows = []
+    handles = []
+
+    def make_hook(name, layer):
+        def hook(lyr, inp, out):
+            total, _ = _n_params(lyr)
+            rows.append((f"{type(lyr).__name__} ({name})", _shapes(out),
+                         total))
+        return hook
+
+    was_training = net.training
+    net.eval()
+    for name, layer in _leaf_layers(net):
+        handles.append(layer.register_forward_post_hook(
+            make_hook(name, layer)))
+    try:
+        net(*inputs)
+    finally:
+        for h in handles:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total_params = trainable_params = 0
+    for ref in net.parameters():
+        n = int(np.prod(ref.shape))
+        total_params += n
+        if ref.trainable:
+            trainable_params += n
+
+    w_layer = max([len(r[0]) for r in rows] + [20]) + 2
+    w_shape = max([len(r[1]) for r in rows] + [14]) + 2
+    header = (f"{'Layer (type)':{w_layer}s}{'Output Shape':{w_shape}s}"
+              f"{'Param #':>12s}")
+    sep = "-" * len(header)
+    lines = [sep, header, sep]
+    for name, shape, n in rows:
+        lines.append(f"{name:{w_layer}s}{shape:{w_shape}s}{n:>12,d}")
+    lines += [sep,
+              f"Total params: {total_params:,}",
+              f"Trainable params: {trainable_params:,}",
+              f"Non-trainable params: {total_params - trainable_params:,}",
+              sep]
+    print("\n".join(lines))
+    return {"total_params": total_params,
+            "trainable_params": trainable_params}

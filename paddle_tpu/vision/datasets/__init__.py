@@ -66,3 +66,178 @@ class MNIST(Dataset):
 
 class FashionMNIST(MNIST):
     pass
+
+
+__all__ += ["Cifar10", "Cifar100", "Flowers", "DatasetFolder", "ImageFolder"]
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 (ref datasets/cifar.py). With `data_file` pointing at the
+    standard python-pickle tarball (or extracted batch files) it reads real
+    data; otherwise a deterministic synthetic set (class prototypes + noise,
+    split-consistent like MNIST above)."""
+
+    _n_classes = 10
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform=None, download: bool = False,
+                 backend: str = "numpy", synthetic_size: Optional[int] = None):
+        self.transform = transform
+        if data_file and os.path.exists(data_file):
+            self.images, self.labels = self._load_real(data_file, mode)
+        else:
+            n = synthetic_size or (5000 if mode == "train" else 1000)
+            base = np.random.default_rng(54321).standard_normal(
+                (self._n_classes, 3, 32, 32)).astype(np.float32)
+            rng = np.random.default_rng(0 if mode == "train" else 1)
+            self.labels = rng.integers(0, self._n_classes,
+                                       size=(n,)).astype(np.int64)
+            noise = 0.3 * rng.standard_normal((n, 3, 32, 32)) \
+                .astype(np.float32)
+            self.images = base[self.labels] + noise
+
+    def _load_real(self, data_file, mode):
+        import pickle
+        import tarfile
+        label_key = b"labels" if self._n_classes == 10 else b"fine_labels"
+        imgs, labels = [], []
+
+        def want(name):
+            if self._n_classes == 10:
+                return ("data_batch" in name) if mode == "train" \
+                    else ("test_batch" in name)
+            return name.endswith("train" if mode == "train" else "test")
+
+        if tarfile.is_tarfile(data_file):
+            with tarfile.open(data_file) as tf:
+                for m in tf.getmembers():
+                    if m.isfile() and want(m.name):
+                        d = pickle.load(tf.extractfile(m), encoding="bytes")
+                        imgs.append(d[b"data"])
+                        labels.extend(d[label_key])
+        else:
+            with open(data_file, "rb") as f:
+                d = pickle.load(f, encoding="bytes")
+            imgs.append(d[b"data"])
+            labels.extend(d[label_key])
+        images = np.concatenate(imgs).reshape(-1, 3, 32, 32) \
+            .astype(np.float32) / 255.0
+        return images, np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    _n_classes = 100
+
+
+class Flowers(Cifar10):
+    """Flowers102-style dataset; synthetic fallback (ref datasets/flowers.py
+    — real download is unavailable in this environment)."""
+
+    _n_classes = 102
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode: str = "train", transform=None, download: bool = False,
+                 backend: str = "numpy", synthetic_size: Optional[int] = None):
+        if data_file or label_file or setid_file:
+            raise NotImplementedError(
+                "Flowers: reading the real .mat files is not supported in "
+                "this build (no scipy.io loader wired); only the synthetic "
+                "mode is available — do not pass data/label/setid files")
+        super().__init__(data_file=None, mode=mode, transform=transform,
+                         synthetic_size=synthetic_size or
+                         (1020 if mode == "train" else 102))
+
+
+def _default_loader(path: str):
+    if path.endswith(".npy"):
+        return np.load(path)
+    try:
+        import PIL.Image
+        with PIL.Image.open(path) as img:
+            return np.asarray(img.convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError(
+            f"cannot load {path}: PIL unavailable and not a .npy file") from e
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".npy")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory dataset (ref datasets/folder.py):
+    root/class_x/xxx.ext -> (sample, class_index)."""
+
+    def __init__(self, root: str, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = tuple(extensions) if extensions else IMG_EXTENSIONS
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class directories under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = is_valid_file(path) if is_valid_file else \
+                        fname.lower().endswith(extensions)
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (unlabeled) image folder (ref datasets/folder.py ImageFolder):
+    returns [sample] per item."""
+
+    def __init__(self, root: str, loader=None, extensions=None,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        extensions = tuple(extensions) if extensions else IMG_EXTENSIONS
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = is_valid_file(path) if is_valid_file else \
+                    fname.lower().endswith(extensions)
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -24,14 +24,13 @@ class Compose:
 
 class Normalize:
     def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
-        self.mean = np.asarray(mean, dtype=np.float32)
-        self.std = np.asarray(std, dtype=np.float32)
+        self.mean = mean
+        self.std = std
         self.data_format = data_format
 
     def __call__(self, img):
-        img = np.asarray(img, dtype=np.float32)
-        shape = (-1, 1, 1) if self.data_format == "CHW" else (1, 1, -1)
-        return (img - self.mean.reshape(shape)) / self.std.reshape(shape)
+        from . import functional as _F
+        return _F.normalize(img, self.mean, self.std, self.data_format)
 
 
 class ToTensor:
@@ -39,14 +38,8 @@ class ToTensor:
         self.data_format = data_format
 
     def __call__(self, img):
-        img = np.asarray(img, dtype=np.float32)
-        if img.max() > 1.0:
-            img = img / 255.0
-        if img.ndim == 2:
-            img = img[None] if self.data_format == "CHW" else img[..., None]
-        elif self.data_format == "CHW" and img.shape[-1] in (1, 3, 4):
-            img = img.transpose(2, 0, 1)
-        return img
+        from . import functional as _F
+        return _F.to_tensor(img, self.data_format)
 
 
 class Resize:
@@ -129,3 +122,175 @@ class Transpose:
 
     def __call__(self, img):
         return np.asarray(img).transpose(self.order)
+
+
+# -- 2nd wave: functional-backed transforms (ref transforms/transforms.py) --
+
+from . import functional as F  # noqa: E402
+functional = F
+
+__all__ += ["functional", "RandomVerticalFlip", "RandomResizedCrop",
+            "RandomRotation", "ColorJitter", "BrightnessTransform",
+            "ContrastTransform", "SaturationTransform", "HueTransform",
+            "Grayscale", "Pad", "RandomErasing"]
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob: float = 0.5):
+        self.prob = prob
+        self._rng = np.random.default_rng()
+
+    def __call__(self, img):
+        if self._rng.random() < self.prob:
+            return F.vflip(img)
+        return img
+
+
+class RandomResizedCrop:
+    """Random area/aspect crop then resize (ref RandomResizedCrop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4., 4. / 3.),
+                 interpolation: str = "bilinear"):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+        self._rng = np.random.default_rng()
+
+    def __call__(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = arr.shape[1:3] if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = self._rng.uniform(*self.scale) * area
+            log_r = self._rng.uniform(np.log(self.ratio[0]),
+                                      np.log(self.ratio[1]))
+            aspect = np.exp(log_r)
+            tw = int(round(np.sqrt(target * aspect)))
+            th = int(round(np.sqrt(target / aspect)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = int(self._rng.integers(0, h - th + 1))
+                j = int(self._rng.integers(0, w - tw + 1))
+                return F.resize(F.crop(arr, i, j, th, tw), self.size,
+                                self.interpolation)
+        return F.resize(F.center_crop(arr, min(h, w)), self.size,
+                        self.interpolation)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation: str = "nearest",
+                 expand: bool = False, center=None, fill=0):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+        self._rng = np.random.default_rng()
+
+    def __call__(self, img):
+        angle = float(self._rng.uniform(*self.degrees))
+        return F.rotate(img, angle, self.interpolation, self.expand,
+                        self.center, self.fill)
+
+
+class BrightnessTransform:
+    def __init__(self, value: float):
+        self.value = value
+        self._rng = np.random.default_rng()
+
+    def _factor(self):
+        return float(self._rng.uniform(max(0, 1 - self.value),
+                                       1 + self.value))
+
+    def __call__(self, img):
+        return F.adjust_brightness(img, self._factor())
+
+
+class ContrastTransform(BrightnessTransform):
+    def __call__(self, img):
+        return F.adjust_contrast(img, self._factor())
+
+
+class SaturationTransform(BrightnessTransform):
+    def __call__(self, img):
+        return F.adjust_saturation(img, self._factor())
+
+
+class HueTransform:
+    def __init__(self, value: float):
+        assert 0 <= value <= 0.5
+        self.value = value
+        self._rng = np.random.default_rng()
+
+    def __call__(self, img):
+        return F.adjust_hue(img, float(self._rng.uniform(-self.value,
+                                                         self.value)))
+
+
+class ColorJitter:
+    """Randomly-ordered brightness/contrast/saturation/hue jitter."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self.transforms = []
+        if brightness:
+            self.transforms.append(BrightnessTransform(brightness))
+        if contrast:
+            self.transforms.append(ContrastTransform(contrast))
+        if saturation:
+            self.transforms.append(SaturationTransform(saturation))
+        if hue:
+            self.transforms.append(HueTransform(hue))
+        self._rng = np.random.default_rng()
+
+    def __call__(self, img):
+        for idx in self._rng.permutation(len(self.transforms)):
+            img = self.transforms[idx](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels: int = 1):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode: str = "constant"):
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def __call__(self, img):
+        return F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class RandomErasing:
+    """Random cutout rectangle (ref RandomErasing)."""
+
+    def __init__(self, prob: float = 0.5, scale=(0.02, 0.33),
+                 ratio=(0.3, 3.3), value=0):
+        self.prob, self.scale, self.ratio, self.value = \
+            prob, scale, ratio, value
+        self._rng = np.random.default_rng()
+
+    def __call__(self, img):
+        if self._rng.random() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h, w = arr.shape[1:3] if chw else arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = self._rng.uniform(*self.scale) * area
+            aspect = np.exp(self._rng.uniform(np.log(self.ratio[0]),
+                                              np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * aspect)))
+            ew = int(round(np.sqrt(target / aspect)))
+            if eh < h and ew < w:
+                i = int(self._rng.integers(0, h - eh + 1))
+                j = int(self._rng.integers(0, w - ew + 1))
+                return F.erase(arr, i, j, eh, ew, self.value)
+        return arr

@@ -1,0 +1,139 @@
+"""Vision transforms (class + functional), datasets, and paddle.summary.
+
+Ref test models: test/legacy_test/test_transforms.py,
+test_datasets.py, test_model.py (summary)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, transforms
+from paddle_tpu.vision.transforms import functional as TF
+
+rng = np.random.default_rng(0)
+IMG = rng.uniform(0, 255, (24, 32, 3)).astype(np.uint8)
+CHW = IMG.transpose(2, 0, 1)
+
+
+class TestFunctional:
+    def test_resize_shapes_and_aspect(self):
+        assert TF.resize(IMG, (12, 16)).shape == (12, 16, 3)
+        assert TF.resize(IMG, 12).shape == (12, 16, 3)  # short edge
+        assert TF.resize(CHW, (12, 16)).shape == (3, 12, 16)
+
+    def test_flips_and_crop(self):
+        np.testing.assert_array_equal(TF.hflip(IMG), IMG[:, ::-1])
+        np.testing.assert_array_equal(TF.vflip(IMG), IMG[::-1])
+        np.testing.assert_array_equal(TF.crop(IMG, 2, 3, 10, 12),
+                                      IMG[2:12, 3:15])
+        assert TF.center_crop(IMG, 10).shape == (10, 10, 3)
+
+    def test_pad_modes(self):
+        assert TF.pad(IMG, 2).shape == (28, 36, 3)
+        assert TF.pad(IMG, (1, 2)).shape == (28, 34, 3)
+        assert TF.pad(IMG, (1, 2, 3, 4)).shape == (30, 36, 3)
+        assert TF.pad(CHW, 2, padding_mode="reflect").shape == (3, 28, 36)
+
+    def test_rotate(self):
+        # 360-degree rotation is identity up to nearest-sampling
+        out = TF.rotate(IMG, 360.0)
+        assert (out == IMG).mean() > 0.95
+        assert TF.rotate(IMG, 45, expand=True).shape[0] > 24
+
+    def test_color_adjust_identity_factors(self):
+        np.testing.assert_array_equal(TF.adjust_brightness(IMG, 1.0), IMG)
+        assert np.abs(TF.adjust_contrast(IMG, 1.0).astype(int)
+                      - IMG.astype(int)).max() <= 1
+        assert np.abs(TF.adjust_saturation(IMG, 1.0).astype(int)
+                      - IMG.astype(int)).max() <= 1
+        np.testing.assert_array_equal(TF.adjust_hue(IMG, 0.0), IMG)
+
+    def test_grayscale(self):
+        g1 = TF.to_grayscale(IMG)
+        assert g1.shape == (24, 32, 1)
+        g3 = TF.to_grayscale(IMG, 3)
+        assert (g3[..., 0] == g3[..., 1]).all()
+
+    def test_erase(self):
+        out = TF.erase(IMG, 2, 3, 5, 6, 0)
+        assert (out[2:7, 3:9] == 0).all()
+        assert (IMG[2:7, 3:9] != 0).any()  # original untouched
+
+
+class TestTransformClasses:
+    def test_pipeline_end_to_end(self):
+        pipe = transforms.Compose([
+            transforms.RandomResizedCrop(16),
+            transforms.ColorJitter(0.2, 0.2, 0.2, 0.1),
+            transforms.RandomRotation(10),
+            transforms.RandomVerticalFlip(1.0),
+            transforms.Grayscale(3),
+            transforms.Pad(2),
+            transforms.RandomErasing(prob=1.0),
+            transforms.ToTensor(),
+            transforms.Normalize([0.5] * 3, [0.5] * 3),
+        ])
+        out = pipe(IMG)
+        assert out.shape == (3, 20, 20)
+        assert out.dtype == np.float32
+
+    def test_random_resized_crop_bounds(self):
+        t = transforms.RandomResizedCrop(8, scale=(0.5, 1.0))
+        for _ in range(5):
+            assert t(IMG).shape == (8, 8, 3)
+
+
+class TestDatasets:
+    def test_cifar_synthetic_learnable_split(self):
+        tr = datasets.Cifar10(mode="train", synthetic_size=32)
+        te = datasets.Cifar10(mode="test", synthetic_size=8)
+        img, lab = tr[0]
+        assert img.shape == (3, 32, 32) and 0 <= int(lab) < 10
+        assert len(tr) == 32 and len(te) == 8
+
+    def test_cifar_real_pickle_format(self, tmp_path):
+        import pickle
+        batch = {b"data": rng.integers(0, 256, (20, 3072)).astype(np.uint8),
+                 b"labels": list(rng.integers(0, 10, 20))}
+        p = tmp_path / "test_batch"
+        with open(p, "wb") as f:
+            pickle.dump(batch, f)
+        ds = datasets.Cifar10(data_file=str(p), mode="test")
+        img, lab = ds[3]
+        assert img.shape == (3, 32, 32) and len(ds) == 20
+        assert img.max() <= 1.0
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ["ant", "bee"]:
+            os.makedirs(tmp_path / cls)
+            for i in range(2):
+                np.save(tmp_path / cls / f"{i}.npy",
+                        np.zeros((4, 4, 3), np.float32))
+        ds = datasets.DatasetFolder(str(tmp_path))
+        assert ds.classes == ["ant", "bee"]
+        assert len(ds) == 4
+        assert ds[3][1] == 1
+        flat = datasets.ImageFolder(str(tmp_path))
+        assert len(flat) == 4 and flat[0][0].shape == (4, 4, 3)
+
+    def test_dataset_folder_empty_raises(self, tmp_path):
+        with pytest.raises(RuntimeError):
+            datasets.DatasetFolder(str(tmp_path))
+
+
+class TestSummary:
+    def test_summary_counts_and_shapes(self, capsys):
+        from paddle_tpu.vision.models import LeNet
+        info = paddle.summary(LeNet(10), (1, 1, 28, 28))
+        out = capsys.readouterr().out
+        assert info["total_params"] == 61610
+        assert "Conv2D" in out and "[1, 6, 28, 28]" in out
+        assert "Total params: 61,610" in out
+
+    def test_model_summary_delegates(self):
+        from paddle_tpu.vision.models import LeNet
+        m = paddle.Model(LeNet(10))
+        info = m.summary((1, 1, 28, 28))
+        assert info["total_params"] == 61610
