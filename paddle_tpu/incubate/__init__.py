@@ -1,1 +1,2 @@
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401

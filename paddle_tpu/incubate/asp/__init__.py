@@ -1,0 +1,161 @@
+"""ASP — automatic (semi-structured) sparsity.
+
+Ref: ``python/paddle/incubate/asp/asp.py`` — n:m fine-grained sparsity
+(default 2:4): prune weights so every m consecutive elements keep only the
+n largest in magnitude, record the masks, and keep pruned coordinates at
+zero through training by re-masking after every optimizer step
+(``OptimizerWithSparsityGuarantee``). On TPU the masked matmuls run dense
+(the MXU has no 2:4 sparse mode like sparse tensor cores), so ASP here is
+the *training-method* parity: mask computation, pruning, density checks,
+and the sparsity-preserving optimizer wrapper.
+"""
+
+from __future__ import annotations
+
+import weakref
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["calculate_density", "compute_mask_1d", "compute_mask_2d",
+           "check_sparsity", "prune_model", "decorate",
+           "set_excluded_layers", "reset_excluded_layers"]
+
+# Weak keys: a freed model must not leak its exclusion list or have it
+# mis-apply to a new object reusing the same address.
+_excluded: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zero entries (ref asp.py calculate_density)."""
+    arr = np.asarray(x)
+    return float(np.count_nonzero(arr)) / max(1, arr.size)
+
+
+def compute_mask_1d(weight, n: int = 2, m: int = 4) -> np.ndarray:
+    """n:m mask along the last dim:every m-block keeps the n largest |w|
+    (ref sparsity/utils.py get_mask_1d)."""
+    w = np.asarray(weight)
+    if w.shape[-1] % m:
+        raise ValueError(f"last dim {w.shape[-1]} not divisible by m={m}")
+    blocks = np.abs(w).reshape(-1, m)
+    order = np.argsort(-blocks, axis=1)[:, :n]
+    mask = np.zeros_like(blocks, dtype=bool)
+    np.put_along_axis(mask, order, True, axis=1)
+    return mask.reshape(w.shape)
+
+
+def compute_mask_2d(weight, n: int = 2, m: int = 4) -> np.ndarray:
+    """Greedy 2D n:m (ref get_mask_2d_greedy): over each m x m patch of the
+    trailing 2-D view, accept entries in descending |w| order while both the
+    patch row and patch column still have fewer than n accepted entries —
+    sparsity holds along rows AND columns. Rows are zero-padded to a
+    multiple of m when needed."""
+    w = np.asarray(weight)
+    if w.shape[-1] % m:
+        raise ValueError(f"last dim {w.shape[-1]} not divisible by m={m}")
+    mat = np.abs(w).reshape(-1, w.shape[-1])
+    rows, cols = mat.shape
+    pad_r = (-rows) % m
+    if pad_r:
+        mat = np.pad(mat, ((0, pad_r), (0, 0)))
+    mask = np.zeros_like(mat, dtype=bool)
+    for bi in range(0, mat.shape[0], m):
+        for bj in range(0, cols, m):
+            patch = mat[bi:bi + m, bj:bj + m]
+            order = np.dstack(np.unravel_index(
+                np.argsort(-patch, axis=None), (m, m)))[0]
+            rcount = np.zeros(m, dtype=int)
+            ccount = np.zeros(m, dtype=int)
+            for r, c in order:
+                if rcount[r] < n and ccount[c] < n:
+                    mask[bi + r, bj + c] = True
+                    rcount[r] += 1
+                    ccount[c] += 1
+    return mask[:rows].reshape(w.shape)
+
+
+def check_sparsity(weight, n: int = 2, m: int = 4) -> bool:
+    """True when every m-block along the last dim has <= n non-zeros."""
+    w = np.asarray(weight)
+    if w.shape[-1] % m:
+        return False
+    nz = (np.abs(w.reshape(-1, m)) > 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def set_excluded_layers(model, param_names: List[str]) -> None:
+    _excluded[model] = list(param_names)
+
+
+def reset_excluded_layers(model=None) -> None:
+    if model is None:
+        _excluded.clear()
+    else:
+        _excluded.pop(model, None)
+
+
+def _prunable(model, m: int):
+    """Multi-dim weights of Linear/Conv-style layers, minus exclusions."""
+    excluded = _excluded.get(model, [])
+    for name, ref in model.named_parameters():
+        if not name.endswith("weight"):
+            continue
+        if any(tag in name for tag in excluded):
+            continue
+        if len(ref.shape) >= 2 and ref.shape[-1] % m == 0:
+            yield name, ref
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True) -> Dict[str, np.ndarray]:
+    """Apply n:m pruning to the model's prunable weights in place; the
+    masks are recorded so decorate()d optimizers preserve them."""
+    algo = {"mask_1d": compute_mask_1d, "mask_2d_greedy": compute_mask_2d,
+            "mask_2d_best": compute_mask_2d}[mask_algo]
+    masks = {}
+    for name, ref in _prunable(model, m):
+        mask = algo(ref.value, n, m)
+        ref.value = ref.value * jnp.asarray(mask, dtype=ref.value.dtype)
+        masks[name] = mask
+        if with_mask:
+            # The mask lives on the owning layer keyed by attr name
+            # (ParamRef handles are recreated per collection and slotted):
+            # decorate()d optimizers find it by identity, immune to
+            # model-id reuse or name clashes.
+            setattr(ref.layer, f"_asp_mask_{ref.attr_name}", mask)
+    return masks
+
+
+class OptimizerWithSparsityGuarantee:
+    """Re-applies the recorded masks after every step (ref ASPHelper
+    decorate): pruned coordinates stay zero through training."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _refs_with_masks(self):
+        for ref in self._inner._refs():
+            mask = ref.layer.__dict__.get(f"_asp_mask_{ref.attr_name}")
+            if mask is not None:
+                yield ref, mask
+
+    def step(self):
+        self._inner.step()
+        for ref, mask in self._refs_with_masks():
+            ref.value = ref.value * jnp.asarray(mask,
+                                                dtype=ref.value.dtype)
+
+    def minimize(self, loss=None, **kw):
+        self.step()
+
+    def clear_grad(self):
+        self._inner.clear_grad()
+
+
+def decorate(optimizer) -> OptimizerWithSparsityGuarantee:
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,93 @@
+"""ASP n:m sparsity tests (ref test/legacy_test/test_asp_*.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import asp
+
+
+def test_mask_1d_keeps_top_n_per_block():
+    w = np.array([[1.0, -5.0, 2.0, 0.1, 9.0, 0.2, -3.0, 0.3]], np.float32)
+    mask = asp.compute_mask_1d(w, n=2, m=4)
+    np.testing.assert_array_equal(
+        mask, [[False, True, True, False, True, False, True, False]])
+
+
+def test_prune_and_density():
+    net = nn.Linear(8, 8, bias_attr=False)
+    masks = asp.prune_model(net, n=2, m=4)
+    assert len(masks) == 1
+    (ref,) = [r for r in net.parameters()]
+    assert abs(asp.calculate_density(ref.value) - 0.5) < 1e-6
+    assert asp.check_sparsity(np.asarray(ref.value), 2, 4)
+
+
+def test_decorated_optimizer_preserves_sparsity():
+    from paddle_tpu import autograd
+    net = nn.Linear(8, 4, bias_attr=False)
+    asp.prune_model(net, n=2, m=4)
+    opt = asp.decorate(optimizer.SGD(0.1, parameters=net.parameters()))
+    x = jnp.ones((2, 8))
+    for _ in range(3):
+        autograd.backward(net, lambda: jnp.sum(net(x) ** 2))
+        opt.step()
+        opt.clear_grad()
+    (ref,) = net.parameters()
+    assert asp.check_sparsity(np.asarray(ref.value), 2, 4)
+    assert abs(asp.calculate_density(ref.value) - 0.5) < 1e-6
+
+
+def test_excluded_layers():
+    net = nn.Sequential(nn.Linear(8, 8, bias_attr=False),
+                        nn.Linear(8, 8, bias_attr=False))
+    asp.set_excluded_layers(net, ["0.weight"])
+    masks = asp.prune_model(net, n=2, m=4)
+    assert list(masks) == ["1.weight"]
+    asp.reset_excluded_layers(net)
+
+
+def test_mask_2d_rows_and_columns_sparse():
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(8, 8)).astype(np.float32)
+    mask = asp.compute_mask_2d(w, n=2, m=4)
+    # every 4-wide row block and column block has <= 2 kept entries
+    for bi in range(0, 8, 4):
+        for bj in range(0, 8, 4):
+            patch = mask[bi:bi + 4, bj:bj + 4]
+            assert (patch.sum(axis=1) <= 2).all()
+            assert (patch.sum(axis=0) <= 2).all()
+
+
+def test_custom_nm_config():
+    net = nn.Linear(8, 4, bias_attr=False)
+    asp.prune_model(net, n=1, m=2)
+    (ref,) = net.parameters()
+    assert asp.check_sparsity(np.asarray(ref.value), 1, 2)
+    assert abs(asp.calculate_density(ref.value) - 0.5) < 1e-6
+
+
+def test_non_divisible_m_skipped():
+    net = nn.Linear(4, 6, bias_attr=False)  # weight [4, 6]: 6 % 4 != 0
+    assert asp.prune_model(net, n=2, m=4) == {}
+    assert asp.prune_model(net, n=1, m=2) != {}  # 6 % 2 == 0
+
+
+def test_training_still_learns_when_sparse():
+    from paddle_tpu import autograd
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(32, 8)).astype(np.float32))
+    y = jnp.asarray(rng.normal(size=(32, 1)).astype(np.float32))
+    net = nn.Linear(8, 1, bias_attr=False)
+    asp.prune_model(net, n=2, m=4)
+    opt = asp.decorate(optimizer.SGD(0.05, parameters=net.parameters()))
+    first = last = None
+    for _ in range(40):
+        loss = autograd.backward(
+            net, lambda: jnp.mean((net(x) - y) ** 2))
+        opt.step()
+        opt.clear_grad()
+        first = first or float(loss)
+        last = float(loss)
+    assert last < first * 0.9
