@@ -102,7 +102,10 @@ def _prunable(model, m: int):
     for name, ref in model.named_parameters():
         if not name.endswith("weight"):
             continue
-        if any(tag in name for tag in excluded):
+        # exact name or dot-suffix only — a substring tag like "0.weight"
+        # must not also catch "10.weight"
+        if any(name == tag or name.endswith("." + tag)
+               for tag in excluded):
             continue
         if len(ref.shape) >= 2 and ref.shape[-1] % m == 0:
             yield name, ref
@@ -112,8 +115,12 @@ def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
                 with_mask: bool = True) -> Dict[str, np.ndarray]:
     """Apply n:m pruning to the model's prunable weights in place; the
     masks are recorded so decorate()d optimizers preserve them."""
-    algo = {"mask_1d": compute_mask_1d, "mask_2d_greedy": compute_mask_2d,
-            "mask_2d_best": compute_mask_2d}[mask_algo]
+    if mask_algo == "mask_2d_best":
+        raise NotImplementedError(
+            "mask_2d_best (exhaustive patch search) is not implemented; "
+            "use 'mask_2d_greedy'")
+    algo = {"mask_1d": compute_mask_1d,
+            "mask_2d_greedy": compute_mask_2d}[mask_algo]
     masks = {}
     for name, ref in _prunable(model, m):
         mask = algo(ref.value, n, m)

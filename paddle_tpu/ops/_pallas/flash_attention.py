@@ -75,8 +75,17 @@ def _dot(a, b, dims):
 # Forward: grid (bh, num_q_blocks, num_k_blocks), k innermost (streamed).
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale, causal, block_q, block_k, seq_q, seq_k):
+def _seg_mask(s, segq_ref, segk_ref):
+    """Cross-segment entries get NEG_INF (packed-varlen attention).
+    seg refs hold one int32 per position, [1, block] rows."""
+    seg_q = segq_ref[0].T        # [bq, 1]
+    seg_k = segk_ref[0]          # [1, bk]
+    return jnp.where(seg_q == seg_k, s, NEG_INF)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr,
+                *, scale, causal, segmented, block_q, block_k, seq_q, seq_k):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -103,6 +112,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = _dot(q, kb, ((1,), (1,))) * scale  # [bq, bk] fp32
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        if segmented:
+            s = _seg_mask(s, segq_ref, segk_ref)
         m_prev = m_scr[...]
         l_prev = l_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -125,16 +136,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0] = (m_scr[...][:, :1] + jnp.log(l[:, :1])).T
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
-    """q,k,v: [BH, S, D] -> (o [BH, Sq, D], lse [BH, 1, Sq] fp32)."""
+def _segments_or_dummy(seg_q, seg_k, bh, sq, sk):
+    """Kernels take segment refs unconditionally (one code path); the dense
+    case feeds a [BH, 1, 1]-broadcastable dummy the specs tile for free."""
+    segmented = seg_q is not None
+    if not segmented:
+        seg_q = jnp.zeros((bh, 1, sq), jnp.int32)
+        seg_k = jnp.zeros((bh, 1, sk), jnp.int32)
+    return segmented, seg_q, seg_k
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None, seg_k=None):
+    """q,k,v: [BH, S, D] (+ optional [BH, 1, S] int32 segment ids)
+    -> (o [BH, Sq, D], lse [BH, 1, Sq] fp32)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    segmented, seg_q, seg_k = _segments_or_dummy(seg_q, seg_k, bh, sq, sk)
     grid = (bh, sq // block_q, sk // block_k)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                             block_q=block_q, block_k=block_k, seq_q=sq,
-                             seq_k=sk)
+                             segmented=segmented, block_q=block_q,
+                             block_k=block_k, seq_q=sq, seq_k=sk)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
@@ -142,6 +165,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -161,7 +186,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
             transcendentals=bh * sq * sk,
         ),
-    )(q, k, v)
+    )(q, k, v, seg_q, seg_k)
     return o, lse
 
 
@@ -169,8 +194,10 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
 # Backward dq: grid (bh, num_q_blocks, num_k_blocks), k streamed.
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_scr, *, scale, causal, block_q, block_k, seq_q, seq_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   segq_ref, segk_ref, dq_ref, dq_scr,
+                   *, scale, causal, segmented, block_q, block_k,
+                   seq_q, seq_k):
     qi = pl.program_id(1)
     kj = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -194,6 +221,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = _dot(q, kb, ((1,), (1,))) * scale
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        if segmented:
+            s = _seg_mask(s, segq_ref, segk_ref)
         p = jnp.exp(s - lse) * (s > NEG_INF / 2)
         dp = _dot(do, vb, ((1,), (1,)))
         ds = (p * (dp - delta) * scale).astype(kb.dtype)
@@ -209,8 +238,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 # ---------------------------------------------------------------------------
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr, *, scale, causal,
-                    block_q, block_k, seq_q, seq_k):
+                    segq_ref, segk_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale, causal, segmented, block_q, block_k,
+                    seq_q, seq_k):
     kj = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -235,6 +265,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         s = _dot(qb, kb, ((1,), (1,))) * scale  # [bq, bk]
         if causal:
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
+        if segmented:
+            s = _seg_mask(s, segq_ref, segk_ref)
         p = jnp.exp(s - lse) * (s > NEG_INF / 2)
         dv_scr[...] = dv_scr[...] + _dot(p.astype(dob.dtype), dob,
                                          ((0,), (0,)))
@@ -248,19 +280,21 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
+def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+         seg_q=None, seg_k=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
+    segmented, seg_q, seg_k = _segments_or_dummy(seg_q, seg_k, bh, sq, sk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # [BH, Sq]
     delta = delta[:, None, :]  # [BH, 1, Sq] — matches the slim lse layout
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_q=sq,
-                          seq_k=sk),
+                          segmented=segmented, block_q=block_q,
+                          block_k=block_k, seq_q=sq, seq_k=sk),
         grid=(bh, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -269,16 +303,18 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, seg_q, seg_k)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_q=sq,
-                          seq_k=sk),
+                          segmented=segmented, block_q=block_q,
+                          block_k=block_k, seq_q=sq, seq_k=sk),
         grid=(bh, sk // block_k, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -287,6 +323,8 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -300,7 +338,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-    )(k, v, q, do, lse, delta)
+    )(k, v, q, do, lse, delta, seg_q, seg_k)
     return dq, dk, dv
 
 
@@ -308,21 +346,22 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k):
 # custom_vjp wrapper, [B, S, H, D] public layout
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash_bhsd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k, seg_q, seg_k)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, seg_q, seg_k)
+    return o, (q, k, v, o, lse, seg_q, seg_k)
 
 
 def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
-    q, k, v, o, lse = res
-    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k)
-    return dq, dk, dv
+    q, k, v, o, lse, seg_q, seg_k = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+                      seg_q, seg_k)
+    return dq, dk, dv, None, None
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -338,11 +377,16 @@ def supported_shapes(query, key) -> bool:
 def flash_attention_pallas(query, key, value, causal: bool = False,
                            scale: Optional[float] = None,
                            block_q: Optional[int] = None,
-                           block_k: Optional[int] = None):
+                           block_k: Optional[int] = None,
+                           segment_ids=None, segment_ids_k=None):
     """[B, S, H, D] flash attention via Pallas. Differentiable.
 
     Block sizes default to the autotuned table in ``_pick_blocks``; pass
-    explicit ``block_q``/``block_k`` to override."""
+    explicit ``block_q``/``block_k`` to override. ``segment_ids`` ([B, Sq]
+    int32) enables packed-varlen attention: tokens attend only keys with
+    an equal segment id (the TPU-native form of flash_attn_unpadded —
+    static shapes, sequences packed along S). ``segment_ids_k`` ([B, Sk])
+    defaults to ``segment_ids`` (self-attention packing)."""
     b, sq, h, d = query.shape
     sk = key.shape[1]
     auto_q, auto_k = _pick_blocks(sq, sk, d)
@@ -365,5 +409,20 @@ def flash_attention_pallas(query, key, value, causal: bool = False,
     q = to_bhsd(query, sq)
     k = to_bhsd(key, sk)
     v = to_bhsd(value, sk)
-    o = _flash_bhsd(q, k, v, float(scale), bool(causal), block_q, block_k)
+    seg_q = seg_k = None
+    if segment_ids is not None:
+        def per_head(seg, s, what):
+            seg = jnp.asarray(seg, jnp.int32)
+            if seg.shape != (b, s):
+                raise ValueError(
+                    f"{what} must be [batch, seq] = ({b}, {s}); "
+                    f"got {seg.shape}")
+            return jnp.repeat(seg[:, None, :], h,
+                              axis=1).reshape(b * h, 1, s)
+        seg_q = per_head(segment_ids, sq, "segment_ids")
+        seg_k = seg_q if segment_ids_k is None and sq == sk else \
+            per_head(segment_ids_k if segment_ids_k is not None
+                     else segment_ids, sk, "segment_ids_k")
+    o = _flash_bhsd(q, k, v, seg_q, seg_k, float(scale), bool(causal),
+                    block_q, block_k)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

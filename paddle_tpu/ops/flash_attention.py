@@ -95,12 +95,48 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         max_seqlen_q: int, max_seqlen_k: int,
                         scale: Optional[float] = None, dropout: float = 0.0,
                         causal: bool = False):
-    """Varlen parity (ref flash_attn_kernel.cu:289). XLA needs static shapes,
-    so varlen is expressed as a padded batch + segment mask (the TPU idiom —
-    bucketing/padding policy per SURVEY §7 hard-part (c))."""
-    # cu_seqlens: [B+1] prefix sums. Build a segment mask and run dense.
+    """Varlen parity (ref flash_attn_kernel.cu:289). XLA needs static
+    shapes, so varlen is expressed with static totals (SURVEY §7
+    hard-part (c)):
+
+    - **fast path** (self-attention, tile-divisible packed length): run the
+      Pallas kernel directly on the packed [1, total, H, D] layout with
+      per-token segment ids — no padding FLOPs at all;
+    - fallback: scatter to a padded batch + segment-mask dense reference.
+    """
     b = cu_seqlens_q.shape[0] - 1
     total_q, h, d = query.shape
+    # Causal masking in the packed kernel uses global positions, which
+    # equals per-sequence causality only when q and k share boundaries;
+    # cu values are traced (uninspectable), so require the same object.
+    fast_ok = dropout == 0.0 and \
+        (not causal or cu_seqlens_q is cu_seqlens_k)
+    if fast_ok:
+        q4 = query[None]
+        k4 = key[None]
+        v4 = value[None]
+        if _use_pallas(q4, k4):
+            from ._pallas.flash_attention import flash_attention_pallas
+
+            def token_segments(cu, total, pad_sentinel):
+                # token -> sequence index; tail padding (tokens past
+                # cu[-1], if the caller padded the packed dim) gets a
+                # side-specific sentinel so q-padding and k-padding never
+                # match each other -> padded rows attend nothing and come
+                # out as the kernel's masked-row zeros
+                idx = jnp.arange(total)
+                seg = jnp.searchsorted(cu, idx, side="right") - 1
+                return jnp.where(idx < cu[-1], seg, pad_sentinel)
+
+            # q and k carry their own boundaries: cross-attention packings
+            # with different per-sequence splits stay correct
+            seg_q = token_segments(cu_seqlens_q, total_q, -1)
+            seg_k = token_segments(cu_seqlens_k, key.shape[0], -2)
+            out = flash_attention_pallas(q4, k4, v4, causal=causal,
+                                         scale=scale,
+                                         segment_ids=seg_q[None],
+                                         segment_ids_k=seg_k[None])
+            return out[0]
     # Scatter the packed tokens into [B, max_seqlen, H, D].
     def to_padded(x, cu, max_len):
         out = jnp.zeros((b, max_len, x.shape[-2], x.shape[-1]), x.dtype)

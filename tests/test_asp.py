@@ -91,3 +91,20 @@ def test_training_still_learns_when_sparse():
         first = first or float(loss)
         last = float(loss)
     assert last < first * 0.9
+
+
+def test_exclusion_is_suffix_match_not_substring():
+    layers = [nn.Linear(8, 8, bias_attr=False) for _ in range(11)]
+    net = nn.Sequential(*layers)
+    asp.set_excluded_layers(net, ["0.weight"])
+    masks = asp.prune_model(net, n=2, m=4)
+    assert "0.weight" not in masks
+    assert "10.weight" in masks  # substring of the tag, but a different layer
+    asp.reset_excluded_layers(net)
+
+
+def test_mask_2d_best_unimplemented():
+    import pytest as _pytest
+    net = nn.Linear(8, 8, bias_attr=False)
+    with _pytest.raises(NotImplementedError):
+        asp.prune_model(net, mask_algo="mask_2d_best")
