@@ -50,6 +50,9 @@ __all__ = [
     # geometry (2nd wave)
     "grid_sample", "affine_grid", "pixel_shuffle", "channel_shuffle",
     "unfold", "fold",
+    # 1-D conv/pool
+    "conv1d", "conv1d_transpose", "max_pool1d", "avg_pool1d",
+    "adaptive_avg_pool1d",
 ]
 
 
@@ -1198,3 +1201,80 @@ def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
             out = out.at[:, :, hi:hi + lh * s[0]:s[0],
                          wj:wj + lw * s[1]:s[1]].add(cols[:, :, i, j])
     return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+
+# ---------------------------------------------------------------------------
+# 1-D convolution / pooling (ref phi conv1d / pool1d kernels)
+# ---------------------------------------------------------------------------
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NCL"):
+    """x [N, C, L]; weight [out_c, in_c/groups, k]."""
+    assert data_format == "NCL"
+    (stride,) = _ntuple(stride, 1)
+    (dilation,) = _ntuple(dilation, 1)
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        (p,) = _ntuple(padding, 1)
+        pad = [(p, p)]
+    dn = lax.conv_dimension_numbers(x.shape, weight.shape,
+                                    ("NCH", "OIH", "NCH"))
+    out = lax.conv_general_dilated(
+        x, weight, window_strides=(stride,), padding=pad,
+        rhs_dilation=(dilation,), dimension_numbers=dn,
+        feature_group_count=groups).astype(x.dtype)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups: int = 1,
+                     output_size=None, data_format: str = "NCL"):
+    """weight [in_c, out_c/groups, k] (paddle transposed layout)."""
+    assert data_format == "NCL"
+    if output_size is not None:
+        (output_padding,) = _output_padding_from_size(
+            x, weight, stride, padding, dilation,
+            [output_size] if isinstance(output_size, int) else output_size,
+            1)
+    # reuse the 2-D core on a singleton height
+    out = _conv_transpose(x[:, :, None, :], weight[:, :, None, :], None,
+                          (1, _ntuple(stride, 1)[0]),
+                          (0, _ntuple(padding, 1)[0]),
+                          (0, _ntuple(output_padding, 1)[0]),
+                          (1, _ntuple(dilation, 1)[0]),
+                          groups, 2, "NCHW")
+    out = out[:, :, 0, :]
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NCL"):
+    assert data_format == "NCL"
+    out = max_pool2d(x[:, :, None, :],
+                     (1, _ntuple(kernel_size, 1)[0]),
+                     (1, _ntuple(stride if stride is not None
+                                 else kernel_size, 1)[0]),
+                     (0, _ntuple(padding, 1)[0]))
+    return out[:, :, 0, :]
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               data_format: str = "NCL"):
+    assert data_format == "NCL"
+    out = avg_pool2d(x[:, :, None, :],
+                     (1, _ntuple(kernel_size, 1)[0]),
+                     (1, _ntuple(stride if stride is not None
+                                 else kernel_size, 1)[0]),
+                     (0, _ntuple(padding, 1)[0]), exclusive=exclusive)
+    return out[:, :, 0, :]
+
+
+def adaptive_avg_pool1d(x, output_size: int, data_format: str = "NCL"):
+    assert data_format == "NCL"
+    out = adaptive_avg_pool2d(x[:, :, None, :], (1, output_size))
+    return out[:, :, 0, :]

@@ -40,6 +40,8 @@ __all__ = [
     "Conv3D", "Conv2DTranspose", "Conv3DTranspose", "MaxPool3D", "AvgPool3D",
     "MaxUnPool2D", "InstanceNorm2D", "LocalResponseNorm", "PixelShuffle",
     "ChannelShuffle", "Fold", "Dropout2D",
+    "Conv1D", "Conv1DTranspose", "MaxPool1D", "AvgPool1D",
+    "AdaptiveAvgPool1D",
 ]
 
 
@@ -951,3 +953,104 @@ class Fold(Layer):
     def forward(self, x):
         return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
                       self.paddings, self.dilations)
+
+
+# -- 1-D conv / pool layers --------------------------------------------------
+
+class Conv1D(Layer):
+    """weight [out, in/g, k] (ref nn/layer/conv.py Conv1D)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, dilation=1, groups: int = 1,
+                 padding_mode: str = "zeros", weight_attr=None,
+                 bias_attr=None, data_format: str = "NCL", dtype=None):
+        super().__init__(dtype=dtype)
+        (k,) = F._ntuple(kernel_size, 1)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.groups, self.data_format = groups, data_format
+        fan_in = in_channels // groups * k
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, k), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in,
+                                                 negative_slope=math.sqrt(5),
+                                                 nonlinearity="leaky_relu"))
+        if bias_attr is not False:
+            bound = 1 / math.sqrt(fan_in) if fan_in > 0 else 0
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return F.conv1d(x, self.weight, self.bias, self.stride,
+                        self.padding, self.dilation, self.groups,
+                        self.data_format)
+
+
+class Conv1DTranspose(Layer):
+    """weight [in, out/g, k] (paddle transposed layout)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size,
+                 stride=1, padding=0, output_padding=0, dilation=1,
+                 groups: int = 1, weight_attr=None, bias_attr=None,
+                 data_format: str = "NCL", dtype=None):
+        super().__init__(dtype=dtype)
+        (k,) = F._ntuple(kernel_size, 1)
+        self.stride, self.padding = stride, padding
+        self.output_padding, self.dilation = output_padding, dilation
+        self.groups, self.data_format = groups, data_format
+        fan_in = in_channels // groups * k
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups, k), attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in,
+                                                 negative_slope=math.sqrt(5),
+                                                 nonlinearity="leaky_relu"))
+        if bias_attr is not False:
+            bound = 1 / math.sqrt(fan_in) if fan_in > 0 else 0
+            self.bias = self.create_parameter(
+                (out_channels,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self.stride,
+                                  self.padding, self.output_padding,
+                                  self.dilation, self.groups, output_size,
+                                  self.data_format)
+
+
+class MaxPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCL"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            self.data_format)
+
+
+class AvgPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 data_format: str = "NCL"):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = \
+            kernel_size, stride, padding
+        self.exclusive, self.data_format = exclusive, data_format
+
+    def forward(self, x):
+        return F.avg_pool1d(x, self.kernel_size, self.stride, self.padding,
+                            self.exclusive, self.data_format)
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size: int):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)

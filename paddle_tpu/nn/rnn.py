@@ -1,0 +1,312 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the recurrence is a ``jax.lax.scan`` over time — one
+compiled loop whose per-step matmuls hit the MXU, instead of the
+reference's C++ cudnn/RNN ops. Cells follow paddle's equations (identical
+to torch's): gate order i,f,c(g),o for LSTM; r,z,c for GRU with the reset
+gate applied to the *hidden projection* (paddle/torch convention).
+
+Layout: inputs [batch, time, size] (``time_major=False`` default) like the
+reference.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import functional as F
+from . import initializer as I
+from .layer import Layer
+from .layers import LayerList
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size: int, hidden_size: int, n_gates: int,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, dtype=None):
+        super().__init__(dtype=dtype)
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        k = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-k, k)
+        g = n_gates * hidden_size
+        self.weight_ih = self.create_parameter(
+            (g, input_size), attr=weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            (g, hidden_size), attr=weight_hh_attr, default_initializer=init)
+        if bias_ih_attr is not False:
+            self.bias_ih = self.create_parameter(
+                (g,), attr=bias_ih_attr, is_bias=True,
+                default_initializer=init)
+        else:
+            self.bias_ih = None
+        if bias_hh_attr is not False:
+            self.bias_hh = self.create_parameter(
+                (g,), attr=bias_hh_attr, is_bias=True,
+                default_initializer=init)
+        else:
+            self.bias_hh = None
+
+    def _proj(self, x, h):
+        gi = x @ self.weight_ih.T
+        gh = h @ self.weight_hh.T
+        if self.bias_ih is not None:
+            gi = gi + self.bias_ih
+        if self.bias_hh is not None:
+            gh = gh + self.bias_hh
+        return gi, gh
+
+    def get_initial_states(self, batch: int, dtype=jnp.float32):
+        shape = (batch, self.hidden_size)
+        if len(self.state_shape) > 1:
+            return tuple(jnp.zeros(shape, dtype) for _ in self.state_shape)
+        return jnp.zeros(shape, dtype)  # single-state cells carry a bare h
+
+
+class SimpleRNNCell(_RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh) (ref rnn.py SimpleRNNCell)."""
+
+    state_shape = ("h",)
+
+    def __init__(self, input_size, hidden_size, activation: str = "tanh",
+                 **kwargs):
+        super().__init__(input_size, hidden_size, 1, **kwargs)
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else \
+            self.get_initial_states(inputs.shape[0], inputs.dtype)
+        if isinstance(h, (tuple, list)):
+            h = h[0]
+        gi, gh = self._proj(inputs, h)
+        act = jnp.tanh if self.activation == "tanh" else F.relu
+        h_new = act(gi + gh)
+        return h_new, h_new
+
+
+class LSTMCell(_RNNCellBase):
+    """Gate order (i, f, g, o) like the reference; returns (h, (h, c))."""
+
+    state_shape = ("h", "c")
+
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__(input_size, hidden_size, 4, **kwargs)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0], inputs.dtype)
+        h, c = states
+        gi, gh = self._proj(inputs, h)
+        i, f, g, o = jnp.split(gi + gh, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_RNNCellBase):
+    """Gates (r, z, c); reset gate scales the hidden projection of the
+    candidate (paddle/torch convention)."""
+
+    state_shape = ("h",)
+
+    def __init__(self, input_size, hidden_size, **kwargs):
+        super().__init__(input_size, hidden_size, 3, **kwargs)
+
+    def forward(self, inputs, states=None):
+        h = states if states is not None else \
+            self.get_initial_states(inputs.shape[0], inputs.dtype)
+        if isinstance(h, (tuple, list)):
+            h = h[0]
+        gi, gh = self._proj(inputs, h)
+        i_r, i_z, i_c = jnp.split(gi, 3, axis=-1)
+        h_r, h_z, h_c = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(i_r + h_r)
+        z = jax.nn.sigmoid(i_z + h_z)
+        c = jnp.tanh(i_c + r * h_c)
+        h_new = (1.0 - z) * c + z * h
+        return h_new, h_new
+
+
+def _scan_cell(cell, inputs, initial_states, reverse=False):
+    """Run `cell` over time with lax.scan using the cell's *functional*
+    form: parameters are closed over as traced values (the Layer tree is
+    read-only during the scan)."""
+    def step(states, x_t):
+        out, new_states = cell(x_t, states)
+        return new_states, out
+
+    xs = jnp.swapaxes(inputs, 0, 1)  # [T, B, C]
+    final, ys = jax.lax.scan(step, initial_states, xs, reverse=reverse)
+    return jnp.swapaxes(ys, 0, 1), final
+
+
+class RNN(Layer):
+    """Wraps a cell into a (batch, time, size) recurrence
+    (ref rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        if self.time_major:
+            inputs = jnp.swapaxes(inputs, 0, 1)
+        if initial_states is None:
+            initial_states = self.cell.get_initial_states(
+                inputs.shape[0], inputs.dtype)
+        out, final = _scan_cell(self.cell, inputs, initial_states,
+                                reverse=self.is_reverse)
+        if self.time_major:
+            out = jnp.swapaxes(out, 0, 1)
+        return out, final
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (ref rnn.py BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major: bool = False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        if self.time_major:
+            inputs = jnp.swapaxes(inputs, 0, 1)
+        if initial_states is None:
+            states_fw = self.cell_fw.get_initial_states(inputs.shape[0],
+                                                        inputs.dtype)
+            states_bw = self.cell_bw.get_initial_states(inputs.shape[0],
+                                                        inputs.dtype)
+        else:
+            states_fw, states_bw = initial_states
+        out_fw, fin_fw = _scan_cell(self.cell_fw, inputs, states_fw)
+        out_bw, fin_bw = _scan_cell(self.cell_bw, inputs, states_bw,
+                                    reverse=True)
+        out = jnp.concatenate([out_fw, out_bw], axis=-1)
+        if self.time_major:
+            out = jnp.swapaxes(out, 0, 1)
+        return out, (fin_fw, fin_bw)
+
+
+class _StackedRNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrence
+    (ref rnn.py SimpleRNN/LSTM/GRU)."""
+
+    _cell_cls = None
+    _n_states = 1
+
+    def __init__(self, input_size: int, hidden_size: int,
+                 num_layers: int = 1, direction: str = "forward",
+                 time_major: bool = False, dropout: float = 0.0,
+                 activation: Optional[str] = None,
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, dtype=None):
+        super().__init__(dtype=dtype)
+        if direction not in ("forward", "bidirect", "bidirectional"):
+            raise ValueError(f"unknown direction {direction!r}")
+        self.bidirectional = direction != "forward"
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.hidden_size = hidden_size
+        n_dir = 2 if self.bidirectional else 1
+        kwargs = dict(weight_ih_attr=weight_ih_attr,
+                      weight_hh_attr=weight_hh_attr,
+                      bias_ih_attr=bias_ih_attr, bias_hh_attr=bias_hh_attr,
+                      dtype=dtype)
+        if activation is not None:
+            kwargs["activation"] = activation
+        cells = []
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * n_dir
+            for _ in range(n_dir):
+                cells.append(self._cell_cls(in_sz, hidden_size, **kwargs))
+        self.cells = LayerList(cells)
+
+    def forward(self, inputs, initial_states=None):
+        if self.time_major:
+            inputs = jnp.swapaxes(inputs, 0, 1)
+        batch = inputs.shape[0]
+        n_dir = 2 if self.bidirectional else 1
+        out = inputs
+        finals = []
+        for layer in range(self.num_layers):
+            cell_fw = self.cells[layer * n_dir]
+            init_fw = self._layer_init(initial_states, layer, 0, batch,
+                                       inputs.dtype, cell_fw)
+            out_fw, fin_fw = _scan_cell(cell_fw, out, init_fw)
+            if self.bidirectional:
+                cell_bw = self.cells[layer * n_dir + 1]
+                init_bw = self._layer_init(initial_states, layer, 1, batch,
+                                           inputs.dtype, cell_bw)
+                out_bw, fin_bw = _scan_cell(cell_bw, out, init_bw,
+                                            reverse=True)
+                out = jnp.concatenate([out_fw, out_bw], axis=-1)
+                finals += [fin_fw, fin_bw]
+            else:
+                out = out_fw
+                finals.append(fin_fw)
+            if self.dropout and layer != self.num_layers - 1 \
+                    and self.training:
+                out = F.dropout(out, self.dropout, training=True)
+        final_states = self._stack_finals(finals)
+        if self.time_major:
+            out = jnp.swapaxes(out, 0, 1)
+        return out, final_states
+
+    def _layer_init(self, initial_states, layer, direction, batch, dtype,
+                    cell):
+        if initial_states is None:
+            return cell.get_initial_states(batch, dtype)
+        idx = layer * (2 if self.bidirectional else 1) + direction
+        if self._n_states == 2:
+            h, c = initial_states
+            return (h[idx], c[idx])
+        h = initial_states
+        return h[idx]
+
+    def _stack_finals(self, finals):
+        if self._n_states == 2:
+            hs = jnp.stack([f[0] for f in finals])
+            cs = jnp.stack([f[1] for f in finals])
+            return (hs, cs)
+        return jnp.stack(finals)
+
+
+class SimpleRNN(_StackedRNNBase):
+    _cell_cls = SimpleRNNCell
+    _n_states = 1
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, activation=activation,
+                         **kwargs)
+
+
+class LSTM(_StackedRNNBase):
+    _cell_cls = LSTMCell
+    _n_states = 2
+
+
+class GRU(_StackedRNNBase):
+    _cell_cls = GRUCell
+    _n_states = 1

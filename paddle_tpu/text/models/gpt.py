@@ -126,6 +126,33 @@ class GPTAttention(nn.Layer):
         out = self.out_proj(out)
         return self.dropout(out)
 
+    def decode(self, x, cache, offset):
+        """Incremental attention with a KV cache.
+
+        x: [b, s, h] new tokens (s = prompt len at prefill, 1 per decode
+        step); cache: (k, v) each [b, max_len, heads, head_dim]; offset:
+        traced scalar — how many positions are already cached. Returns
+        (out [b, s, h], new_cache). The cache is written with
+        dynamic_update_slice (traced offsets compose with lax.scan), and
+        attention masks keys past offset+s plus intra-block causality.
+        """
+        b, s, h = x.shape
+        qkv = self.qkv_proj(x).reshape(b, s, 3, self.num_heads,
+                                       self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_cache, v_cache = cache
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, offset, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, offset, 0, 0))
+        max_len = k_cache.shape[1]
+        q_pos = offset + jnp.arange(s)              # [s]
+        k_pos = jnp.arange(max_len)                 # [max_len]
+        mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,s,max]
+        out = F.scaled_dot_product_attention(
+            q, k_cache, v_cache, attn_mask=mask, is_causal=False,
+            training=False)
+        out = self.out_proj(out.reshape(b, s, h))
+        return out, (k_cache, v_cache)
+
 
 class GPTMLP(nn.Layer):
     def __init__(self, cfg: GPTConfig):
@@ -174,6 +201,12 @@ class GPTBlock(nn.Layer):
                                   policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)(x)
         return self._inner(x)
 
+    def decode(self, x, cache, offset):
+        attn_out, cache = self.attn.decode(self.ln_1(x), cache, offset)
+        x = x + attn_out
+        x = x + self.mlp(self.ln_2(x))
+        return x, cache
+
 
 class GPT(nn.Layer):
     def __init__(self, cfg: GPTConfig):
@@ -196,6 +229,24 @@ class GPT(nn.Layer):
         for block in self.h:
             x = block(x)
         return self.ln_f(x)
+
+    def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
+        head_dim = self.cfg.hidden_size // self.cfg.num_heads
+        shape = (batch, max_len, self.cfg.num_heads, head_dim)
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in self.h]
+
+    def decode(self, input_ids, caches, offset):
+        """Forward with KV caches. input_ids [b, s]; offset = number of
+        already-cached positions (traced). Returns (hidden, new_caches)."""
+        b, s = input_ids.shape
+        pos = offset + jnp.arange(s)[None, :]
+        x = self.wte(input_ids) + self.wpe(pos)
+        new_caches = []
+        for block, cache in zip(self.h, caches):
+            x, cache = block.decode(x, cache, offset)
+            new_caches.append(cache)
+        return self.ln_f(x), new_caches
 
 
 class GPTForCausalLM(nn.Layer):
@@ -223,3 +274,80 @@ class GPTForCausalLM(nn.Layer):
             return logits
         loss = self.loss_fn(logits, labels)
         return jnp.mean(loss)
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0,
+                 eos_token_id: Optional[int] = None, seed: int = 0):
+        """Autoregressive decoding with a KV cache
+        (ref paddlenlp-style generate; decode loop is one lax.scan —
+        compiled once, MXU matmuls per step).
+
+        Returns [b, prompt_len + max_new_tokens] token ids; positions after
+        an emitted eos are padded with eos.
+        """
+        input_ids = jnp.asarray(input_ids)
+        b, prompt_len = input_ids.shape
+        total = prompt_len + max_new_tokens
+        if total > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt {prompt_len} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_position_embeddings "
+                f"{self.cfg.max_position_embeddings}")
+        if max_new_tokens <= 0:
+            return input_ids
+        was_training = self.training
+        self.eval()  # dropout must be off in the decode loop
+        # Cache dtype must match the activations (bf16 under AMP O2).
+        act_dtype = self.gpt.wte.weight.dtype
+        caches = self.gpt.init_cache(b, total, dtype=act_dtype)
+        hidden, caches = self.gpt.decode(input_ids, caches, 0)
+        key = jax.random.PRNGKey(seed)
+
+        def pick(logits, key):
+            logits = logits / jnp.maximum(temperature, 1e-6)
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1)
+            if top_k:
+                kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+                logits = jnp.where(logits < kth, -jnp.inf, logits)
+            if top_p < 1.0:
+                sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+                probs = jax.nn.softmax(sorted_logits, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # smallest set with cumulative prob >= top_p (keep the
+                # first token crossing the threshold)
+                cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+                cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx,
+                                             axis=-1)
+                logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+            return jax.random.categorical(key, logits, axis=-1)
+
+        key, sub = jax.random.split(key)
+        next_tok = pick(self.logits(hidden[:, -1:])[:, 0], sub)
+        finished = (next_tok == eos_token_id) \
+            if eos_token_id is not None else None
+
+        def step(carry, _):
+            caches, tok, offset, key, finished = carry
+            hidden, caches = self.gpt.decode(tok[:, None], caches, offset)
+            key, sub = jax.random.split(key)
+            nxt = pick(self.logits(hidden)[:, 0], sub)
+            if finished is not None:
+                nxt = jnp.where(finished, eos_token_id, nxt)
+                finished = finished | (nxt == eos_token_id)
+            return (caches, nxt, offset + 1, key, finished), nxt
+
+        if max_new_tokens > 1:
+            (_, _, _, _, _), rest = jax.lax.scan(
+                step, (caches, next_tok, jnp.asarray(prompt_len), key,
+                       finished),
+                None, length=max_new_tokens - 1)
+            rest = jnp.swapaxes(rest, 0, 1)  # [b, T-1]
+            out = jnp.concatenate([input_ids, next_tok[:, None], rest],
+                                  axis=1)
+        else:
+            out = jnp.concatenate([input_ids, next_tok[:, None]], axis=1)
+        if was_training:
+            self.train()
+        return out

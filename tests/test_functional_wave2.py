@@ -291,3 +291,10 @@ def test_rrelu_modes():
     neg = np.asarray(x) < 0
     ratios = tr[neg] / np.asarray(x)[neg]
     assert ((ratios > 1 / 8 - 1e-6) & (ratios < 1 / 3 + 1e-6)).all()
+
+
+def test_conv1d_transpose_output_size():
+    x = jnp.asarray(rng.normal(size=(1, 2, 5)).astype(np.float32))
+    w = jnp.asarray(rng.normal(size=(2, 3, 3)).astype(np.float32))
+    out = F.conv1d_transpose(x, w, stride=2, output_size=[12])
+    assert out.shape == (1, 3, 12)

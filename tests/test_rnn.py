@@ -1,0 +1,181 @@
+"""RNN family vs the torch oracle (paddle and torch share cell equations).
+
+Ref test model: test/legacy_test/test_rnn_op.py and rnn/ tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu.nn as nn
+
+rng = np.random.default_rng(0)
+
+
+def _copy_cell_weights(cell, t_mod, suffix=""):
+    from paddle_tpu.nn.layer import Parameter
+    sd = {
+        f"weight_ih{suffix}": cell.weight_ih,
+        f"weight_hh{suffix}": cell.weight_hh,
+        f"bias_ih{suffix}": cell.bias_ih,
+        f"bias_hh{suffix}": cell.bias_hh,
+    }
+    for name, val in sd.items():
+        getattr(t_mod, name).data = torch.tensor(np.asarray(val))
+
+
+class TestCells:
+    def test_lstm_cell_matches_torch(self):
+        cell = nn.LSTMCell(6, 8)
+        tc = torch.nn.LSTMCell(6, 8)
+        _copy_cell_weights(cell, tc)
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        h0 = rng.normal(size=(3, 8)).astype(np.float32)
+        c0 = rng.normal(size=(3, 8)).astype(np.float32)
+        out, (h, c) = cell(jnp.asarray(x), (jnp.asarray(h0), jnp.asarray(c0)))
+        th, tcs = tc(torch.tensor(x), (torch.tensor(h0), torch.tensor(c0)))
+        np.testing.assert_allclose(np.asarray(h), th.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c), tcs.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_gru_cell_matches_torch(self):
+        cell = nn.GRUCell(6, 8)
+        tc = torch.nn.GRUCell(6, 8)
+        _copy_cell_weights(cell, tc)
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        h0 = rng.normal(size=(3, 8)).astype(np.float32)
+        out, h = cell(jnp.asarray(x), jnp.asarray(h0))
+        th = tc(torch.tensor(x), torch.tensor(h0))
+        np.testing.assert_allclose(np.asarray(h), th.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_simple_rnn_cell_matches_torch(self):
+        cell = nn.SimpleRNNCell(6, 8, activation="tanh")
+        tc = torch.nn.RNNCell(6, 8, nonlinearity="tanh")
+        _copy_cell_weights(cell, tc)
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        h0 = rng.normal(size=(3, 8)).astype(np.float32)
+        out, h = cell(jnp.asarray(x), jnp.asarray(h0))
+        th = tc(torch.tensor(x), torch.tensor(h0))
+        np.testing.assert_allclose(np.asarray(h), th.detach().numpy(),
+                                   atol=1e-5)
+
+
+def _copy_stacked(pt_rnn, t_rnn, num_layers, bidirectional):
+    n_dir = 2 if bidirectional else 1
+    for layer in range(num_layers):
+        for d in range(n_dir):
+            cell = pt_rnn.cells[layer * n_dir + d]
+            sfx = f"_l{layer}" + ("_reverse" if d else "")
+            for pt_name, t_name in [("weight_ih", f"weight_ih{sfx}"),
+                                    ("weight_hh", f"weight_hh{sfx}"),
+                                    ("bias_ih", f"bias_ih{sfx}"),
+                                    ("bias_hh", f"bias_hh{sfx}")]:
+                getattr(t_rnn, t_name).data = torch.tensor(
+                    np.asarray(getattr(cell, pt_name)))
+
+
+class TestStacked:
+    @pytest.mark.parametrize("bidirectional", [False, True])
+    def test_lstm_matches_torch(self, bidirectional):
+        L, B, T, I, H = 2, 3, 7, 5, 8
+        direction = "bidirect" if bidirectional else "forward"
+        m = nn.LSTM(I, H, num_layers=L, direction=direction)
+        t = torch.nn.LSTM(I, H, num_layers=L, batch_first=True,
+                          bidirectional=bidirectional)
+        _copy_stacked(m, t, L, bidirectional)
+        x = rng.normal(size=(B, T, I)).astype(np.float32)
+        out, (h, c) = m(jnp.asarray(x))
+        tout, (th, tc) = t(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h), th.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(c), tc.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_gru_matches_torch(self):
+        m = nn.GRU(5, 8, num_layers=2)
+        t = torch.nn.GRU(5, 8, num_layers=2, batch_first=True)
+        _copy_stacked(m, t, 2, False)
+        x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+        out, h = m(jnp.asarray(x))
+        tout, th = t(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                                   atol=1e-5)
+        np.testing.assert_allclose(np.asarray(h), th.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_simple_rnn_matches_torch(self):
+        m = nn.SimpleRNN(5, 8)
+        t = torch.nn.RNN(5, 8, batch_first=True, nonlinearity="tanh")
+        _copy_stacked(m, t, 1, False)
+        x = rng.normal(size=(3, 7, 5)).astype(np.float32)
+        out, h = m(jnp.asarray(x))
+        tout, th = t(torch.tensor(x))
+        np.testing.assert_allclose(np.asarray(out), tout.detach().numpy(),
+                                   atol=1e-5)
+
+    def test_time_major_layout(self):
+        m = nn.LSTM(5, 8, time_major=True)
+        x = jnp.asarray(rng.normal(size=(7, 3, 5)).astype(np.float32))
+        out, _ = m(x)
+        assert out.shape == (7, 3, 8)
+
+
+class TestWrappers:
+    def test_rnn_wrapper_reverse(self):
+        cell = nn.GRUCell(4, 6)
+        fwd = nn.RNN(cell)
+        rev = nn.RNN(cell, is_reverse=True)
+        x = jnp.asarray(rng.normal(size=(2, 5, 4)).astype(np.float32))
+        of, _ = fwd(x)
+        orv, _ = rev(x)
+        np.testing.assert_allclose(
+            np.asarray(orv),
+            np.asarray(fwd(x[:, ::-1])[0])[:, ::-1], atol=1e-6)
+
+    def test_birnn_concats(self):
+        b = nn.BiRNN(nn.GRUCell(4, 6), nn.GRUCell(4, 6))
+        x = jnp.asarray(rng.normal(size=(2, 5, 4)).astype(np.float32))
+        out, (ff, fb) = b(x)
+        assert out.shape == (2, 5, 12)
+
+    def test_lstm_trains(self):
+        from paddle_tpu import autograd, optimizer
+        m = nn.LSTM(4, 8)
+        head = nn.Linear(8, 1)
+        params = m.parameters() + head.parameters()
+        opt = optimizer.Adam(1e-2, parameters=params)
+        x = jnp.asarray(rng.normal(size=(8, 6, 4)).astype(np.float32))
+        y = jnp.asarray(rng.normal(size=(8, 1)).astype(np.float32))
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.m, self.head = m, head
+
+            def forward(self, x):
+                out, _ = self.m(x)
+                return self.head(out[:, -1])
+
+        net = Net()
+        first = last = None
+        for _ in range(30):
+            loss = autograd.backward(
+                net, lambda: jnp.mean((net(x) - y) ** 2))
+            opt.step()
+            opt.clear_grad()
+            first = first if first is not None else float(loss)
+            last = float(loss)
+        assert last < first * 0.5
+
+    def test_lstm_under_jit(self):
+        m = nn.LSTM(4, 8)
+        x = jnp.asarray(rng.normal(size=(2, 5, 4)).astype(np.float32))
+        eager, _ = m(x)
+        jitted, _ = jax.jit(lambda v: m(v))(x)
+        np.testing.assert_allclose(np.asarray(eager), np.asarray(jitted),
+                                   rtol=1e-5, atol=1e-6)
