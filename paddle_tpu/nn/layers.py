@@ -41,7 +41,7 @@ __all__ = [
     "MaxUnPool2D", "InstanceNorm2D", "LocalResponseNorm", "PixelShuffle",
     "ChannelShuffle", "Fold", "Dropout2D",
     "Conv1D", "Conv1DTranspose", "MaxPool1D", "AvgPool1D",
-    "AdaptiveAvgPool1D",
+    "AdaptiveAvgPool1D", "Bilinear",
 ]
 
 
@@ -1054,3 +1054,29 @@ class AdaptiveAvgPool1D(Layer):
 
     def forward(self, x):
         return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class Bilinear(Layer):
+    """out[b, o] = x1[b, :] @ W[o] @ x2[b, :] + bias
+    (ref nn/layer/common.py Bilinear; weight [out, in1, in2])."""
+
+    def __init__(self, in1_features: int, in2_features: int,
+                 out_features: int, weight_attr=None, bias_attr=None,
+                 name=None, dtype=None):
+        super().__init__(dtype=dtype)
+        bound = 1 / math.sqrt(in1_features)
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        if bias_attr is not False:
+            self.bias = self.create_parameter(
+                (out_features,), attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+
+    def forward(self, x1, x2):
+        out = jnp.einsum("bi,oij,bj->bo", x1, self.weight, x2)
+        if self.bias is not None:
+            out = out + self.bias
+        return out

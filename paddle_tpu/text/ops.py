@@ -1,0 +1,121 @@
+"""Sequence ops (ref: paddle.text.viterbi_decode / fluid sequence ops).
+
+viterbi_decode and gather_tree are lax.scan dynamic programs (TPU-friendly:
+static shapes, no host loops); edit_distance is a host-side numpy DP (its
+output is a scalar per pair and the reference computes it on CPU too).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["viterbi_decode", "edit_distance", "gather_tree", "shard_index"]
+
+
+def viterbi_decode(potentials, transition, lengths=None,
+                   include_bos_eos_tag: bool = False):
+    """CRF Viterbi decoding (ref paddle.text.viterbi_decode /
+    phi viterbi_decode kernel).
+
+    potentials: [B, T, N] unary emission scores; transition: [N, N]
+    (transition[i, j] = score of i -> j); lengths: [B] valid lengths.
+    Returns (scores [B], paths [B, T]).
+    """
+    if include_bos_eos_tag:
+        raise NotImplementedError(
+            "include_bos_eos_tag=True (implicit BOS/EOS transition rows) "
+            "is not implemented; append explicit BOS/EOS tags instead")
+    b, t, n = potentials.shape
+    if lengths is None:
+        lengths = jnp.full((b,), t, jnp.int32)
+
+    def step(carry, inp):
+        alpha, t_idx = carry
+        emit = inp  # [B, N]
+        # candidate[i, j] = alpha[i] + transition[i, j]
+        cand = alpha[:, :, None] + transition[None]       # [B, N, N]
+        best_prev = jnp.argmax(cand, axis=1)              # [B, N]
+        new_alpha = jnp.max(cand, axis=1) + emit
+        # positions past a sequence's length keep their alpha frozen
+        active = (t_idx < lengths)[:, None]
+        new_alpha = jnp.where(active, new_alpha, alpha)
+        best_prev = jnp.where(active, best_prev,
+                              jnp.arange(n)[None, :])
+        return (new_alpha, t_idx + 1), best_prev
+
+    alpha0 = potentials[:, 0]
+    (alpha, _), backptrs = jax.lax.scan(
+        step, (alpha0, jnp.asarray(1, jnp.int32)),
+        jnp.swapaxes(potentials[:, 1:], 0, 1))
+    scores = jnp.max(alpha, axis=-1)
+    last = jnp.argmax(alpha, axis=-1)                     # [B]
+
+    def backward(carry, ptrs):
+        tok = carry
+        prev = jnp.take_along_axis(ptrs, tok[:, None], axis=1)[:, 0]
+        return prev, tok
+
+    first, path_rev = jax.lax.scan(backward, last, backptrs, reverse=True)
+    paths = jnp.concatenate([first[None], path_rev], axis=0)  # [T, B]
+    return scores, jnp.swapaxes(paths, 0, 1)
+
+
+def edit_distance(hyps, refs, normalized: bool = True):
+    """Levenshtein distance per (hyp, ref) pair (ref fluid edit_distance
+    op). Accepts lists of int sequences; returns ([B, 1] distances,
+    [B] sequence count). Host-side numpy DP."""
+    if len(hyps) != len(refs):
+        raise ValueError(
+            f"edit_distance needs paired sequences; got {len(hyps)} "
+            f"hypotheses vs {len(refs)} references")
+    out = np.zeros((len(hyps), 1), np.float32)
+    for i, (h, r) in enumerate(zip(hyps, refs)):
+        h = list(np.asarray(h).reshape(-1))
+        r = list(np.asarray(r).reshape(-1))
+        m, n = len(h), len(r)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for x in range(1, m + 1):
+            prev_diag = dp[0]
+            dp[0] = x
+            for y in range(1, n + 1):
+                cur = dp[y]
+                dp[y] = min(dp[y] + 1, dp[y - 1] + 1,
+                            prev_diag + (h[x - 1] != r[y - 1]))
+                prev_diag = cur
+        d = float(dp[n])
+        out[i, 0] = d / max(n, 1) if normalized else d
+    return jnp.asarray(out), jnp.asarray(len(hyps))
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (ref phi gather_tree kernel): follow parent
+    pointers from the last step so every step holds the token of its final
+    beam. ids/parents: [T, B, W]. Returns [T, B, W]."""
+    t = ids.shape[0]
+
+    def step(beams, inp):
+        step_ids, step_parents = inp
+        tokens = jnp.take_along_axis(step_ids, beams, axis=-1)
+        parents = jnp.take_along_axis(step_parents, beams, axis=-1)
+        return parents, tokens
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2])[None, :],
+                            ids.shape[1:])
+    _, out = jax.lax.scan(step, init, (ids, parents), reverse=True)
+    return out
+
+
+def shard_index(input, index_num: int, nshards: int, shard_id: int,
+                ignore_value: int = -1):
+    """Recalculate label ids for a sharded embedding/classifier
+    (ref phi shard_index kernel): ids owned by `shard_id` map to their
+    local offset, others to `ignore_value`."""
+    shard_size = (index_num + nshards - 1) // nshards
+    lo = shard_id * shard_size
+    hi = lo + shard_size
+    in_shard = (input >= lo) & (input < hi)
+    return jnp.where(in_shard, input - lo, ignore_value)

@@ -1,0 +1,94 @@
+"""Sequence ops: viterbi_decode (vs exhaustive search), edit_distance,
+gather_tree, shard_index, nn.Bilinear (vs torch).
+
+Ref models: test/legacy_test/test_viterbi_decode_op.py,
+test_edit_distance_op.py, test_gather_tree_op.py, test_shard_index_op.py,
+test_bilinear_api.py."""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import torch
+
+import paddle_tpu.nn as nn
+from paddle_tpu.text import (edit_distance, gather_tree, shard_index,
+                             viterbi_decode)
+
+rng = np.random.default_rng(0)
+
+
+def test_viterbi_matches_exhaustive_search():
+    B, T, N = 2, 5, 4
+    pot = rng.normal(size=(B, T, N)).astype(np.float32)
+    trans = rng.normal(size=(N, N)).astype(np.float32)
+    scores, paths = viterbi_decode(jnp.asarray(pot), jnp.asarray(trans))
+    for b in range(B):
+        best, bestp = -1e9, None
+        for p in itertools.product(range(N), repeat=T):
+            s = pot[b, 0, p[0]] + sum(
+                trans[p[i - 1], p[i]] + pot[b, i, p[i]]
+                for i in range(1, T))
+            if s > best:
+                best, bestp = s, p
+        assert abs(float(scores[b]) - best) < 1e-4
+        assert tuple(np.asarray(paths[b])) == bestp
+
+
+def test_viterbi_respects_lengths():
+    B, T, N = 2, 6, 3
+    pot = rng.normal(size=(B, T, N)).astype(np.float32)
+    trans = rng.normal(size=(N, N)).astype(np.float32)
+    s_full, _ = viterbi_decode(jnp.asarray(pot[:, :4]), jnp.asarray(trans))
+    s_len, _ = viterbi_decode(jnp.asarray(pot), jnp.asarray(trans),
+                              lengths=jnp.asarray([4, 4]))
+    np.testing.assert_allclose(np.asarray(s_full), np.asarray(s_len),
+                               atol=1e-5)
+
+
+def test_edit_distance():
+    d, n = edit_distance([[1, 2, 3], [1, 1]], [[1, 3, 3], [2, 2, 2]],
+                         normalized=False)
+    assert d[0, 0] == 1 and d[1, 0] == 3
+    assert int(n) == 2
+    dn, _ = edit_distance([[1, 2, 3]], [[1, 3, 3]], normalized=True)
+    assert abs(float(dn[0, 0]) - 1 / 3) < 1e-6
+
+
+def test_shard_index():
+    out = shard_index(jnp.asarray([1, 7, 14, 19]), 20, 2, 0)
+    assert out.tolist() == [1, 7, -1, -1]
+    out = shard_index(jnp.asarray([1, 7, 14, 19]), 20, 2, 1)
+    assert out.tolist() == [-1, -1, 4, 9]
+
+
+def test_gather_tree():
+    ids = jnp.asarray(np.array([[[1, 2, 3]], [[4, 5, 6]], [[7, 8, 9]]]))
+    par = jnp.asarray(np.array([[[0, 0, 0]], [[0, 1, 1]], [[2, 1, 2]]]))
+    out = gather_tree(ids, par)
+    assert np.asarray(out)[:, 0, 0].tolist() == [2, 6, 7]
+
+
+def test_bilinear_matches_torch():
+    bl = nn.Bilinear(4, 5, 3)
+    tb = torch.nn.Bilinear(4, 5, 3)
+    tb.weight.data = torch.tensor(np.asarray(bl.weight))
+    tb.bias.data = torch.tensor(np.asarray(bl.bias))
+    x1 = rng.normal(size=(6, 4)).astype(np.float32)
+    x2 = rng.normal(size=(6, 5)).astype(np.float32)
+    got = np.asarray(bl(jnp.asarray(x1), jnp.asarray(x2)))
+    want = tb(torch.tensor(x1), torch.tensor(x2)).detach().numpy()
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_viterbi_bos_eos_unimplemented():
+    import pytest
+    with pytest.raises(NotImplementedError):
+        viterbi_decode(jnp.zeros((1, 3, 4)), jnp.zeros((4, 4)),
+                       include_bos_eos_tag=True)
+
+
+def test_edit_distance_mismatched_lengths_raise():
+    import pytest
+    with pytest.raises(ValueError, match="paired"):
+        edit_distance([[1], [2, 3]], [[9]])
