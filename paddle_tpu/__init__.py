@@ -52,6 +52,17 @@ from . import quantization  # noqa: F401
 from . import incubate  # noqa: F401
 from . import fft  # noqa: F401
 from . import text  # noqa: F401
+from . import signal  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import utils  # noqa: F401
+from .utils import flops  # noqa: F401
+from . import device  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import hub  # noqa: F401
+from . import onnx  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import reader  # noqa: F401
+from .batch import batch  # noqa: F401
 
 # paddle.Tensor alias: a Tensor IS a jax.Array.
 import jax as _jax

@@ -1,0 +1,28 @@
+"""Reader batching helper (``paddle.batch`` parity).
+
+Reference: ``python/paddle/batch.py`` — wraps a sample-level reader
+generator into a batch-level one.
+"""
+
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """Turn ``reader`` (a no-arg callable yielding samples) into a reader
+    yielding lists of ``batch_size`` samples."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

@@ -1,0 +1,13 @@
+"""Top-level callback namespace (``paddle.callbacks`` parity).
+
+Reference: ``python/paddle/callbacks.py`` re-exports the hapi callbacks.
+"""
+
+from .hapi.callbacks import (Callback, EarlyStopping,  # noqa: F401
+                             LRSchedulerCallback, ModelCheckpoint,
+                             ProgBarLogger)
+
+LRScheduler = LRSchedulerCallback  # paddle names the callback LRScheduler
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping"]

@@ -1,0 +1,64 @@
+"""Model-hub loading (``paddle.hub`` parity).
+
+Reference: ``python/paddle/hub.py`` — list/help/load driven by a repo's
+``hubconf.py``. Supports ``source='local'`` fully; github/gitee sources
+require network egress, which this environment does not have, so they raise
+with an actionable message instead of hanging on a download.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} found in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _resolve(repo_dir: str, source: str):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access; this environment "
+            f"has zero egress. Clone the repo and use source='local'.")
+    return _load_hubconf(os.path.expanduser(repo_dir))
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False):
+    """Entrypoint names exported by the repo's hubconf."""
+    mod = _resolve(repo_dir, source)
+    return [name for name in dir(mod)
+            if callable(getattr(mod, name)) and not name.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False):
+    """Docstring of one hub entrypoint."""
+    mod = _resolve(repo_dir, source)
+    if not hasattr(mod, model):
+        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate a hub entrypoint."""
+    mod = _resolve(repo_dir, source)
+    if not hasattr(mod, model):
+        raise ValueError(f"{model!r} not found in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model)(**kwargs)

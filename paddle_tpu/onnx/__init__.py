@@ -1,0 +1,30 @@
+"""Model export namespace (``paddle.onnx`` parity).
+
+Reference: ``python/paddle/onnx/export.py`` delegates to paddle2onnx to
+serialize an inference program. The TPU-native portable interchange format
+is StableHLO (the XLA ecosystem's ONNX analog): ``export`` lowers the model
+through ``paddle_tpu.jit.save`` and writes the ``.stablehlo.mlir`` module +
+weights next to ``path``. If the optional ``onnx`` package is installed, a
+real ONNX graph can additionally be produced via third-party converters —
+absent here (zero-dependency environment), so the StableHLO artifact is the
+product, loadable with ``paddle_tpu.jit.load`` or any StableHLO consumer.
+"""
+
+from __future__ import annotations
+
+from .. import jit as _jit
+
+__all__ = ["export"]
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs) -> str:
+    """Export ``layer`` for interchange; returns the artifact prefix.
+
+    ``opset_version`` is accepted for API parity; StableHLO is versioned by
+    its own serialization, not ONNX opsets.
+    """
+    if path.endswith(".onnx"):
+        path = path[:-5]
+    _jit.save(layer, path, input_spec=input_spec, **configs)
+    return path

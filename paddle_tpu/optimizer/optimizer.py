@@ -51,6 +51,15 @@ class Optimizer:
         self._learning_rate = learning_rate
         self._param_refs: Optional[List[ParamRef]] = \
             list(parameters) if parameters is not None else None
+        # paddle parity: weight_decay may be a float or a
+        # regularizer.L1Decay/L2Decay instance (ref python/paddle/regularizer.py).
+        from ..regularizer import L1Decay, L2Decay
+        self.l1_decay = 0.0
+        if isinstance(weight_decay, L1Decay):
+            self.l1_decay = weight_decay.coeff
+            weight_decay = 0.0
+        elif isinstance(weight_decay, L2Decay):
+            weight_decay = weight_decay.coeff
         self.weight_decay = float(weight_decay or 0.0)
         self.grad_clip = grad_clip
         self.multi_precision = multi_precision
@@ -127,6 +136,8 @@ class Optimizer:
             else:
                 p32 = _f32(p)
             g32 = _f32(g)
+            if self.l1_decay:
+                g32 = g32 + self.l1_decay * jnp.sign(p32)
             new_p32, st = self._update(name, p32, g32, st, lr, step)
             if "master" in st:
                 st["master"] = new_p32

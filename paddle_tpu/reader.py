@@ -1,0 +1,219 @@
+"""Reader decorators (``paddle.reader`` parity).
+
+Reference: ``python/paddle/reader/decorator.py`` — composable generator
+transforms predating DataLoader (shuffle/buffered/chain/compose/cache/
+firstn/map_readers/xmap_readers). The buffered/xmap variants use a
+background thread pool feeding a queue, same shape as the reference's
+implementation but without its multiprocess plumbing (the heavy path in
+this build is ``paddle_tpu.io.DataLoader``'s native shared-memory workers).
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers"]
+
+
+def cache(reader):
+    """Materialize the reader once; replay from memory afterwards."""
+    data = []
+    filled = threading.Event()
+    lock = threading.Lock()
+
+    def cached():
+        with lock:
+            if not filled.is_set():
+                data.clear()  # discard partial fill from a failed attempt
+                data.extend(reader())
+                filled.set()
+        return iter(data)
+
+    return cached
+
+
+def map_readers(func, *readers):
+    """Zip several readers and map ``func`` over the sample tuples."""
+
+    def reader():
+        for args in zip(*[r() for r in readers]):
+            yield func(*args)
+
+    return reader
+
+
+def shuffle(reader, buf_size: int):
+    """Shuffle within a sliding buffer of ``buf_size`` samples."""
+
+    def shuffled():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers end to end."""
+
+    def chained():
+        return itertools.chain(*[r() for r in readers])
+
+    return chained
+
+
+def compose(*readers, check_alignment: bool = True):
+    """Read in lockstep, yielding flattened tuples of parallel samples."""
+
+    def flatten(sample):
+        out = []
+        for item in sample:
+            if isinstance(item, tuple):
+                out.extend(item)
+            else:
+                out.append(item)
+        return tuple(out)
+
+    def composed():
+        iters = [r() for r in readers]
+        if check_alignment:
+            for samples in itertools.zip_longest(*iters):
+                if any(s is None for s in samples):
+                    raise RuntimeError("composed readers have different "
+                                       "lengths")
+                yield flatten(samples)
+        else:
+            for samples in zip(*iters):
+                yield flatten(samples)
+
+    return composed
+
+
+def firstn(reader, n: int):
+    """Only the first ``n`` samples."""
+
+    def limited():
+        return itertools.islice(reader(), n)
+
+    return limited
+
+
+_END = object()
+
+
+class _Raise:
+    """Producer-side exception carrier: re-raised in the consumer."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
+def buffered(reader, size: int):
+    """Decouple producer/consumer through a ``size``-bounded queue filled by
+    a daemon thread. Producer exceptions are forwarded and re-raised in the
+    consumer rather than truncating the stream."""
+
+    def buffered_reader():
+        q: queue.Queue = queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:  # noqa: BLE001 — forwarded, not dropped
+                q.put(_Raise(e))
+                return
+            q.put(_END)
+
+        threading.Thread(target=fill, daemon=True).start()
+        while True:
+            sample = q.get()
+            if sample is _END:
+                return
+            if isinstance(sample, _Raise):
+                raise sample.exc
+            yield sample
+
+    return buffered_reader
+
+
+def xmap_readers(mapper, reader, process_num: int, buffer_size: int,
+                 order: bool = False):
+    """Map ``mapper`` over the reader with ``process_num`` worker threads.
+
+    ``order=True`` preserves input order by tagging samples with sequence
+    numbers and releasing them in order.
+    """
+
+    def ordered_reader():
+        in_q: queue.Queue = queue.Queue(buffer_size)
+        out_q: queue.Queue = queue.Queue(buffer_size)
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:  # noqa: BLE001 — forwarded below
+                out_q.put(_Raise(e))
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_END)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _END:
+                    out_q.put(_END)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as e:  # noqa: BLE001 — forwarded below
+                    out_q.put(_Raise(e))
+                    out_q.put(_END)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        done = 0
+        if order:
+            pending = {}
+            expect = 0
+            while done < process_num:
+                item = out_q.get()
+                if item is _END:
+                    done += 1
+                    continue
+                if isinstance(item, _Raise):
+                    raise item.exc
+                i, mapped = item
+                pending[i] = mapped
+                while expect in pending:
+                    yield pending.pop(expect)
+                    expect += 1
+            for i in sorted(pending):
+                yield pending[i]
+        else:
+            while done < process_num:
+                item = out_q.get()
+                if item is _END:
+                    done += 1
+                    continue
+                if isinstance(item, _Raise):
+                    raise item.exc
+                yield item[1]
+
+    return ordered_reader

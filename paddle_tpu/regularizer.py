@@ -1,0 +1,38 @@
+"""Weight-decay regularizers (``paddle.regularizer`` parity).
+
+Reference: ``python/paddle/regularizer.py`` (L1Decay/L2Decay classes whose
+``__call__`` appends a decay term to the gradient inside the optimizer).
+Here they are lightweight coefficient holders consumed by
+``paddle_tpu.optimizer.Optimizer`` — L2 folds into the optimizer's coupled
+``weight_decay`` path, L1 adds ``coeff * sign(param)`` to the gradient before
+the update (both inside the jitted step, fused by XLA).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class _Regularizer:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(_Regularizer):
+    """Lasso penalty: adds ``coeff * sign(param)`` to the gradient."""
+
+    def __call__(self, grad, param):
+        return grad + self.coeff * jnp.sign(param)
+
+
+class L2Decay(_Regularizer):
+    """Ridge penalty: adds ``coeff * param`` to the gradient (coupled decay —
+    use AdamW's decoupled ``weight_decay`` for the AdamW-paper behavior)."""
+
+    def __call__(self, grad, param):
+        return grad + self.coeff * param

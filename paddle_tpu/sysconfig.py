@@ -1,0 +1,23 @@
+"""Install introspection (``paddle.sysconfig`` parity).
+
+Reference: ``python/paddle/sysconfig.py`` — get_include()/get_lib() for
+building C++ extensions against the install.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of native headers shipped with the package."""
+    return os.path.join(_PKG, "native")
+
+
+def get_lib() -> str:
+    """Directory containing the built native shared library."""
+    return os.path.join(_PKG, "native")

@@ -1,0 +1,132 @@
+"""Utility surface (``paddle.utils`` parity).
+
+Reference: ``python/paddle/utils/`` — deprecated.py, lazy_import.py
+(try_import), unique_name.py, install_check.py (run_check), flops.py,
+dlpack.py, download.py, cpp_extension/. Each maps to a TPU-native
+equivalent here; ``flops`` counts XLA-compiled FLOPs instead of walking a
+per-layer table, and ``cpp_extension`` drives the in-tree g++ build used for
+the native runtime pieces.
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import threading
+import warnings
+
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import unique_name  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["deprecated", "try_import", "run_check", "flops", "dlpack",
+           "download", "unique_name", "cpp_extension"]
+
+
+def deprecated(update_to: str = "", since: str = "", reason: str = "",
+               level: int = 1):
+    """Decorator marking an API deprecated; warns once per call site
+    (ref ``python/paddle/utils/deprecated.py``)."""
+
+    def decorator(func):
+        msg = f"API {func.__module__}.{func.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use {update_to} instead"
+        if reason:
+            msg += f". Reason: {reason}"
+        if level >= 2:
+            @functools.wraps(func)
+            def error_out(*a, **k):
+                raise RuntimeError(msg)
+            return error_out
+
+        @functools.wraps(func)
+        def wrapper(*a, **k):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*a, **k)
+
+        wrapper.__doc__ = (f"\n.. warning:: {msg}\n\n" + (func.__doc__ or ""))
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name: str):
+    """Import a soft dependency with an actionable error
+    (ref ``python/paddle/utils/lazy_import.py``)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            f"Optional dependency {module_name!r} is required for this "
+            f"feature but is not installed in this environment.") from e
+
+
+def run_check() -> None:
+    """Smoke-check the install: run a tiny jitted matmul on the default
+    device and, if multiple devices exist, a psum across all of them
+    (ref ``python/paddle/utils/install_check.py``)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    x = jnp.ones((128, 128), jnp.float32)
+    out = jax.jit(lambda a: a @ a)(x)
+    np.testing.assert_allclose(np.asarray(out[0, 0]), 128.0, rtol=1e-5)
+    n = jax.device_count()
+    if n > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()), ("d",))
+        arr = jax.device_put(jnp.arange(n, dtype=jnp.float32),
+                             NamedSharding(mesh, P("d")))
+        total = jax.jit(lambda a: jnp.sum(a))(arr)
+        np.testing.assert_allclose(np.asarray(total), n * (n - 1) / 2)
+    dev = jax.devices()[0]
+    print(f"paddle_tpu is installed successfully! device={dev}, "
+          f"device_count={n}")
+
+
+_flops_lock = threading.Lock()
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail: bool = False) -> int:
+    """Count the model's forward FLOPs (ref ``python/paddle/utils/flops.py``).
+
+    TPU-native twist: instead of a hand-maintained per-layer FLOP table, jit
+    the forward, lower it through XLA, and read the compiled
+    ``cost_analysis()`` — the number the hardware will actually execute
+    (fusions included). ``custom_ops`` is accepted for API parity but
+    unnecessary: every op XLA compiles is counted.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..framework.functional import functional_call, get_buffers, get_params
+
+    if inputs is None:
+        if input_size is None:
+            raise ValueError("provide input_size or inputs")
+        inputs = (jnp.asarray(
+            np.zeros(tuple(input_size), np.float32)),)
+    elif not isinstance(inputs, (tuple, list)):
+        inputs = (inputs,)
+    params = get_params(net)
+    buffers = get_buffers(net)
+
+    def fwd(p, *args):
+        return functional_call(net, p, *args, buffers=buffers, training=False)
+
+    with _flops_lock:
+        compiled = jax.jit(fwd).lower(params, *inputs).compile()
+    cost = compiled.cost_analysis()
+    if isinstance(cost, list):
+        cost = cost[0] if cost else {}
+    total = int(cost.get("flops", 0))
+    if print_detail:
+        print(f"Total Flops: {total} (XLA compiled cost analysis)")
+    return total

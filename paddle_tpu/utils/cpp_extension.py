@@ -1,0 +1,78 @@
+"""Custom native-extension build helpers (``paddle.utils.cpp_extension``
+parity).
+
+Reference: ``python/paddle/utils/cpp_extension/`` builds pybind11 custom ops
+into loadable .so files (``CppExtension``/``CUDAExtension``/``load``). The
+TPU-native analog: custom *device* kernels are written as Pallas (Python),
+so the native extension path exists for host-side runtime pieces (IO,
+queues, schedulers). ``load`` compiles C++ sources with the baked-in g++
+toolchain and returns a ``ctypes.CDLL`` — the same mechanism the in-tree
+native runtime uses (``paddle_tpu/native/build.py``).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+from typing import List, Optional, Sequence
+
+__all__ = ["CppExtension", "load", "get_build_directory"]
+
+
+def get_build_directory() -> str:
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    """Declarative description of a host-side C++ extension."""
+
+    def __init__(self, sources: Sequence[str],
+                 extra_compile_args: Optional[List[str]] = None,
+                 extra_link_args: Optional[List[str]] = None,
+                 include_dirs: Optional[List[str]] = None, name: str = ""):
+        self.name = name
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.extra_link_args = list(extra_link_args or [])
+        self.include_dirs = list(include_dirs or [])
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_cflags: Optional[List[str]] = None,
+         extra_ldflags: Optional[List[str]] = None,
+         extra_include_paths: Optional[List[str]] = None,
+         build_directory: Optional[str] = None,
+         verbose: bool = False) -> ctypes.CDLL:
+    """JIT-compile C++ sources into a shared library and dlopen it.
+
+    Recompiles only when a source is newer than the cached .so.
+    """
+    build_dir = build_directory or get_build_directory()
+    lib = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    for s in srcs:
+        if not os.path.isfile(s):
+            raise FileNotFoundError(s)
+    stale = (not os.path.exists(lib)
+             or any(os.path.getmtime(s) > os.path.getmtime(lib)
+                    for s in srcs))
+    if stale:
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-o",
+               lib + ".tmp", *srcs]
+        for inc in extra_include_paths or []:
+            cmd += ["-I", inc]
+        cmd += (extra_cxx_cflags or [])
+        cmd += (extra_ldflags or ["-lpthread"])
+        if verbose:
+            print("cpp_extension:", " ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"extension build failed:\n{proc.stderr}")
+        os.replace(lib + ".tmp", lib)
+    return ctypes.CDLL(lib)

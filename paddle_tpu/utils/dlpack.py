@@ -1,0 +1,26 @@
+"""DLPack interop (``paddle.utils.dlpack`` parity).
+
+Reference: ``python/paddle/utils/dlpack.py`` (to_dlpack/from_dlpack over
+``fluid/framework/dlpack_tensor.cc``). On JAX the exchange rides the
+standard ``__dlpack__`` protocol, so tensors move zero-copy between
+paddle_tpu, torch (CPU), and numpy.
+"""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack capsule."""
+    from jax import dlpack as jdl
+    return jdl.to_dlpack(x)
+
+
+def from_dlpack(capsule_or_array) -> jax.Array:
+    """Import a DLPack capsule or any ``__dlpack__``-bearing object
+    (torch/numpy/cupy tensor) as a paddle_tpu Tensor (jax.Array)."""
+    from jax import dlpack as jdl
+    return jdl.from_dlpack(capsule_or_array)

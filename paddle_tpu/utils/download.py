@@ -1,0 +1,47 @@
+"""Pretrained-weight cache resolution (``paddle.utils.download`` parity).
+
+Reference: ``python/paddle/utils/download.py`` (get_weights_path_from_url →
+``~/.cache/paddle/hapi/weights``). This build runs with zero network egress,
+so resolution is cache-only: a URL maps to its basename inside the cache
+directory (seeded out-of-band or by tests); a missing file raises with the
+exact path to provision instead of attempting a download.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url", "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_TPU_WEIGHTS_HOME", "~/.cache/paddle_tpu/weights"))
+
+
+def _check_md5(path: str, md5sum: str) -> bool:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_path_from_url(url: str, root_dir: str = WEIGHTS_HOME,
+                      md5sum: str | None = None,
+                      check_exist: bool = True) -> str:
+    fname = os.path.basename(url.split("?", 1)[0])
+    path = os.path.join(root_dir, fname)
+    if os.path.isfile(path):
+        if md5sum and not _check_md5(path, md5sum):
+            raise RuntimeError(
+                f"cached file {path} fails md5 check {md5sum}; remove it and "
+                f"re-provision")
+        return path
+    raise FileNotFoundError(
+        f"{fname} is not in the local weights cache and this environment has "
+        f"no network egress. Place the file at {path} (or set "
+        f"PADDLE_TPU_WEIGHTS_HOME) to use it.")
+
+
+def get_weights_path_from_url(url: str, md5sum: str | None = None) -> str:
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

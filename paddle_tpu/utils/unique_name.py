@@ -1,0 +1,47 @@
+"""Unique-name generator (``paddle.utils.unique_name`` parity).
+
+Reference: ``python/paddle/utils/unique_name.py`` — a per-prefix counter with
+``generate``/``guard``/``switch``. Used by layers to mint default parameter
+names.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import defaultdict
+
+__all__ = ["generate", "switch", "guard"]
+
+_lock = threading.Lock()
+_generators = [defaultdict(int)]
+
+
+def generate(key: str) -> str:
+    with _lock:
+        counters = _generators[-1]
+        n = counters[key]
+        counters[key] += 1
+    return f"{key}_{n}"
+
+
+def switch(new_generator=None):
+    """Replace the current counter set; returns the old one."""
+    with _lock:
+        old = _generators[-1]
+        _generators[-1] = new_generator if new_generator is not None \
+            else defaultdict(int)
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    """Scope with a fresh (or given) counter set, restored on exit."""
+    with _lock:
+        _generators.append(new_generator if new_generator is not None
+                           else defaultdict(int))
+    try:
+        yield
+    finally:
+        with _lock:
+            _generators.pop()

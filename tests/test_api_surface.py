@@ -1,0 +1,376 @@
+"""Tests for the top-level API parity modules: signal, regularizer, utils,
+device, hub, batch/reader, callbacks, sysconfig, onnx.
+
+Reference anchors: python/paddle/signal.py, regularizer.py, utils/,
+device/, hub.py, batch.py, reader/decorator.py.
+"""
+
+import os
+import tempfile
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------------------
+# signal
+# ---------------------------------------------------------------------------
+
+class TestSignal:
+    def test_frame_shapes(self):
+        x = jnp.arange(16.0)
+        f = paddle.signal.frame(x, 4, 2)
+        assert f.shape == (4, 7)
+        np.testing.assert_array_equal(np.asarray(f[:, 0]), [0, 1, 2, 3])
+        np.testing.assert_array_equal(np.asarray(f[:, 1]), [2, 3, 4, 5])
+
+    def test_frame_axis0(self):
+        x = jnp.arange(12.0).reshape(12)
+        f = paddle.signal.frame(x, 4, 4, axis=0)
+        assert f.shape == (3, 4)
+
+    def test_frame_batched(self):
+        x = jnp.ones((2, 3, 32))
+        f = paddle.signal.frame(x, 8, 4)
+        assert f.shape == (2, 3, 8, 7)
+
+    def test_overlap_add_inverts_hop_eq_frame(self):
+        x = jnp.arange(16.0)
+        f = paddle.signal.frame(x, 4, 4)
+        back = paddle.signal.overlap_add(f, 4)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+    def test_overlap_add_sums_overlap(self):
+        frames = jnp.ones((4, 3))  # 3 frames of length 4, hop 2
+        out = paddle.signal.overlap_add(frames, 2)
+        # positions 2..5 covered twice
+        np.testing.assert_array_equal(np.asarray(out),
+                                      [1, 1, 2, 2, 2, 2, 1, 1])
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 512)).astype(np.float32)
+        w = np.hanning(128).astype(np.float32)
+        spec = paddle.signal.stft(x, n_fft=128, hop_length=32, window=w)
+        assert spec.shape == (2, 65, 17)  # 1 + (512+2*64-128)//32
+        assert jnp.iscomplexobj(spec)
+        back = paddle.signal.istft(spec, n_fft=128, hop_length=32, window=w,
+                                   length=512)
+        # Perfect reconstruction away from the edges (COLA window).
+        np.testing.assert_allclose(np.asarray(back)[:, 64:-64],
+                                   x[:, 64:-64], atol=1e-4)
+
+    def test_stft_normalized_and_twosided(self):
+        x = np.random.default_rng(1).standard_normal(256).astype(np.float32)
+        spec = paddle.signal.stft(x, n_fft=64, normalized=True,
+                                  onesided=False)
+        assert spec.shape[0] == 64
+
+    def test_stft_jit_and_grad(self):
+        x = jnp.asarray(np.random.default_rng(2)
+                        .standard_normal(256).astype(np.float32))
+
+        def loss(sig):
+            s = paddle.signal.stft(sig, n_fft=64, hop_length=16)
+            return jnp.sum(jnp.abs(s) ** 2)
+
+        g = jax.jit(jax.grad(loss))(x)
+        assert g.shape == x.shape
+        assert bool(jnp.isfinite(g).all())
+
+    def test_errors(self):
+        x = jnp.ones(32)
+        with pytest.raises(ValueError):
+            paddle.signal.frame(x, 8, 0)
+        with pytest.raises(ValueError):
+            paddle.signal.frame(x, 64, 8)
+        with pytest.raises(ValueError):
+            paddle.signal.stft(x.astype(jnp.complex64), n_fft=16,
+                               onesided=True)
+
+
+# ---------------------------------------------------------------------------
+# regularizer
+# ---------------------------------------------------------------------------
+
+class TestRegularizer:
+    def test_l2_matches_float_weight_decay(self):
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        grads = {"w": jnp.zeros((4,), jnp.float32)}
+        opt_a = paddle.optimizer.SGD(learning_rate=0.1, weight_decay=0.5)
+        opt_b = paddle.optimizer.SGD(
+            learning_rate=0.1, weight_decay=paddle.regularizer.L2Decay(0.5))
+        pa, _ = opt_a.apply_gradients(params, grads, opt_a.init(params))
+        pb, _ = opt_b.apply_gradients(params, grads, opt_b.init(params))
+        np.testing.assert_allclose(np.asarray(pa["w"]), np.asarray(pb["w"]))
+
+    def test_l1_sign_decay(self):
+        params = {"w": jnp.asarray([2.0, -3.0])}
+        grads = {"w": jnp.zeros((2,))}
+        opt = paddle.optimizer.SGD(
+            learning_rate=1.0, weight_decay=paddle.regularizer.L1Decay(0.1))
+        new_p, _ = opt.apply_gradients(params, grads, opt.init(params))
+        np.testing.assert_allclose(np.asarray(new_p["w"]), [1.9, -2.9],
+                                   atol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# utils
+# ---------------------------------------------------------------------------
+
+class TestUtils:
+    def test_deprecated_warns(self):
+        @paddle.utils.deprecated(update_to="paddle.new", since="2.0")
+        def legacy():
+            return 7
+
+        with warnings.catch_warnings(record=True) as rec:
+            warnings.simplefilter("always")
+            assert legacy() == 7
+        assert any("deprecated" in str(w.message) for w in rec)
+
+    def test_deprecated_level2_raises(self):
+        @paddle.utils.deprecated(level=2)
+        def gone():
+            return 1
+
+        with pytest.raises(RuntimeError):
+            gone()
+
+    def test_try_import(self):
+        assert paddle.utils.try_import("math") is not None
+        with pytest.raises(ImportError):
+            paddle.utils.try_import("definitely_not_a_module_xyz")
+
+    def test_unique_name(self):
+        with paddle.utils.unique_name.guard():
+            a = paddle.utils.unique_name.generate("fc")
+            b = paddle.utils.unique_name.generate("fc")
+            c = paddle.utils.unique_name.generate("conv")
+        assert (a, b, c) == ("fc_0", "fc_1", "conv_0")
+
+    def test_unique_name_guard_isolates(self):
+        with paddle.utils.unique_name.guard():
+            paddle.utils.unique_name.generate("x")
+            with paddle.utils.unique_name.guard():
+                assert paddle.utils.unique_name.generate("x") == "x_0"
+            assert paddle.utils.unique_name.generate("x") == "x_1"
+
+    def test_dlpack_roundtrip(self):
+        src = np.arange(12, dtype=np.float32).reshape(3, 4)
+        arr = paddle.utils.dlpack.from_dlpack(src)
+        assert isinstance(arr, jax.Array)
+        np.testing.assert_array_equal(np.asarray(arr), src)
+
+    def test_download_cache_only(self):
+        with tempfile.TemporaryDirectory() as d:
+            target = os.path.join(d, "weights.bin")
+            with open(target, "wb") as f:
+                f.write(b"abc")
+            got = paddle.utils.download.get_path_from_url(
+                "https://example.com/weights.bin", root_dir=d)
+            assert got == target
+            with pytest.raises(FileNotFoundError):
+                paddle.utils.download.get_path_from_url(
+                    "https://example.com/missing.bin", root_dir=d)
+
+    def test_flops_counts_matmul(self):
+        net = paddle.nn.Linear(16, 8)
+        n = paddle.flops(net, input_size=(4, 16))
+        assert n >= 2 * 4 * 16 * 8  # at least the matmul MACs*2
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+    def test_cpp_extension_load(self):
+        with tempfile.TemporaryDirectory() as d:
+            src = os.path.join(d, "ext.cpp")
+            with open(src, "w") as f:
+                f.write('extern "C" int triple(int x) { return 3 * x; }\n')
+            lib = paddle.utils.cpp_extension.load(
+                "testext", [src], build_directory=d)
+            assert lib.triple(5) == 15
+
+
+# ---------------------------------------------------------------------------
+# device
+# ---------------------------------------------------------------------------
+
+class TestDeviceAPI:
+    def test_device_types(self):
+        kinds = paddle.device.get_all_device_type()
+        assert "cpu" in kinds or "tpu" in kinds
+
+    def test_stream_event_sync(self):
+        s = paddle.device.Stream()
+        e = s.record_event()
+        e.synchronize()
+        assert e.query()
+        s.synchronize()
+
+    def test_stream_guard(self):
+        s = paddle.device.Stream()
+        with paddle.device.stream_guard(s) as got:
+            assert got is s
+            assert paddle.device.current_stream() is s
+
+    def test_wait_event_and_stream(self):
+        s1, s2 = paddle.device.Stream(), paddle.device.Stream()
+        e = paddle.device.Event()
+        e.record(s1)
+        s2.wait_event(e)
+        s2.wait_stream(s1)
+
+    def test_accelerator_namespace(self):
+        assert paddle.device.cuda is paddle.device.tpu
+        assert paddle.device.tpu.device_count() >= 1
+        paddle.device.tpu.empty_cache()
+        stats = paddle.device.tpu.memory_stats()
+        assert isinstance(stats, dict)
+        assert paddle.device.tpu.memory_allocated() >= 0
+
+    def test_get_device_properties(self):
+        dev = paddle.device.get_device_properties(0)
+        assert hasattr(dev, "platform")
+
+
+# ---------------------------------------------------------------------------
+# batch / reader
+# ---------------------------------------------------------------------------
+
+class TestBatchReader:
+    def test_batch(self):
+        out = [b for b in paddle.batch(lambda: iter(range(7)), 3)()]
+        assert [len(b) for b in out] == [3, 3, 1]
+        out = [b for b in paddle.batch(lambda: iter(range(7)), 3,
+                                       drop_last=True)()]
+        assert [len(b) for b in out] == [3, 3]
+
+    def test_shuffle_preserves_multiset(self):
+        got = sorted(paddle.reader.shuffle(lambda: iter(range(20)), 5)())
+        assert got == list(range(20))
+
+    def test_chain_compose_firstn_cache(self):
+        r = lambda: iter([1, 2])  # noqa: E731
+        assert list(paddle.reader.chain(r, r)()) == [1, 2, 1, 2]
+        assert list(paddle.reader.compose(r, r)()) == [(1, 1), (2, 2)]
+        assert list(paddle.reader.firstn(lambda: iter(range(9)), 4)()) == \
+            [0, 1, 2, 3]
+        cached = paddle.reader.cache(lambda: iter(range(3)))
+        assert list(cached()) == [0, 1, 2]
+        assert list(cached()) == [0, 1, 2]
+
+    def test_compose_misaligned_raises(self):
+        a = lambda: iter([1, 2, 3])  # noqa: E731
+        b = lambda: iter([1])  # noqa: E731
+        with pytest.raises(RuntimeError):
+            list(paddle.reader.compose(a, b)())
+
+    def test_buffered(self):
+        assert list(paddle.reader.buffered(lambda: iter(range(50)), 8)()) == \
+            list(range(50))
+
+    def test_map_readers(self):
+        r = lambda: iter([1, 2, 3])  # noqa: E731
+        assert list(paddle.reader.map_readers(
+            lambda a, b: a + b, r, r)()) == [2, 4, 6]
+
+    def test_xmap_ordered(self):
+        out = list(paddle.reader.xmap_readers(
+            lambda v: v * v, lambda: iter(range(16)), 4, 4, order=True)())
+        assert out == [v * v for v in range(16)]
+
+    def test_xmap_unordered(self):
+        out = sorted(paddle.reader.xmap_readers(
+            lambda v: v + 1, lambda: iter(range(16)), 4, 4)())
+        assert out == list(range(1, 17))
+
+    def test_buffered_forwards_producer_exception(self):
+        def bad():
+            yield 1
+            raise IOError("disk gone")
+
+        it = paddle.reader.buffered(bad, 4)()
+        assert next(it) == 1
+        with pytest.raises(IOError):
+            list(it)
+
+    def test_xmap_forwards_mapper_exception(self):
+        def bad_map(v):
+            if v == 3:
+                raise ValueError("bad sample")
+            return v
+
+        with pytest.raises(ValueError):
+            list(paddle.reader.xmap_readers(
+                bad_map, lambda: iter(range(8)), 2, 4)())
+
+    def test_cache_retries_clean_after_failure(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            yield 1
+            yield 2
+            if calls["n"] == 1:
+                raise IOError("transient")
+            yield 3
+
+        cached = paddle.reader.cache(flaky)
+        with pytest.raises(IOError):
+            list(cached())
+        assert list(cached()) == [1, 2, 3]
+        assert list(cached()) == [1, 2, 3]
+
+    def test_stft_rejects_zero_hop(self):
+        x = jnp.ones(64)
+        with pytest.raises(ValueError):
+            paddle.signal.stft(x, n_fft=16, hop_length=0)
+
+
+# ---------------------------------------------------------------------------
+# hub / sysconfig / onnx / callbacks namespace
+# ---------------------------------------------------------------------------
+
+class TestHubAndMisc:
+    def test_hub_local(self):
+        with tempfile.TemporaryDirectory() as d:
+            with open(os.path.join(d, "hubconf.py"), "w") as f:
+                f.write("def tiny_model(scale=1):\n"
+                        "    'A tiny model.'\n"
+                        "    return {'scale': scale}\n")
+            names = paddle.hub.list(d)
+            assert "tiny_model" in names
+            assert "tiny" in paddle.hub.help(d, "tiny_model")
+            got = paddle.hub.load(d, "tiny_model", scale=3)
+            assert got == {"scale": 3}
+
+    def test_hub_remote_refuses(self):
+        with pytest.raises(RuntimeError):
+            paddle.hub.list("owner/repo", source="github")
+
+    def test_sysconfig(self):
+        assert os.path.isdir(paddle.sysconfig.get_include())
+        assert os.path.isdir(paddle.sysconfig.get_lib())
+
+    def test_callbacks_namespace(self):
+        assert paddle.callbacks.LRScheduler is not None
+        assert paddle.callbacks.EarlyStopping is not None
+
+    def test_onnx_export_roundtrip(self):
+        net = paddle.nn.Linear(4, 2)
+        net.eval()
+        x = jnp.ones((1, 4), jnp.float32)
+        ref = net(x)
+        with tempfile.TemporaryDirectory() as d:
+            prefix = paddle.onnx.export(net, os.path.join(d, "m.onnx"),
+                                        input_spec=[x])
+            loaded = paddle.jit.load(prefix)
+            np.testing.assert_allclose(np.asarray(loaded(x)),
+                                       np.asarray(ref), atol=1e-6)
