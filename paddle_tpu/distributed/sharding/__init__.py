@@ -1,0 +1,40 @@
+"""User-facing ZeRO/GroupSharded API (``paddle.distributed.sharding`` parity).
+
+Reference: ``python/paddle/distributed/sharding/group_sharded.py`` —
+``group_sharded_parallel(model, optimizer, level)`` and
+``save_group_sharded_model``. The mechanics live in
+``fleet/meta_parallel/sharding.py`` (PartitionSpec stamping consumed by the
+pjit'd train step); this package is the stable import path.
+"""
+
+from __future__ import annotations
+
+import os
+
+from ..fleet.meta_parallel.sharding import (  # noqa: F401
+    SHARDING_AXIS, GroupShardedStage3, group_sharded_parallel,
+    shard_spec_for_param)
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "GroupShardedStage3", "shard_spec_for_param"]
+
+
+def save_group_sharded_model(model, output: str, optimizer=None) -> None:
+    """Gather the (possibly stage-3 sharded) model and save a plain
+    single-host checkpoint (ref ``group_sharded.py`` save_group_sharded_model:
+    stage-3 gathers params before save). Under GSPMD, ``state_dict`` already
+    yields addressable full values, so this is save + optional opt-state."""
+    from ...framework.io import save
+
+    if output.endswith((".pdmodel", ".pdparams", ".pdopt")):
+        raise ValueError(
+            f"output should be a directory/prefix, not a file path: {output}")
+    os.makedirs(output, exist_ok=True)
+    save(model.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        # Always written when an optimizer is passed (ref behavior). Under
+        # purely functional training the optimizer object holds no step
+        # state (it lives in the caller's opt_state pytree — checkpoint it
+        # via distributed.checkpoint.save_sharded); the file then carries
+        # just the LR-scheduler/step metadata.
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))

@@ -1,0 +1,53 @@
+"""Runtime autotuning switches (``paddle.incubate.autotune`` parity).
+
+Reference: ``python/paddle/incubate/autotune.py`` ``set_config`` toggles
+kernel autotune (``phi/kernels/autotune/``), layout autotune, and dataloader
+tuning. TPU-native mapping: kernel autotune = the Pallas flash-attention
+block sweep (``ops/_pallas/flash_attention.py`` block-size table) plus XLA's
+own autotuner (latency-hiding scheduler etc., already on); layout autotune
+is XLA's layout assignment (always on); dataloader tuning adjusts the
+DataLoader's worker count. ``set_config`` records the switches in the flags
+registry so subsystems can consult them.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+from ..core import flags as _flags
+
+__all__ = ["set_config"]
+
+_KNOWN = {"kernel", "layout", "dataloader"}
+
+for _name, _default in (("autotune_kernel", True),
+                        ("autotune_layout", True),
+                        ("autotune_dataloader", False)):
+    if _name not in _flags.get_flags():
+        _flags.define_flag(_name, _default,
+                           f"incubate.autotune switch: {_name}")
+
+
+def set_config(config=None) -> None:
+    """Enable/disable tuning subsystems. ``config`` may be None (enable all),
+    a dict like {"kernel": {"enable": True, ...}}, or a path to a JSON file
+    with that layout."""
+    if config is None:
+        for key in _KNOWN:
+            _flags.set_flags({f"autotune_{key}": True})
+        return
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    if not isinstance(config, dict):
+        raise TypeError(f"config must be None, dict, or path, got "
+                        f"{type(config)}")
+    for key, val in config.items():
+        if key not in _KNOWN:
+            warnings.warn(f"autotune.set_config: unknown field {key!r} "
+                          f"(known: {sorted(_KNOWN)})")
+            continue
+        enable = bool(val.get("enable", True)) if isinstance(val, dict) \
+            else bool(val)
+        _flags.set_flags({f"autotune_{key}": enable})

@@ -1,0 +1,219 @@
+"""Fused transformer layers (``paddle.incubate.nn`` parity).
+
+Reference: ``python/paddle/incubate/nn/layer/fused_transformer.py``
+(FusedLinear/FusedFeedForward/FusedMultiHeadAttention/
+FusedTransformerEncoderLayer/FusedBiasDropoutResidualLayerNorm over the CUDA
+megakernels). Here each layer owns reference-shaped parameters and calls the
+``incubate.nn.functional`` bodies, which XLA fuses and which route attention
+through the Pallas flash kernel — the TPU analog of the fused ops.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from . import functional as F
+
+__all__ = ["FusedLinear", "FusedFeedForward", "FusedMultiHeadAttention",
+           "FusedTransformerEncoderLayer",
+           "FusedBiasDropoutResidualLayerNorm", "functional"]
+
+
+class FusedLinear(Layer):
+    """ref ``incubate/nn/layer/fused_linear.py`` (weight [in, out])."""
+
+    def __init__(self, in_features: int, out_features: int, weight_attr=None,
+                 bias_attr=None, transpose_weight: bool = False, name=None):
+        super().__init__()
+        shape = ((out_features, in_features) if transpose_weight
+                 else (in_features, out_features))
+        self.transpose_weight = transpose_weight
+        self.weight = self.create_parameter(
+            shape, attr=weight_attr, default_initializer=I.XavierNormal())
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_features,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x):
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self.transpose_weight)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """ref ``incubate/nn/layer/fused_transformer.py:FusedBiasDropoutResidualLayerNorm``."""
+
+    def __init__(self, embed_dim: int, dropout_rate: float = 0.5,
+                 weight_attr=None, bias_attr=None, epsilon: float = 1e-5,
+                 name=None):
+        super().__init__()
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+        self.linear_bias = self.create_parameter((embed_dim,), attr=bias_attr,
+                                                 is_bias=True)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True)
+
+    def forward(self, x, residual):
+        return F.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            self.dropout_rate, self.epsilon, training=self.training)
+
+
+class FusedMultiHeadAttention(Layer):
+    """ref ``incubate/nn/layer/fused_transformer.py:FusedMultiHeadAttention``.
+
+    Parameters use the reference's fused layouts: qkv_weight
+    [3, H, D, embed], linear_weight [embed, embed].
+    """
+
+    def __init__(self, embed_dim: int, num_heads: int,
+                 dropout_rate: float = 0.5, attn_dropout_rate: float = 0.5,
+                 kdim=None, vdim=None, normalize_before: bool = False,
+                 need_weights: bool = False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None,
+                 linear_bias_attr=None, pre_ln_scale_attr=None,
+                 pre_ln_bias_attr=None, ln_scale_attr=None, ln_bias_attr=None,
+                 epsilon: float = 1e-5, nranks: int = 1, ring_id: int = -1,
+                 name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"embed_dim ({embed_dim}) must be divisible by num_heads "
+                f"({num_heads})")
+        if (kdim and kdim != embed_dim) or (vdim and vdim != embed_dim):
+            raise NotImplementedError("fused path requires k/v dim == embed")
+        if need_weights:
+            raise NotImplementedError(
+                "need_weights=True is unsupported (the flash path never "
+                "materializes attention probs); the reference raises too")
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        bound = 1.0 / math.sqrt(embed_dim)
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, self.head_dim, embed_dim), attr=qkv_weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.qkv_bias = (None if qkv_bias_attr is False else
+                         self.create_parameter(
+                             (3, num_heads, self.head_dim),
+                             attr=qkv_bias_attr, is_bias=True))
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=linear_weight_attr,
+            default_initializer=I.XavierNormal())
+        self.linear_bias = (None if linear_bias_attr is False else
+                            self.create_parameter((embed_dim,),
+                                                  attr=linear_bias_attr,
+                                                  is_bias=True))
+        self.pre_ln_scale = self.create_parameter(
+            (embed_dim,), attr=pre_ln_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.pre_ln_bias = self.create_parameter((embed_dim,), is_bias=True,
+                                                 attr=pre_ln_bias_attr)
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=ln_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter((embed_dim,), is_bias=True,
+                                             attr=ln_bias_attr)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        if key is not None or value is not None:
+            raise NotImplementedError("fused MHA is self-attention only")
+        return F.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            pre_ln_epsilon=self.epsilon, qkv_bias=self.qkv_bias,
+            linear_bias=self.linear_bias, cache_kv=cache,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training)
+
+
+class FusedFeedForward(Layer):
+    """ref ``incubate/nn/layer/fused_transformer.py:FusedFeedForward``."""
+
+    def __init__(self, d_model: int, dim_feedforward: int,
+                 dropout_rate: float = 0.1, epsilon: float = 1e-5,
+                 activation: str = "relu", act_dropout_rate=None,
+                 normalize_before: bool = False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None, ln2_bias_attr=None,
+                 nranks: int = 1, ring_id: int = -1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = (dropout_rate if act_dropout_rate is None
+                                 else act_dropout_rate)
+        self.epsilon = epsilon
+        self.linear1_weight = self.create_parameter(
+            (d_model, dim_feedforward), attr=linear1_weight_attr,
+            default_initializer=I.XavierNormal())
+        self.linear1_bias = (None if linear1_bias_attr is False else
+                             self.create_parameter((dim_feedforward,),
+                                                   attr=linear1_bias_attr,
+                                                   is_bias=True))
+        self.linear2_weight = self.create_parameter(
+            (dim_feedforward, d_model), attr=linear2_weight_attr,
+            default_initializer=I.XavierNormal())
+        self.linear2_bias = (None if linear2_bias_attr is False else
+                             self.create_parameter((d_model,),
+                                                   attr=linear2_bias_attr,
+                                                   is_bias=True))
+        self.ln1_scale = self.create_parameter(
+            (d_model,), attr=ln1_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln1_bias = self.create_parameter((d_model,), is_bias=True,
+                                              attr=ln1_bias_attr)
+        self.ln2_scale = self.create_parameter(
+            (d_model,), attr=ln2_scale_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln2_bias = self.create_parameter((d_model,), is_bias=True,
+                                              attr=ln2_bias_attr)
+
+    def forward(self, x):
+        return F.fused_feedforward(
+            x, self.linear1_weight, self.linear2_weight, self.linear1_bias,
+            self.linear2_bias, self.ln1_scale, self.ln1_bias, self.ln2_scale,
+            self.ln2_bias, self.act_dropout_rate, self.dropout_rate,
+            self.activation, self.epsilon, self.epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """ref ``incubate/nn/layer/fused_transformer.py:FusedTransformerEncoderLayer``."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout_rate: float = 0.1, activation: str = "relu",
+                 attn_dropout_rate=None, act_dropout_rate=None,
+                 normalize_before: bool = False, weight_attr=None,
+                 bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=(dropout_rate if attn_dropout_rate is None
+                               else attn_dropout_rate),
+            normalize_before=normalize_before,
+            qkv_weight_attr=weight_attr, qkv_bias_attr=bias_attr,
+            linear_weight_attr=weight_attr, linear_bias_attr=bias_attr)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before,
+            linear1_weight_attr=weight_attr, linear1_bias_attr=bias_attr,
+            linear2_weight_attr=weight_attr, linear2_bias_attr=bias_attr)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        return self.ffn(out)

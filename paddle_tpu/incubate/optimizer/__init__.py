@@ -1,0 +1,226 @@
+"""Incubating optimizers (``paddle.incubate.optimizer`` parity).
+
+Reference: ``python/paddle/incubate/optimizer/`` — LookAhead ("Lookahead
+Optimizer: k steps forward, 1 step back", lookahead.py) and ModelAverage
+(Polyak-style parameter averaging for eval, modelaverage.py). Both follow
+this build's wrapper-optimizer shape (see
+``distributed/fleet/meta_optimizers.py``): functional init/apply_gradients
+that jit cleanly (lax.cond on the step boundary, no Python branching on
+traced values) plus the imperative step()/apply()/restore() shims.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k fast steps with the inner optimizer, then interpolate slow weights:
+    slow += alpha * (fast - slow); fast = slow (ref lookahead.py:30)."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self._inner_opt = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._eager_state = None
+
+    def __getattr__(self, name):
+        return getattr(self._inner_opt, name)
+
+    # -- functional ---------------------------------------------------------
+
+    def init(self, params):
+        return {
+            "inner": self._inner_opt.init(params),
+            "slow": {n: jnp.asarray(p, jnp.float32)
+                     for n, p in params.items()},
+            "count": jnp.zeros((), jnp.int32),
+        }
+
+    def apply_gradients(self, params, grads, state, lr=None):
+        fast, inner = self._inner_opt.apply_gradients(
+            params, grads, state["inner"], lr=lr)
+        count = state["count"] + 1
+        sync = count >= self.k
+        slow = dict(state["slow"])
+        new_fast = dict(fast)
+
+        names = [n for n in fast if n in slow]
+
+        def sync_branch(ops):
+            fast_, slow_ = ops
+            out_fast, out_slow = dict(fast_), dict(slow_)
+            for n in names:
+                s = slow_[n] + self.alpha * (
+                    fast_[n].astype(jnp.float32) - slow_[n])
+                out_slow[n] = s
+                out_fast[n] = s.astype(fast_[n].dtype)
+            return out_fast, out_slow, jnp.zeros((), jnp.int32)
+
+        def keep_branch(ops):
+            fast_, slow_ = ops
+            return dict(fast_), dict(slow_), count
+
+        new_fast, new_slow, new_count = jax.lax.cond(
+            sync, sync_branch, keep_branch, (new_fast, slow))
+        # Track slow copies for params that appeared after init.
+        for n, p in fast.items():
+            if n not in new_slow:
+                new_slow[n] = jnp.asarray(p, jnp.float32)
+        return new_fast, {"inner": inner, "slow": new_slow,
+                          "count": new_count}
+
+    # -- imperative ---------------------------------------------------------
+
+    def _ensure_param_state(self, state, n, p):
+        if n not in state["slow"]:
+            state["slow"][n] = jnp.asarray(p, jnp.float32)
+        self._inner_opt._ensure_param_state(state["inner"], n, p)
+
+    def step(self):
+        from ...distributed.fleet.meta_optimizers import _imperative_step
+        _imperative_step(self)
+
+    def minimize(self, loss=None, **kw):
+        self.step()
+
+    def clear_grad(self):
+        self._inner_opt.clear_grad()
+
+    def state_dict(self) -> Dict[str, Any]:
+        from ...distributed.fleet.meta_optimizers import _with_state
+        out = {}
+        if self._eager_state is not None:
+            out["lookahead@count"] = self._eager_state["count"]
+            for n, v in self._eager_state["slow"].items():
+                out[f"lookahead@slow@{n}"] = v
+        out.update(_with_state(self._inner_opt,
+                               (self._eager_state or {}).get("inner"),
+                               self._inner_opt.state_dict))
+        return out
+
+    def set_state_dict(self, state: Dict[str, Any]) -> None:
+        from ...distributed.fleet.meta_optimizers import _with_state
+        state = dict(state)
+        slow = {}
+        count = state.pop("lookahead@count", None)
+        for key in [k for k in state if k.startswith("lookahead@slow@")]:
+            slow[key[len("lookahead@slow@"):]] = jnp.asarray(
+                state.pop(key), jnp.float32)
+        inner_box = {}
+
+        def restore_inner():
+            self._inner_opt.set_state_dict(state)
+            inner_box["state"] = self._inner_opt._eager_state
+
+        _with_state(self._inner_opt, None, restore_inner)
+        self._eager_state = {
+            "inner": inner_box["state"],
+            "slow": slow,
+            "count": (jnp.asarray(count, jnp.int32) if count is not None
+                      else jnp.zeros((), jnp.int32)),
+        }
+
+
+class ModelAverage(Optimizer):
+    """Maintain a running sum of parameter values; ``apply()`` swaps in the
+    average for evaluation, ``restore()`` swaps back
+    (ref modelaverage.py:34 — accumulators sum_1/sum_2/sum_3 collapse to one
+    fp32 running sum + count here; the reference's three-tier scheme is a
+    fixed-point overflow workaround that fp32 master sums don't need).
+
+    min_average_window/max_average_window bound how many recent steps the
+    window covers: the sum resets when it exceeds max_average_window.
+    """
+
+    def __init__(self, average_window_rate: float, parameters=None,
+                 min_average_window: int = 10000,
+                 max_average_window: int = 10000, name=None):
+        super().__init__(learning_rate=1.0, parameters=parameters)
+        self.average_window_rate = float(average_window_rate)
+        self.min_average_window = int(min_average_window)
+        self.max_average_window = int(max_average_window)
+        self._backup = None
+
+    def _init_param_state(self, p):
+        return {"sum": jnp.zeros(p.shape, jnp.float32),
+                "count": jnp.zeros((), jnp.int32)}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        # "Gradient" application is accumulation of the *current* value;
+        # the params themselves are left untouched.
+        window = jnp.maximum(
+            jnp.int32(self.min_average_window),
+            jnp.minimum(jnp.int32(self.max_average_window),
+                        (step.astype(jnp.float32)
+                         * self.average_window_rate).astype(jnp.int32)))
+        reset = st["count"] >= window
+        new_sum = jnp.where(reset, p32, st["sum"] + p32)
+        new_count = jnp.where(reset, jnp.int32(1), st["count"] + 1)
+        return p32, {"sum": new_sum, "count": new_count}
+
+    def accumulate(self):
+        """Record the current parameter values (call once per train step)."""
+        refs = [r for r in self._refs() if r.trainable]
+        params = {r.name: r.value for r in refs}
+        grads = {r.name: jnp.zeros_like(r.value) for r in refs}
+        if self._eager_state is None:
+            self._eager_state = self.init(params)
+        for n, p in params.items():
+            self._ensure_param_state(self._eager_state, n, p)
+        _, self._eager_state = self.apply_gradients(
+            params, grads, self._eager_state)
+
+    step = accumulate  # the reference calls it via optimizer.step()
+
+    def apply(self, executor=None, need_restore: bool = True):
+        """Swap averaged values into the live parameters."""
+        if self._eager_state is None:
+            raise RuntimeError("no accumulated state; call step() during "
+                               "training first")
+        self._backup = {}
+        for r in self._refs():
+            st = self._eager_state["param_states"].get(r.name)
+            if not st or "sum" not in st:
+                continue
+            count = jnp.maximum(st["count"], 1).astype(jnp.float32)
+            self._backup[r.name] = r.value
+            r.value = (st["sum"] / count).astype(r.value.dtype)
+        if not need_restore:
+            self._backup = None
+        return _NullContext(self) if need_restore else None
+
+    def restore(self, executor=None):
+        """Undo ``apply()``."""
+        if self._backup is None:
+            return
+        for r in self._refs():
+            if r.name in self._backup:
+                r.value = self._backup[r.name]
+        self._backup = None
+
+
+class _NullContext:
+    """Lets ``with model_average.apply(): ...`` auto-restore."""
+
+    def __init__(self, ma: ModelAverage):
+        self._ma = ma
+
+    def __enter__(self):
+        return self._ma
+
+    def __exit__(self, *exc):
+        self._ma.restore()
+        return False

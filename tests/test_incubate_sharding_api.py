@@ -1,0 +1,339 @@
+"""Tests for paddle.distributed.sharding user API and paddle.incubate
+extensions (nn fused layers, optimizer.LookAhead/ModelAverage, autotune).
+
+Reference anchors: python/paddle/distributed/sharding/group_sharded.py,
+python/paddle/incubate/nn/layer/fused_transformer.py,
+python/paddle/incubate/optimizer/{lookahead,modelaverage}.py,
+python/paddle/incubate/autotune.py.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.incubate import autotune
+from paddle_tpu.incubate import nn as inn
+from paddle_tpu.incubate import optimizer as iopt
+from paddle_tpu.incubate.nn import functional as IF
+
+
+# ---------------------------------------------------------------------------
+# distributed.sharding
+# ---------------------------------------------------------------------------
+
+class TestShardingAPI:
+    def test_namespace(self):
+        assert paddle.distributed.sharding.group_sharded_parallel is \
+            paddle.distributed.group_sharded_parallel
+
+    def test_group_sharded_parallel_stamps_specs(self):
+        from paddle_tpu.distributed.topology import (create_hybrid_mesh,
+                                                     set_hybrid_mesh)
+        mesh = create_hybrid_mesh(sharding=4, dp=2, devices=jax.devices())
+        set_hybrid_mesh(mesh)
+        try:
+            net = nn.Linear(8, 16)
+            model, opt, _ = paddle.distributed.sharding.group_sharded_parallel(
+                net, paddle.optimizer.AdamW(parameters=net.parameters()),
+                level="p_g_os")
+            specs = [r.meta.partition_spec
+                     for _, r in model.named_parameters()]
+            assert any(s is not None and "sharding" in tuple(s)
+                       for s in specs if s is not None)
+            assert opt._sharding_level == "p_g_os"
+        finally:
+            set_hybrid_mesh(None)
+
+    def test_bad_level_raises(self):
+        net = nn.Linear(4, 4)
+        with pytest.raises(ValueError):
+            paddle.distributed.sharding.group_sharded_parallel(
+                net, None, level="zeRO-9")
+
+    def test_save_group_sharded_model(self):
+        net = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(parameters=net.parameters())
+        with tempfile.TemporaryDirectory() as d:
+            out = os.path.join(d, "ckpt")
+            paddle.distributed.sharding.save_group_sharded_model(net, out, opt)
+            assert os.path.isfile(os.path.join(out, "model.pdparams"))
+            # optimizer file always written when an optimizer is passed,
+            # even before any imperative step (functional training).
+            assert os.path.isfile(os.path.join(out, "model.pdopt"))
+            state = paddle.load(os.path.join(out, "model.pdparams"))
+            assert "weight" in state
+
+
+# ---------------------------------------------------------------------------
+# incubate.nn
+# ---------------------------------------------------------------------------
+
+class TestFusedLayers:
+    def setup_method(self):
+        paddle.seed(42)
+        self.x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((2, 8, 32)), jnp.float32)
+
+    def test_fused_linear_matches_linear(self):
+        fl = inn.FusedLinear(32, 16)
+        out = fl(self.x)
+        ref = self.x @ fl.weight + fl.bias
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-6)
+
+    def test_fused_linear_transpose_weight(self):
+        fl = inn.FusedLinear(32, 16, transpose_weight=True)
+        assert fl.weight.shape == (16, 32)
+        assert fl(self.x).shape == (2, 8, 16)
+
+    def test_fused_mha_matches_unfused(self):
+        """The fused qkv layout must reproduce per-head projections."""
+        mha = inn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0)
+        mha.eval()
+        out = mha(self.x)
+        assert out.shape == self.x.shape
+        # Unfused reference: same math with reshaped weights.
+        from paddle_tpu.nn import functional as F
+        w = jnp.transpose(mha.qkv_weight, (3, 0, 1, 2)).reshape(32, -1)
+        qkv = (self.x @ w + mha.qkv_bias.reshape(-1)).reshape(2, 8, 3, 4, 8)
+        att = F.scaled_dot_product_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2], dropout_p=0.0,
+            training=False)
+        ref = att.reshape(2, 8, 32) @ mha.linear_weight + mha.linear_bias
+        ref = self.x + ref
+        ref = F.layer_norm(ref, (32,), mha.ln_scale, mha.ln_bias, 1e-5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_fused_mha_need_weights_rejected(self):
+        with pytest.raises(NotImplementedError):
+            inn.FusedMultiHeadAttention(32, 4, need_weights=True)
+
+    def test_fused_mha_pre_layer_norm(self):
+        mha = inn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0,
+                                          normalize_before=True)
+        mha.eval()
+        assert mha(self.x).shape == self.x.shape
+
+    def test_fused_mha_with_mask(self):
+        mha = inn.FusedMultiHeadAttention(32, 4, dropout_rate=0.0,
+                                          attn_dropout_rate=0.0)
+        mha.eval()
+        mask = jnp.tril(jnp.ones((8, 8), jnp.bool_))
+        assert mha(self.x, attn_mask=mask).shape == self.x.shape
+
+    def test_fused_ffn_pre_and_post_ln(self):
+        for pre in (False, True):
+            ffn = inn.FusedFeedForward(32, 64, dropout_rate=0.0,
+                                       normalize_before=pre)
+            ffn.eval()
+            out = ffn(self.x)
+            assert out.shape == self.x.shape
+            assert bool(jnp.isfinite(out).all())
+
+    def test_fused_encoder_layer_trains(self):
+        enc = inn.FusedTransformerEncoderLayer(32, 4, 64, dropout_rate=0.0)
+        enc.train()
+        from paddle_tpu.framework.functional import functional_call, get_params
+        params = get_params(enc)
+
+        def loss_fn(p):
+            return jnp.mean(functional_call(enc, p, self.x,
+                                            training=True) ** 2)
+
+        g = jax.grad(loss_fn)(params)
+        assert all(bool(jnp.isfinite(v).all()) for v in g.values())
+
+    def test_fused_bias_dropout_residual_ln(self):
+        bdr = inn.FusedBiasDropoutResidualLayerNorm(32, dropout_rate=0.0)
+        bdr.eval()
+        out = bdr(self.x, self.x)
+        # LayerNorm output: ~zero mean per row.
+        assert float(jnp.abs(jnp.mean(out, axis=-1)).max()) < 1e-5
+
+    def test_functional_fused_matmul_bias(self):
+        a = jnp.ones((2, 3)); b = jnp.ones((3, 4))
+        out = IF.fused_matmul_bias(a, b, jnp.ones((4,)))
+        np.testing.assert_allclose(np.asarray(out), 4.0)
+        out_t = IF.fused_matmul_bias(jnp.ones((3, 2)), b, None,
+                                     transpose_x=True)
+        assert out_t.shape == (2, 4)
+
+
+# ---------------------------------------------------------------------------
+# incubate.optimizer
+# ---------------------------------------------------------------------------
+
+class TestLookAhead:
+    def test_functional_sync_math(self):
+        inner = paddle.optimizer.SGD(learning_rate=0.1)
+        la = iopt.LookAhead(inner, alpha=0.5, k=2)
+        params = {"w": jnp.ones((3,), jnp.float32)}
+        g = {"w": jnp.ones((3,), jnp.float32)}
+        st = la.init(params)
+        params, st = la.apply_gradients(params, g, st)   # fast: 0.9
+        np.testing.assert_allclose(np.asarray(params["w"]), 0.9, atol=1e-6)
+        params, st = la.apply_gradients(params, g, st)   # fast 0.8 -> sync
+        # slow = 1 + 0.5*(0.8 - 1) = 0.9; fast := slow
+        np.testing.assert_allclose(np.asarray(params["w"]), 0.9, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(st["slow"]["w"]), 0.9,
+                                   atol=1e-6)
+        assert int(st["count"]) == 0
+
+    def test_jit_compatible(self):
+        inner = paddle.optimizer.Adam(learning_rate=0.01)
+        la = iopt.LookAhead(inner, alpha=0.8, k=3)
+        params = {"w": jnp.ones((4,), jnp.float32)}
+        st = la.init(params)
+
+        @jax.jit
+        def step(p, s):
+            g = {"w": jnp.ones((4,), jnp.float32)}
+            return la.apply_gradients(p, g, s)
+
+        for _ in range(7):
+            params, st = step(params, st)
+        assert bool(jnp.isfinite(params["w"]).all())
+
+    def test_imperative_step_converges(self):
+        from paddle_tpu.autograd import backward
+        net = nn.Linear(4, 1)
+        la = iopt.LookAhead(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net.parameters()),
+            alpha=0.5, k=2)
+        x = jnp.ones((8, 4), jnp.float32)
+        y = jnp.zeros((8, 1), jnp.float32)
+        losses = []
+        for _ in range(10):
+            loss = backward(net,
+                            loss_closure=lambda m: jnp.mean((m(x) - y) ** 2))
+            losses.append(float(loss))
+            la.step()
+            la.clear_grad()
+        assert losses[-1] < losses[0] * 0.7, losses
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            iopt.LookAhead(paddle.optimizer.SGD(), alpha=2.0)
+        with pytest.raises(ValueError):
+            iopt.LookAhead(paddle.optimizer.SGD(), k=0)
+
+    def test_state_dict_roundtrip(self):
+        from paddle_tpu.autograd import backward
+        paddle.seed(7)
+        net = nn.Linear(4, 1)
+        la = iopt.LookAhead(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net.parameters()),
+            alpha=0.5, k=3)
+        x = jnp.ones((4, 4), jnp.float32)
+        for _ in range(2):
+            backward(net, loss_closure=lambda m: jnp.mean(m(x) ** 2))
+            la.step()
+            la.clear_grad()
+        saved = la.state_dict()
+        assert any(k.startswith("lookahead@slow@") for k in saved)
+
+        # Fresh optimizer restores and continues identically.
+        la2 = iopt.LookAhead(
+            paddle.optimizer.SGD(learning_rate=0.05,
+                                 parameters=net.parameters()),
+            alpha=0.5, k=3)
+        la2.set_state_dict(saved)
+        assert int(la2._eager_state["count"]) == int(la._eager_state["count"])
+        for n, v in la._eager_state["slow"].items():
+            np.testing.assert_allclose(np.asarray(la2._eager_state["slow"][n]),
+                                       np.asarray(v))
+        # One more step on each must produce identical params.
+        snap = {r.name: np.asarray(r.value).copy() for r in la._refs()}
+        backward(net, loss_closure=lambda m: jnp.mean(m(x) ** 2))
+        la.step()
+        after_a = {r.name: np.asarray(r.value).copy() for r in la._refs()}
+        for r in la._refs():
+            r.value = jnp.asarray(snap[r.name])
+            r.clear_grad()
+        backward(net, loss_closure=lambda m: jnp.mean(m(x) ** 2))
+        la2.step()
+        for r in la2._refs():
+            np.testing.assert_allclose(np.asarray(r.value),
+                                       after_a[r.name], atol=1e-6)
+
+
+class TestModelAverage:
+    def test_average_and_restore(self):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        ma = iopt.ModelAverage(0.5, parameters=net.parameters(),
+                               min_average_window=100,
+                               max_average_window=100)
+        ref = [r for r in ma._refs() if r.name.endswith("weight")][0]
+        w0 = np.asarray(ref.value).copy()
+        for _ in range(3):
+            for r in ma._refs():
+                r.value = r.value + 1.0
+            ma.accumulate()
+        with ma.apply():
+            # mean of (w0+1, w0+2, w0+3) = w0+2
+            np.testing.assert_allclose(np.asarray(ref.value), w0 + 2.0,
+                                       atol=1e-5)
+        np.testing.assert_allclose(np.asarray(ref.value), w0 + 3.0,
+                                   atol=1e-5)
+
+    def test_window_reset(self):
+        net = nn.Linear(2, 2)
+        ma = iopt.ModelAverage(1.0, parameters=net.parameters(),
+                               min_average_window=2, max_average_window=2)
+        ref = [r for r in ma._refs() if r.name.endswith("weight")][0]
+        w0 = np.asarray(ref.value).copy()
+        for _ in range(3):
+            for r in ma._refs():
+                r.value = r.value + 1.0
+            ma.accumulate()
+        with ma.apply():
+            # window 2 forced a reset at step 3: average == last value
+            np.testing.assert_allclose(np.asarray(ref.value), w0 + 3.0,
+                                       atol=1e-5)
+
+    def test_apply_without_accumulate_raises(self):
+        net = nn.Linear(2, 2)
+        ma = iopt.ModelAverage(0.5, parameters=net.parameters())
+        with pytest.raises(RuntimeError):
+            ma.apply()
+
+
+# ---------------------------------------------------------------------------
+# incubate.autotune
+# ---------------------------------------------------------------------------
+
+class TestAutotune:
+    def test_set_config_dict_and_none(self):
+        autotune.set_config({"kernel": {"enable": False}})
+        assert paddle.get_flags(["autotune_kernel"])["autotune_kernel"] \
+            is False
+        autotune.set_config(None)
+        assert paddle.get_flags(["autotune_kernel"])["autotune_kernel"] \
+            is True
+
+    def test_set_config_file(self, tmp_path):
+        cfg = tmp_path / "tune.json"
+        cfg.write_text('{"dataloader": {"enable": true}}')
+        autotune.set_config(str(cfg))
+        assert paddle.get_flags(["autotune_dataloader"])[
+            "autotune_dataloader"] is True
+
+    def test_unknown_key_warns(self):
+        with pytest.warns(UserWarning):
+            autotune.set_config({"frobnicator": True})
+
+    def test_bad_type_raises(self):
+        with pytest.raises(TypeError):
+            autotune.set_config(42)
