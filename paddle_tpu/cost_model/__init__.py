@@ -1,0 +1,122 @@
+"""Cost model (``paddle.cost_model`` parity).
+
+Reference: ``python/paddle/cost_model/cost_model.py`` — ``CostModel`` with
+``profile_measure`` (runs a program under the profiler and reports per-op
+cost) and a static per-op time table (``static_op_benchmark.json``) consumed
+by the auto-parallel planner. TPU-native design: the compiled XLA executable
+*is* the cost database — ``profile_measure`` jits the program, reads
+``cost_analysis()`` (flops / bytes accessed / optimal seconds) and measures
+wall time; ``get_static_op_time`` times individual ops on canonical MXU-sized
+shapes and caches the result in-process (measured on the real device rather
+than shipped as a frozen JSON).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CostModel"]
+
+# Canonical single-op bodies for get_static_op_time, chosen MXU-shaped.
+_OP_BODIES: Dict[str, Callable] = {
+    "matmul": lambda x: x @ x,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "softmax": lambda x: jax.nn.softmax(x, axis=-1),
+    "layer_norm": lambda x: (x - x.mean(-1, keepdims=True))
+    / jnp.sqrt(x.var(-1, keepdims=True) + 1e-5),
+    "add": lambda x: x + x,
+    "multiply": lambda x: x * x,
+    "transpose": lambda x: x.T,
+    "reduce_sum": lambda x: jnp.sum(x),
+    "exp": lambda x: jnp.exp(x),
+    "tanh": lambda x: jnp.tanh(x),
+    "sigmoid": lambda x: jax.nn.sigmoid(x),
+    "gelu": lambda x: jax.nn.gelu(x),
+}
+
+
+def _cost_dict(compiled) -> Dict[str, float]:
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        return {k: float(v) for k, v in cost.items()
+                if isinstance(v, (int, float))}
+    except Exception:
+        return {}
+
+
+class CostModel:
+    """ref ``cost_model.py:25``."""
+
+    def __init__(self):
+        self._op_time_cache: Dict[str, float] = {}
+
+    # -- whole-program measurement -----------------------------------------
+
+    def profile_measure(self, program, *args, device: Optional[str] = None,
+                        fetch_cost_list: Sequence[str] = ("time",),
+                        warmup: int = 1, iters: int = 3) -> Dict[str, Any]:
+        """Measure a program (a callable, a jitted fn, or a
+        ``paddle_tpu.static.Program``). Returns {"time" (ms), "flops",
+        "bytes_accessed", "static_cost" (XLA's modeled optimal-seconds)}.
+        """
+        fn = program
+        if hasattr(program, "compile") and not callable(
+                getattr(program, "lower", None)):
+            fn = program.compile()
+        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
+        compiled = jitted.lower(*args).compile()
+        cost = _cost_dict(compiled)
+        out: Dict[str, Any] = {
+            "flops": cost.get("flops", 0.0),
+            "bytes_accessed": cost.get("bytes accessed", 0.0),
+            "static_cost": cost.get("optimal_seconds", 0.0),
+        }
+        if "time" in fetch_cost_list:
+            if iters < 1:
+                raise ValueError(f"iters must be >= 1, got {iters}")
+            for _ in range(max(warmup, 1)):  # >=1 so timing excludes dispatch
+                res = compiled(*args)
+            jax.block_until_ready(res)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                res = compiled(*args)
+            jax.block_until_ready(res)
+            out["time"] = (time.perf_counter() - t0) / iters * 1e3
+        return out
+
+    # -- per-op static table -------------------------------------------------
+
+    def static_cost_data(self) -> Dict[str, float]:
+        """The measured per-op table accumulated so far (ms). Ops are added
+        lazily by get_static_op_time (ref loads a frozen JSON instead)."""
+        return dict(self._op_time_cache)
+
+    def get_static_op_time(self, op_name: str, forward: bool = True,
+                           dtype: str = "float32") -> Dict[str, float]:
+        """Time one op on a canonical [1024, 1024] operand; cached per
+        (op, direction, dtype). Returns {"op_time": ms} like the reference
+        table rows."""
+        key = f"{op_name}{'(f)' if forward else '(b)'}@{dtype}"
+        if key not in self._op_time_cache:
+            if op_name not in _OP_BODIES:
+                raise ValueError(
+                    f"unknown op {op_name!r}; known: {sorted(_OP_BODIES)}")
+            body = _OP_BODIES[op_name]
+            if not forward:
+                fwd = body
+                body = jax.grad(lambda x: jnp.sum(fwd(x)))
+            x = jnp.ones((1024, 1024), jnp.dtype(dtype))
+            compiled = jax.jit(body).lower(x).compile()
+            jax.block_until_ready(compiled(x))  # warmup, fully drained
+            t0 = time.perf_counter()
+            for _ in range(5):
+                r = compiled(x)
+            jax.block_until_ready(r)
+            self._op_time_cache[key] = (time.perf_counter() - t0) / 5 * 1e3
+        return {"op_time": self._op_time_cache[key]}

@@ -116,3 +116,5 @@ class Executor:
         if not isinstance(out, (tuple, list)):
             out = [out]
         return list(out)
+
+from . import nn  # noqa: F401,E402

@@ -1,0 +1,255 @@
+"""Declarative layer functions (``paddle.static.nn`` parity).
+
+Reference: ``python/paddle/static/nn/`` — fc/embedding/conv2d/batch_norm/…
+create parameters inside the current Program, and ``control_flow.py`` gives
+cond/while_loop/case/switch_case as program ops. TPU-native design:
+parameters live in a per-Program parameter store keyed by layer name
+(created on first trace, reused on re-trace so jit recompiles see the same
+values), and control flow lowers to ``lax.cond``/``lax.while_loop`` — the
+structured-control-flow primitives XLA compiles natively.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..utils import unique_name
+from . import default_main_program
+
+__all__ = ["fc", "embedding", "conv2d", "batch_norm", "layer_norm",
+           "group_norm", "prelu", "cond", "while_loop", "case",
+           "switch_case"]
+
+
+def _param_store() -> Dict[str, jax.Array]:
+    prog = default_main_program()
+    if not hasattr(prog, "_params"):
+        prog._params = {}
+    return prog._params
+
+
+def _get_or_create(name: str, shape, dtype, init: I.Initializer) -> jax.Array:
+    store = _param_store()
+    if name not in store:
+        # Concrete even when first touched inside a jit trace, so the stored
+        # value survives re-traces instead of leaking a tracer.
+        with jax.ensure_compile_time_eval():
+            store[name] = init(tuple(shape), dtype=jnp.dtype(dtype))
+    return store[name]
+
+
+def _resolve_name(name: Optional[str], prefix: str, x) -> str:
+    """Auto-naming is only safe when the call runs eagerly exactly once: a
+    jit re-trace would mint a fresh unique name and silently reinitialize
+    the parameters. Inside a trace, an explicit name is required."""
+    if name is not None:
+        return name
+    if isinstance(x, jax.core.Tracer):
+        raise ValueError(
+            f"static.nn.{prefix} under jit/trace needs an explicit name= "
+            f"(auto-generated names change across re-traces, which would "
+            f"silently re-create the layer's parameters)")
+    return unique_name.generate(prefix)
+
+
+def _apply_act(x, act: Optional[str]):
+    return getattr(F, act)(x) if act else x
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation: Optional[str] = None,
+       name: Optional[str] = None):
+    """ref ``static/nn/common.py`` fc: flatten dims [num_flatten_dims:] and
+    project to ``size`` (paddle default num_flatten_dims=1; -1 means
+    project the last dim only)."""
+    name = _resolve_name(name, "fc", x)
+    if num_flatten_dims == -1:
+        num_flatten_dims = x.ndim - 1
+    lead = x.shape[:num_flatten_dims]
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= d
+    x2 = x.reshape(lead + (in_dim,))
+    w = _get_or_create(f"{name}.w_0", (in_dim, size), x.dtype,
+                       I.XavierNormal())
+    out = x2 @ w
+    if bias_attr is not False:
+        b = _get_or_create(f"{name}.b_0", (size,), x.dtype, I.Constant(0.0))
+        out = out + b
+    return _apply_act(out, activation)
+
+
+def embedding(input, size, padding_idx: Optional[int] = None,
+              dtype="float32", is_sparse: bool = False, param_attr=None,
+              name: Optional[str] = None):
+    """ref ``static/nn/common.py`` embedding (size = [vocab, dim])."""
+    name = _resolve_name(name, "embedding", input)
+    vocab, dim = size
+    table = _get_or_create(f"{name}.w_0", (vocab, dim), dtype,
+                           I.XavierNormal())
+    return F.embedding(input, table, padding_idx=padding_idx,
+                       sparse=is_sparse)
+
+
+def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
+           dilation=1, groups: int = 1, param_attr=None, bias_attr=None,
+           act: Optional[str] = None, data_format: str = "NCHW",
+           name: Optional[str] = None):
+    """ref ``static/nn/common.py`` conv2d."""
+    name = _resolve_name(name, "conv2d", input)
+    kh, kw = F._pair(filter_size)
+    in_ch = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    fan_in = in_ch // groups * kh * kw
+    w = _get_or_create(f"{name}.w_0",
+                       (num_filters, in_ch // groups, kh, kw), input.dtype,
+                       I.KaimingUniform(fan_in=fan_in))
+    b = None
+    if bias_attr is not False:
+        b = _get_or_create(f"{name}.b_0", (num_filters,), input.dtype,
+                           I.Constant(0.0))
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups, data_format=data_format)
+    return _apply_act(out, act)
+
+
+def batch_norm(input, act: Optional[str] = None, momentum: float = 0.9,
+               epsilon: float = 1e-5, data_layout: str = "NCHW",
+               is_test: bool = False, name: Optional[str] = None):
+    """ref ``static/nn/common.py`` batch_norm. The static facade always
+    normalizes with the stored (population) statistics — the is_test=False
+    running-stat update belongs to the imperative nn.BatchNorm2D path."""
+    name = _resolve_name(name, "batch_norm", input)
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _get_or_create(f"{name}.w_0", (ch,), input.dtype, I.Constant(1.0))
+    bias = _get_or_create(f"{name}.b_0", (ch,), input.dtype, I.Constant(0.0))
+    mean = _get_or_create(f"{name}.w_1", (ch,), input.dtype, I.Constant(0.0))
+    var = _get_or_create(f"{name}.w_2", (ch,), input.dtype, I.Constant(1.0))
+    out, _, _ = F.batch_norm(input, mean, var, scale, bias, training=False,
+                             momentum=momentum, epsilon=epsilon,
+                             data_format=data_layout)
+    return _apply_act(out, act)
+
+
+def layer_norm(input, scale: bool = True, shift: bool = True,
+               begin_norm_axis: int = 1, epsilon: float = 1e-5,
+               act: Optional[str] = None, name: Optional[str] = None):
+    """ref ``static/nn/common.py`` layer_norm (normalizes dims
+    [begin_norm_axis:])."""
+    name = _resolve_name(name, "layer_norm", input)
+    shape = input.shape[begin_norm_axis:]
+    w = _get_or_create(f"{name}.w_0", shape, input.dtype,
+                       I.Constant(1.0)) if scale else None
+    b = _get_or_create(f"{name}.b_0", shape, input.dtype,
+                       I.Constant(0.0)) if shift else None
+    return _apply_act(F.layer_norm(input, shape, w, b, epsilon), act)
+
+
+def group_norm(input, groups: int, epsilon: float = 1e-5,
+               param_attr=None, bias_attr=None, act: Optional[str] = None,
+               data_layout: str = "NCHW", name: Optional[str] = None):
+    name = _resolve_name(name, "group_norm", input)
+    ch = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    w = _get_or_create(f"{name}.w_0", (ch,), input.dtype, I.Constant(1.0))
+    b = _get_or_create(f"{name}.b_0", (ch,), input.dtype, I.Constant(0.0))
+    return _apply_act(
+        F.group_norm(input, groups, w, b, epsilon, data_format=data_layout),
+        act)
+
+
+def prelu(x, mode: str = "all", param_attr=None,
+          data_format: str = "NCHW", name: Optional[str] = None):
+    """ref ``static/nn/common.py`` prelu; mode in {all, channel, element}."""
+    name = _resolve_name(name, "prelu", x)
+    if mode == "all":
+        shape = (1,)
+    elif mode == "channel":
+        shape = (x.shape[1] if data_format == "NCHW" else x.shape[-1],)
+    elif mode == "element":
+        shape = tuple(x.shape[1:])
+    else:
+        raise ValueError(f"mode must be all/channel/element, got {mode!r}")
+    alpha = _get_or_create(f"{name}.w_0", shape, x.dtype, I.Constant(0.25))
+    if mode == "channel":
+        return F.prelu(x, alpha, data_format=data_format)
+    a = alpha if mode == "element" else alpha.reshape(())
+    return jnp.where(x > 0, x, a * x)
+
+
+# ---------------------------------------------------------------------------
+# Control flow (ref python/paddle/static/nn/control_flow.py) — these are the
+# public names that make data-dependent branching jit-compilable on TPU.
+# ---------------------------------------------------------------------------
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None):
+    """ref control_flow.py cond → ``lax.cond`` (both branches traced; XLA
+    selects at run time without host sync)."""
+    return jax.lax.cond(jnp.asarray(pred).astype(bool).reshape(()),
+                        lambda _: true_fn(), lambda _: false_fn(), None)
+
+
+def while_loop(cond_fn: Callable, body: Callable, loop_vars: Sequence[Any],
+               is_test: bool = False, name=None):
+    """ref control_flow.py while_loop → ``lax.while_loop`` (carried values
+    must keep static shapes/dtypes — the XLA contract)."""
+    loop_vars = tuple(loop_vars)
+
+    def _cond(vs):
+        return jnp.asarray(cond_fn(*vs)).astype(bool).reshape(())
+
+    def _body(vs):
+        out = body(*vs)
+        if not isinstance(out, (tuple, list)):
+            out = (out,)
+        return tuple(out)
+
+    return list(jax.lax.while_loop(_cond, _body, loop_vars))
+
+
+def case(pred_fn_pairs, default: Optional[Callable] = None, name=None):
+    """ref control_flow.py case: first true predicate wins. Lowered as a
+    nested lax.cond chain (predicates are traced values)."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    if default is None:
+        *pairs, (last_pred, last_fn) = list(pred_fn_pairs)
+        default = last_fn
+    else:
+        pairs = list(pred_fn_pairs)
+
+    def build(i):
+        if i == len(pairs):
+            return lambda: default()
+        pred, fn = pairs[i]
+        nxt = build(i + 1)
+        return lambda: jax.lax.cond(
+            jnp.asarray(pred).astype(bool).reshape(()),
+            lambda _: fn(), lambda _: nxt(), None)
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default: Optional[Callable] = None,
+                name=None):
+    """ref control_flow.py switch_case → ``lax.switch``."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    else:
+        items = list(enumerate(branch_fns))
+    max_idx = max(i for i, _ in items)
+    table = []
+    fallback = default if default is not None else items[-1][1]
+    by_idx = dict(items)
+    for i in range(max_idx + 1):
+        table.append(by_idx.get(i, fallback))
+    table.append(fallback)  # out-of-range → default (lax.switch clamps)
+    idx = jnp.clip(jnp.asarray(branch_index).reshape(()).astype(jnp.int32),
+                   0, max_idx + 1)
+    in_range = jnp.isin(jnp.asarray(branch_index).reshape(()),
+                        jnp.asarray([i for i, _ in items]))
+    idx = jnp.where(in_range, idx, max_idx + 1)
+    return jax.lax.switch(idx, [lambda fn=fn: fn() for fn in table])

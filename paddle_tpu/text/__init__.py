@@ -1,3 +1,4 @@
 from . import models  # noqa: F401
 from .ops import (viterbi_decode, edit_distance,  # noqa: F401
                   gather_tree, shard_index)
+from . import datasets  # noqa: F401

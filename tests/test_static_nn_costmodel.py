@@ -1,0 +1,276 @@
+"""Tests for paddle.static.nn, paddle.cost_model, and paddle.text.datasets.
+
+Reference anchors: python/paddle/static/nn/{common,control_flow}.py,
+python/paddle/cost_model/cost_model.py, python/paddle/text/datasets/.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.cost_model import CostModel
+from paddle_tpu.text import datasets as tds
+
+
+class TestStaticNN:
+    def setup_method(self):
+        self.prog = static.Program()
+        self.guard = static.program_guard(self.prog)
+        self.guard.__enter__()
+
+    def teardown_method(self):
+        self.guard.__exit__(None, None, None)
+
+    def test_fc_shapes_and_param_reuse(self):
+        x = jnp.ones((2, 3, 4), jnp.float32)
+        # paddle default num_flatten_dims=1: [2, 12] @ [12, 8]
+        out1 = static.nn.fc(x, 8, name="shared")
+        out2 = static.nn.fc(x, 8, name="shared")
+        assert out1.shape == (2, 8)
+        np.testing.assert_array_equal(np.asarray(out1), np.asarray(out2))
+        # -1: project the last dim only
+        out3 = static.nn.fc(x, 8, num_flatten_dims=-1, name="last")
+        assert out3.shape == (2, 3, 8)
+        assert "shared.w_0" in self.prog._params
+        assert "last.w_0" in self.prog._params
+
+    def test_auto_name_rejected_under_trace(self):
+        with pytest.raises(ValueError, match="explicit name"):
+            jax.jit(lambda x: static.nn.fc(x, 4))(jnp.ones((2, 3)))
+        # With an explicit name the same call traces fine and re-traces
+        # reuse the parameters.
+        f = jax.jit(lambda x: static.nn.fc(x, 4, name="jfc"))
+        a = f(jnp.ones((2, 3)))
+        b = f(jnp.ones((5, 3)))  # re-trace on new shape
+        np.testing.assert_array_equal(np.asarray(a[0]), np.asarray(b[0]))
+        assert sum(k.startswith("jfc") for k in self.prog._params) == 2
+
+    def test_fc_activation_and_no_bias(self):
+        x = -jnp.ones((2, 4), jnp.float32)
+        out = static.nn.fc(x, 4, activation="relu", name="r")
+        assert float(out.min()) >= 0.0
+        static.nn.fc(x, 4, bias_attr=False, name="nb")
+        assert "nb.b_0" not in self.prog._params
+
+    def test_embedding(self):
+        ids = jnp.asarray([[0, 1], [2, 3]], jnp.int32)
+        emb = static.nn.embedding(ids, (10, 6), name="emb")
+        assert emb.shape == (2, 2, 6)
+
+    def test_conv_bn_norms(self):
+        img = jnp.ones((2, 3, 8, 8), jnp.float32)
+        c = static.nn.conv2d(img, 4, 3, padding=1, act="relu", name="c")
+        assert c.shape == (2, 4, 8, 8)
+        bn = static.nn.batch_norm(c, name="bn")
+        assert bn.shape == c.shape
+        ln = static.nn.layer_norm(jnp.ones((2, 6)), name="ln")
+        assert abs(float(ln.mean())) < 1e-5
+        gn = static.nn.group_norm(img, 3, name="gn")
+        assert gn.shape == img.shape
+
+    def test_prelu_modes(self):
+        x = jnp.asarray([[-2.0, 4.0]], jnp.float32)
+        out = static.nn.prelu(x, mode="all", name="p1")
+        np.testing.assert_allclose(np.asarray(out), [[-0.5, 4.0]])
+        img = -jnp.ones((1, 3, 2, 2), jnp.float32)
+        outc = static.nn.prelu(img, mode="channel", name="p2")
+        np.testing.assert_allclose(np.asarray(outc), -0.25 * np.ones(
+            (1, 3, 2, 2)), atol=1e-6)
+        oute = static.nn.prelu(x, mode="element", name="p3")
+        assert oute.shape == x.shape
+        with pytest.raises(ValueError):
+            static.nn.prelu(x, mode="banana", name="p4")
+
+    def test_params_train_through_grad(self):
+        """Program params participate in autodiff via closure capture."""
+        x = jnp.ones((4, 4), jnp.float32)
+        static.nn.fc(x, 2, name="train_me")
+        w = self.prog._params["train_me.w_0"]
+
+        def loss(w_):
+            self.prog._params["train_me.w_0"] = w_
+            return jnp.sum(static.nn.fc(x, 2, name="train_me") ** 2)
+
+        g = jax.grad(loss)(w)
+        assert g.shape == w.shape
+        assert float(jnp.abs(g).max()) > 0
+
+
+class TestStaticControlFlow:
+    def test_cond(self):
+        t = static.nn.cond(jnp.asarray(True), lambda: jnp.float32(1),
+                           lambda: jnp.float32(2))
+        f = static.nn.cond(jnp.asarray(False), lambda: jnp.float32(1),
+                           lambda: jnp.float32(2))
+        assert float(t) == 1.0 and float(f) == 2.0
+
+    def test_cond_inside_jit(self):
+        @jax.jit
+        def run(x):
+            return static.nn.cond(x.sum() > 0, lambda: x * 2, lambda: -x)
+
+        np.testing.assert_allclose(np.asarray(run(jnp.ones(2))), 2.0)
+        np.testing.assert_allclose(np.asarray(run(-jnp.ones(2))), 1.0)
+
+    def test_while_loop(self):
+        i, acc = static.nn.while_loop(
+            lambda i, acc: i < 10,
+            lambda i, acc: (i + 1, acc + i),
+            [jnp.int32(0), jnp.int32(0)])
+        assert int(i) == 10 and int(acc) == 45
+
+    def test_while_loop_single_var(self):
+        (i,) = static.nn.while_loop(lambda i: i < 3, lambda i: i + 1,
+                                    [jnp.int32(0)])
+        assert int(i) == 3
+
+    def test_case_first_true_wins(self):
+        out = static.nn.case(
+            [(jnp.asarray(True), lambda: jnp.float32(1)),
+             (jnp.asarray(True), lambda: jnp.float32(2))],
+            default=lambda: jnp.float32(9))
+        assert float(out) == 1.0
+
+    def test_case_default_and_last_fallback(self):
+        out = static.nn.case(
+            [(jnp.asarray(False), lambda: jnp.float32(1)),
+             (jnp.asarray(False), lambda: jnp.float32(2))],
+            default=lambda: jnp.float32(9))
+        assert float(out) == 9.0
+        # No explicit default: last fn is the fallback.
+        out2 = static.nn.case(
+            [(jnp.asarray(False), lambda: jnp.float32(1)),
+             (jnp.asarray(False), lambda: jnp.float32(7))])
+        assert float(out2) == 7.0
+        with pytest.raises(ValueError):
+            static.nn.case([])
+
+    def test_switch_case(self):
+        fns = {0: lambda: jnp.float32(10), 2: lambda: jnp.float32(30)}
+        assert float(static.nn.switch_case(jnp.int32(0), fns)) == 10.0
+        assert float(static.nn.switch_case(jnp.int32(2), fns)) == 30.0
+        # gap index and out-of-range hit the default
+        assert float(static.nn.switch_case(
+            jnp.int32(1), fns, default=lambda: jnp.float32(-1))) == -1.0
+        assert float(static.nn.switch_case(
+            jnp.int32(99), fns, default=lambda: jnp.float32(-1))) == -1.0
+
+    def test_switch_case_list(self):
+        out = static.nn.switch_case(
+            jnp.int32(1), [lambda: jnp.float32(5), lambda: jnp.float32(6)])
+        assert float(out) == 6.0
+
+
+class TestCostModel:
+    def test_profile_measure_callable(self):
+        cm = CostModel()
+        res = cm.profile_measure(lambda a: a @ a, jnp.ones((128, 128)))
+        assert res["flops"] >= 2 * 128 ** 3
+        assert res["time"] > 0
+
+    def test_profile_measure_program(self):
+        prog = static.Program()
+        prog.set_build_fn(lambda x: x * 2 + 1)
+        cm = CostModel()
+        res = cm.profile_measure(prog, jnp.ones((64,)),
+                                 fetch_cost_list=())
+        assert "flops" in res and "time" not in res
+
+    def test_static_op_time_cached(self):
+        cm = CostModel()
+        t1 = cm.get_static_op_time("add")["op_time"]
+        assert t1 > 0
+        assert cm.get_static_op_time("add")["op_time"] == t1
+        assert "add(f)@float32" in cm.static_cost_data()
+
+    def test_backward_op_time(self):
+        cm = CostModel()
+        assert cm.get_static_op_time("tanh", forward=False)["op_time"] > 0
+
+    def test_unknown_op_raises(self):
+        with pytest.raises(ValueError):
+            CostModel().get_static_op_time("frobnicate")
+
+    def test_profile_measure_warmup0_iters0(self):
+        cm = CostModel()
+        res = cm.profile_measure(lambda a: a + 1, jnp.ones((8,)), warmup=0)
+        assert res["time"] > 0
+        with pytest.raises(ValueError):
+            cm.profile_measure(lambda a: a + 1, jnp.ones((8,)), iters=0)
+
+
+class TestTextDatasets:
+    def test_imdb_structure_and_signal(self):
+        d = tds.Imdb(mode="train", synthetic_size=64)
+        doc, label = d[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        assert len(d) == 64
+        assert len(d.word_idx) == 5147
+        # The synthetic task carries signal: mean word id differs by class.
+        pos = np.mean([d[i][0].mean() for i in range(64) if d[i][1] == 1])
+        neg = np.mean([d[i][0].mean() for i in range(64) if d[i][1] == 0])
+        assert pos > neg
+
+    def test_imdb_modes_differ(self):
+        a = tds.Imdb(mode="train", synthetic_size=8)
+        b = tds.Imdb(mode="test", synthetic_size=8)
+        assert not np.array_equal(a[0][0], b[0][0])
+        with pytest.raises(ValueError):
+            tds.Imdb(mode="banana")
+
+    def test_imikolov_ngram_and_seq(self):
+        d = tds.Imikolov(mode="train", synthetic_size=32, window_size=5)
+        assert len(d[0]) == 5
+        s = tds.Imikolov(mode="train", synthetic_size=32, data_type="SEQ")
+        src, trg = s[0]
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+        with pytest.raises(ValueError):
+            tds.Imikolov(data_type="TREE")
+
+    def test_uci_housing(self):
+        d = tds.UCIHousing(mode="train", synthetic_size=50)
+        x, y = d[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert x.dtype == np.float32
+
+    def test_movielens(self):
+        d = tds.Movielens(mode="train", synthetic_size=30)
+        row = d[0]
+        assert len(row) == 8
+        rating = row[-1]
+        assert 1.0 <= float(rating) <= 5.0
+
+    def test_conll05(self):
+        d = tds.Conll05(mode="train", synthetic_size=10, seq_len=12)
+        row = d[0]
+        assert len(row) == 9
+        words, *ctx, predicate, mark, labels = row
+        assert words.shape == (12,) and labels.shape == (12,)
+        assert int(mark.sum()) == 1
+
+    def test_wmt16_val_differs_from_test(self):
+        val = tds.WMT16(mode="val", synthetic_size=16)
+        test = tds.WMT16(mode="test", synthetic_size=16)
+        assert any(not np.array_equal(val[i][0], test[i][0])
+                   for i in range(16))
+
+    def test_wmt16(self):
+        d = tds.WMT16(mode="train", synthetic_size=16, seq_len=12)
+        src, trg, trg_next = d[0]
+        assert trg[0] == tds.WMT16.BOS
+        assert trg_next[-1] == tds.WMT16.EOS
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+        vocab = d.get_dict("en")
+        assert vocab["<s>"] == 0
+        rev = d.get_dict("en", reverse=True)
+        assert rev[0] == "<s>"
+
+    def test_dataloader_integration(self):
+        from paddle_tpu.io import DataLoader
+        d = tds.UCIHousing(mode="train", synthetic_size=32)
+        dl = DataLoader(d, batch_size=8, shuffle=False)
+        x, y = next(iter(dl))
+        assert x.shape == (8, 13) and y.shape == (8, 1)
