@@ -34,16 +34,39 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = -1e30
 
+from ...core import flags as _flags  # noqa: E402
+
+# Sweep hooks: set both to a 128-multiple (paddle.set_flags or
+# FLAGS_flash_block_q/_k env vars) to override the tuned table; 0 = auto.
+for _n in ("flash_block_q", "flash_block_k"):
+    if _n not in _flags.get_flags():
+        _flags.define_flag(_n, 0, "flash-attention block override (0=auto)")
+
 
 def _pick_blocks(sq: int, sk: int, d: int) -> tuple:
     """Autotuned (block_q, block_k) per head_dim for v5e-class VMEM: larger
     blocks amortize the sequential-grid overhead and keep the MXU busy
     (measured 1.8x over 128/128 at seq 1024, d 64). Returns the largest
-    128-multiple <= the tuned target that divides the sequence length."""
-    if d <= 64:
+    128-multiple <= the tuned target that divides the sequence length.
+    ``flash_block_q``/``flash_block_k`` flags override (sweep hook)."""
+    ov_q = int(_flags.flag("flash_block_q"))
+    ov_k = int(_flags.flag("flash_block_k"))
+    if ov_q or ov_k:
+        if not (ov_q and ov_k):
+            raise ValueError(
+                f"flash_block_q/flash_block_k must be set together "
+                f"(got q={ov_q}, k={ov_k}); set both or neither")
+        if ov_q % 128 or ov_k % 128:
+            raise ValueError(
+                f"flash block overrides must be multiples of 128; got "
+                f"q={ov_q}, k={ov_k}")
+        tq, tk = ov_q, ov_k
+    elif d <= 64:
         tq, tk = 512, 1024
     elif d <= 128:
-        tq, tk = 512, 512   # swept on-chip at seq 1024: 16.6ms vs 17.1 (256/512)
+        # swept on the 254M GPT bench step (B16 S1024 H8): 1024/1024 =
+        # 221.6ms vs 512/512 = 229.4ms (fewer grid steps, bigger MXU tiles)
+        tq, tk = 1024, 1024
     else:
         tq, tk = 128, 256
 
