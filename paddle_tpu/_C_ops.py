@@ -1,0 +1,65 @@
+"""Raw op-call shim (``paddle._C_ops`` parity).
+
+Reference: ``python/paddle/_C_ops.py:21`` re-exports the generated pybind
+wrappers (``core.eager.ops``) around PHI kernels. In the TPU build there is
+no Python/C++ boundary: the op table IS the Python functional surface
+(``tensor/*``, ``nn.functional``, jax.numpy). This shim keeps reference
+code that calls ``_C_ops.<name>(...)`` importable: names resolve against
+the public op modules, plus explicit wrappers where the C-op signature
+differs from the Python API (positional attrs like ``matmul``'s transpose
+flags).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def matmul(x, y, transpose_x: bool = False, transpose_y: bool = False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2)
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2)
+    return x @ y
+
+
+def scale(x, scale_=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale_ + bias
+    return (x + bias) * scale_
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, perm)
+
+
+def reshape(x, shape):
+    return jnp.reshape(x, shape)
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def _resolve(name: str):
+    from . import tensor as _tensor
+    from .nn import functional as _F
+
+    for mod in (_tensor, _F):
+        fn = getattr(mod, name, None)
+        if fn is not None and callable(fn):
+            return fn
+    fn = getattr(jnp, name, None)
+    if fn is not None and callable(fn):
+        return fn
+    # final_state_<op> / <op>_ aliases used by reference call sites
+    stripped = name.removeprefix("final_state_").rstrip("_")
+    if stripped != name:
+        return _resolve(stripped)
+    raise AttributeError(f"_C_ops has no op {name!r}")
+
+
+def __getattr__(name: str):
+    if name.startswith("__"):
+        raise AttributeError(name)
+    return _resolve(name)

@@ -63,6 +63,7 @@ from . import onnx  # noqa: F401
 from . import callbacks  # noqa: F401
 from . import reader  # noqa: F401
 from .batch import batch  # noqa: F401
+from . import _C_ops  # noqa: F401
 
 # paddle.Tensor alias: a Tensor IS a jax.Array.
 import jax as _jax

@@ -29,7 +29,8 @@ __all__ = [
     "Sequential", "LayerList", "ParameterList", "Pad2D", "Upsample",
     "CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCEWithLogitsLoss",
     "SmoothL1Loss", "KLDivLoss", "MultiHeadAttention", "TransformerEncoderLayer",
-    "TransformerEncoder", "Unfold",
+    "TransformerEncoder", "TransformerDecoderLayer", "TransformerDecoder",
+    "Transformer", "Unfold",
     # 2nd wave
     "ELU", "SELU", "CELU", "Hardshrink", "Hardtanh", "Softshrink", "Softsign",
     "Tanhshrink", "ThresholdedReLU", "LogSigmoid", "Maxout", "PReLU", "RReLU",
@@ -529,18 +530,35 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
+    def gen_cache(self, key, value=None, type=None):
+        """Empty KV cache for incremental decode (ref MultiHeadAttention
+        Cache/StaticCache). Returns (k, v) with zero-length sequence."""
+        b = key.shape[0]
+        empty = jnp.zeros((b, 0, self.num_heads, self.head_dim),
+                          key.dtype)
+        return (empty, empty)
+
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+        """With ``cache`` (a (k, v) pair from :meth:`gen_cache` or a prior
+        step), keys/values are appended to it and ``(out, new_cache)`` is
+        returned — paddle's incremental-decode contract."""
         key = query if key is None else key
         value = query if value is None else value
         b, sq, _ = query.shape
         q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
         k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads, self.head_dim)
         v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads, self.head_dim)
+        if cache is not None:
+            ck, cv = cache
+            k = jnp.concatenate([ck, k], axis=1)
+            v = jnp.concatenate([cv, v], axis=1)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
             training=self.training)
-        out = out.reshape(b, sq, self.embed_dim)
-        return self.out_proj(out)
+        out = self.out_proj(out.reshape(b, sq, self.embed_dim))
+        if cache is not None:
+            return out, (k, v)
+        return out
 
 
 class TransformerEncoderLayer(Layer):
@@ -599,6 +617,164 @@ class TransformerEncoder(Layer):
         if self.norm is not None:
             out = self.norm(out)
         return out
+
+
+class TransformerDecoderLayer(Layer):
+    """ref: python/paddle/nn/layer/transformer.py TransformerDecoderLayer —
+    self-attention (masked), cross-attention over encoder memory, FFN."""
+
+    def __init__(self, d_model: int, nhead: int, dim_feedforward: int,
+                 dropout: float = 0.1, activation: str = "relu",
+                 attn_dropout=None, act_dropout=None,
+                 normalize_before: bool = False, weight_attr=None,
+                 bias_attr=None, dtype=None):
+        super().__init__(dtype=dtype)
+        self.normalize_before = normalize_before
+        ad = attn_dropout if attn_dropout is not None else dropout
+        self.self_attn = MultiHeadAttention(d_model, nhead, ad,
+                                            weight_attr=weight_attr,
+                                            bias_attr=bias_attr)
+        self.cross_attn = MultiHeadAttention(d_model, nhead, ad,
+                                             weight_attr=weight_attr,
+                                             bias_attr=bias_attr)
+        self.linear1 = Linear(d_model, dim_feedforward, weight_attr,
+                              bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model, weight_attr,
+                              bias_attr)
+        self.norm1 = LayerNorm(d_model)
+        self.norm2 = LayerNorm(d_model)
+        self.norm3 = LayerNorm(d_model)
+        self.dropout1 = Dropout(dropout)
+        self.dropout2 = Dropout(dropout)
+        self.dropout3 = Dropout(dropout)
+        self.dropout_act = Dropout(act_dropout if act_dropout is not None
+                                   else dropout)
+        self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
+
+    def gen_cache(self, memory):
+        """Self-attention KV cache for incremental decode (ref
+        TransformerDecoderLayer.gen_cache)."""
+        return self.self_attn.gen_cache(memory)
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm1(tgt)
+        if cache is not None:
+            tgt, new_cache = self.self_attn(tgt, attn_mask=tgt_mask,
+                                            cache=cache)
+        else:
+            tgt = self.self_attn(tgt, attn_mask=tgt_mask)
+        tgt = residual + self.dropout1(tgt)
+        if not self.normalize_before:
+            tgt = self.norm1(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm2(tgt)
+        tgt = self.cross_attn(tgt, memory, memory, attn_mask=memory_mask)
+        tgt = residual + self.dropout2(tgt)
+        if not self.normalize_before:
+            tgt = self.norm2(tgt)
+        residual = tgt
+        if self.normalize_before:
+            tgt = self.norm3(tgt)
+        tgt = self.linear2(self.dropout_act(self.activation(
+            self.linear1(tgt))))
+        tgt = residual + self.dropout3(tgt)
+        if not self.normalize_before:
+            tgt = self.norm3(tgt)
+        if cache is not None:
+            return tgt, new_cache
+        return tgt
+
+
+class TransformerDecoder(Layer):
+    """ref: transformer.py TransformerDecoder (factory-based like
+    TransformerEncoder: pass a zero-arg layer factory)."""
+
+    def __init__(self, decoder_layer_fn, num_layers: int, norm=None):
+        super().__init__()
+        if not callable(decoder_layer_fn):
+            raise TypeError(
+                "pass a factory: TransformerDecoder(lambda: layer, N)")
+        self.layers = LayerList([decoder_layer_fn()
+                                 for _ in range(num_layers)])
+        self.norm = norm
+
+    def gen_cache(self, memory, do_zip: bool = False):
+        """Per-layer self-attention caches (ref TransformerDecoder
+        gen_cache)."""
+        caches = [layer.gen_cache(memory) for layer in self.layers]
+        return list(zip(*caches)) if do_zip else caches
+
+    def forward(self, tgt, memory, tgt_mask=None, memory_mask=None,
+                cache=None):
+        out = tgt
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            if cache is not None:
+                out, c = layer(out, memory, tgt_mask=tgt_mask,
+                               memory_mask=memory_mask, cache=cache[i])
+                new_caches.append(c)
+            else:
+                out = layer(out, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+        if self.norm is not None:
+            out = self.norm(out)
+        if cache is not None:
+            return out, new_caches
+        return out
+
+
+class Transformer(Layer):
+    """ref: transformer.py Transformer — full encoder-decoder seq2seq."""
+
+    def __init__(self, d_model: int = 512, nhead: int = 8,
+                 num_encoder_layers: int = 6, num_decoder_layers: int = 6,
+                 dim_feedforward: int = 2048, dropout: float = 0.1,
+                 activation: str = "relu", attn_dropout=None,
+                 act_dropout=None, normalize_before: bool = False,
+                 weight_attr=None, bias_attr=None,
+                 custom_encoder=None, custom_decoder=None):
+        super().__init__()
+        self.d_model, self.nhead = d_model, nhead
+        if custom_encoder is not None:
+            self.encoder = custom_encoder
+        else:
+            # Final norms are unconditional, matching the reference
+            # Transformer.__init__ (encoder_norm/decoder_norm always
+            # created), so state_dicts line up in both norm modes.
+            self.encoder = TransformerEncoder(
+                lambda: TransformerEncoderLayer(
+                    d_model, nhead, dim_feedforward, dropout, activation,
+                    attn_dropout, act_dropout, normalize_before,
+                    weight_attr, bias_attr),
+                num_encoder_layers, norm=LayerNorm(d_model))
+        if custom_decoder is not None:
+            self.decoder = custom_decoder
+        else:
+            self.decoder = TransformerDecoder(
+                lambda: TransformerDecoderLayer(
+                    d_model, nhead, dim_feedforward, dropout, activation,
+                    attn_dropout, act_dropout, normalize_before,
+                    weight_attr, bias_attr),
+                num_decoder_layers, norm=LayerNorm(d_model))
+
+    def forward(self, src, tgt, src_mask=None, tgt_mask=None,
+                memory_mask=None):
+        memory = self.encoder(src, src_mask=src_mask)
+        return self.decoder(tgt, memory, tgt_mask=tgt_mask,
+                            memory_mask=memory_mask)
+
+    @staticmethod
+    def generate_square_subsequent_mask(length: int):
+        """Causal mask: [length, length] with 0 on/below the diagonal and
+        -inf above (paddle's additive-mask convention)."""
+        import jax.numpy as jnp
+        return jnp.where(
+            jnp.tril(jnp.ones((length, length), bool)), 0.0, -jnp.inf
+        ).astype(jnp.float32)
 
 
 # -- 2nd wave: activation layers -------------------------------------------

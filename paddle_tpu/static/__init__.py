@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["InputSpec", "Program", "program_guard", "default_main_program",
-           "Executor", "data", "name_scope"]
+           "Executor", "data", "name_scope", "save_inference_model",
+           "load_inference_model", "gradients", "append_backward"]
 
 
 @dataclass(frozen=True)
@@ -116,5 +117,107 @@ class Executor:
         if not isinstance(out, (tuple, list)):
             out = [out]
         return list(out)
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars=None,
+                         executor=None, program: Optional[Program] = None,
+                         **kwargs) -> None:
+    """Export a Program for inference (ref ``python/paddle/static/io.py``
+    save_inference_model: program + params files). The TPU artifact is the
+    StableHLO export of the program's build function over the feed specs
+    plus the program's parameter store — written as ``.pdmodel`` /
+    ``.pdiparams`` like the reference."""
+    import pickle
+
+    import numpy as np
+    from jax import export as jax_export
+
+    program = program or default_main_program()
+    if program._build_fn is None:
+        raise RuntimeError("program has no build function; call "
+                           "set_build_fn first")
+    specs = []
+    for fv in feed_vars:
+        if isinstance(fv, InputSpec):
+            specs.append(fv.to_sds())
+        else:
+            specs.append(jax.ShapeDtypeStruct(tuple(fv.shape), fv.dtype))
+    params = dict(getattr(program, "_params", {}))
+
+    def fn(params_, *xs):
+        # Trace inside the program's own guard so static.nn layers resolve
+        # against ITS parameter store (not whatever program happens to be
+        # top-of-stack at save time), with the traced params swapped in.
+        with program_guard(program):
+            saved = getattr(program, "_params", {})
+            program._params = dict(params_)
+            try:
+                return program._build_fn(*xs)
+            finally:
+                program._params = saved
+
+    exported = jax_export.export(jax.jit(fn))(
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+        *specs)
+    import os
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"params": {k: np.asarray(v) for k, v in params.items()},
+                     "n_feeds": len(specs)}, f, protocol=4)
+
+
+def load_inference_model(path_prefix: str, executor=None):
+    """Load a saved inference program; returns (callable_program,
+    feed_names, fetch_names)-shaped tuple like the reference (names are
+    positional here — jax exports are positional)."""
+    import pickle
+
+    from jax import export as jax_export
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        blob = pickle.load(f)
+    params = {k: jnp.asarray(v) for k, v in blob["params"].items()}
+    n_inputs = int(blob["n_feeds"])
+
+    def run(*xs):
+        return exported.call(params, *xs)
+
+    return run, [f"x{i}" for i in range(n_inputs)], ["out"]
+
+
+def gradients(targets, inputs, target_gradients=None):
+    """ref ``python/paddle/static/gradients``: d(sum targets)/d inputs.
+    In the traced world targets must be produced by a function of inputs;
+    use the closure form: gradients(lambda *ins: loss, example_inputs)."""
+    if callable(targets):
+        example = inputs if isinstance(inputs, (tuple, list)) else [inputs]
+
+        def scalar(*xs):
+            out = targets(*xs)
+            return jnp.sum(out) if getattr(out, "ndim", 0) else out
+
+        grads = jax.grad(scalar, argnums=tuple(range(len(example))))(
+            *[jnp.asarray(x) for x in example])
+        return list(grads)
+    raise TypeError(
+        "the TPU build has no global graph to differentiate post-hoc; pass "
+        "a callable producing the target from the inputs: "
+        "static.gradients(lambda x: build(x), [x0])")
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """ref fluid append_backward. Under jit tracing, autodiff is functional
+    (jax.grad at call time), so there is no program to append ops to; this
+    exists to give porters an actionable error."""
+    raise RuntimeError(
+        "append_backward is a graph-mutation API; in paddle_tpu use "
+        "jax.grad / paddle_tpu.autograd.backward, or static.gradients with "
+        "a callable (functional autodiff replaces backward-op insertion)")
+
 
 from . import nn  # noqa: F401,E402

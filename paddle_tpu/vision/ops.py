@@ -1,0 +1,351 @@
+"""Detection/vision operators (``paddle.vision.ops`` parity).
+
+Reference: ``python/paddle/vision/ops.py`` (nms, roi_align, roi_pool,
+box_coder, prior_box, yolo_box, distribute_fpn_proposals, read_file,
+decode_jpeg — each backed by a fluid detection CUDA kernel). TPU-native
+design notes:
+
+- ``roi_align``/``roi_pool`` sample through
+  ``jax.scipy.ndimage.map_coordinates`` (bilinear gather — XLA lowers it to
+  dynamic-gathers that run well on TPU); sampling counts are static, per
+  XLA's static-shape contract, so ``sampling_ratio=-1`` (adaptive in the
+  CUDA kernel) resolves to a fixed 2 samples per bin axis.
+- ``nms`` computes the pairwise-IoU suppression with a jittable
+  ``lax.fori_loop`` over a keep mask; the final variable-length index
+  extraction is host-side (detection postprocessing is eager in paddle
+  too).
+- ``distribute_fpn_proposals`` returns variable-length per-level splits and
+  is therefore an eager (host) op.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.ndimage import map_coordinates
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "prior_box",
+           "yolo_box", "distribute_fpn_proposals", "read_file",
+           "decode_jpeg"]
+
+
+def _pairwise_iou(boxes):
+    """[N, 4] xyxy -> [N, N] IoU."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+    ix1 = jnp.maximum(x1[:, None], x1[None, :])
+    iy1 = jnp.maximum(y1[:, None], y1[None, :])
+    ix2 = jnp.minimum(x2[:, None], x2[None, :])
+    iy2 = jnp.minimum(y2[:, None], y2[None, :])
+    inter = jnp.maximum(ix2 - ix1, 0) * jnp.maximum(iy2 - iy1, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _nms_keep_mask(boxes, scores, iou_threshold: float):
+    """Jittable greedy NMS keep mask over score-sorted boxes."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    ious = _pairwise_iou(boxes[order])
+
+    def body(i, keep):
+        sup = keep[i] & (ious[i] > iou_threshold) & (jnp.arange(n) > i)
+        return keep & ~sup
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    return order, keep_sorted
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories: Optional[Sequence[int]] = None,
+        top_k: Optional[int] = None):
+    """Greedy hard NMS (ref ``vision/ops.py`` nms). ``boxes`` [N, 4] xyxy.
+    Returns kept indices sorted by descending score. With
+    ``category_idxs``/``categories``, suppression is per category (the
+    standard coordinate-offset trick)."""
+    boxes = jnp.asarray(boxes)
+    n = boxes.shape[0]
+    if scores is None:
+        scores_arr = jnp.arange(n, 0, -1, dtype=jnp.float32)  # keep order
+    else:
+        scores_arr = jnp.asarray(scores, jnp.float32)
+    nms_boxes = boxes
+    if category_idxs is not None:
+        # Shift each category into its own coordinate island so cross-
+        # category pairs never overlap.
+        cat = jnp.asarray(category_idxs)
+        span = jnp.max(boxes) - jnp.min(boxes) + 1.0
+        nms_boxes = boxes + (cat.astype(boxes.dtype) * span)[:, None]
+    order, keep_sorted = _nms_keep_mask(nms_boxes, scores_arr, iou_threshold)
+    kept = np.asarray(order)[np.asarray(keep_sorted)]
+    if top_k is not None:
+        kept = kept[:top_k]
+    return jnp.asarray(kept)
+
+
+def _roi_images(boxes_num, num_rois: int):
+    """Per-roi image index from the per-image roi counts."""
+    if boxes_num is None:
+        return jnp.zeros((num_rois,), jnp.int32)
+    boxes_num = jnp.asarray(boxes_num, jnp.int32)
+    return jnp.repeat(jnp.arange(boxes_num.shape[0], dtype=jnp.int32),
+                      boxes_num, total_repeat_length=num_rois)
+
+
+def _roi_sample(x, boxes, boxes_num, output_size, spatial_scale,
+                sampling_ratio, aligned, reduce):
+    """Shared RoIAlign/RoIPool sampler: S x S bilinear samples per output
+    bin, reduced by mean (align) or max (pool)."""
+    if isinstance(output_size, int):
+        ph = pw = output_size
+    else:
+        ph, pw = output_size
+    S = sampling_ratio if sampling_ratio and sampling_ratio > 0 else 2
+    x = jnp.asarray(x)
+    boxes = jnp.asarray(boxes, jnp.float32)
+    num_rois = boxes.shape[0]
+    img_ids = _roi_images(boxes_num, num_rois)
+    offset = 0.5 if aligned else 0.0
+    x1 = boxes[:, 0] * spatial_scale - offset
+    y1 = boxes[:, 1] * spatial_scale - offset
+    x2 = boxes[:, 2] * spatial_scale - offset
+    y2 = boxes[:, 3] * spatial_scale - offset
+    if not aligned:  # legacy: force rois to be at least 1x1
+        x2 = jnp.maximum(x2, x1 + 1.0)
+        y2 = jnp.maximum(y2, y1 + 1.0)
+    bin_h = (y2 - y1) / ph
+    bin_w = (x2 - x1) / pw
+    # Sample coordinates [R, ph*S] / [R, pw*S].
+    sy = (jnp.arange(ph * S) + 0.5) / S   # in bin units
+    sx = (jnp.arange(pw * S) + 0.5) / S
+    ys = y1[:, None] + bin_h[:, None] * sy[None, :]
+    xs = x1[:, None] + bin_w[:, None] * sx[None, :]
+
+    def sample_roi(img_id, ys_r, xs_r):
+        yy = jnp.broadcast_to(ys_r[:, None], (ph * S, pw * S))
+        xx = jnp.broadcast_to(xs_r[None, :], (ph * S, pw * S))
+
+        def per_channel(chan):
+            return map_coordinates(chan, [yy, xx], order=1, mode="constant",
+                                   cval=0.0)
+
+        return jax.vmap(per_channel)(x[img_id])   # [C, ph*S, pw*S]
+
+    samples = jax.vmap(sample_roi)(img_ids, ys, xs)  # [R, C, ph*S, pw*S]
+    c = x.shape[1]
+    samples = samples.reshape(num_rois, c, ph, S, pw, S)
+    if reduce == "max":
+        return samples.max(axis=(3, 5))
+    return samples.mean(axis=(3, 5))
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=1,
+              spatial_scale: float = 1.0, sampling_ratio: int = -1,
+              aligned: bool = True, name=None):
+    """RoIAlign (ref ``vision/ops.py`` roi_align): averaged bilinear samples
+    per output bin. ``x`` [N, C, H, W]; ``boxes`` [R, 4] xyxy in input
+    coords; ``boxes_num`` [N] rois per image."""
+    return _roi_sample(x, boxes, boxes_num, output_size, spatial_scale,
+                       sampling_ratio, aligned, "mean")
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=1,
+             spatial_scale: float = 1.0, name=None):
+    """RoIPool (max). The CUDA kernel maxes over every integer pixel in a
+    bin; with static shapes this maxes over a fixed 2x2 bilinear sample
+    grid per bin — equal for bins <= 2px and a tight approximation above."""
+    return _roi_sample(x, boxes, boxes_num, output_size, spatial_scale,
+                       2, False, "max")
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type: str = "encode_center_size",
+              box_normalized: bool = True, axis: int = 0, name=None):
+    """Encode/decode boxes against priors (ref fluid box_coder op).
+
+    encode: target [M, 4] xyxy vs priors [M, 4] -> offsets [M, 4]
+    decode: offsets [M, 4] + priors -> boxes [M, 4] xyxy
+    """
+    prior = jnp.asarray(prior_box, jnp.float32)
+    target = jnp.asarray(target_box, jnp.float32)
+    var = (jnp.asarray(prior_box_var, jnp.float32)
+           if prior_box_var is not None else jnp.ones((4,), jnp.float32))
+    norm = 0.0 if box_normalized else 1.0
+    pw = prior[:, 2] - prior[:, 0] + norm
+    ph = prior[:, 3] - prior[:, 1] + norm
+    pcx = prior[:, 0] + pw * 0.5
+    pcy = prior[:, 1] + ph * 0.5
+    if code_type == "encode_center_size":
+        tw = target[:, 2] - target[:, 0] + norm
+        th = target[:, 3] - target[:, 1] + norm
+        tcx = target[:, 0] + tw * 0.5
+        tcy = target[:, 1] + th * 0.5
+        out = jnp.stack([(tcx - pcx) / pw, (tcy - pcy) / ph,
+                         jnp.log(jnp.maximum(tw / pw, 1e-10)),
+                         jnp.log(jnp.maximum(th / ph, 1e-10))], axis=1)
+        return out / var.reshape(-1, 4)
+    if code_type == "decode_center_size":
+        d = target * var.reshape(-1, 4)
+        cx = d[:, 0] * pw + pcx
+        cy = d[:, 1] * ph + pcy
+        w = jnp.exp(d[:, 2]) * pw
+        h = jnp.exp(d[:, 3]) * ph
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm], axis=1)
+    raise ValueError(f"code_type must be encode/decode_center_size, got "
+                     f"{code_type!r}")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None,
+              aspect_ratios=(1.0,), variance=(0.1, 0.1, 0.2, 0.2),
+              flip: bool = False, clip: bool = False, steps=(0.0, 0.0),
+              offset: float = 0.5, min_max_aspect_ratios_order: bool = False,
+              name=None):
+    """SSD prior (anchor) boxes for one feature map (ref fluid prior_box).
+    Returns (boxes [H, W, A, 4] xyxy-normalized, variances same shape)."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ratios = list(aspect_ratios)
+    if flip:
+        ratios += [1.0 / r for r in aspect_ratios if r != 1.0]
+    whs = []
+    for i, ms in enumerate(min_sizes):
+        per_ms = [(ms * np.sqrt(r), ms / np.sqrt(r)) for r in ratios]
+        if max_sizes:
+            mx = max_sizes[i]
+            max_box = (np.sqrt(ms * mx), np.sqrt(ms * mx))
+            if min_max_aspect_ratios_order:
+                # ref ordering flag: [min(ratio=1), max, remaining ratios]
+                per_ms = per_ms[:1] + [max_box] + per_ms[1:]
+            else:
+                per_ms = per_ms + [max_box]
+        whs.extend(per_ms)
+    whs = jnp.asarray(whs, jnp.float32)                 # [A, 2]
+    cy = (jnp.arange(fh, dtype=jnp.float32) + offset) * step_h
+    cx = (jnp.arange(fw, dtype=jnp.float32) + offset) * step_w
+    cxg, cyg = jnp.meshgrid(cx, cy)                      # [H, W]
+    centers = jnp.stack([cxg, cyg], axis=-1)[:, :, None, :]  # [H, W, 1, 2]
+    half = whs[None, None, :, :] * 0.5
+    mins = (centers - half) / jnp.asarray([iw, ih], jnp.float32)
+    maxs = (centers + half) / jnp.asarray([iw, ih], jnp.float32)
+    boxes = jnp.concatenate([mins, maxs], axis=-1)       # [H, W, A, 4]
+    if clip:
+        boxes = jnp.clip(boxes, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32), boxes.shape)
+    return boxes, var
+
+
+def yolo_box(x, img_size, anchors, class_num: int, conf_thresh: float = 0.01,
+             downsample_ratio: int = 32, clip_bbox: bool = True,
+             scale_x_y: float = 1.0, iou_aware: bool = False,
+             iou_aware_factor: float = 0.5, name=None):
+    """Decode one YOLOv3 head (ref ``vision/ops.py`` yolo_box).
+
+    x: [N, A*(5+C), H, W]; img_size [N, 2] (h, w).
+    Returns (boxes [N, H*W*A, 4] xyxy in image coords,
+    scores [N, H*W*A, C]); below-threshold entries are zeroed (static
+    shapes; the CUDA kernel zeroes too).
+    """
+    x = jnp.asarray(x, jnp.float32)
+    n, _, h, w = x.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    ioup = None
+    if iou_aware:
+        # PP-YOLO layout [N, A*(6+C), H, W]: first A channels are the IoU
+        # predictions, the rest the standard head.
+        ioup = jax.nn.sigmoid(x[:, :na].reshape(n, na, h, w))
+        x = x[:, na:]
+    x = x.reshape(n, na, 5 + class_num, h, w)
+    gx = jnp.arange(w, dtype=jnp.float32)
+    gy = jnp.arange(h, dtype=jnp.float32)
+    alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+    bx = (alpha * jax.nn.sigmoid(x[:, :, 0]) + beta
+          + gx[None, None, None, :]) / w                      # [N,A,H,W]
+    by = (alpha * jax.nn.sigmoid(x[:, :, 1]) + beta
+          + gy[None, None, :, None]) / h
+    input_h = downsample_ratio * h
+    input_w = downsample_ratio * w
+    bw = jnp.exp(x[:, :, 2]) * an[None, :, 0, None, None] / input_w
+    bh = jnp.exp(x[:, :, 3]) * an[None, :, 1, None, None] / input_h
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    if ioup is not None:
+        conf = conf ** (1.0 - iou_aware_factor) * ioup ** iou_aware_factor
+    probs = jax.nn.sigmoid(x[:, :, 5:])                       # [N,A,C,H,W]
+    scores = conf[:, :, None] * probs
+    keep = (conf > conf_thresh)[:, :, None]
+    scores = jnp.where(keep, scores, 0.0)
+    img_h = jnp.asarray(img_size, jnp.float32)[:, 0]
+    img_w = jnp.asarray(img_size, jnp.float32)[:, 1]
+    sx = img_w[:, None, None, None]
+    sy = img_h[:, None, None, None]
+    x1 = (bx - bw * 0.5) * sx
+    y1 = (by - bh * 0.5) * sy
+    x2 = (bx + bw * 0.5) * sx
+    y2 = (by + bh * 0.5) * sy
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, sx - 1)
+        y1 = jnp.clip(y1, 0.0, sy - 1)
+        x2 = jnp.clip(x2, 0.0, sx - 1)
+        y2 = jnp.clip(y2, 0.0, sy - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1)              # [N,A,H,W,4]
+    boxes = boxes.transpose(0, 2, 3, 1, 4).reshape(n, -1, 4)
+    scores = scores.transpose(0, 3, 4, 1, 2).reshape(n, -1, class_num)
+    return boxes, scores
+
+
+def distribute_fpn_proposals(fpn_rois, min_level: int, max_level: int,
+                             refer_level: int, refer_scale: int,
+                             pixel_offset: bool = False, rois_num=None,
+                             name=None):
+    """Assign RoIs to FPN levels by scale (ref fluid
+    distribute_fpn_proposals): level = refer + log2(sqrt(area)/scale).
+    Variable-length outputs -> host-side op. Returns (per-level roi list,
+    restore_index [R, 1])."""
+    rois = np.asarray(fpn_rois, np.float32)
+    off = 1.0 if pixel_offset else 0.0
+    ws = rois[:, 2] - rois[:, 0] + off
+    hs = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(ws * hs, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, order = [], []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        outs.append(jnp.asarray(rois[idx]))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros((0,), np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    return outs, jnp.asarray(restore.reshape(-1, 1))
+
+
+def read_file(path: str, name=None):
+    """Raw file bytes as a uint8 tensor (ref ``vision/ops.py`` read_file)."""
+    with open(path, "rb") as f:
+        return jnp.asarray(np.frombuffer(f.read(), np.uint8))
+
+
+def decode_jpeg(x, mode: str = "unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (ref decode_jpeg; the
+    CUDA build uses nvJPEG — here PIL does the host-side decode)."""
+    import io
+
+    from ..utils import try_import
+    Image = try_import("PIL.Image")
+    img = Image.open(io.BytesIO(np.asarray(x).tobytes()))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return jnp.asarray(arr)

@@ -1,0 +1,382 @@
+"""Tests for paddle.vision.ops (detection operators), the nn.Transformer
+decoder family, paddle._C_ops, and static save/load_inference_model.
+
+Reference anchors: python/paddle/vision/ops.py,
+python/paddle/nn/layer/transformer.py, python/paddle/_C_ops.py,
+python/paddle/static/io.py.
+"""
+
+import os
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.vision import ops as vops
+
+
+class TestNMS:
+    def test_basic_suppression(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11],
+                             [50, 50, 60, 60]], jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7])
+        keep = np.asarray(vops.nms(boxes, 0.5, scores))
+        np.testing.assert_array_equal(keep, [0, 2])
+
+    def test_score_order_respected(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], jnp.float32)
+        scores = jnp.asarray([0.5, 0.9])  # second box wins
+        keep = np.asarray(vops.nms(boxes, 0.5, scores))
+        np.testing.assert_array_equal(keep, [1])
+
+    def test_no_scores_keeps_input_order(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], jnp.float32)
+        keep = np.asarray(vops.nms(boxes, 0.5))
+        np.testing.assert_array_equal(keep, [0])
+
+    def test_multiclass_no_cross_class_suppression(self):
+        boxes = jnp.asarray([[0, 0, 10, 10], [0, 0, 10, 10]], jnp.float32)
+        scores = jnp.asarray([0.9, 0.8])
+        cats = jnp.asarray([0, 1])
+        keep = np.asarray(vops.nms(boxes, 0.5, scores, category_idxs=cats,
+                                   categories=[0, 1]))
+        assert set(keep.tolist()) == {0, 1}
+
+    def test_top_k(self):
+        boxes = jnp.asarray([[i * 20, 0, i * 20 + 10, 10]
+                             for i in range(5)], jnp.float32)
+        scores = jnp.asarray([0.9, 0.8, 0.7, 0.6, 0.5])
+        keep = np.asarray(vops.nms(boxes, 0.5, scores, top_k=2))
+        np.testing.assert_array_equal(keep, [0, 1])
+
+
+class TestRoiOps:
+    def test_roi_align_values(self):
+        # Feature map = column index -> averaging a 4x4 roi into 2x2 bins
+        # gives the bin-center column means.
+        x = jnp.broadcast_to(jnp.arange(8.0), (1, 1, 8, 8))
+        rois = jnp.asarray([[0, 0, 4, 4]], jnp.float32)
+        out = vops.roi_align(x, rois, jnp.asarray([1]), output_size=2)
+        assert out.shape == (1, 1, 2, 2)
+        np.testing.assert_allclose(np.asarray(out[0, 0, 0]), [0.5, 2.5],
+                                   atol=1e-5)
+
+    def test_roi_align_multi_image(self):
+        x = jnp.stack([jnp.zeros((1, 8, 8)), jnp.ones((1, 8, 8))])
+        rois = jnp.asarray([[0, 0, 4, 4], [0, 0, 4, 4]], jnp.float32)
+        out = vops.roi_align(x, rois, jnp.asarray([1, 1]), output_size=1)
+        np.testing.assert_allclose(np.asarray(out).ravel(), [0.0, 1.0],
+                                   atol=1e-6)
+
+    def test_roi_align_spatial_scale_and_jit(self):
+        x = jnp.arange(64.0).reshape(1, 1, 8, 8)
+        rois = jnp.asarray([[0, 0, 16, 16]], jnp.float32)
+        f = jax.jit(lambda x, r: vops.roi_align(x, r, jnp.asarray([1]),
+                                                output_size=2,
+                                                spatial_scale=0.5))
+        out = f(x, rois)
+        assert out.shape == (1, 1, 2, 2)
+
+    def test_roi_pool_max(self):
+        x = jnp.zeros((1, 1, 8, 8)).at[0, 0, 3, 3].set(9.0)
+        rois = jnp.asarray([[0, 0, 8, 8]], jnp.float32)
+        out = vops.roi_pool(x, rois, jnp.asarray([1]), output_size=2)
+        assert float(out.max()) > 0  # the peak lands in one bin
+
+    def test_roi_align_grad(self):
+        x = jnp.arange(64.0).reshape(1, 1, 8, 8)
+        rois = jnp.asarray([[1, 1, 6, 6]], jnp.float32)
+        g = jax.grad(lambda x: jnp.sum(vops.roi_align(
+            x, rois, jnp.asarray([1]), output_size=2)))(x)
+        assert float(jnp.abs(g).sum()) > 0
+
+
+class TestBoxOps:
+    def test_box_coder_roundtrip(self):
+        priors = jnp.asarray([[0, 0, 10, 10], [5, 5, 20, 20]], jnp.float32)
+        var = jnp.asarray([0.1, 0.1, 0.2, 0.2])
+        targets = jnp.asarray([[1, 1, 9, 9], [6, 6, 22, 18]], jnp.float32)
+        enc = vops.box_coder(priors, var, targets, "encode_center_size")
+        dec = vops.box_coder(priors, var, enc, "decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec), np.asarray(targets),
+                                   atol=1e-3)
+        with pytest.raises(ValueError):
+            vops.box_coder(priors, var, targets, "banana")
+
+    def test_prior_box(self):
+        feat = jnp.zeros((1, 3, 4, 4))
+        img = jnp.zeros((1, 3, 32, 32))
+        boxes, var = vops.prior_box(feat, img, min_sizes=[8.0],
+                                    aspect_ratios=[1.0, 2.0], flip=True,
+                                    clip=True)
+        assert boxes.shape == (4, 4, 3, 4)  # 1 + 2 ratios (flip adds 0.5)
+        assert var.shape == boxes.shape
+        assert float(boxes.min()) >= 0.0 and float(boxes.max()) <= 1.0
+
+    def test_prior_box_min_max_order(self):
+        feat = jnp.zeros((1, 3, 2, 2))
+        img = jnp.zeros((1, 3, 16, 16))
+        a, _ = vops.prior_box(feat, img, min_sizes=[4.0], max_sizes=[8.0],
+                              aspect_ratios=[1.0, 2.0])
+        b, _ = vops.prior_box(feat, img, min_sizes=[4.0], max_sizes=[8.0],
+                              aspect_ratios=[1.0, 2.0],
+                              min_max_aspect_ratios_order=True)
+        assert a.shape == b.shape == (2, 2, 3, 4)
+        # default: max box last; ordered: max box second
+        np.testing.assert_allclose(np.asarray(a[0, 0, 2]),
+                                   np.asarray(b[0, 0, 1]), atol=1e-6)
+        assert not np.allclose(np.asarray(a), np.asarray(b))
+
+    def test_yolo_box_iou_aware(self):
+        na, classes, h = 3, 5, 4
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal(
+            (1, na * (6 + classes), h, h)), jnp.float32)
+        boxes, scores = vops.yolo_box(
+            x, jnp.asarray([[128, 128]]), anchors=[10, 13, 16, 30, 33, 23],
+            class_num=classes, iou_aware=True, iou_aware_factor=0.5)
+        assert boxes.shape == (1, h * h * na, 4)
+        assert scores.shape == (1, h * h * na, classes)
+        assert bool(jnp.isfinite(scores).all())
+
+    def test_yolo_box_shapes_and_range(self):
+        n_anchors, classes, h = 3, 5, 4
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, n_anchors * (5 + classes), h, h)), jnp.float32)
+        boxes, scores = vops.yolo_box(x, jnp.asarray([[128, 128], [64, 64]]),
+                                      anchors=[10, 13, 16, 30, 33, 23],
+                                      class_num=classes)
+        assert boxes.shape == (2, h * h * n_anchors, 4)
+        assert scores.shape == (2, h * h * n_anchors, classes)
+        assert float(scores.min()) >= 0.0
+
+    def test_distribute_fpn_proposals(self):
+        rois = jnp.asarray([[0, 0, 16, 16], [0, 0, 200, 200],
+                            [0, 0, 450, 450]], jnp.float32)
+        outs, restore = vops.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+        assert len(outs) == 4
+        assert sum(o.shape[0] for o in outs) == 3
+        # restore index maps concatenated-order back to input order
+        order = np.concatenate([np.asarray(o) for o in outs if o.shape[0]])
+        np.testing.assert_allclose(
+            order[np.asarray(restore).ravel()], np.asarray(rois))
+
+    def test_read_file_decode_jpeg(self, tmp_path):
+        from PIL import Image
+        img = Image.fromarray(
+            np.random.default_rng(0).integers(0, 255, (16, 16, 3),
+                                              dtype=np.uint8).astype(np.uint8))
+        p = tmp_path / "t.jpg"
+        img.save(p)
+        raw = vops.read_file(str(p))
+        assert raw.dtype == jnp.uint8
+        arr = vops.decode_jpeg(raw, mode="rgb")
+        assert arr.shape == (3, 16, 16)
+
+
+class TestTransformerFamily:
+    def setup_method(self):
+        paddle.seed(0)
+
+    def test_decoder_layer_shapes(self):
+        from paddle_tpu import nn
+        layer = nn.TransformerDecoderLayer(32, 4, 64, dropout=0.0)
+        layer.eval()
+        tgt = jnp.ones((2, 5, 32))
+        mem = jnp.ones((2, 7, 32))
+        assert layer(tgt, mem).shape == (2, 5, 32)
+
+    def test_full_transformer_and_mask(self):
+        from paddle_tpu import nn
+        tr = nn.Transformer(d_model=32, nhead=4, num_encoder_layers=2,
+                            num_decoder_layers=2, dim_feedforward=64,
+                            dropout=0.0)
+        tr.eval()
+        rng = np.random.default_rng(0)
+        src = jnp.asarray(rng.standard_normal((2, 6, 32)), jnp.float32)
+        tgt = jnp.asarray(rng.standard_normal((2, 5, 32)), jnp.float32)
+        mask = nn.Transformer.generate_square_subsequent_mask(5)
+        out = tr(src, tgt, tgt_mask=mask)
+        assert out.shape == (2, 5, 32)
+        assert bool(jnp.isfinite(out).all())
+
+    def test_causal_mask_blocks_future(self):
+        """With the causal mask, output at position t must not depend on
+        tgt positions > t."""
+        from paddle_tpu import nn
+        tr = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                            num_decoder_layers=1, dim_feedforward=32,
+                            dropout=0.0)
+        tr.eval()
+        rng = np.random.default_rng(0)
+        src = jnp.asarray(rng.standard_normal((1, 4, 16)), jnp.float32)
+        tgt = jnp.asarray(rng.standard_normal((1, 4, 16)), jnp.float32)
+        mask = nn.Transformer.generate_square_subsequent_mask(4)
+        base = tr(src, tgt, tgt_mask=mask)
+        bumped = tgt.at[0, 3].add(10.0)  # change only the last position
+        out = tr(src, bumped, tgt_mask=mask)
+        np.testing.assert_allclose(np.asarray(out[0, :3]),
+                                   np.asarray(base[0, :3]), atol=1e-5)
+
+    def test_normalize_before_variant(self):
+        from paddle_tpu import nn
+        tr = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                            num_decoder_layers=1, dim_feedforward=32,
+                            dropout=0.0, normalize_before=True)
+        tr.eval()
+        out = tr(jnp.ones((1, 3, 16)), jnp.ones((1, 2, 16)))
+        assert out.shape == (1, 2, 16)
+
+    def test_mha_cache_incremental_matches_full(self):
+        from paddle_tpu import nn
+        mha = nn.MultiHeadAttention(16, 2, dropout=0.0)
+        mha.eval()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((1, 4, 16)), jnp.float32)
+        causal = jnp.where(jnp.tril(jnp.ones((4, 4), bool)), 0.0, -jnp.inf)
+        full = mha(x, attn_mask=causal)
+        cache = mha.gen_cache(x)
+        outs = []
+        for t in range(4):
+            out, cache = mha(x[:, t:t + 1], cache=cache)
+            outs.append(out)
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate(outs, axis=1)), np.asarray(full),
+            atol=1e-5)
+
+    def test_decoder_cache_incremental_matches_full(self):
+        from paddle_tpu import nn
+        dec = nn.TransformerDecoder(
+            lambda: nn.TransformerDecoderLayer(16, 2, 32, dropout=0.0), 2)
+        dec.eval()
+        rng = np.random.default_rng(0)
+        tgt = jnp.asarray(rng.standard_normal((1, 4, 16)), jnp.float32)
+        mem = jnp.asarray(rng.standard_normal((1, 3, 16)), jnp.float32)
+        causal = jnp.where(jnp.tril(jnp.ones((4, 4), bool)), 0.0, -jnp.inf)
+        full = dec(tgt, mem, tgt_mask=causal)
+        cache = dec.gen_cache(mem)
+        outs = []
+        for t in range(4):
+            out, cache = dec(tgt[:, t:t + 1], mem, cache=cache)
+            outs.append(out)
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate(outs, axis=1)), np.asarray(full),
+            atol=1e-5)
+
+    def test_final_norms_always_present(self):
+        from paddle_tpu import nn
+        tr = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                            num_decoder_layers=1, dim_feedforward=32)
+        keys = set(tr.state_dict())
+        assert any("encoder.norm" in k for k in keys)
+        assert any("decoder.norm" in k for k in keys)
+
+    def test_trains(self):
+        from paddle_tpu import nn
+        from paddle_tpu.framework.functional import (functional_call,
+                                                     get_params)
+        tr = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                            num_decoder_layers=1, dim_feedforward=32,
+                            dropout=0.0)
+        tr.train()
+        params = get_params(tr)
+        src = jnp.ones((2, 3, 16))
+        tgt = jnp.ones((2, 3, 16))
+
+        def loss(p):
+            return jnp.mean(functional_call(tr, p, src, tgt,
+                                            training=True) ** 2)
+
+        g = jax.grad(loss)(params)
+        assert all(bool(jnp.isfinite(v).all()) for v in g.values())
+
+
+class TestCOps:
+    def test_matmul_flags(self):
+        a = jnp.ones((2, 3))
+        out = paddle._C_ops.matmul(a, a, False, True)
+        assert out.shape == (2, 2)
+        out = paddle._C_ops.matmul(a, a, True, False)
+        assert out.shape == (3, 3)
+
+    def test_resolution_chain(self):
+        np.testing.assert_allclose(
+            np.asarray(paddle._C_ops.relu(jnp.asarray([-1.0, 2.0]))),
+            [0.0, 2.0])
+        np.testing.assert_allclose(
+            np.asarray(paddle._C_ops.final_state_add(jnp.ones(2),
+                                                     jnp.ones(2))), 2.0)
+        # trailing-underscore (inplace-style) alias
+        np.testing.assert_allclose(
+            np.asarray(paddle._C_ops.relu_(jnp.asarray([-3.0, 1.0]))),
+            [0.0, 1.0])
+
+    def test_scale_and_cast(self):
+        out = paddle._C_ops.scale(jnp.ones(2), 2.0, 1.0, True)
+        np.testing.assert_allclose(np.asarray(out), 3.0)
+        out = paddle._C_ops.scale(jnp.ones(2), 2.0, 1.0, False)
+        np.testing.assert_allclose(np.asarray(out), 4.0)
+        assert paddle._C_ops.cast(jnp.ones(2), jnp.int32).dtype == jnp.int32
+
+    def test_unknown_raises(self):
+        with pytest.raises(AttributeError):
+            paddle._C_ops.definitely_not_an_op
+
+
+class TestStaticInferenceModel:
+    def test_save_load_roundtrip(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            def build(x):
+                h = static.nn.fc(x, 8, activation="relu", name="h0")
+                return static.nn.fc(h, 2, name="h1")
+            prog.set_build_fn(build)
+            ref = build(jnp.ones((3, 4)))
+        with tempfile.TemporaryDirectory() as d:
+            prefix = os.path.join(d, "m")
+            with static.program_guard(prog):
+                static.save_inference_model(
+                    prefix, [static.InputSpec((3, 4))], program=prog)
+            assert os.path.isfile(prefix + ".pdmodel")
+            assert os.path.isfile(prefix + ".pdiparams")
+            run, feeds, fetches = static.load_inference_model(prefix)
+            assert len(feeds) == 1  # one feed, however many param leaves
+            out = run(jnp.ones((3, 4)))
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=1e-6)
+
+    def test_save_outside_guard(self):
+        """Saving with program= while a DIFFERENT program is active must
+        still export the given program's parameters (not re-init)."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            def build(x):
+                return static.nn.fc(x, 2, name="og")
+            prog.set_build_fn(build)
+            ref = build(jnp.ones((2, 3)))
+        other = static.Program()
+        with tempfile.TemporaryDirectory() as d, \
+                static.program_guard(other):
+            prefix = os.path.join(d, "m2")
+            static.save_inference_model(prefix, [static.InputSpec((2, 3))],
+                                        program=prog)
+            run, _, _ = static.load_inference_model(prefix)
+            np.testing.assert_allclose(np.asarray(run(jnp.ones((2, 3)))),
+                                       np.asarray(ref), atol=1e-6)
+
+    def test_gradients_closure(self):
+        g = static.gradients(lambda x: jnp.sum(x ** 3),
+                             [jnp.asarray([1.0, 2.0])])
+        np.testing.assert_allclose(np.asarray(g[0]), [3.0, 12.0])
+
+    def test_gradients_posthoc_rejected(self):
+        with pytest.raises(TypeError):
+            static.gradients(jnp.ones(3), jnp.ones(3))
+
+    def test_append_backward_actionable_error(self):
+        with pytest.raises(RuntimeError, match="jax.grad"):
+            static.append_backward(jnp.ones(()))
