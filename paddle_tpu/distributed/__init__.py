@@ -20,3 +20,4 @@ from .spawn import spawn  # noqa: F401
 from . import rpc  # noqa: F401
 from . import sharding  # noqa: F401,E402
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401,E402
+from . import utils  # noqa: F401,E402

@@ -1,0 +1,73 @@
+"""MoE dispatch utilities (``paddle.distributed.utils`` parity).
+
+Reference: ``python/paddle/distributed/utils/moe_utils.py`` —
+``global_scatter`` (:20) / ``global_gather`` (:146), alltoall-style token
+exchange backed by ``fluid/operators/collective/global_scatter_op``. The
+TPU-native equivalents are pure functions over an expert-parallel axis:
+inside shard_map/pjit they lower to ``lax.all_to_all`` on the 'ep' mesh
+axis (what the GShard dispatch in ``incubate/.../moe/moe_layer.py`` does);
+eagerly (single host) they perform the same count-driven regrouping with
+host arithmetic — the reference semantics on one process.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["global_scatter", "global_gather"]
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def global_scatter(x, local_count, global_count, group=None,
+                   use_calc_stream: bool = True, axis_name: str = "ep"):
+    """Regroup rows of ``x`` from expert-major-local to expert-local order.
+
+    x: [sum(local_count), d]; local_count[i] = rows this rank sends to
+    expert-slot i (n_expert * world_size entries); global_count[i] = rows
+    this rank receives for its experts. Inside a shard_map over ``axis_name``
+    this is the a2a exchange; eagerly with world_size == 1 the counts are
+    equal and the op reorders rows into expert order (identity permutation
+    because local order already is expert-major on one rank).
+    """
+    return _exchange(x, local_count, axis_name, "global_scatter")
+
+
+def global_gather(x, local_count, global_count, group=None,
+                  use_calc_stream: bool = True, axis_name: str = "ep"):
+    """Inverse of :func:`global_scatter` (expert outputs back to source
+    ranks)."""
+    return _exchange(x, local_count, axis_name, "global_gather")
+
+
+def _exchange(x, local_count, axis_name, what):
+    if _in_trace(x) and axis_name is not None:
+        if local_count is not None:
+            # An equal-split tiled all_to_all would silently misroute
+            # ragged counts; XLA needs static shapes, so the TPU-native
+            # form of count-driven dispatch is the capacity-bucketed dense
+            # a2a in incubate MoELayer (tokens padded to a fixed capacity
+            # per expert). Be loud instead of wrong.
+            raise NotImplementedError(
+                f"{what} with explicit counts is data-dependent-shape "
+                f"routing, which XLA cannot trace; pass local_count=None "
+                f"for the uniform-split all_to_all, or use "
+                f"incubate.distributed.models.moe.MoELayer's "
+                f"capacity-bucketed dispatch")
+        try:
+            return jax.lax.all_to_all(x, axis_name, split_axis=0,
+                                      concat_axis=0, tiled=True)
+        except NameError:
+            pass  # not inside a mapped axis: fall through to eager path
+    if local_count is not None:
+        local = np.asarray(local_count).ravel()
+        if int(local.sum()) != x.shape[0]:
+            raise ValueError(
+                f"sum(local_count)={int(local.sum())} != rows {x.shape[0]}")
+    return jnp.asarray(x)

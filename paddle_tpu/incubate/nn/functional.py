@@ -22,6 +22,7 @@ from ...ops.flash_attention import flash_attention
 __all__ = [
     "fused_linear", "fused_matmul_bias", "fused_feedforward",
     "fused_multi_head_attention", "fused_bias_dropout_residual_layer_norm",
+    "fused_rms_norm",
 ]
 
 
@@ -135,4 +136,20 @@ def fused_multi_head_attention(
         out = residual + out
     if not pre_layer_norm:
         out = F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None,
+                   epsilon: float = 1e-6, begin_norm_axis: int = -1):
+    """ref ``incubate/nn/functional/fused_rms_norm.py`` — on TPU the rms
+    normalization chain is one XLA fusion already. Normalizes jointly over
+    axes [begin_norm_axis, ndim), the reference semantics."""
+    axes = tuple(range(begin_norm_axis % x.ndim, x.ndim))
+    x32 = x.astype(jnp.float32)
+    rms = jnp.sqrt(jnp.mean(x32 * x32, axis=axes, keepdims=True) + epsilon)
+    out = (x32 / rms).astype(x.dtype)
+    if norm_weight is not None:
+        out = out * norm_weight.reshape(x.shape[axes[0]:])
+    if norm_bias is not None:
+        out = out + norm_bias.reshape(x.shape[axes[0]:])
     return out

@@ -53,6 +53,9 @@ __all__ = [
     # 1-D conv/pool
     "conv1d", "conv1d_transpose", "max_pool1d", "avg_pool1d",
     "adaptive_avg_pool1d",
+    # extension ops (3rd wave)
+    "sequence_mask", "temporal_shift", "pixel_unshuffle", "upsample",
+    "dice_loss", "npair_loss", "margin_cross_entropy", "class_center_sample",
 ]
 
 
@@ -1278,3 +1281,142 @@ def adaptive_avg_pool1d(x, output_size: int, data_format: str = "NCL"):
     assert data_format == "NCL"
     out = adaptive_avg_pool2d(x[:, :, None, :], (1, output_size))
     return out[:, :, 0, :]
+
+
+# ---------------------------------------------------------------------------
+# Extension ops (3rd wave) — ref python/paddle/nn/functional/extension.py
+# and loss.py
+# ---------------------------------------------------------------------------
+
+def sequence_mask(x, maxlen=None, dtype="int64"):
+    """mask[..., j] = j < x[...] (ref extension.py:154). ``maxlen`` must be
+    static under jit (XLA shapes); defaults to max(x) eagerly."""
+    x = jnp.asarray(x)
+    if maxlen is None:
+        maxlen = int(jnp.max(x))
+    steps = jnp.arange(maxlen, dtype=x.dtype)
+    # canonicalize (int64 -> int32 without x64) to avoid per-call warnings
+    out_dtype = jax.dtypes.canonicalize_dtype(jnp.dtype(dtype))
+    return (steps < x[..., None]).astype(out_dtype)
+
+
+def temporal_shift(x, seg_num: int, shift_ratio: float = 0.25,
+                   data_format: str = "NCHW"):
+    """TSM channel shift across the segment (time) axis
+    (ref extension.py:343): the first ``shift_ratio`` channels read from
+    t-1, the next block from t+1, the rest stay."""
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    x5 = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    pad_prev = jnp.pad(x5[:, :-1, :c1], ((0, 0), (1, 0), (0, 0), (0, 0),
+                                         (0, 0)))
+    pad_next = jnp.pad(x5[:, 1:, c1:c2], ((0, 0), (0, 1), (0, 0), (0, 0),
+                                          (0, 0)))
+    out = jnp.concatenate([pad_prev, pad_next, x5[:, :, c2:]], axis=2)
+    out = out.reshape(nt, c, h, w)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def pixel_unshuffle(x, downscale_factor: int, data_format: str = "NCHW"):
+    """Inverse of pixel_shuffle (ref vision.py pixel_unshuffle)."""
+    r = downscale_factor
+    if data_format == "NHWC":
+        x = jnp.moveaxis(x, -1, 1)
+    n, c, h, w = x.shape
+    out = x.reshape(n, c, h // r, r, w // r, r)
+    out = out.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r,
+                                                  w // r)
+    if data_format == "NHWC":
+        out = jnp.moveaxis(out, 1, -1)
+    return out
+
+
+def upsample(x, size=None, scale_factor=None, mode: str = "nearest",
+             align_corners: bool = False, data_format: str = "NCHW"):
+    """Alias of interpolate (ref common.py upsample). ``align_corners`` is
+    accepted for parity; jax.image.resize uses half-pixel centers (the
+    align_corners=False convention)."""
+    return interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                       data_format=data_format)
+
+
+def dice_loss(input, label, epsilon: float = 1e-5):
+    """ref loss.py:35 — 1 - 2|X∩Y| / (|X|+|Y|); input [..., C] probs,
+    label [..., 1] int."""
+    label = jnp.asarray(label)
+    if label.ndim == input.ndim and label.shape[-1] == 1:
+        label = label[..., 0]
+    onehot = jax.nn.one_hot(label, input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * onehot, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(onehot,
+                                                       axis=reduce_dims)
+    return jnp.mean(1.0 - (2.0 * inter + epsilon) / (union + epsilon))
+
+
+def npair_loss(anchor, positive, labels, l2_reg: float = 0.002):
+    """N-pair metric loss (ref loss.py:311): softmax CE over anchor·posᵀ
+    similarities with same-label targets + L2 on the embeddings."""
+    anchor = jnp.asarray(anchor, jnp.float32)
+    positive = jnp.asarray(positive, jnp.float32)
+    labels = jnp.asarray(labels)
+    reg = (jnp.sum(anchor ** 2) + jnp.sum(positive ** 2)) \
+        / anchor.shape[0] * (l2_reg * 0.25)
+    sim = anchor @ positive.T                      # [B, B]
+    same = (labels[:, None] == labels[None, :]).astype(jnp.float32)
+    target = same / jnp.maximum(same.sum(-1, keepdims=True), 1.0)
+    ce = cross_entropy(sim, target, soft_label=True, reduction="mean")
+    return ce + reg
+
+
+def margin_cross_entropy(logits, label, margin1: float = 1.0,
+                         margin2: float = 0.5, margin3: float = 0.0,
+                         scale: float = 64.0, group=None,
+                         return_softmax: bool = False,
+                         reduction: str = "mean"):
+    """ArcFace/CosFace-family margin softmax (ref loss.py:2082; the
+    reference's hybrid-parallel op shards classes over the mp group — under
+    GSPMD the same sharding falls out of the logits' PartitionSpec, so one
+    formula serves both). logits are cosines in [-1, 1]:
+    target logit -> cos(m1·θ + m2) - m3, all scaled by ``scale``."""
+    logits = jnp.asarray(logits, jnp.float32)
+    label = jnp.asarray(label)
+    if label.ndim == logits.ndim and label.shape[-1] == 1:
+        label = label[..., 0]
+    theta = jnp.arccos(jnp.clip(logits, -1.0 + 1e-7, 1.0 - 1e-7))
+    modified = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=jnp.bool_)
+    out = jnp.where(onehot, modified, logits) * scale
+    loss = cross_entropy(out, label, reduction=reduction)
+    if return_softmax:
+        return loss, jax.nn.softmax(out, axis=-1)
+    return loss
+
+
+def class_center_sample(label, num_classes: int, num_samples: int,
+                        group=None, seed: Optional[int] = None):
+    """PartialFC negative-class sampling (ref common.py
+    class_center_sample): keep all positive classes plus uniformly sampled
+    negatives; returns (remapped_label, sampled_class_indices). Host-side
+    (variable-length class sets are data-dependent)."""
+    import numpy as np
+    label_np = np.asarray(label).ravel()
+    pos = np.unique(label_np)
+    rng = np.random.default_rng(seed)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rng.choice(neg_pool, size=num_samples - len(pos),
+                           replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    return jnp.asarray(remap[label_np].reshape(np.asarray(label).shape)), \
+        jnp.asarray(sampled)
