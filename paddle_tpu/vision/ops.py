@@ -27,9 +27,26 @@ import jax.numpy as jnp
 import numpy as np
 from jax.scipy.ndimage import map_coordinates
 
+from ..nn.layer import Layer as _Layer
+
 __all__ = ["nms", "roi_align", "roi_pool", "box_coder", "prior_box",
            "yolo_box", "distribute_fpn_proposals", "read_file",
            "decode_jpeg"]
+
+
+def _pairwise_iou_np(boxes: np.ndarray, offset: float = 0.0) -> np.ndarray:
+    """Host-side [N, 4] xyxy -> [N, N] IoU (offset=1 for the
+    integer-coordinate normalized=False convention)."""
+    x1, y1, x2, y2 = boxes[:, 0], boxes[:, 1], boxes[:, 2], boxes[:, 3]
+    area = np.maximum(x2 - x1 + offset, 0) * np.maximum(y2 - y1 + offset, 0)
+    ix1 = np.maximum(x1[:, None], x1[None, :])
+    iy1 = np.maximum(y1[:, None], y1[None, :])
+    ix2 = np.minimum(x2[:, None], x2[None, :])
+    iy2 = np.minimum(y2[:, None], y2[None, :])
+    inter = np.maximum(ix2 - ix1 + offset, 0) * \
+        np.maximum(iy2 - iy1 + offset, 0)
+    union = area[:, None] + area[None, :] - inter
+    return inter / np.maximum(union, 1e-10)
 
 
 def _pairwise_iou(boxes):
@@ -349,3 +366,171 @@ def decode_jpeg(x, mode: str = "unchanged", name=None):
     else:
         arr = arr.transpose(2, 0, 1)
     return jnp.asarray(arr)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups: int = 1, groups: int = 1,
+                  mask=None, name=None):
+    """Deformable convolution v1/v2 (ref ``vision/ops.py`` deform_conv2d →
+    ``fluid/operators/deformable_conv_op``).
+
+    x [N, Cin, H, W]; offset [N, 2*dg*kh*kw, Ho, Wo] with channel 2k = Δy
+    and 2k+1 = Δx of tap k; mask (v2) [N, dg*kh*kw, Ho, Wo]. Sampling is
+    bilinear via map_coordinates (XLA gathers); taps/channels vectorize
+    with vmap — no im2col buffer.
+    """
+    from ..nn.functional import _pair
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+    n, cin, h, w = x.shape
+    cout, cpg, kh, kw = weight.shape
+    k = kh * kw
+    dg = deformable_groups
+    offset = jnp.asarray(offset, jnp.float32)
+    ho, wo = offset.shape[2], offset.shape[3]
+    if offset.shape[1] != 2 * dg * k:
+        raise ValueError(
+            f"offset channels {offset.shape[1]} != 2*dg*kh*kw = {2 * dg * k}")
+    # Base sampling grid per tap: [k, Ho, Wo]
+    ys = (jnp.arange(ho) * sh - ph)[None, :, None] + \
+        (jnp.arange(kh) * dh).repeat(kw)[:, None, None]
+    xs = (jnp.arange(wo) * sw - pw)[None, None, :] + \
+        jnp.tile(jnp.arange(kw) * dw, kh)[:, None, None]
+    off = offset.reshape(n, dg, k, 2, ho, wo)
+    py = ys[None, None] + off[:, :, :, 0]          # [N, dg, k, Ho, Wo]
+    px = xs[None, None] + off[:, :, :, 1]
+    if mask is not None:
+        m = jnp.asarray(mask, jnp.float32).reshape(n, dg, k, ho, wo)
+    else:
+        m = jnp.ones((n, dg, k, ho, wo), jnp.float32)
+
+    ch_per_dg = cin // dg
+
+    def sample_image(xi, pyi, pxi, mi):
+        # xi [Cin, H, W]; pyi/pxi/mi [dg, k, Ho, Wo]
+        def per_channel(c):
+            g = c // ch_per_dg
+            vals = map_coordinates(xi[c].astype(jnp.float32),
+                                   [pyi[g], pxi[g]], order=1,
+                                   mode="constant", cval=0.0)
+            return vals * mi[g]                     # [k, Ho, Wo]
+        return jax.vmap(per_channel)(jnp.arange(cin))  # [Cin, k, Ho, Wo]
+
+    sampled = jax.vmap(sample_image)(x, py, px, m)   # [N, Cin, k, Ho, Wo]
+    wk = weight.reshape(cout, cpg, k).astype(jnp.float32)
+    if groups == 1:
+        out = jnp.einsum("nckhw,ock->nohw", sampled, wk)
+    else:
+        outs = []
+        cout_g = cout // groups
+        for g in range(groups):
+            sg = sampled[:, g * cpg:(g + 1) * cpg]
+            wg = wk[g * cout_g:(g + 1) * cout_g]
+            outs.append(jnp.einsum("nckhw,ock->nohw", sg, wg))
+        out = jnp.concatenate(outs, axis=1)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(x.dtype)
+
+
+class DeformConv2D(_Layer):
+    """Layer wrapper over :func:`deform_conv2d` (ref ``vision/ops.py``
+    DeformConv2D)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from ..nn import initializer as I
+        from ..nn.functional import _pair
+
+        super().__init__()
+        kh, kw = _pair(kernel_size)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        self.deformable_groups, self.groups = deformable_groups, groups
+        fan_in = in_channels // groups * kh * kw
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups, kh, kw),
+            attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_channels,), attr=bias_attr,
+                                           is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(
+            x, offset, self.weight, self.bias, stride=self.stride,
+            padding=self.padding, dilation=self.dilation,
+            deformable_groups=self.deformable_groups, groups=self.groups,
+            mask=mask)
+
+
+def matrix_nms(bboxes, scores, score_threshold: float, post_threshold: float,
+               nms_top_k: int, keep_top_k: int, use_gaussian: bool = False,
+               gaussian_sigma: float = 2.0, background_label: int = 0,
+               normalized: bool = True, return_index: bool = False,
+               return_rois_num: bool = True, name=None):
+    """Matrix NMS (ref ``vision/ops.py`` matrix_nms, SOLOv2): instead of
+    hard suppression, each box's score decays by the worst overlap with any
+    higher-scored box of its class. Variable-length output -> host-side op.
+
+    bboxes [N, M, 4]; scores [N, C, M]. Returns (out [R, 6]
+    (label, score, x1, y1, x2, y2), index [R, 1] if requested,
+    rois_num [N]).
+    """
+    bboxes_np = np.asarray(bboxes, np.float32)
+    scores_np = np.asarray(scores, np.float32)
+    n, c, m = scores_np.shape
+    outs, idxs, counts = [], [], []
+    for b in range(n):
+        per_img = []
+        per_idx = []
+        for cls in range(c):
+            if cls == background_label:
+                continue
+            s = scores_np[b, cls]
+            sel = np.nonzero(s > score_threshold)[0]
+            if sel.size == 0:
+                continue
+            order = sel[np.argsort(-s[sel])][:nms_top_k]
+            iou = _pairwise_iou_np(bboxes_np[b, order],
+                                   offset=0.0 if normalized else 1.0)
+            iou = np.triu(iou, 1)        # iou[i, j], i higher-scored than j
+            # SOLOv2 matrix decay: decay_j = min_i f(iou_ij) / f(comp_i),
+            # comp_i = box i's own worst overlap with anything above it
+            # (= column max of the upper triangle).
+            comp = iou.max(axis=0)
+
+            def f(x):
+                return np.exp(-(x ** 2) / gaussian_sigma) if use_gaussian \
+                    else 1.0 - x
+
+            ratio = f(iou) / np.maximum(f(comp)[:, None], 1e-12)
+            tri = np.triu(np.ones_like(iou, bool), 1)
+            ratio = np.where(tri, ratio, np.inf)
+            decay = np.minimum(ratio.min(axis=0, initial=np.inf), 1.0)
+            new_scores = s[order] * decay
+            keep = new_scores > post_threshold
+            for i, ok in zip(range(len(order)), keep):
+                if ok:
+                    per_img.append((float(cls), float(new_scores[i]),
+                                    *bboxes_np[b, order[i]].tolist()))
+                    per_idx.append(b * m + order[i])
+        if per_img:
+            pack = sorted(zip(per_img, per_idx), key=lambda t: -t[0][1])
+            pack = pack[:keep_top_k] if keep_top_k > 0 else pack
+            per_img = [p for p, _ in pack]
+            per_idx = [i for _, i in pack]
+        outs.extend(per_img)
+        idxs.extend(per_idx)
+        counts.append(len(per_img))
+    out = jnp.asarray(np.asarray(outs, np.float32).reshape(-1, 6))
+    result = [out]
+    if return_index:
+        result.append(jnp.asarray(np.asarray(idxs, np.int64).reshape(-1, 1)))
+    if return_rois_num:
+        result.append(jnp.asarray(np.asarray(counts, np.int64)))
+    return tuple(result) if len(result) > 1 else out
+
+
+__all__ += ["deform_conv2d", "DeformConv2D", "matrix_nms"]

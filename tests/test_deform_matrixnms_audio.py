@@ -1,0 +1,195 @@
+"""Tests for deform_conv2d/DeformConv2D, matrix_nms, and audio backends.
+
+Reference anchors: python/paddle/vision/ops.py (deform_conv2d, matrix_nms),
+python/paddle/audio/backends/.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+class TestDeformConv:
+    def setup_method(self):
+        paddle.seed(0)
+        rng = np.random.default_rng(0)
+        self.x = jnp.asarray(rng.standard_normal((2, 4, 6, 6)), jnp.float32)
+        self.w = jnp.asarray(rng.standard_normal((8, 4, 3, 3)) * 0.1,
+                             jnp.float32)
+
+    def test_zero_offset_equals_conv(self):
+        from paddle_tpu.nn import functional as F
+        offset = jnp.zeros((2, 18, 4, 4))
+        out = vops.deform_conv2d(self.x, offset, self.w)
+        ref = F.conv2d(self.x, self.w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_integer_offset_shifts_sampling(self):
+        """Offsetting every tap by a whole pixel equals shifting the
+        input."""
+        from paddle_tpu.nn import functional as F
+        offset = jnp.zeros((2, 2, 9, 4, 4))
+        offset = offset.at[:, 1].set(1.0)  # Δx = +1 for every tap
+        offset = offset.transpose(0, 2, 1, 3, 4).reshape(2, 18, 4, 4)
+        out = vops.deform_conv2d(self.x, offset, self.w)
+        shifted = jnp.pad(self.x, ((0, 0), (0, 0), (0, 0), (0, 1)))[
+            :, :, :, 1:]
+        ref = F.conv2d(shifted, self.w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_mask_modulation(self):
+        from paddle_tpu.nn import functional as F
+        offset = jnp.zeros((2, 18, 4, 4))
+        mask = jnp.full((2, 9, 4, 4), 0.25)
+        out = vops.deform_conv2d(self.x, offset, self.w, mask=mask)
+        ref = F.conv2d(self.x, self.w)
+        np.testing.assert_allclose(np.asarray(out), 0.25 * np.asarray(ref),
+                                   atol=1e-5)
+
+    def test_stride_padding_and_bias(self):
+        offset = jnp.zeros((2, 18, 3, 3))
+        bias = jnp.ones((8,))
+        out = vops.deform_conv2d(self.x, offset, self.w, bias=bias,
+                                 stride=2, padding=0)
+        # the offset's spatial dims define the output grid
+        assert out.shape == (2, 8, 3, 3)
+
+    def test_layer_and_grad(self):
+        layer = vops.DeformConv2D(4, 8, 3)
+        offset = jnp.zeros((2, 18, 4, 4))
+        out = layer(self.x, offset)
+        assert out.shape == (2, 8, 4, 4)
+        from paddle_tpu.framework.functional import (functional_call,
+                                                     get_params)
+        params = get_params(layer)
+
+        def loss(p, off):
+            return jnp.sum(functional_call(layer, p, self.x, off) ** 2)
+
+        gp, goff = jax.grad(loss, argnums=(0, 1))(params, offset)
+        assert all(bool(jnp.isfinite(v).all()) for v in gp.values())
+        assert bool(jnp.isfinite(goff).all())
+        assert float(jnp.abs(goff).max()) > 0  # offsets are trainable
+
+    def test_groups(self):
+        w = jnp.asarray(np.random.default_rng(1).standard_normal(
+            (8, 2, 3, 3)) * 0.1, jnp.float32)
+        offset = jnp.zeros((2, 18, 4, 4))
+        out = vops.deform_conv2d(self.x, offset, w, groups=2)
+        assert out.shape == (2, 8, 4, 4)
+
+    def test_deformable_groups(self):
+        offset = jnp.zeros((2, 2 * 2 * 9, 4, 4))
+        out = vops.deform_conv2d(self.x, offset, self.w,
+                                 deformable_groups=2)
+        assert out.shape == (2, 8, 4, 4)
+
+    def test_bad_offset_channels(self):
+        with pytest.raises(ValueError):
+            vops.deform_conv2d(self.x, jnp.zeros((2, 10, 4, 4)), self.w)
+
+
+class TestMatrixNMS:
+    def test_decay_and_threshold(self):
+        bboxes = jnp.asarray([[[0, 0, 10, 10], [1, 1, 11, 11],
+                               [50, 50, 60, 60]]], jnp.float32)
+        scores = jnp.zeros((1, 2, 3)).at[0, 1].set(
+            jnp.asarray([0.9, 0.8, 0.7]))
+        out, idx, num = vops.matrix_nms(bboxes, scores, 0.1, 0.05, 10, 10,
+                                        return_index=True)
+        assert out.shape[1] == 6
+        assert int(num[0]) == 3
+        s = np.asarray(out[:, 1])
+        # top box undecayed; the overlapped box decays by (1 - IoU) with
+        # IoU = 81 / (100 + 100 - 81)
+        iou = 81.0 / (100 + 100 - 81.0)
+        assert s[0] == pytest.approx(0.9, abs=1e-5)
+        decayed = 0.8 * (1.0 - iou)
+        assert any(abs(v - decayed) < 1e-4 for v in s)
+        # far-away box untouched
+        assert any(abs(v - 0.7) < 1e-5 for v in s)
+
+    def test_normalized_false_pixel_iou(self):
+        """normalized=False adds +1 to widths/heights (integer-coordinate
+        convention), changing the IoU and hence the decay."""
+        bboxes = jnp.asarray([[[0, 0, 4, 4], [1, 1, 5, 5]]], jnp.float32)
+        scores = jnp.zeros((1, 2, 2)).at[0, 1].set(jnp.asarray([0.9, 0.8]))
+        out_n, _ = vops.matrix_nms(bboxes, scores, 0.1, 0.0, 10, 10)
+        out_p, _ = vops.matrix_nms(bboxes, scores, 0.1, 0.0, 10, 10,
+                                   normalized=False)
+        s_n = sorted(np.asarray(out_n[:, 1]).tolist())
+        s_p = sorted(np.asarray(out_p[:, 1]).tolist())
+        assert s_n != s_p
+
+    def test_post_threshold_filters(self):
+        bboxes = jnp.asarray([[[0, 0, 10, 10], [0, 0, 10, 10]]], jnp.float32)
+        scores = jnp.zeros((1, 2, 2)).at[0, 1].set(jnp.asarray([0.9, 0.85]))
+        out, num = vops.matrix_nms(bboxes, scores, 0.1, 0.5, 10, 10)
+        # identical boxes: second decays to ~0 and is filtered
+        assert int(num[0]) == 1
+
+    def test_gaussian_mode_and_background(self):
+        bboxes = jnp.asarray([[[0, 0, 10, 10], [2, 2, 12, 12]]], jnp.float32)
+        scores = jnp.asarray([[[0.9, 0.8], [0.7, 0.6]]])
+        out, num = vops.matrix_nms(bboxes, scores, 0.1, 0.01, 10, 10,
+                                   use_gaussian=True, background_label=0)
+        # class 0 is background -> only class-1 detections
+        assert np.asarray(out)[:, 0].min() >= 1.0
+
+
+class TestAudioBackends:
+    def test_save_load_roundtrip_16bit(self, tmp_path):
+        sr = 8000
+        t = np.linspace(0, 1, sr, endpoint=False)
+        wav = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)
+        p = str(tmp_path / "tone.wav")
+        paddle.audio.save(p, wav[None, :], sr)
+        back, sr2 = paddle.audio.load(p)
+        assert sr2 == sr
+        assert back.shape == (1, sr)
+        np.testing.assert_allclose(np.asarray(back[0]), wav, atol=2e-4)
+
+    def test_info(self, tmp_path):
+        p = str(tmp_path / "x.wav")
+        paddle.audio.save(p, np.zeros((2, 100), np.float32), 16000)
+        i = paddle.audio.info(p)
+        assert i.sample_rate == 16000
+        assert i.num_channels == 2
+        assert i.num_samples == 100
+        assert i.bits_per_sample == 16
+
+    def test_frame_offset_and_num_frames(self, tmp_path):
+        sr = 1000
+        wav = np.arange(100, dtype=np.float32) / 200.0
+        p = str(tmp_path / "seg.wav")
+        paddle.audio.save(p, wav[None, :], sr)
+        seg, _ = paddle.audio.load(p, frame_offset=10, num_frames=20)
+        assert seg.shape == (1, 20)
+        np.testing.assert_allclose(np.asarray(seg[0]), wav[10:30], atol=2e-4)
+
+    def test_channels_last_and_8bit(self, tmp_path):
+        p = str(tmp_path / "c.wav")
+        paddle.audio.save(p, np.zeros((50, 2), np.float32), 8000,
+                          channels_first=False, bits_per_sample=8)
+        data, _ = paddle.audio.load(p, channels_first=False)
+        assert data.shape == (50, 2)
+
+    def test_int_save_matching_width(self, tmp_path):
+        p = str(tmp_path / "i.wav")
+        paddle.audio.save(p, np.zeros((1, 10), np.int16), 8000)
+        assert paddle.audio.info(p).num_samples == 10
+        with pytest.raises(ValueError, match="bits_per_sample"):
+            paddle.audio.save(p, np.zeros((1, 10), np.int32), 8000)
+
+    def test_backend_listing(self):
+        assert "wave" in paddle.audio.backends.list_available_backends()
+        assert paddle.audio.backends.get_current_backend() in \
+            paddle.audio.backends.list_available_backends()
+        with pytest.raises(ValueError):
+            paddle.audio.backends.set_backend("ffmpeg")
