@@ -13,8 +13,11 @@ program — no atomics.
 Reference parity: ``paddle/phi/kernels/gpu/flash_attn_kernel.cu:324``
 (FlashAttnKernel → vendored CUTLASS flash-attn). Layout in/out is paddle's
 [batch, seq, heads, head_dim]; internally [batch*heads, seq, head_dim].
-Causal masking is bottom-right aligned (query i attends keys <= i + sk - sq),
-matching flash-attn decode semantics for sq != sk.
+Grouped-query attention keeps KV at [batch*kv_heads, seq, head_dim]: the
+BlockSpec index maps route each query head to its shared KV tile, so GQA
+never materializes repeated K/V (dK/dV fold the query-head groups after the
+kernel). Causal masking is bottom-right aligned (query i attends keys <=
+i + sk - sq), matching flash-attn decode semantics for sq != sk.
 """
 
 from __future__ import annotations
@@ -169,11 +172,26 @@ def _segments_or_dummy(seg_q, seg_k, bh, sq, sk):
     return segmented, seg_q, seg_k
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None, seg_k=None):
-    """q,k,v: [BH, S, D] (+ optional [BH, 1, S] int32 segment ids)
-    -> (o [BH, Sq, D], lse [BH, 1, Sq] fp32)."""
+def _kv_index(h: int, hk: int):
+    """Grid row (= b*h + head) -> row of the [B*HK, S, D] KV array: query
+    head g maps to KV head (g % h) // (h // hk) — grouped-query KV tiles
+    are read through the index map, never materialized per query head."""
+    rep = h // hk
+
+    def index(b, i, j):
+        return ((b // h) * hk + (b % h) // rep, j, 0)
+
+    return index
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
+         seg_q=None, seg_k=None):
+    """q: [BH, S, D]; k,v: [B*HK, S, D] (+ optional [BH, 1, S] int32
+    segment ids) -> (o [BH, Sq, D], lse [BH, 1, Sq] fp32)."""
     bh, sq, d = q.shape
     sk = k.shape[1]
+    h = num_heads
+    hk = k.shape[0] // (bh // h)
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     segmented, seg_q, seg_k = _segments_or_dummy(seg_q, seg_k, bh, sq, sk)
@@ -181,13 +199,14 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, seg_q=None, seg_k=None):
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              segmented=segmented, block_q=block_q,
                              block_k=block_k, seq_q=sq, seq_k=sk)
+    kv_index = _kv_index(h, hk)
     o, lse = pl.pallas_call(
         kern,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index),
+            pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
         ],
@@ -303,16 +322,21 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
          seg_q=None, seg_k=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
+    h = num_heads
+    b_ = bh // h
+    hk = k.shape[0] // b_
+    rep = h // hk
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     segmented, seg_q, seg_k = _segments_or_dummy(seg_q, seg_k, bh, sq, sk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # [BH, Sq]
     delta = delta[:, None, :]  # [BH, 1, Sq] — matches the slim lse layout
+    kv_index = _kv_index(h, hk)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -321,8 +345,8 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
         grid=(bh, sq // block_q, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: kv_index(b, i, j)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: kv_index(b, i, j)),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
@@ -334,14 +358,20 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
     )(q, k, v, do, lse, delta, seg_q, seg_k)
 
+    def kv_index_t(b, j, i):
+        return kv_index(b, i, j)
+
+    # dk/dv are emitted per QUERY head ([BH, Sk, D]) — each program owns its
+    # output block — and the query-head groups fold into the true KV heads
+    # after the call (zero-cost for the dense rep == 1 case).
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           segmented=segmented, block_q=block_q,
                           block_k=block_k, seq_q=sq, seq_k=sk),
         grid=(bh, sk // block_k, sq // block_q),
         in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_index_t),
+            pl.BlockSpec((1, block_k, d), kv_index_t),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
@@ -362,6 +392,11 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
     )(k, v, q, do, lse, delta, seg_q, seg_k)
+    if rep > 1:
+        dk = dk.reshape(b_, hk, rep, sk, d).sum(axis=2).reshape(b_ * hk,
+                                                                sk, d)
+        dv = dv.reshape(b_, hk, rep, sk, d).sum(axis=2).reshape(b_ * hk,
+                                                                sk, d)
     return dq, dk, dv
 
 
@@ -369,21 +404,25 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
 # custom_vjp wrapper, [B, S, H, D] public layout
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash_bhsd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
-    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k, seg_q, seg_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
+                num_heads):
+    o, _ = _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
+                seg_q, seg_k)
     return o
 
 
-def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k):
-    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, seg_q, seg_k)
+def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
+                    num_heads):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
+                  seg_q, seg_k)
     return o, (q, k, v, o, lse, seg_q, seg_k)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, res, do):
+def _flash_bwd_rule(scale, causal, block_q, block_k, num_heads, res, do):
     q, k, v, o, lse, seg_q, seg_k = res
     dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-                      seg_q, seg_k)
+                      num_heads, seg_q, seg_k)
     return dq, dk, dv, None, None
 
 
@@ -405,11 +444,14 @@ def flash_attention_pallas(query, key, value, causal: bool = False,
     """[B, S, H, D] flash attention via Pallas. Differentiable.
 
     Block sizes default to the autotuned table in ``_pick_blocks``; pass
-    explicit ``block_q``/``block_k`` to override. ``segment_ids`` ([B, Sq]
-    int32) enables packed-varlen attention: tokens attend only keys with
-    an equal segment id (the TPU-native form of flash_attn_unpadded —
-    static shapes, sequences packed along S). ``segment_ids_k`` ([B, Sk])
-    defaults to ``segment_ids`` (self-attention packing)."""
+    explicit ``block_q``/``block_k`` to override. Grouped-query attention
+    (kv heads dividing query heads) reads shared KV tiles through the
+    BlockSpec index map — no repeat materialization. ``segment_ids``
+    ([B, Sq] int32) enables packed-varlen attention: tokens attend only
+    keys with an equal segment id (the TPU-native form of
+    flash_attn_unpadded — static shapes, sequences packed along S).
+    ``segment_ids_k`` ([B, Sk]) defaults to ``segment_ids``
+    (self-attention packing)."""
     b, sq, h, d = query.shape
     sk = key.shape[1]
     auto_q, auto_k = _pick_blocks(sq, sk, d)
@@ -420,18 +462,20 @@ def flash_attention_pallas(query, key, value, causal: bool = False,
             f"flash_attention_pallas needs seq lengths divisible by the "
             f"block sizes; got sq={sq}, sk={sk} (use supported_shapes())")
     hk = key.shape[2]
-    if hk != h:  # grouped-query: broadcast kv heads
-        rep = h // hk
-        key = jnp.repeat(key, rep, axis=2)
-        value = jnp.repeat(value, rep, axis=2)
+    if hk != h and (hk == 0 or h % hk):
+        raise ValueError(
+            f"query heads {h} must be a multiple of kv heads {hk} "
+            f"(grouped-query)")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    def to_bhsd(x, s):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+    def to_bhsd(x, s, heads):
+        return x.transpose(0, 2, 1, 3).reshape(b * heads, s, d)
 
-    q = to_bhsd(query, sq)
-    k = to_bhsd(key, sk)
-    v = to_bhsd(value, sk)
+    # Grouped-query KV stays [B*HK, S, D]: the kernels' BlockSpec index map
+    # routes each query head to its shared KV tile (no repeat materialized).
+    q = to_bhsd(query, sq, h)
+    k = to_bhsd(key, sk, hk)
+    v = to_bhsd(value, sk, hk)
     seg_q = seg_k = None
     if segment_ids is not None:
         def per_head(seg, s, what):
@@ -447,5 +491,5 @@ def flash_attention_pallas(query, key, value, causal: bool = False,
             per_head(segment_ids_k if segment_ids_k is not None
                      else segment_ids, sk, "segment_ids_k")
     o = _flash_bhsd(q, k, v, seg_q, seg_k, float(scale), bool(causal),
-                    block_q, block_k)
+                    block_q, block_k, h)
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -38,6 +38,10 @@ class GPTConfig:
     hidden_size: int = 2048
     num_layers: int = 24
     num_heads: int = 16
+    # Grouped-query attention: fewer KV heads shared by query-head groups
+    # (None = MHA). The Pallas flash kernel reads shared KV tiles through
+    # its BlockSpec index map, so GQA adds no repeat materialization.
+    num_kv_heads: Optional[int] = None
     max_position_embeddings: int = 2048
     intermediate_size: Optional[int] = None  # default 4*hidden
     hidden_dropout: float = 0.0
@@ -54,6 +58,13 @@ class GPTConfig:
     @property
     def ffn_size(self) -> int:
         return self.intermediate_size or 4 * self.hidden_size
+
+    @property
+    def kv_heads(self) -> int:
+        # explicit None check: num_kv_heads=0 must be rejected by the
+        # attention layer's validation, not silently become MHA
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
 
 
 def gpt3_1p3b(**overrides) -> "GPTConfig":
@@ -84,23 +95,53 @@ class GPTAttention(nn.Layer):
         super().__init__()
         self.cfg = cfg
         self.num_heads = cfg.num_heads
+        self.kv_heads = cfg.kv_heads
         self.head_dim = cfg.hidden_size // cfg.num_heads
+        if self.kv_heads < 1 or self.num_heads % self.kv_heads:
+            raise ValueError(
+                f"num_heads ({self.num_heads}) must be a multiple of "
+                f"num_kv_heads ({self.kv_heads})")
         h = cfg.hidden_size
-        self.qkv_proj = ColumnParallelLinear(
-            h, 3 * h, weight_attr=_init_attr(cfg), has_bias=True,
-            gather_output=False)
+        if self.kv_heads == self.num_heads:
+            self.qkv_proj = ColumnParallelLinear(
+                h, 3 * h, weight_attr=_init_attr(cfg), has_bias=True,
+                gather_output=False)
+        else:
+            self.q_proj = ColumnParallelLinear(
+                h, h, weight_attr=_init_attr(cfg), has_bias=True,
+                gather_output=False)
+            self.kv_proj = ColumnParallelLinear(
+                h, 2 * self.kv_heads * self.head_dim,
+                weight_attr=_init_attr(cfg), has_bias=True,
+                gather_output=False)
         self.out_proj = RowParallelLinear(
             h, h, weight_attr=_init_attr(cfg), has_bias=True,
             input_is_parallel=True)
         self.dropout = nn.Dropout(cfg.hidden_dropout)
 
+    def _project_qkv(self, x):
+        """-> q [b,s,H,D], k/v [b,s,KH,D], heads sharded over mp."""
+        b, s, _ = x.shape
+        if self.kv_heads == self.num_heads:
+            qkv = self.qkv_proj(x)  # [b, s, 3h] (h sharded over mp)
+            qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+            qkv = _constrain(qkv, P(None, None, None, MP_AXIS, None))
+            return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q = self.q_proj(x).reshape(b, s, self.num_heads, self.head_dim)
+        q = _constrain(q, P(None, None, MP_AXIS, None))
+        kv = self.kv_proj(x).reshape(b, s, 2, self.kv_heads, self.head_dim)
+        kv = _constrain(kv, P(None, None, None, MP_AXIS, None))
+        return q, kv[:, :, 0], kv[:, :, 1]
+
+    def _repeat_kv(self, k, v):
+        rep = self.num_heads // self.kv_heads
+        if rep == 1:
+            return k, v
+        return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
     def forward(self, x):
         b, s, h = x.shape
-        qkv = self.qkv_proj(x)  # [b, s, 3h] (h sharded over mp)
-        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
-        # Keep heads sharded over mp: heads dim = mp * local_heads.
-        qkv = _constrain(qkv, P(None, None, None, MP_AXIS, None))
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        q, k, v = self._project_qkv(x)
         if self.cfg.context_parallel and _cp_active():
             from ...distributed.context_parallel import (ring_attention,
                                                          ulysses_attention)
@@ -112,15 +153,22 @@ class GPTAttention(nn.Layer):
                 raise NotImplementedError(
                     "attention_dropout > 0 is not supported with context "
                     "parallelism (probs are never materialized globally)")
-            cp = (ring_attention if self.cfg.context_parallel == "ring"
-                  else ulysses_attention)
-            out = cp(q, k, v, causal=True)
+            if self.cfg.context_parallel == "ring":
+                # ring's block attention contracts equal head counts;
+                # broadcast grouped KV for it only.
+                out = ring_attention(q, *self._repeat_kv(k, v), causal=True)
+            else:
+                # ulysses repeats KV just enough for the head all-to-all —
+                # pass the grouped tensors through untouched.
+                out = ulysses_attention(q, k, v, causal=True)
         elif self.cfg.use_flash_attention:
+            # flash handles grouped KV natively (index-mapped tiles)
             out = flash_attention(q, k, v, dropout=self.cfg.attention_dropout,
                                   causal=True, training=self.training)
         else:
             out = F.scaled_dot_product_attention(
-                q, k, v, is_causal=True, dropout_p=self.cfg.attention_dropout,
+                q, *self._repeat_kv(k, v), is_causal=True,
+                dropout_p=self.cfg.attention_dropout,
                 training=self.training)
         out = out.reshape(b, s, h)
         out = self.out_proj(out)
@@ -137,10 +185,8 @@ class GPTAttention(nn.Layer):
         attention masks keys past offset+s plus intra-block causality.
         """
         b, s, h = x.shape
-        qkv = self.qkv_proj(x).reshape(b, s, 3, self.num_heads,
-                                       self.head_dim)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        k_cache, v_cache = cache
+        q, k, v = self._project_qkv(x)
+        k_cache, v_cache = cache                     # [b, max, KH, D]
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, offset, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, offset, 0, 0))
         max_len = k_cache.shape[1]
@@ -148,8 +194,8 @@ class GPTAttention(nn.Layer):
         k_pos = jnp.arange(max_len)                 # [max_len]
         mask = (k_pos[None, :] <= q_pos[:, None])[None, None]  # [1,1,s,max]
         out = F.scaled_dot_product_attention(
-            q, k_cache, v_cache, attn_mask=mask, is_causal=False,
-            training=False)
+            q, *self._repeat_kv(k_cache, v_cache), attn_mask=mask,
+            is_causal=False, training=False)
         out = self.out_proj(out.reshape(b, s, h))
         return out, (k_cache, v_cache)
 
@@ -232,7 +278,7 @@ class GPT(nn.Layer):
 
     def init_cache(self, batch: int, max_len: int, dtype=jnp.float32):
         head_dim = self.cfg.hidden_size // self.cfg.num_heads
-        shape = (batch, max_len, self.cfg.num_heads, head_dim)
+        shape = (batch, max_len, self.cfg.kv_heads, head_dim)
         return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
                 for _ in self.h]
 
