@@ -1,0 +1,48 @@
+"""Export a trained model and serve it through the inference predictor.
+
+Train briefly -> jit.save (StableHLO + params) -> Config/create_predictor
+-> run. The exported artifact is portable to any StableHLO consumer.
+
+    python examples/infer_export.py
+"""
+
+import os
+import tempfile
+
+import jax.numpy as jnp
+import numpy as np
+
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, nn
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    net.eval()
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 16)),
+                    jnp.float32)
+    ref = net(x)
+
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, "model")
+        paddle.jit.save(net, prefix, input_spec=[x])
+
+        config = inference.Config(prefix + ".pdmodel",
+                                  prefix + ".pdiparams")
+        predictor = inference.create_predictor(config)
+        in_names = predictor.get_input_names()
+        handle = predictor.get_input_handle(in_names[0])
+        handle.copy_from_cpu(np.asarray(x))
+        predictor.run()
+        out = predictor.get_output_handle(
+            predictor.get_output_names()[0]).copy_to_cpu()
+        print("max |predictor - eager| =",
+              float(np.abs(out - np.asarray(ref)).max()))
+
+
+if __name__ == "__main__":
+    main()
