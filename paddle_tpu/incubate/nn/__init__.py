@@ -14,6 +14,7 @@ import math
 
 from ...nn import initializer as I
 from ...nn.layer import Layer
+from ...ops.flash_attention import flash_attention
 from . import functional as F
 
 __all__ = ["FusedLinear", "FusedFeedForward", "FusedMultiHeadAttention",
@@ -217,3 +218,190 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
         return self.ffn(out)
+
+
+class _FusedMTLayer(Layer):
+    """One FusedMultiTransformer block: pre/post-LN attention + FFN with the
+    reference's fused parameter layouts (qkv_weight [3, H, D, E]). The
+    attention/FFN bodies are the shared fused functional paths."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward, dropout_rate,
+                 activation, normalize_before, epsilon, attrs):
+        super().__init__()
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.dropout_rate = dropout_rate
+        self.activation = activation
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        bound = 1.0 / math.sqrt(embed_dim)
+
+        def bias(name, shape):
+            a = attrs.get(name)
+            return None if a is False else self.create_parameter(
+                shape, is_bias=True, attr=a)
+
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=attrs.get("ln_scale"),
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = bias("ln_bias", (embed_dim,))
+        self.qkv_weight = self.create_parameter(
+            (3, num_heads, self.head_dim, embed_dim),
+            attr=attrs.get("qkv_weight"),
+            default_initializer=I.Uniform(-bound, bound))
+        self.qkv_bias = bias("qkv_bias", (3, num_heads, self.head_dim))
+        self.linear_weight = self.create_parameter(
+            (embed_dim, embed_dim), attr=attrs.get("linear_weight"),
+            default_initializer=I.XavierNormal())
+        self.linear_bias = bias("linear_bias", (embed_dim,))
+        self.ffn_ln_scale = self.create_parameter(
+            (embed_dim,), attr=attrs.get("ffn_ln_scale"),
+            default_initializer=I.Constant(1.0))
+        self.ffn_ln_bias = bias("ffn_ln_bias", (embed_dim,))
+        self.ffn1_weight = self.create_parameter(
+            (embed_dim, dim_feedforward), attr=attrs.get("ffn1_weight"),
+            default_initializer=I.XavierNormal())
+        self.ffn1_bias = bias("ffn1_bias", (dim_feedforward,))
+        self.ffn2_weight = self.create_parameter(
+            (dim_feedforward, embed_dim), attr=attrs.get("ffn2_weight"),
+            default_initializer=I.XavierNormal())
+        self.ffn2_bias = bias("ffn2_bias", (embed_dim,))
+
+    def _cached_attn(self, x, attn_mask, cache, time_step):
+        """Incremental decode: append K/V at time_step, attend over the
+        cache with the causal mask combined with any user mask."""
+        import jax
+        import jax.numpy as jnp
+
+        from ...nn import functional as NF
+        b, s, e = x.shape
+        w = jnp.transpose(self.qkv_weight, (3, 0, 1, 2)).reshape(e, -1)
+        qkv = x @ w
+        if self.qkv_bias is not None:
+            qkv = qkv + self.qkv_bias.reshape(-1)
+        qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_cache, v_cache = cache          # [b, max_len, H, D]
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k, (0, time_step, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v, (0, time_step, 0, 0))
+        max_len = k_cache.shape[1]
+        q_pos = time_step + jnp.arange(s)
+        mask = (jnp.arange(max_len)[None, :]
+                <= q_pos[:, None])[None, None]    # [1, 1, s, max_len] bool
+        if attn_mask is not None:
+            if attn_mask.dtype == jnp.bool_:
+                mask = mask & attn_mask
+            else:  # additive mask: fold ours into additive form
+                mask = jnp.where(mask, 0.0, -jnp.inf) + attn_mask
+        out = NF.scaled_dot_product_attention(
+            q, k_cache, v_cache, attn_mask=mask, training=False)
+        out = out.reshape(b, s, e) @ self.linear_weight
+        if self.linear_bias is not None:
+            out = out + self.linear_bias
+        return out, (k_cache, v_cache)
+
+    def forward(self, x, attn_mask=None, cache=None, time_step=0):
+        from ...nn import functional as NF
+        residual = x
+        h = x
+        if self.normalize_before:
+            h = NF.layer_norm(h, (h.shape[-1],), self.ln_scale,
+                              self.ln_bias, self.epsilon)
+        if cache is not None:
+            attn_out, new_cache = self._cached_attn(h, attn_mask, cache,
+                                                    time_step)
+        else:
+            attn_out = F._qkv_attention_core(
+                h, self.qkv_weight, self.qkv_bias, self.linear_weight,
+                self.linear_bias, attn_mask, self.dropout_rate,
+                self.training, causal=attn_mask is None)
+            new_cache = None
+        attn_out = NF.dropout(attn_out, self.dropout_rate,
+                              training=self.training)
+        h = residual + attn_out
+        if not self.normalize_before:
+            h = NF.layer_norm(h, (h.shape[-1],), self.ln_scale,
+                              self.ln_bias, self.epsilon)
+        # FFN body: the shared fused path (pre/post LN + residual inside).
+        out = F.fused_feedforward(
+            h, self.ffn1_weight, self.ffn2_weight, self.ffn1_bias,
+            self.ffn2_bias,
+            ln1_scale=self.ffn_ln_scale, ln1_bias=self.ffn_ln_bias,
+            ln2_scale=self.ffn_ln_scale, ln2_bias=self.ffn_ln_bias,
+            dropout1_rate=self.dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation, ln1_epsilon=self.epsilon,
+            ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)
+        return out, new_cache
+
+
+class FusedMultiTransformer(Layer):
+    """ref ``incubate/nn/layer/fused_transformer.py:1033`` — the fused
+    multi-layer decoder stack used for LLM inference (one CUDA megakernel
+    per layer there; one XLA fusion region + flash attention here).
+
+    ``forward(src, attn_mask=None, caches=None, time_step=None)``:
+    caches = per-layer (k, v) arrays [b, max_len, H, D] enables
+    incremental decode at position ``time_step`` (a traced scalar is fine —
+    the cache update is a dynamic_update_slice); returns (out, caches)
+    when caches are given, else out.
+    """
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate: float = 0.0, activation: str = "gelu",
+                 normalize_before: bool = True, num_layers: int = -1,
+                 epsilon: float = 1e-5, nranks: int = 1, ring_id: int = -1,
+                 **per_layer_attrs):
+        super().__init__()
+        if num_layers <= 0:
+            raise ValueError(f"num_layers must be positive, got {num_layers}")
+        if embed_dim % num_heads:
+            raise ValueError(
+                f"embed_dim ({embed_dim}) must be divisible by num_heads "
+                f"({num_heads})")
+
+        def attr_for(i):
+            out = {}
+            for key, val in per_layer_attrs.items():
+                if not key.endswith("_attrs"):
+                    continue
+                out[key[:-6]] = val[i] if isinstance(val, (list, tuple)) \
+                    else val
+            return out
+
+        from ...nn.layers import LayerList
+        self.layers = LayerList([
+            _FusedMTLayer(embed_dim, num_heads, dim_feedforward,
+                          dropout_rate, activation, normalize_before,
+                          epsilon, attr_for(i))
+            for i in range(num_layers)])
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+
+    def gen_cache(self, batch: int, max_len: int, dtype=None):
+        """Per-layer KV caches for incremental decode."""
+        import jax.numpy as jnp
+        shape = (batch, max_len, self.num_heads, self.head_dim)
+        dtype = dtype or jnp.float32
+        return [(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+                for _ in self.layers]
+
+    def forward(self, src, attn_mask=None, caches=None, time_step=None):
+        h = src
+        new_caches = []
+        for i, layer in enumerate(self.layers):
+            cache = caches[i] if caches is not None else None
+            h, new_cache = layer(
+                h, attn_mask=attn_mask, cache=cache,
+                time_step=0 if time_step is None else time_step)
+            if caches is not None:
+                new_caches.append(new_cache)
+        if caches is not None:
+            return h, new_caches
+        return h
+
+
+__all__ += ["FusedMultiTransformer"]

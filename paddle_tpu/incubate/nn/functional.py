@@ -106,16 +106,33 @@ def fused_multi_head_attention(
     """
     if cache_kv is not None:
         raise NotImplementedError(
-            "decode-cache path: use nn.MultiHeadAttention with cache")
-    three, num_heads, head_dim, embed_dim = qkv_weight.shape
-    if three != 3:
-        raise ValueError(f"qkv_weight dim0 must be 3, got {three}")
+            "decode-cache path: use nn.MultiHeadAttention with cache or "
+            "FusedMultiTransformer's caches")
     residual = x
     if pre_layer_norm:
         x = F.layer_norm(x, x.shape[-1:], pre_ln_scale, pre_ln_bias,
                          pre_ln_epsilon)
+    out = _qkv_attention_core(x, qkv_weight, qkv_bias, linear_weight,
+                              linear_bias, attn_mask, attn_dropout_rate,
+                              training, causal=False)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = residual + out
+    if not pre_layer_norm:
+        out = F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def _qkv_attention_core(x, qkv_weight, qkv_bias, linear_weight, linear_bias,
+                        attn_mask, attn_dropout_rate, training,
+                        causal: bool = False):
+    """Fused-qkv attention shared by fused_multi_head_attention and
+    FusedMultiTransformer: [3, H, D, E] weight -> one [E, 3HD] matmul,
+    attention (flash when unmasked), output projection."""
+    three, num_heads, head_dim, embed_dim = qkv_weight.shape
+    if three != 3:
+        raise ValueError(f"qkv_weight dim0 must be 3, got {three}")
     b, s, _ = x.shape
-    # One [embed, 3*H*D] matmul for q,k,v — the actual fusion that matters.
     w = jnp.transpose(qkv_weight, (3, 0, 1, 2)).reshape(embed_dim, -1)
     qkv = x @ w
     if qkv_bias is not None:
@@ -124,19 +141,13 @@ def fused_multi_head_attention(
     q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
     if attn_mask is not None:
         out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, dropout_p=attn_dropout_rate,
-            training=training)
+            q, k, v, attn_mask=attn_mask, is_causal=causal,
+            dropout_p=attn_dropout_rate, training=training)
     else:
-        out = flash_attention(q, k, v, dropout=attn_dropout_rate,
-                              training=training)
+        out = flash_attention(q, k, v, causal=causal,
+                              dropout=attn_dropout_rate, training=training)
     out = out.reshape(b, s, num_heads * head_dim)
-    out = fused_linear(out, linear_weight, linear_bias)
-    out = F.dropout(out, dropout_rate, training=training, mode=mode)
-    if add_residual:
-        out = residual + out
-    if not pre_layer_norm:
-        out = F.layer_norm(out, out.shape[-1:], ln_scale, ln_bias, ln_epsilon)
-    return out
+    return fused_linear(out, linear_weight, linear_bias)
 
 
 def fused_rms_norm(x, norm_weight=None, norm_bias=None,

@@ -168,6 +168,118 @@ class TestFusedLayers:
         assert out_t.shape == (2, 4)
 
 
+class TestFusedMultiTransformer:
+    def setup_method(self):
+        paddle.seed(0)
+        self.x = jnp.asarray(
+            np.random.default_rng(0).standard_normal((2, 6, 64)),
+            jnp.float32)
+
+    def test_forward_shapes(self):
+        mt = inn.FusedMultiTransformer(64, 4, 128, num_layers=2,
+                                       dropout_rate=0.0)
+        mt.eval()
+        out = mt(self.x)
+        assert out.shape == self.x.shape
+        assert bool(jnp.isfinite(out).all())
+
+    def test_incremental_decode_matches_full(self):
+        mt = inn.FusedMultiTransformer(64, 4, 128, num_layers=2,
+                                       dropout_rate=0.0)
+        mt.eval()
+        full = mt(self.x)
+        caches = mt.gen_cache(2, 6)
+        outs = []
+        cur = caches
+        for t in range(6):
+            o, cur = mt(self.x[:, t:t + 1], caches=cur, time_step=t)
+            outs.append(o)
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate(outs, axis=1)), np.asarray(full),
+            atol=1e-4)
+
+    def test_post_layer_norm_variant(self):
+        mt = inn.FusedMultiTransformer(64, 4, 128, num_layers=1,
+                                       dropout_rate=0.0,
+                                       normalize_before=False)
+        mt.eval()
+        assert mt(self.x).shape == self.x.shape
+
+    def test_explicit_mask(self):
+        mt = inn.FusedMultiTransformer(64, 4, 128, num_layers=1,
+                                       dropout_rate=0.0)
+        mt.eval()
+        mask = jnp.tril(jnp.ones((6, 6), jnp.bool_))
+        out = mt(self.x, attn_mask=mask)
+        # a full causal mask equals the default causal path
+        np.testing.assert_allclose(np.asarray(out), np.asarray(mt(self.x)),
+                                   atol=1e-5)
+
+    def test_trains(self):
+        from paddle_tpu.framework.functional import (functional_call,
+                                                     get_params)
+        mt = inn.FusedMultiTransformer(64, 4, 128, num_layers=2,
+                                       dropout_rate=0.0)
+        mt.train()
+        params = get_params(mt)
+        g = jax.grad(lambda p: jnp.mean(functional_call(
+            mt, p, self.x, training=True) ** 2))(params)
+        assert all(bool(jnp.isfinite(v).all()) for v in g.values())
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            inn.FusedMultiTransformer(64, 4, 128)  # num_layers required
+        with pytest.raises(ValueError):
+            inn.FusedMultiTransformer(30, 4, 128, num_layers=1)
+
+    def test_jitted_decode_with_traced_time_step(self):
+        mt = inn.FusedMultiTransformer(64, 4, 128, num_layers=1,
+                                       dropout_rate=0.0)
+        mt.eval()
+        caches = mt.gen_cache(2, 6)
+
+        @jax.jit
+        def decode(tok, caches, t):
+            return mt(tok, caches=caches, time_step=t)
+
+        cur = caches
+        for t in range(3):
+            o, cur = decode(self.x[:, t:t + 1], cur, jnp.int32(t))
+        assert o.shape == (2, 1, 64)
+
+    def test_bias_attrs_false(self):
+        mt = inn.FusedMultiTransformer(64, 4, 128, num_layers=1,
+                                       dropout_rate=0.0,
+                                       qkv_bias_attrs=False,
+                                       linear_bias_attrs=False,
+                                       ffn1_bias_attrs=False,
+                                       ffn2_bias_attrs=False)
+        mt.eval()
+        assert mt.layers[0].qkv_bias is None
+        out = mt(self.x)
+        assert bool(jnp.isfinite(out).all())
+        # bias-less decode path too
+        caches = mt.gen_cache(2, 6)
+        o, _ = mt(self.x[:, :1], caches=caches, time_step=0)
+        assert o.shape == (2, 1, 64)
+
+    def test_decode_respects_user_mask(self):
+        """A padding mask must change decode output (it was silently
+        ignored before)."""
+        mt = inn.FusedMultiTransformer(64, 4, 128, num_layers=1,
+                                       dropout_rate=0.0)
+        mt.eval()
+        caches = mt.gen_cache(2, 4)
+        # prefill 3 tokens
+        _, cur = mt(self.x[:, :3], caches=caches, time_step=0)
+        # decode step 3, masking out cached position 1
+        pad = jnp.ones((1, 1, 1, 4), jnp.bool_).at[..., 1].set(False)
+        with_mask, _ = mt(self.x[:, 3:4], attn_mask=pad, caches=cur,
+                          time_step=3)
+        without, _ = mt(self.x[:, 3:4], caches=cur, time_step=3)
+        assert float(jnp.abs(with_mask - without).max()) > 1e-6
+
+
 # ---------------------------------------------------------------------------
 # incubate.optimizer
 # ---------------------------------------------------------------------------
