@@ -132,3 +132,76 @@ def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
     src = jnp.asarray(src_index, jnp.int32)
     dst = jnp.asarray(dst_index, jnp.int32)
     return _combine(x[src], y[dst], message_op)
+
+
+# ---------------------------------------------------------------------------
+# Graph sampling + reindexing (ref geometric/sampling/neighbors.py:23,
+# geometric/reindex.py:25). Variable-length outputs are data-dependent, so
+# these are host-side ops (the reference's GPU kernels also return dynamic
+# shapes and are used in the eager data-prep stage of GNN pipelines).
+# ---------------------------------------------------------------------------
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False,
+                     perm_buffer=None, name=None):
+    """Uniformly sample up to ``sample_size`` in-neighbors of each input
+    node from a CSC graph (row = concatenated neighbor lists, colptr =
+    per-node offsets). Returns (out_neighbors, out_count[, out_eids])."""
+    row_np = np.asarray(row).ravel()
+    colptr_np = np.asarray(colptr).ravel()
+    nodes = np.asarray(input_nodes).ravel()
+    eids_np = np.asarray(eids).ravel() if eids is not None else None
+    if return_eids and eids_np is None:
+        raise ValueError("return_eids=True requires eids")
+    rng = np.random.default_rng()
+    out_n, out_c, out_e = [], [], []
+    for node in nodes:
+        beg, end = int(colptr_np[node]), int(colptr_np[node + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            pick = np.arange(beg, end)
+        else:
+            pick = beg + rng.choice(deg, size=sample_size, replace=False)
+        out_n.append(row_np[pick])
+        out_c.append(len(pick))
+        if eids_np is not None:
+            out_e.append(eids_np[pick])
+    neighbors = jnp.asarray(np.concatenate(out_n) if out_n
+                            else np.zeros((0,), row_np.dtype))
+    count = jnp.asarray(np.asarray(out_c, np.int32))
+    if return_eids:
+        return neighbors, count, jnp.asarray(
+            np.concatenate(out_e) if out_e else np.zeros((0,), np.int64))
+    return neighbors, count
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact node ids to a local range: input nodes first, then unseen
+    neighbors in first-appearance order. Returns (reindexed_src,
+    reindexed_dst, out_nodes)."""
+    x_np = np.asarray(x).ravel()
+    nbr_np = np.asarray(neighbors).ravel()
+    cnt_np = np.asarray(count).ravel()
+    if int(cnt_np.sum()) != nbr_np.size:
+        raise ValueError(
+            f"sum(count)={int(cnt_np.sum())} != neighbors {nbr_np.size}")
+    mapping = {}
+    order = []
+    for n in x_np.tolist():
+        if n not in mapping:
+            mapping[n] = len(order)
+            order.append(n)
+    for n in nbr_np.tolist():
+        if n not in mapping:
+            mapping[n] = len(order)
+            order.append(n)
+    reindex_src = np.asarray([mapping[n] for n in nbr_np.tolist()],
+                             np.int64)
+    # dst: each input node repeated by its neighbor count
+    dst_ids = np.repeat(np.arange(x_np.size), cnt_np)
+    return (jnp.asarray(reindex_src), jnp.asarray(dst_ids),
+            jnp.asarray(np.asarray(order, x_np.dtype)))
+
+
+__all__ += ["sample_neighbors", "reindex_graph"]

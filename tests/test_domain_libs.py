@@ -307,3 +307,53 @@ class TestMetrics:
         pred = np.asarray([[0.1, 0.9], [0.8, 0.2]])
         label = np.asarray([1, 1])
         assert paddle.metric.accuracy(pred, label, k=1) == 0.5
+
+
+class TestGraphSampling:
+    """sample_neighbors / reindex_graph (ref geometric/sampling/neighbors.py
+    :23, geometric/reindex.py:25)."""
+
+    def setup_method(self):
+        # CSC: node0 <- {1,2,3}, node1 <- {0}, node2 <- {}
+        self.row = jnp.asarray([1, 2, 3, 0])
+        self.colptr = jnp.asarray([0, 3, 4, 4])
+
+    def test_sample_all(self):
+        import paddle_tpu.geometric as G
+        nbr, cnt = G.sample_neighbors(self.row, self.colptr,
+                                      jnp.asarray([0, 1, 2]))
+        np.testing.assert_array_equal(np.asarray(cnt), [3, 1, 0])
+        np.testing.assert_array_equal(np.asarray(nbr), [1, 2, 3, 0])
+
+    def test_sample_size_limits(self):
+        import paddle_tpu.geometric as G
+        nbr, cnt = G.sample_neighbors(self.row, self.colptr,
+                                      jnp.asarray([0]), sample_size=2)
+        assert int(cnt[0]) == 2
+        assert set(np.asarray(nbr).tolist()) <= {1, 2, 3}
+
+    def test_eids(self):
+        import paddle_tpu.geometric as G
+        nbr, cnt, eids = G.sample_neighbors(
+            self.row, self.colptr, jnp.asarray([0, 1]),
+            eids=jnp.arange(4), return_eids=True)
+        np.testing.assert_array_equal(np.asarray(eids), [0, 1, 2, 3])
+        with pytest.raises(ValueError):
+            G.sample_neighbors(self.row, self.colptr, jnp.asarray([0]),
+                               return_eids=True)
+
+    def test_reindex_graph(self):
+        import paddle_tpu.geometric as G
+        src, dst, nodes = G.reindex_graph(
+            jnp.asarray([10, 20]), jnp.asarray([30, 20, 10]),
+            jnp.asarray([2, 1]))
+        # input nodes keep ids 0..n-1; new neighbor 30 -> id 2
+        np.testing.assert_array_equal(np.asarray(nodes), [10, 20, 30])
+        np.testing.assert_array_equal(np.asarray(src), [2, 1, 0])
+        np.testing.assert_array_equal(np.asarray(dst), [0, 0, 1])
+
+    def test_reindex_count_mismatch(self):
+        import paddle_tpu.geometric as G
+        with pytest.raises(ValueError):
+            G.reindex_graph(jnp.asarray([1]), jnp.asarray([2, 3]),
+                            jnp.asarray([1]))
