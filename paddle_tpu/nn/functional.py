@@ -1420,3 +1420,12 @@ def class_center_sample(label, num_classes: int, num_samples: int,
     remap[sampled] = np.arange(len(sampled))
     return jnp.asarray(remap[label_np].reshape(np.asarray(label).shape)), \
         jnp.asarray(sampled)
+
+
+# paddle parity: paddle.nn.functional.flash_attention lives under nn.
+# functional in the reference; the implementation is ops/flash_attention.py
+# (Pallas kernel + fallbacks).
+from ..ops.flash_attention import (flash_attention,  # noqa: E402,F401
+                                   flash_attn_unpadded)
+
+__all__ += ["flash_attention", "flash_attn_unpadded"]

@@ -53,3 +53,46 @@ class Softmax:
         denom = jax.ops.segment_sum(e, rows, num_segments=n_rows)
         out = e / denom[rows]
         return SparseCooTensor(jsparse.BCOO((out, t.indices), shape=t.shape))
+
+
+class BatchNorm:
+    """Sparse BatchNorm (ref sparse/nn/layer/norm.py): normalizes the
+    nonzero VALUES per channel; the sparsity pattern is untouched."""
+
+    def __init__(self, num_features: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5):
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.weight = jnp.ones((num_features,))
+        self.bias = jnp.zeros((num_features,))
+        self._mean = jnp.zeros((num_features,))
+        self._var = jnp.ones((num_features,))
+        self.training = True
+
+    def eval(self):
+        self.training = False
+        return self
+
+    def train(self):
+        self.training = True
+        return self
+
+    def __call__(self, x):
+        values = x.values()
+        if self.training:
+            mean = values.mean(axis=0)
+            var = values.var(axis=0)
+            self._mean = (self.momentum * self._mean
+                          + (1 - self.momentum) * mean)
+            self._var = (self.momentum * self._var
+                         + (1 - self.momentum) * var)
+        else:
+            mean, var = self._mean, self._var
+        out_vals = ((values - mean) / jnp.sqrt(var + self.epsilon)
+                    * self.weight + self.bias)
+        from . import sparse_coo_tensor
+        return sparse_coo_tensor(x.indices(), out_vals, x.shape)
+
+
+__all__ = [n for n in dir() if n[0].isupper()]

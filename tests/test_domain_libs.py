@@ -357,3 +357,35 @@ class TestGraphSampling:
         with pytest.raises(ValueError):
             G.reindex_graph(jnp.asarray([1]), jnp.asarray([2, 3]),
                             jnp.asarray([1]))
+
+
+class TestSparseBatchNorm:
+    def test_normalizes_values_per_channel(self):
+        import paddle_tpu.sparse as sp
+        x = sp.sparse_coo_tensor(
+            jnp.asarray([[0, 1], [1, 0]]),
+            jnp.asarray([[1.0, 10.0], [3.0, 30.0]]), (2, 2, 2))
+        bn = sp.nn.BatchNorm(2)
+        out = bn(x)
+        vals = np.asarray(out.values())
+        np.testing.assert_allclose(vals.mean(0), 0.0, atol=1e-5)
+        np.testing.assert_array_equal(np.asarray(out.indices()),
+                                      np.asarray(x.indices()))
+
+    def test_eval_uses_running_stats(self):
+        import paddle_tpu.sparse as sp
+        bn = sp.nn.BatchNorm(1)
+        x = sp.sparse_coo_tensor(jnp.asarray([[0, 1]]),
+                                 jnp.asarray([[2.0], [4.0]]), (2, 1))
+        bn(x)  # update running stats
+        bn.eval()
+        out = bn(x)
+        assert out.values().shape == (2, 1)
+
+
+class TestFlashAttentionNamespace:
+    def test_importable_from_nn_functional(self):
+        from paddle_tpu.nn import functional as F
+        q = jnp.ones((1, 8, 2, 16), jnp.float32)
+        out = F.flash_attention(q, q, q, causal=True)
+        assert out.shape == q.shape
