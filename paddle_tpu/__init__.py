@@ -69,5 +69,145 @@ from . import _C_ops  # noqa: F401
 import jax as _jax
 Tensor = _jax.Array
 
+# --- paddle parity shims (ref python/paddle/__init__.py __all__) ----------
+
+dtype = _jax.numpy.dtype          # paddle.dtype("float32") etc.
+bool = bool_  # noqa: A001 — paddle exports `paddle.bool` the same way
+
+from .autograd import enable_grad, set_grad_enabled  # noqa: F401,E402
+from .autograd import is_grad_enabled  # noqa: F401,E402
+
+
+class CPUPlace:
+    """ref paddle.CPUPlace — device placement token (JAX resolves actual
+    placement from shardings/default device; these exist for ported code)."""
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class CUDAPlace:
+    """ref paddle.CUDAPlace — maps to the accelerator (TPU here)."""
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place(tpu:{self.device_id})"
+
+
+class CUDAPinnedPlace:
+    def __repr__(self):
+        return "Place(tpu_pinned)"
+
+
+class LazyGuard:
+    """ref paddle.LazyGuard (lazy parameter init). JAX initializers already
+    run lazily at first trace under jit; eager construction is cheap, so
+    this is a no-op scope kept for ported code."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def in_dynamic_mode() -> bool:
+    """Always True: "dygraph" is op-by-op dispatch; `static` mode is just
+    jit tracing of the same code (ref paddle.in_dynamic_mode)."""
+    return True
+
+
+def enable_static():
+    """No-op: programs are built by tracing the same eager code under
+    jit/Program (ref paddle.enable_static toggles a global graph mode)."""
+
+
+def disable_static():
+    """No-op (see enable_static)."""
+
+
+def disable_signal_handler():
+    """No-op: no C++ signal handlers are installed (ref
+    paddle.disable_signal_handler exists to unhook fluid's)."""
+
+
+def get_cuda_rng_state():
+    """Accelerator RNG state (threefry key) — paddle-named alias."""
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state) -> None:
+    set_rng_state(state)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias: bool = False, default_initializer=None):
+    """ref paddle.create_parameter: a standalone trainable array."""
+    from .nn import initializer as _I
+    init = default_initializer or (_I.Constant(0.0) if is_bias
+                                   else _I.XavierNormal())
+    return init(tuple(shape), dtype=_jax.numpy.dtype(dtype))
+
+
+from .text.ops import shard_index  # noqa: F401,E402
+
+
+def check_shape(x, expected_shape, name=None):
+    """ref paddle.check_shape: raise when a shape doesn't match (wildcard
+    -1 entries allowed)."""
+    actual = tuple(_jax.numpy.asarray(x).shape)
+    exp = tuple(expected_shape)
+    # NB: plain loop — builtins `any`/`bool` are shadowed by tensor ops in
+    # this namespace (paddle.any / paddle.bool), as in the reference.
+    ok = len(actual) == len(exp)
+    if ok:
+        for a, e in zip(actual, exp):
+            if e != -1 and a != e:
+                ok = False
+                break
+    if not ok:
+        raise ValueError(f"shape mismatch: expected {exp}, got {actual}")
+    return x
+
+
+def _install_inplace_aliases():
+    """paddle's trailing-underscore in-place ops, aliased to the pure ops.
+
+    JAX arrays are immutable, so these CANNOT mutate their argument: like
+    paddle's in-place ops they return the result tensor, and ported call
+    sites must use that return value (``x = paddle.clip_(x, ...)``). A
+    bare-statement call relying on mutation gets the unchanged input — the
+    one paddle idiom this build cannot honor. Only the alias names the
+    reference actually exports are installed (harvested from its
+    ``__all__`` at packaging time), so no fabricated names pollute the
+    namespace.
+    """
+    ref_inplace = [
+        "abs_", "acos_", "addmm_", "asin_", "atan_", "bitwise_and_",
+        "bitwise_not_", "bitwise_or_", "bitwise_xor_", "cast_", "ceil_",
+        "clip_", "cos_", "cosh_", "cumprod_", "cumsum_", "digamma_",
+        "divide_", "equal_", "erf_", "erfinv_", "exp_", "expm1_", "fill_",
+        "flatten_", "floor_", "floor_divide_", "floor_mod_", "frac_",
+        "gcd_", "greater_equal_", "greater_than_", "i0_", "lcm_",
+        "ldexp_", "less_equal_", "less_than_", "lgamma_", "log_", "log10_",
+        "log1p_", "log2_", "logical_and_", "logical_not_", "logical_or_",
+        "logical_xor_", "logit_", "mod_", "multiply_", "nan_to_num_",
+        "neg_", "not_equal_", "polygamma_", "pow_", "reciprocal_",
+        "remainder_", "renorm_", "reshape_", "round_", "rsqrt_", "scale_",
+        "scatter_", "sigmoid_", "sin_", "sinh_", "sqrt_", "square_",
+        "squeeze_", "subtract_", "tan_", "tanh_", "tril_", "triu_",
+        "trunc_", "uniform_", "unsqueeze_", "where_", "zero_",
+    ]
+    g = globals()
+    for alias in ref_inplace:
+        public = alias[:-1]
+        if alias not in g and callable(g.get(public)):
+            g[alias] = g[public]
+
+
+_install_inplace_aliases()
+
 from .nn.layer import ParamAttr  # noqa: F401
 from .framework.dataparallel_api import DataParallel  # noqa: F401

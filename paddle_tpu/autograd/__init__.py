@@ -118,6 +118,13 @@ def set_grad_enabled(mode: bool):
     yield
 
 
+def is_grad_enabled() -> bool:
+    """Always True (ref paddle.is_grad_enabled): functional autodiff has no
+    global tape to switch off — gradients exist exactly where jax.grad is
+    applied, and no_grad/enable_grad are compatibility scopes."""
+    return True
+
+
 class _PyLayerContext:
     """Parity with PyLayerContext: save_for_backward / saved_tensor."""
 

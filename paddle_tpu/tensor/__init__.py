@@ -15,3 +15,4 @@ from .logic import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
 from .search import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
