@@ -9,6 +9,7 @@ buffers; the forward is pure jnp so the whole tree jits.
 
 from __future__ import annotations
 
+import collections
 import math
 from typing import List, Optional, Sequence, Tuple, Union
 
@@ -530,34 +531,58 @@ class MultiHeadAttention(Layer):
         self.v_proj = Linear(vdim, embed_dim, weight_attr, bias_attr)
         self.out_proj = Linear(embed_dim, embed_dim, weight_attr, bias_attr)
 
+    Cache = collections.namedtuple("Cache", ["k", "v"])
+    StaticCache = collections.namedtuple("StaticCache", ["k", "v"])
+
     def gen_cache(self, key, value=None, type=None):
-        """Empty KV cache for incremental decode (ref MultiHeadAttention
-        Cache/StaticCache). Returns (k, v) with zero-length sequence."""
+        """KV cache for decode (ref MultiHeadAttention Cache/StaticCache).
+
+        ``type=MultiHeadAttention.StaticCache`` precomputes the cross-attention
+        K/V projections of ``key``/``value`` (reference transformer.py
+        StaticCache semantics); otherwise returns an incremental ``Cache``
+        with a zero-length sequence that grows each step. Note the growing
+        concatenate changes shapes every step, so incremental decode under
+        ``jax.jit`` recompiles per step — use the fused KV-cache decode path
+        (incubate.nn.FusedMultiHeadAttention) for compiled generation."""
+        if type is MultiHeadAttention.StaticCache:
+            value = key if value is None else value
+            b = key.shape[0]
+            k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads,
+                                         self.head_dim)
+            v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads,
+                                           self.head_dim)
+            return MultiHeadAttention.StaticCache(k, v)
         b = key.shape[0]
         empty = jnp.zeros((b, 0, self.num_heads, self.head_dim),
                           key.dtype)
-        return (empty, empty)
+        return MultiHeadAttention.Cache(empty, empty)
 
     def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
         """With ``cache`` (a (k, v) pair from :meth:`gen_cache` or a prior
         step), keys/values are appended to it and ``(out, new_cache)`` is
-        returned — paddle's incremental-decode contract."""
+        returned — paddle's incremental-decode contract. A ``StaticCache``
+        holds precomputed cross-attention K/V used as-is (not grown)."""
         key = query if key is None else key
         value = query if value is None else value
         b, sq, _ = query.shape
         q = self.q_proj(query).reshape(b, sq, self.num_heads, self.head_dim)
-        k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads, self.head_dim)
-        v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads, self.head_dim)
-        if cache is not None:
-            ck, cv = cache
-            k = jnp.concatenate([ck, k], axis=1)
-            v = jnp.concatenate([cv, v], axis=1)
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            k, v = cache.k, cache.v
+        else:
+            k = self.k_proj(key).reshape(b, key.shape[1], self.num_heads, self.head_dim)
+            v = self.v_proj(value).reshape(b, value.shape[1], self.num_heads, self.head_dim)
+            if cache is not None:
+                ck, cv = cache
+                k = jnp.concatenate([ck, k], axis=1)
+                v = jnp.concatenate([cv, v], axis=1)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
             training=self.training)
         out = self.out_proj(out.reshape(b, sq, self.embed_dim))
+        if isinstance(cache, MultiHeadAttention.StaticCache):
+            return out, cache
         if cache is not None:
-            return out, (k, v)
+            return out, MultiHeadAttention.Cache(k, v)
         return out
 
 

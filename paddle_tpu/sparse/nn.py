@@ -55,42 +55,46 @@ class Softmax:
         return SparseCooTensor(jsparse.BCOO((out, t.indices), shape=t.shape))
 
 
-class BatchNorm:
+from ..nn.layers import _BatchNormBase
+
+
+class BatchNorm(_BatchNormBase):
     """Sparse BatchNorm (ref sparse/nn/layer/norm.py): normalizes the
-    nonzero VALUES per channel; the sparsity pattern is untouched."""
+    nonzero VALUES per channel; the sparsity pattern is untouched.
+
+    A real ``nn.Layer`` (via the dense ``_BatchNormBase`` parameter/buffer
+    machinery): weight/bias are registered parameters (visible to optimizers
+    and ``state_dict``) and running stats are registered buffers, so
+    functional_call's ``mutable=True`` path carries stat updates through jit
+    like the dense BatchNorm layers. Only :meth:`forward` differs — stats
+    are taken over the stored values, not the dense volume."""
 
     def __init__(self, num_features: int, momentum: float = 0.9,
-                 epsilon: float = 1e-5):
-        self.num_features = num_features
-        self.momentum = momentum
-        self.epsilon = epsilon
-        self.weight = jnp.ones((num_features,))
-        self.bias = jnp.zeros((num_features,))
-        self._mean = jnp.zeros((num_features,))
-        self._var = jnp.ones((num_features,))
-        self.training = True
+                 epsilon: float = 1e-5, weight_attr=None, bias_attr=None,
+                 data_format: str = "NDHWC", use_global_stats=None, name=None):
+        super().__init__(num_features, momentum=momentum, epsilon=epsilon,
+                         weight_attr=weight_attr, bias_attr=bias_attr,
+                         data_format=data_format,
+                         use_global_stats=use_global_stats)
 
-    def eval(self):
-        self.training = False
-        return self
-
-    def train(self):
-        self.training = True
-        return self
-
-    def __call__(self, x):
+    def forward(self, x):
         values = x.values()
-        if self.training:
-            mean = values.mean(axis=0)
-            var = values.var(axis=0)
-            self._mean = (self.momentum * self._mean
-                          + (1 - self.momentum) * mean)
-            self._var = (self.momentum * self._var
-                         + (1 - self.momentum) * var)
+        training = self.training and not (self.use_global_stats or False)
+        if training:
+            vf = values.astype(jnp.float32)
+            mean = vf.mean(axis=0)
+            var = vf.var(axis=0)
+            self._mean = self.momentum * self._mean + (1 - self.momentum) * mean
+            self._variance = (self.momentum * self._variance
+                              + (1 - self.momentum) * var)
         else:
-            mean, var = self._mean, self._var
-        out_vals = ((values - mean) / jnp.sqrt(var + self.epsilon)
-                    * self.weight + self.bias)
+            mean, var = self._mean, self._variance
+        out_vals = ((values - mean) / jnp.sqrt(var + self.epsilon))
+        if self.weight is not None:
+            out_vals = out_vals * self.weight
+        if self.bias is not None:
+            out_vals = out_vals + self.bias
+        out_vals = out_vals.astype(values.dtype)
         from . import sparse_coo_tensor
         return sparse_coo_tensor(x.indices(), out_vals, x.shape)
 

@@ -23,7 +23,9 @@ __all__ = ["take", "scatter_nd", "tensordot", "cdist", "count_nonzero",
 def take(x, index, mode: str = "raise", name=None):
     """Flat-index gather (ref tensor/math.py take): x treated as 1-D.
     mode='clip' clamps to [0, n-1] with negative indexing DISABLED (the
-    reference semantics); 'raise'/'wrap' allow negatives from the end."""
+    reference semantics); 'raise'/'wrap' allow negatives from the end.
+    mode='raise' checks bounds eagerly; under jit (abstract index values)
+    the check is skipped and out-of-range indices clamp, as documented."""
     flat = jnp.ravel(x)
     idx = jnp.asarray(index)
     n = flat.shape[0]
@@ -31,6 +33,12 @@ def take(x, index, mode: str = "raise", name=None):
         idx = ((idx % n) + n) % n
     elif mode == "clip":
         return flat[jnp.clip(idx, 0, n - 1)]
+    if mode == "raise" and idx.size and not isinstance(idx, jax.core.Tracer):
+        lo, hi = int(idx.min()), int(idx.max())
+        if lo < -n or hi >= n:
+            raise IndexError(
+                f"take(mode='raise'): index out of range for {n} elements "
+                f"(got min {lo}, max {hi})")
     # negative indices count from the end (paddle semantics)
     idx = jnp.where(idx < 0, idx + n, idx)
     return flat[idx]

@@ -101,6 +101,20 @@ class GPTAttention(nn.Layer):
             raise ValueError(
                 f"num_heads ({self.num_heads}) must be a multiple of "
                 f"num_kv_heads ({self.kv_heads})")
+        # GSPMD shards the kv-head axis over mp: kv_heads % mp != 0 is
+        # correct but silently uneven (idle shards + implicit resharding),
+        # so surface it — a warning, since replicate-KV setups are legal.
+        from ...distributed.topology import get_hybrid_mesh
+        mesh = get_hybrid_mesh()
+        if mesh is not None and "mp" in mesh.axis_names:
+            mp = mesh.shape["mp"]
+            if mp > 1 and self.kv_heads % mp:
+                import warnings
+                warnings.warn(
+                    f"num_kv_heads ({self.kv_heads}) is not divisible by the "
+                    f"mp mesh degree ({mp}): GSPMD shards the KV-head axis "
+                    f"unevenly (idle shards + implicit resharding). Use a "
+                    f"kv_heads multiple of mp, or lower mp.", UserWarning)
         h = cfg.hidden_size
         if self.kv_heads == self.num_heads:
             self.qkv_proj = ColumnParallelLinear(

@@ -5,6 +5,8 @@ from __future__ import annotations
 
 from typing import List, Optional, Type, Union
 
+import inspect
+
 from ... import nn
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
@@ -13,19 +15,36 @@ __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2"]
 
 
+def _norm(norm_layer, num_features, data_format):
+    """Construct a norm layer, passing data_format only to callables that
+    accept it (custom norm_layer callables may not)."""
+    try:
+        params = inspect.signature(norm_layer).parameters
+        accepts = "data_format" in params or any(
+            p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values())
+    except (TypeError, ValueError):
+        accepts = False
+    if accepts:
+        return norm_layer(num_features, data_format=data_format)
+    return norm_layer(num_features)
+
+
 class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
+        df = data_format
         self.conv1 = nn.Conv2D(inplanes, planes, 3, stride=stride, padding=1,
-                               bias_attr=False)
-        self.bn1 = norm_layer(planes)
+                               bias_attr=False, data_format=df)
+        self.bn1 = _norm(norm_layer, planes, df)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
-        self.bn2 = norm_layer(planes)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=df)
+        self.bn2 = _norm(norm_layer, planes, df)
         self.downsample = downsample if downsample is not None else None
         self.stride = stride
 
@@ -42,17 +61,22 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None,
-                 groups=1, base_width=64, dilation=1, norm_layer=None):
+                 groups=1, base_width=64, dilation=1, norm_layer=None,
+                 data_format="NCHW"):
         super().__init__()
         norm_layer = norm_layer or nn.BatchNorm2D
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
-        self.bn1 = norm_layer(width)
+        df = data_format
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False,
+                               data_format=df)
+        self.bn1 = _norm(norm_layer, width, df)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation, stride=stride,
-                               groups=groups, dilation=dilation, bias_attr=False)
-        self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
-        self.bn3 = norm_layer(planes * self.expansion)
+                               groups=groups, dilation=dilation,
+                               bias_attr=False, data_format=df)
+        self.bn2 = _norm(norm_layer, width, df)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1,
+                               bias_attr=False, data_format=df)
+        self.bn3 = _norm(norm_layer, planes * self.expansion, df)
         self.relu = nn.ReLU()
         self.downsample = downsample if downsample is not None else None
 
@@ -69,7 +93,7 @@ class BottleneckBlock(nn.Layer):
 class ResNet(nn.Layer):
     def __init__(self, block, depth: int = 50, width: int = 64,
                  num_classes: int = 1000, with_pool: bool = True,
-                 groups: int = 1):
+                 groups: int = 1, data_format: str = "NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -80,35 +104,40 @@ class ResNet(nn.Layer):
         self.with_pool = with_pool
         self.inplanes = 64
         self.dilation = 1
+        # NHWC puts channels on the TPU's 128-lane minor dim — convs tile
+        # directly onto the MXU with no layout canonicalization passes.
+        self.data_format = data_format
 
+        df = data_format
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
-                               bias_attr=False)
-        self.bn1 = nn.BatchNorm2D(self.inplanes)
+                               bias_attr=False, data_format=df)
+        self.bn1 = nn.BatchNorm2D(self.inplanes, data_format=df)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, data_format=df)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), data_format=df)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
     def _make_layer(self, block, planes, blocks, stride=1):
+        df = self.data_format
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
                 nn.Conv2D(self.inplanes, planes * block.expansion, 1,
-                          stride=stride, bias_attr=False),
-                nn.BatchNorm2D(planes * block.expansion),
+                          stride=stride, bias_attr=False, data_format=df),
+                nn.BatchNorm2D(planes * block.expansion, data_format=df),
             )
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width)]
+                        self.base_width, data_format=df)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
-                                base_width=self.base_width))
+                                base_width=self.base_width, data_format=df))
         return nn.Sequential(*layers)
 
     def forward(self, x):

@@ -173,3 +173,86 @@ def test_functional_call_never_leaks_tracers_into_layer_tree():
     for _, buf in net.named_buffers():
         assert isinstance(buf, jax.Array)
         np.asarray(buf)  # would raise on a tracer
+
+
+# --- round-3 advisor fixes ---------------------------------------------------
+
+def test_take_mode_raise_bounds():
+    import pytest
+    import paddle_tpu as paddle
+    x = jnp.arange(5)
+    with pytest.raises(IndexError):
+        paddle.take(x, jnp.asarray([10]), mode="raise")
+    with pytest.raises(IndexError):
+        paddle.take(x, jnp.asarray([-6]), mode="raise")
+    np.testing.assert_array_equal(
+        np.asarray(paddle.take(x, jnp.asarray([-1, 0]), mode="raise")), [4, 0])
+    # clip mode still clamps silently
+    np.testing.assert_array_equal(
+        np.asarray(paddle.take(x, jnp.asarray([10]), mode="clip")), [4])
+
+
+def test_mha_static_cache_cross_attention():
+    from paddle_tpu import nn
+    mha = nn.MultiHeadAttention(16, 2, dropout=0.0)
+    mha.eval()
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 4, 16)), jnp.float32)
+    mem = jnp.asarray(rng.standard_normal((1, 3, 16)), jnp.float32)
+    full = mha(q, mem, mem)
+    cache = mha.gen_cache(mem, type=nn.MultiHeadAttention.StaticCache)
+    out, cache2 = mha(q, mem, mem, cache=cache)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full), atol=1e-5)
+    assert isinstance(cache2, nn.MultiHeadAttention.StaticCache)
+
+
+def test_sparse_batchnorm_is_layer():
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import (functional_call, get_buffers,
+                                                 get_params)
+    bn = paddle.sparse.nn.BatchNorm(4)
+    params = get_params(bn)
+    assert "weight" in params and "bias" in params
+    buffers = get_buffers(bn)
+    assert "_mean" in buffers and "_variance" in buffers
+    # channels-last layout: sparse over rows, dense channel values [nnz, C]
+    sp = paddle.sparse.sparse_coo_tensor(
+        np.array([[0, 2]]),
+        np.asarray(np.random.default_rng(0).standard_normal((2, 4)),
+                   np.float32), (3, 4))
+    out, new_buf = functional_call(bn, params, sp, buffers=buffers,
+                                   mutable=True, training=True)
+    assert out.shape == (3, 4)
+    # running stats updated through the functional path
+    assert not np.allclose(np.asarray(new_buf["_mean"]),
+                           np.asarray(buffers["_mean"]))
+
+
+def test_gqa_kv_heads_mp_divisibility_validated():
+    import pytest
+    from paddle_tpu.distributed import topology
+    from paddle_tpu.text.models.gpt import GPTAttention, GPTConfig
+    mesh = topology.create_hybrid_mesh(mp=4, dp=-1)
+    prev = topology.get_hybrid_mesh()
+    topology.set_hybrid_mesh(mesh)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=1,
+                    num_heads=8, num_kv_heads=2, max_position_embeddings=32)
+    try:
+        with pytest.warns(UserWarning, match="not divisible by the mp"):
+            GPTAttention(cfg)
+    finally:
+        topology.set_hybrid_mesh(prev)
+
+
+def test_take_empty_index_ok():
+    import paddle_tpu as paddle
+    out = paddle.take(jnp.zeros((0,)), jnp.asarray([], dtype=jnp.int32),
+                      mode="raise")
+    assert out.shape == (0,)
+
+
+def test_resnet_custom_norm_layer_without_data_format():
+    from paddle_tpu.vision.models.resnet import BasicBlock
+    blk = BasicBlock(8, 8, norm_layer=lambda c: nn.GroupNorm(4, c))
+    out = blk(jnp.ones((1, 8, 8, 8)))
+    assert out.shape == (1, 8, 8, 8)
