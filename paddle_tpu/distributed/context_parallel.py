@@ -63,13 +63,37 @@ def _block_attn(q, k, v, scale, causal, q_off, k_off):
     return acc, m.transpose(0, 2, 1), l.transpose(0, 2, 1)
 
 
-def _merge(m, l, acc, m_b, l_b, acc_b):
-    m_new = jnp.maximum(m, m_b)
-    alpha = jnp.exp(m - m_new)
-    beta = jnp.exp(m_b - m_new)
-    l_new = alpha * l + beta * l_b
-    acc_new = alpha[..., None] * acc + beta[..., None] * acc_b
-    return m_new, l_new, acc_new
+def _merge_olse(o, lse, o_b, lse_b):
+    """Merge two normalized flash partials over disjoint key sets:
+    out = softmax-weighted combination, lse' = logaddexp(lse, lse_b).
+    NEG_INF sentinels are finite, so fully-masked partials merge safely
+    (weights underflow to 0 instead of producing NaN)."""
+    m = jnp.maximum(lse, lse_b)
+    a = jnp.exp(lse - m)
+    bq = jnp.exp(lse_b - m)
+    denom = a + bq
+    o_new = (a[..., None] * o + bq[..., None] * o_b) / denom[..., None]
+    return o_new, m + jnp.log(denom)
+
+
+def _dense_block_olse(q, k, v, scale, causal, q_off, k_off):
+    """(o, lse) form of _block_attn for the jnp fallback path."""
+    acc, m, l = _block_attn(q, k, v, scale, causal, q_off, k_off)
+    lse = jnp.where(l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+    o = acc / jnp.maximum(l, 1e-30)[..., None]
+    return o, lse
+
+
+def _ring_use_flash(s_local: int, d: int, dtype) -> bool:
+    """Static decision: run the Pallas flash kernel inside the ring step?
+    (TPU backend + kernel-supported local block shapes; else dense jnp —
+    the CPU-mesh test path.)"""
+    from ..core import flags
+    if not flags.flag("use_pallas_kernels"):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    return s_local % 128 == 0 and d in (64, 128, 256)
 
 
 def ring_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
@@ -79,7 +103,10 @@ def ring_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
 
     Inputs/outputs are GLOBAL arrays; the seq dim is sharded over the sep
     axis inside. Equivalent to full (flash) attention over the global
-    sequence."""
+    sequence. On TPU the per-step block compute is the Pallas flash kernel
+    (SURVEY §7: "ring attention ... over a Pallas flash-attention kernel")
+    via its (o, lse) entry — O(block) memory at any global length; the jnp
+    path remains as the CPU/odd-shape fallback."""
     if mesh is None:
         from .topology import get_hybrid_mesh
         mesh = get_hybrid_mesh()
@@ -91,34 +118,64 @@ def ring_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
         return flash_attention(query, key, value, causal=causal, scale=scale)
     s_local = s_global // n
     perm = [(i, (i + 1) % n) for i in range(n)]
+    use_flash = _ring_use_flash(s_local, d, query.dtype)
+    if use_flash:
+        from ..ops._pallas.flash_attention import flash_attention_with_lse
 
     def fn(q, k, v):
         rank = lax.axis_index(axis)
         q_off = rank * s_local
 
+        def block_olse(q, k_blk, v_blk, src):
+            """(o [B,s,H,D] f32, lse [B,s,H] f32) for the resident block."""
+            if not use_flash:
+                return _dense_block_olse(
+                    q, k_blk, v_blk, scale_, causal if causal else None,
+                    q_off, src * s_local)
+            if not causal:
+                o, lse = flash_attention_with_lse(q, k_blk, v_blk,
+                                                  causal=False, scale=scale_)
+                return o.astype(jnp.float32), lse
+            # Causal: the block is diagonal (src == rank, kernel causal),
+            # fully visible (src < rank), or fully masked (src > rank —
+            # no kernel launch, zero partial).
+            def diag(q, kb, vb):
+                o, lse = flash_attention_with_lse(q, kb, vb, causal=True,
+                                                  scale=scale_)
+                return o.astype(jnp.float32), lse
+
+            def full(q, kb, vb):
+                o, lse = flash_attention_with_lse(q, kb, vb, causal=False,
+                                                  scale=scale_)
+                return o.astype(jnp.float32), lse
+
+            def masked(q, kb, vb):
+                return (jnp.zeros(q.shape, jnp.float32),
+                        jnp.full((q.shape[0], q.shape[1], q.shape[2]),
+                                 NEG_INF, jnp.float32))
+
+            case = jnp.where(src == rank, 0, jnp.where(src < rank, 1, 2))
+            return lax.switch(case, [diag, full, masked], q, k_blk, v_blk)
+
         def step_fn(carry, i):
-            k_blk, v_blk, m, l, acc = carry
+            k_blk, v_blk, o, lse = carry
             src = (rank - i) % n  # which global kv block is resident now
-            k_off = src * s_local
-            blk = functools.partial(
-                _block_attn, scale=scale_, causal=causal if causal else None)
+            blk = block_olse
             if remat:
                 blk = jax.checkpoint(blk)
-            acc_b, m_b, l_b = blk(q, k_blk, v_blk, q_off=q_off, k_off=k_off)
-            m, l, acc = _merge(m, l, acc, m_b, l_b, acc_b)
+            o_b, lse_b = blk(q, k_blk, v_blk, src)
+            o, lse = _merge_olse(o, lse, o_b, lse_b)
             k_blk = lax.ppermute(k_blk, axis, perm)
             v_blk = lax.ppermute(v_blk, axis, perm)
-            return (k_blk, v_blk, m, l, acc), None
+            return (k_blk, v_blk, o, lse), None
 
-        m0 = jnp.full((b, s_local, h), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((b, s_local, h), jnp.float32)
-        a0 = jnp.zeros((b, s_local, h, d), jnp.float32)
-        m0, l0, a0 = (lax.pcast(x, (axis,), to="varying")
-                      for x in (m0, l0, a0))
-        (_, _, m, l, acc), _ = lax.scan(
-            step_fn, (k, v, m0, l0, a0), jnp.arange(n))
-        out = acc / jnp.maximum(l, 1e-30)[..., None]
-        return out.astype(query.dtype)
+        lse0 = jnp.full((b, s_local, h), NEG_INF, jnp.float32)
+        o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
+        lse0, o0 = (lax.pcast(x, (axis,), to="varying")
+                    for x in (lse0, o0))
+        (_, _, o, lse), _ = lax.scan(
+            step_fn, (k, v, o0, lse0), jnp.arange(n))
+        return o.astype(query.dtype)
 
     spec = P(None, axis, None, None)
     return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),

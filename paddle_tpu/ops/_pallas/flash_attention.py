@@ -323,7 +323,7 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
-         seg_q=None, seg_k=None):
+         seg_q=None, seg_k=None, dlse=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     h = num_heads
@@ -336,6 +336,11 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # [BH, Sq]
     delta = delta[:, None, :]  # [BH, 1, Sq] — matches the slim lse layout
+    if dlse is not None:
+        # lse cotangent (ring-attention merge differentiates through lse):
+        # dL/ds_ij = p_ij (dp_ij - delta_i + dlse_i), so fold -dlse into the
+        # delta the kernels already subtract.
+        delta = delta - dlse.astype(jnp.float32)
     kv_index = _kv_index(h, hk)
 
     dq = pl.pallas_call(
@@ -427,6 +432,73 @@ def _flash_bwd_rule(scale, causal, block_q, block_k, num_heads, res, do):
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bhsd_lse(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
+                    num_heads):
+    """Like _flash_bhsd but returns (o, lse [BH, 1, Sq] fp32) and is
+    differentiable in BOTH outputs — the lse cotangent feeds ring-attention
+    merges (distributed/context_parallel.py)."""
+    return _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
+                seg_q, seg_k)
+
+
+def _flash_lse_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q,
+                        block_k, num_heads):
+    o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
+                  seg_q, seg_k)
+    return (o, lse), (q, k, v, o, lse, seg_q, seg_k)
+
+
+def _flash_lse_bwd_rule(scale, causal, block_q, block_k, num_heads, res, ct):
+    do, dlse = ct
+    q, k, v, o, lse, seg_q, seg_k = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
+                      num_heads, seg_q, seg_k, dlse=dlse)
+    return dq, dk, dv, None, None
+
+
+_flash_bhsd_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
+def flash_attention_with_lse(query, key, value, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: Optional[int] = None,
+                             block_k: Optional[int] = None):
+    """[B, S, H, D] flash attention returning (o, lse [B, Sq, H] fp32).
+
+    The blockwise-exact building block for ring context parallelism: two
+    (o, lse) partials over disjoint key sets merge to the full softmax via
+    lse' = logaddexp, o' = convex combination — and the custom VJP routes
+    lse cotangents back through the kernels, so the merged result is
+    differentiable end to end."""
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    auto_q, auto_k = _pick_blocks(sq, sk, d)
+    block_q = block_q or auto_q
+    block_k = block_k or auto_k
+    if sq % min(block_q, sq) or sk % min(block_k, sk):
+        raise ValueError(
+            f"flash_attention_with_lse needs seq lengths divisible by the "
+            f"block sizes; got sq={sq}, sk={sk}")
+    hk = key.shape[2]
+    if hk != h and (hk == 0 or h % hk):
+        raise ValueError(
+            f"query heads {h} must be a multiple of kv heads {hk}")
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    def to_bhsd(x, s, heads):
+        return x.transpose(0, 2, 1, 3).reshape(b * heads, s, d)
+
+    q = to_bhsd(query, sq, h)
+    k = to_bhsd(key, sk, hk)
+    v = to_bhsd(value, sk, hk)
+    o, lse = _flash_bhsd_lse(q, k, v, None, None, float(scale), bool(causal),
+                             block_q, block_k, h)
+    o = o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    lse = lse.reshape(b, h, sq).transpose(0, 2, 1)
+    return o, lse
 
 
 def supported_shapes(query, key) -> bool:
