@@ -26,7 +26,7 @@ from ..nn.layer import Layer
 from ..framework.functional import functional_call, get_params, get_buffers
 
 __all__ = ["to_static", "StaticFunction", "save", "load", "TranslatedLayer",
-           "not_to_static", "ignore_module"]
+           "not_to_static", "ignore_module", "dy2static"]
 
 
 def _abstractify(tree):
@@ -64,7 +64,11 @@ class StaticFunction:
 
                 fn = jax.jit(pure)
             else:
-                fn = jax.jit(self._target)
+                # dy2static: AST-convert data-dependent Python control flow
+                # into lax.cond/while_loop (ref dy2static transformers) so
+                # tracing doesn't choke on `if tensor:`.
+                from .dy2static import convert_to_static
+                fn = jax.jit(convert_to_static(self._target))
             self._cache[key] = fn
         return fn
 

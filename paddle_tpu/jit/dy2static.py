@@ -1,0 +1,517 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+The reference rewrites Python ``if``/``while``/``for`` over tensors into
+static-graph control-flow ops by AST transformation
+(``python/paddle/jit/dy2static/ifelse_transformer.py:1``,
+``loop_transformer.py``, driven by ``program_translator.py:313``). Pure
+tracing — the default JAX conversion — cannot handle a branch on a traced
+value. This module is the TPU-native form of those transformers: the same
+source rewrite, but the hoisted branch/loop functions dispatch to
+``lax.cond`` / ``lax.while_loop`` / ``lax.fori_loop`` when the condition is
+a tracer, and run plain Python otherwise (so converted functions behave
+identically outside jit).
+
+What converts:
+
+- ``if``/``elif``/``else`` over tensor conditions → ``lax.cond`` with the
+  branch-assigned variables as carried operands (write-set analysis, like
+  the reference's ``NameVisitor``);
+- ``while`` over tensor conditions → ``lax.while_loop``;
+- ``for i in range(...)`` with traced bounds → ``lax.fori_loop``;
+- ``and`` / ``or`` / ``not`` over tensors → ``jnp.logical_*`` (both sides
+  evaluate — short-circuit semantics are Python-only).
+
+Out of scope (loud errors, matching the reference's supported envelope):
+``break``/``continue`` under a tensor condition, ``return`` from only one
+branch of a tensor ``if``.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, List, Sequence, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["convert_to_static", "Undefined", "UNDEFINED",
+           "convert_ifelse", "convert_while", "convert_for_range",
+           "convert_logical_and", "convert_logical_or", "convert_logical_not"]
+
+
+class Undefined:
+    """Sentinel for a name assigned in only one branch (ref dy2static
+    UndefinedVar). Using it under a tensor condition is an error; under a
+    Python condition it simply never escapes the taken branch."""
+
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+UNDEFINED = Undefined()
+
+# Undefined is an *empty static pytree* (the reference's UndefinedVar): it
+# flattens to zero leaves, so an unread UNDEFINED operand costs lax.cond
+# nothing, and a branch that fails to assign a name returns UNDEFINED whose
+# treedef mismatches the other branch's array — a structural error exactly
+# when the program is genuinely ill-formed.
+jax.tree_util.register_pytree_node(
+    Undefined, lambda u: ((), None), lambda aux, children: UNDEFINED)
+
+
+def _is_traced(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+# ---------------------------------------------------------------------------
+# Runtime converters (the generated code calls these)
+# ---------------------------------------------------------------------------
+
+def convert_ifelse(cond, true_fn, false_fn, operands: tuple):
+    """Dispatch an ``if``: lax.cond for traced conditions, Python otherwise."""
+    if _is_traced(cond) or any(_is_traced(o) for o in operands):
+        if not _is_traced(cond):
+            # Concrete cond with traced operands: still take one branch
+            # eagerly — matches Python semantics and avoids tracing both.
+            return true_fn(*operands) if cond else false_fn(*operands)
+        try:
+            return lax.cond(cond, true_fn, false_fn, *operands)
+        except TypeError as e:
+            if "Undefined" in str(e) or "pytree" in str(e) or \
+                    "structure" in str(e):
+                raise ValueError(
+                    "dy2static: a variable assigned in only one branch of a "
+                    "tensor `if` is used afterwards; initialize it before "
+                    "the branch so both lax.cond branches return the same "
+                    "structure") from e
+            raise
+    return true_fn(*operands) if cond else false_fn(*operands)
+
+
+def convert_while(cond_fn, body_fn, operands: tuple):
+    """Dispatch a ``while``: lax.while_loop when the condition traces."""
+    probe = cond_fn(*operands)
+    if _is_traced(probe) or any(_is_traced(o) for o in operands):
+        for o in operands:
+            if o is UNDEFINED:
+                raise ValueError(
+                    "dy2static: initialize every loop variable before a "
+                    "tensor `while` loop (a name assigned in the loop body "
+                    "has no value on entry)")
+        return lax.while_loop(lambda c: cond_fn(*c), lambda c: body_fn(*c),
+                              operands)
+    while probe:
+        operands = body_fn(*operands)
+        probe = cond_fn(*operands)
+    return operands
+
+
+def convert_for_range(start, stop, step, body_fn, operands: tuple):
+    """Dispatch ``for i in range(...)``: lax.fori_loop (step 1, traced
+    bounds) / lax.while_loop (general step) / Python range otherwise."""
+    traced = any(_is_traced(x) for x in (start, stop, step)) or \
+        any(_is_traced(o) for o in operands)
+    if traced:
+        for o in operands:
+            if o is UNDEFINED:
+                raise ValueError(
+                    "dy2static: initialize every loop variable before a "
+                    "traced `for` loop")
+        if isinstance(step, int) and step == 1:
+            return lax.fori_loop(start, stop,
+                                 lambda i, c: body_fn(i, *c), operands)
+        i0 = jnp.asarray(start)
+
+        def cond(c):
+            i = c[0]
+            return jnp.where(step > 0, i < stop, i > stop)
+
+        def body(c):
+            i, rest = c[0], c[1:]
+            return (i + step,) + tuple(body_fn(i, *rest))
+
+        return lax.while_loop(cond, body, (i0,) + tuple(operands))[1:]
+    for i in range(start, stop, step):
+        operands = tuple(body_fn(i, *operands))
+    return operands
+
+
+def convert_logical_and(lhs, rhs_fn):
+    if _is_traced(lhs) or isinstance(lhs, jax.Array):
+        return jnp.logical_and(lhs, rhs_fn())
+    return lhs and rhs_fn()
+
+
+def convert_logical_or(lhs, rhs_fn):
+    if _is_traced(lhs) or isinstance(lhs, jax.Array):
+        return jnp.logical_or(lhs, rhs_fn())
+    return lhs or rhs_fn()
+
+
+def convert_logical_not(x):
+    if _is_traced(x) or isinstance(x, jax.Array):
+        return jnp.logical_not(x)
+    return not x
+
+
+# ---------------------------------------------------------------------------
+# Static analysis helpers (ref dy2static NameVisitor)
+# ---------------------------------------------------------------------------
+
+def _assigned_names(nodes: Sequence[ast.stmt]) -> List[str]:
+    """Names bound by assignment anywhere in `nodes` (order-stable)."""
+    out: List[str] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, (ast.Store, ast.Del)) and \
+                    node.id not in out:
+                out.append(node.id)
+
+        def visit_FunctionDef(self, node):  # don't descend into nested defs
+            if node.name not in out:
+                out.append(node.name)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node):
+            pass
+
+    for n in nodes:
+        V().visit(n)
+    return out
+
+
+def _read_names(nodes: Sequence[ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+
+    class V(ast.NodeVisitor):
+        def visit_Name(self, node):
+            if isinstance(node.ctx, ast.Load):
+                out.add(node.id)
+
+    for n in nodes:
+        V().visit(n)
+    return out
+
+
+def _contains(nodes: Sequence[ast.stmt], kinds) -> bool:
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, kinds):
+                return True
+    return False
+
+
+def _has_top_level_return(nodes: Sequence[ast.stmt]) -> bool:
+    """Return statements excluding those inside nested function defs."""
+    for n in nodes:
+        for sub in ast.walk(n):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            if isinstance(sub, ast.Return):
+                return True
+    return False
+
+
+_CTR = [0]
+
+
+def _fresh(prefix: str) -> str:
+    _CTR[0] += 1
+    return f"__jst_{prefix}_{_CTR[0]}"
+
+
+class _GeneratedNames:
+    """`some_set - _GENERATED` filters out generated helper names, which
+    must never join a carried-variable set (they are functions)."""
+
+    def __rsub__(self, other):
+        return {n for n in other if not n.startswith("__jst_")}
+
+
+_GENERATED = _GeneratedNames()
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _undefined_default(names: Sequence[str]) -> List[ast.stmt]:
+    """`name = __jst.UNDEFINED if '<name>' not in dir() else name` — cheaper:
+    we emit  try/except NameError guards so names missing on entry carry the
+    sentinel."""
+    stmts = []
+    for nm in names:
+        stmts.append(ast.Try(
+            body=[ast.Assign(targets=[_name(nm, ast.Store())],
+                             value=_name(nm))],
+            handlers=[ast.ExceptHandler(
+                type=ast.Tuple(elts=[_name("NameError"),
+                                     _name("UnboundLocalError")],
+                               ctx=ast.Load()),
+                name=None,
+                body=[ast.Assign(
+                    targets=[_name(nm, ast.Store())],
+                    value=ast.Attribute(value=_name("__jst"),
+                                        attr="UNDEFINED", ctx=ast.Load()))])],
+            orelse=[], finalbody=[]))
+    return stmts
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Bottom-up rewrite of if/while/for-range/boolops into __jst calls."""
+
+    # -- if / elif / else ---------------------------------------------------
+
+    def visit_If(self, node: ast.If):
+        self.generic_visit(node)
+        body, orelse = node.body, node.orelse or [ast.Pass()]
+        t_ret = _has_top_level_return(body)
+        f_ret = _has_top_level_return(orelse)
+        if t_ret or f_ret:
+            # Only the simple total form converts: each branch is exactly
+            # one final `return <expr>` (possibly after other statements,
+            # none of which return).
+            def _tail_return_only(stmts):
+                return (stmts and isinstance(stmts[-1], ast.Return)
+                        and stmts[-1].value is not None
+                        and not _has_top_level_return(stmts[:-1]))
+            if not (_tail_return_only(body) and _tail_return_only(orelse)):
+                raise NotImplementedError(
+                    "dy2static: `return` under a converted `if` must be the "
+                    "final statement of BOTH branches; early/partial return "
+                    "from a tensor condition has no lax.cond form")
+            return self._rewrite_returning_if(node, body, orelse)
+        carried = sorted(
+            (set(_assigned_names(body)) | set(_assigned_names(orelse)))
+            - _GENERATED)
+        tf, ff = _fresh("true_fn"), _fresh("false_fn")
+        args = ast.arguments(posonlyargs=[], args=[ast.arg(arg=c) for c in carried],
+                             kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(c) for c in carried], ctx=ast.Load()))
+        t_def = ast.FunctionDef(name=tf, args=args, body=body + [ret],
+                                decorator_list=[], type_params=[])
+        f_def = ast.FunctionDef(name=ff, args=args, body=list(orelse) + [ret],
+                                decorator_list=[], type_params=[])
+        call = ast.Call(
+            func=ast.Attribute(value=_name("__jst"), attr="convert_ifelse",
+                               ctx=ast.Load()),
+            args=[node.test, _name(tf), _name(ff),
+                  ast.Tuple(elts=[_name(c) for c in carried],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(c, ast.Store()) for c in carried],
+                               ctx=ast.Store())],
+            value=call) if carried else ast.Expr(value=call)
+        out = _undefined_default(carried) + [t_def, f_def, assign]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    def _rewrite_returning_if(self, node, body, orelse):
+        """Both branches end in return: `return convert_ifelse(...)`."""
+        tf, ff = _fresh("true_fn"), _fresh("false_fn")
+        args = ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                             kw_defaults=[], defaults=[])
+        t_def = ast.FunctionDef(name=tf, args=args, body=body,
+                                decorator_list=[], type_params=[])
+        f_def = ast.FunctionDef(name=ff, args=args, body=orelse,
+                                decorator_list=[], type_params=[])
+        ret = ast.Return(value=ast.Call(
+            func=ast.Attribute(value=_name("__jst"), attr="convert_ifelse",
+                               ctx=ast.Load()),
+            args=[node.test, _name(tf), _name(ff),
+                  ast.Tuple(elts=[], ctx=ast.Load())],
+            keywords=[]))
+        out = [t_def, f_def, ret]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    # -- while --------------------------------------------------------------
+
+    def visit_While(self, node: ast.While):
+        self.generic_visit(node)
+        if node.orelse:
+            raise NotImplementedError("dy2static: while/else not supported")
+        if _contains(node.body, (ast.Break, ast.Continue)):
+            # Leave untransformed: valid for Python-valued conditions;
+            # tensor conditions will fail in jax with a clear tracer error.
+            return node
+        if _has_top_level_return(node.body):
+            raise NotImplementedError(
+                "dy2static: `return` inside a converted `while` body")
+        # Carried state = names the body assigns. Loop-invariant reads (in
+        # the condition or body) resolve through the closure instead.
+        carried = sorted(set(_assigned_names(node.body)) - _GENERATED)
+        cf, bf = _fresh("cond_fn"), _fresh("body_fn")
+        args = ast.arguments(posonlyargs=[],
+                             args=[ast.arg(arg=c) for c in carried],
+                             kwonlyargs=[], kw_defaults=[], defaults=[])
+        c_def = ast.FunctionDef(name=cf, args=args,
+                                body=[ast.Return(value=node.test)],
+                                decorator_list=[], type_params=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(c) for c in carried], ctx=ast.Load()))
+        b_def = ast.FunctionDef(name=bf, args=args, body=node.body + [ret],
+                                decorator_list=[], type_params=[])
+        call = ast.Call(
+            func=ast.Attribute(value=_name("__jst"), attr="convert_while",
+                               ctx=ast.Load()),
+            args=[_name(cf), _name(bf),
+                  ast.Tuple(elts=[_name(c) for c in carried],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(c, ast.Store()) for c in carried],
+                               ctx=ast.Store())],
+            value=call) if carried else ast.Expr(value=call)
+        out = _undefined_default(carried) + [c_def, b_def, assign]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    # -- for i in range(...) ------------------------------------------------
+
+    def visit_For(self, node: ast.For):
+        self.generic_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and not node.orelse
+                    and isinstance(node.target, ast.Name)
+                    and not _contains(node.body, (ast.Break, ast.Continue)))
+        if not is_range:
+            return node  # plain Python iteration (lists, enumerate, ...)
+        if _has_top_level_return(node.body):
+            raise NotImplementedError(
+                "dy2static: `return` inside a converted `for` body")
+        rargs = node.iter.args
+        start = rargs[0] if len(rargs) > 1 else ast.Constant(value=0)
+        stop = rargs[1] if len(rargs) > 1 else rargs[0]
+        step = rargs[2] if len(rargs) > 2 else ast.Constant(value=1)
+        carried = sorted(set(_assigned_names(node.body))
+                         - {node.target.id} - _GENERATED)
+        bf = _fresh("for_body")
+        args = ast.arguments(
+            posonlyargs=[],
+            args=[ast.arg(arg=node.target.id)] +
+                 [ast.arg(arg=c) for c in carried],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_name(c) for c in carried], ctx=ast.Load()))
+        b_def = ast.FunctionDef(name=bf, args=args, body=node.body + [ret],
+                                decorator_list=[], type_params=[])
+        call = ast.Call(
+            func=ast.Attribute(value=_name("__jst"),
+                               attr="convert_for_range", ctx=ast.Load()),
+            args=[start, stop, step, _name(bf),
+                  ast.Tuple(elts=[_name(c) for c in carried],
+                            ctx=ast.Load())],
+            keywords=[])
+        assign = ast.Assign(
+            targets=[ast.Tuple(elts=[_name(c, ast.Store()) for c in carried],
+                               ctx=ast.Store())],
+            value=call) if carried else ast.Expr(value=call)
+        out = _undefined_default(carried) + [b_def, assign]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    # -- boolean operators --------------------------------------------------
+
+    def visit_BoolOp(self, node: ast.BoolOp):
+        self.generic_visit(node)
+        conv = ("convert_logical_and" if isinstance(node.op, ast.And)
+                else "convert_logical_or")
+        expr = node.values[-1]
+        for lhs in reversed(node.values[:-1]):
+            rhs_fn = ast.Lambda(
+                args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                                   kw_defaults=[], defaults=[]),
+                body=expr)
+            expr = ast.Call(
+                func=ast.Attribute(value=_name("__jst"), attr=conv,
+                                   ctx=ast.Load()),
+                args=[lhs, rhs_fn], keywords=[])
+        ast.copy_location(expr, node)
+        ast.fix_missing_locations(expr)
+        return expr
+
+    def visit_UnaryOp(self, node: ast.UnaryOp):
+        self.generic_visit(node)
+        if not isinstance(node.op, ast.Not):
+            return node
+        out = ast.Call(
+            func=ast.Attribute(value=_name("__jst"),
+                               attr="convert_logical_not", ctx=ast.Load()),
+            args=[node.operand], keywords=[])
+        ast.copy_location(out, node)
+        ast.fix_missing_locations(out)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Entry point
+# ---------------------------------------------------------------------------
+
+def convert_to_static(fn: Callable) -> Callable:
+    """AST-convert a Python function's control flow for tracing (ref
+    program_translator.py:313 StaticFunction conversion step).
+
+    Returns a new function with identical signature whose ``if``/``while``/
+    ``for range``/boolean ops dispatch through lax control flow when traced.
+    Falls back to the original function when source is unavailable
+    (builtins, lambdas, C extensions)."""
+    if getattr(fn, "__jst_converted__", False):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, IndentationError, SyntaxError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # run undecorated; to_static re-wraps
+    new_tree = _ControlFlowTransformer().visit(tree)
+    ast.fix_missing_locations(new_tree)
+    code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    import sys
+    this = sys.modules[__name__]
+    glb = dict(fn.__globals__)
+    glb["__jst"] = this
+    # Rebind the original closure cells, if any.
+    if fn.__closure__:
+        freevars = fn.__code__.co_freevars
+        for name, cell in zip(freevars, fn.__closure__):
+            try:
+                glb.setdefault(name, cell.cell_contents)
+            except ValueError:
+                pass
+    loc: dict = {}
+    exec(code, glb, loc)
+    new_fn = loc[fdef.name]
+    new_fn = functools.wraps(fn)(new_fn)
+    new_fn.__jst_converted__ = True
+    return new_fn
