@@ -1,0 +1,160 @@
+"""Kernel autotune harness with a persistent on-disk cache.
+
+Reference parity: ``paddle/phi/kernels/autotune/cache.h:1`` (AlgorithmsCache
+— runtime-measured algo choices keyed by shape/dtype, serialized across
+runs) and ``switch_autotune.h`` (global enable switch). TPU-native form:
+the tunable is a Pallas kernel's block configuration; measurement runs the
+real kernel on-device eagerly (compile + time), and the winner is stored in
+a JSON cache keyed by (kernel, chip, shape-key) that ``_pick_blocks``-style
+selectors consult BEFORE their static tables. Autotuning happens at eager
+level — under jit the cached (static) choice is read at trace time, which
+is exactly when block sizes must be known.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...core import flags as _flags
+
+__all__ = ["AutotuneCache", "get_cache", "autotune", "chip_kind"]
+
+for _n, _d, _h in [
+    ("kernel_autotune", 1, "consult the persistent kernel-autotune cache"),
+    ("kernel_autotune_cache_path", "",
+     "override the autotune cache file location"),
+]:
+    try:
+        _flags.flag(_n)
+    except KeyError:
+        _flags.define_flag(_n, _d, _h)
+
+
+def chip_kind() -> str:
+    try:
+        return jax.devices()[0].device_kind.replace(" ", "_")
+    except Exception:
+        return "unknown"
+
+
+def _default_path() -> str:
+    p = str(_flags.flag("kernel_autotune_cache_path") or "")
+    if p:
+        return p
+    p = os.environ.get("PADDLE_TPU_AUTOTUNE_CACHE")
+    if p:
+        return p
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu",
+                        "autotune.json")
+
+
+class AutotuneCache:
+    """(kernel, chip, key) -> config, persisted as JSON (ref cache.h
+    AlgorithmsCache + autotune_cache_utils serialization)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path or _default_path()
+        self._data: Dict[str, Any] = {}
+        self._loaded = False
+
+    def _key(self, kernel: str, key) -> str:
+        return f"{kernel}|{chip_kind()}|{key}"
+
+    def load(self):
+        if self._loaded:
+            return
+        self._loaded = True
+        try:
+            with open(self.path) as f:
+                self._data = json.load(f)
+        except (OSError, ValueError):
+            self._data = {}
+
+    def save(self):
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._data, f, indent=1, sort_keys=True)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # cache is an optimization; never fail the program
+
+    def get(self, kernel: str, key) -> Optional[Any]:
+        if not _flags.flag("kernel_autotune"):
+            return None
+        self.load()
+        ent = self._data.get(self._key(kernel, key))
+        return ent["config"] if ent else None
+
+    def put(self, kernel: str, key, config, measured_ms: float):
+        self.load()
+        self._data[self._key(kernel, key)] = {
+            "config": config,
+            "measured_ms": round(measured_ms, 4),
+            "tuned_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+        }
+        self.save()
+
+    def stats(self):
+        self.load()
+        return dict(self._data)
+
+
+_cache: Optional[AutotuneCache] = None
+
+
+def get_cache() -> AutotuneCache:
+    global _cache
+    if _cache is None:
+        _cache = AutotuneCache()
+    return _cache
+
+
+def _measure(run: Callable[[], Any], warmup: int, iters: int) -> float:
+    """Time an eager kernel launch; a forced device->host sum is the only
+    reliable sync through the axon tunnel (PERF.md measurement note)."""
+    def sync(r):
+        leaves = jax.tree_util.tree_leaves(r)
+        return float(jnp.sum(leaves[0].astype(jnp.float32)))
+
+    for _ in range(warmup):
+        sync(run())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        r = run()
+    sync(r)
+    return (time.perf_counter() - t0) / iters * 1e3
+
+
+def autotune(kernel: str, key, candidates: Sequence[Any],
+             run_fn: Callable[[Any], Any], warmup: int = 1, iters: int = 3,
+             measure: Optional[Callable[[Callable[[], Any]], float]] = None,
+             cache: Optional[AutotuneCache] = None):
+    """Sweep candidates on-device, persist and return the winner.
+
+    run_fn(config) -> result (device arrays). A cached entry short-circuits
+    the sweep. Candidates that raise are skipped (unsupported shapes)."""
+    c = cache or get_cache()
+    hit = c.get(kernel, key)
+    if hit is not None:
+        return hit
+    meas = measure or (lambda run: _measure(run, warmup, iters))
+    best_cfg, best_ms = None, float("inf")
+    for cfg in candidates:
+        try:
+            ms = meas(lambda: run_fn(cfg))
+        except Exception:
+            continue
+        if ms < best_ms:
+            best_cfg, best_ms = cfg, ms
+    if best_cfg is None:
+        raise ValueError(f"autotune({kernel}): no candidate ran for {key}")
+    c.put(kernel, key, best_cfg, best_ms)
+    return best_cfg

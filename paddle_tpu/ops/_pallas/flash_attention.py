@@ -46,6 +46,38 @@ for _n in ("flash_block_q", "flash_block_k"):
         _flags.define_flag(_n, 0, "flash-attention block override (0=auto)")
 
 
+def _tuned_blocks(sq: int, sk: int, d: int):
+    """Cached autotune result for this shape class, or None."""
+    try:
+        from .autotune import get_cache
+        hit = get_cache().get("flash_attention", f"sq{sq}_sk{sk}_d{d}")
+        return tuple(hit) if hit else None
+    except Exception:
+        return None
+
+
+def tune_flash_blocks(query, key, value, causal: bool = False,
+                      candidates=None, iters: int = 3):
+    """On-device sweep of (block_q, block_k) for this shape; persists the
+    winner so _pick_blocks uses it from then on (incl. at trace time).
+    Call eagerly (not under jit) with representative inputs."""
+    from .autotune import autotune
+    b, sq, h, d = query.shape
+    sk = key.shape[1]
+    cands = candidates or [(256, 256), (512, 512), (512, 1024),
+                           (1024, 512), (1024, 1024), (2048, 1024)]
+    cands = [(bq, bk) for bq, bk in cands
+             if sq % min(bq, sq) == 0 and sk % min(bk, sk) == 0]
+
+    def run(cfg):
+        bq, bk = cfg
+        return flash_attention_pallas(query, key, value, causal=causal,
+                                      block_q=bq, block_k=bk)
+
+    return autotune("flash_attention", f"sq{sq}_sk{sk}_d{d}", cands, run,
+                    iters=iters)
+
+
 def _pick_blocks(sq: int, sk: int, d: int) -> tuple:
     """Autotuned (block_q, block_k) per head_dim for v5e-class VMEM: larger
     blocks amortize the sequential-grid overhead and keep the MXU busy
@@ -64,6 +96,10 @@ def _pick_blocks(sq: int, sk: int, d: int) -> tuple:
                 f"flash block overrides must be multiples of 128; got "
                 f"q={ov_q}, k={ov_k}")
         tq, tk = ov_q, ov_k
+    elif (tuned := _tuned_blocks(sq, sk, d)) is not None:
+        # persistent autotune cache beats the static table (ref
+        # phi/kernels/autotune/cache.h); populate via tune_flash_blocks()
+        tq, tk = tuned
     elif d <= 64:
         tq, tk = 512, 1024
     elif d <= 128:

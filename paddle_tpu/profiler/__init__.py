@@ -182,7 +182,13 @@ class Profiler:
 
     def summary(self, sorted_by=None, op_detail: bool = True,
                 thread_sep: bool = False, time_unit: str = "ms"):
-        return self.step_info()
+        """Aggregate report (ref profiler_statistic.py): Overview +
+        OperatorView (host RecordEvent spans) + KernelView (device HLO
+        categories from the captured XPlane trace)."""
+        from .statistic import summary_report
+        return summary_report(self._step_times, self.log_dir,
+                              sorted_by=sorted_by, op_detail=op_detail,
+                              time_unit=time_unit)
 
 
 def load_profiler_result(path: str):

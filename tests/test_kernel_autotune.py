@@ -1,0 +1,88 @@
+"""Kernel autotune harness tests (ref phi/kernels/autotune/cache.h)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags
+from paddle_tpu.ops._pallas.autotune import AutotuneCache, autotune, chip_kind
+
+
+def make_cache(tmp_path):
+    return AutotuneCache(path=str(tmp_path / "autotune.json"))
+
+
+def test_cache_round_trip(tmp_path):
+    c = make_cache(tmp_path)
+    c.put("flash_attention", "sq1024_sk1024_d128", [512, 1024], 3.14)
+    # a fresh instance reads the same file
+    c2 = make_cache(tmp_path)
+    assert c2.get("flash_attention", "sq1024_sk1024_d128") == [512, 1024]
+    # stats expose the measured time + timestamp
+    ent = list(c2.stats().values())[0]
+    assert ent["measured_ms"] == 3.14
+    assert "tuned_at" in ent
+
+
+def test_cache_miss_returns_none(tmp_path):
+    c = make_cache(tmp_path)
+    assert c.get("flash_attention", "nope") is None
+
+
+def test_cache_disabled_by_flag(tmp_path):
+    c = make_cache(tmp_path)
+    c.put("k", "key", [1], 1.0)
+    flags.set_flags({"kernel_autotune": 0})
+    try:
+        assert c.get("k", "key") is None
+    finally:
+        flags.set_flags({"kernel_autotune": 1})
+
+
+def test_autotune_sweeps_and_persists(tmp_path):
+    c = make_cache(tmp_path)
+    costs = {"a": 5.0, "b": 1.0, "c": 3.0}
+    ran = []
+
+    def run_fn(cfg):
+        ran.append(cfg)
+        return cfg
+
+    def measure(run):
+        return costs[run()]
+
+    best = autotune("mykernel", "shape1", ["a", "b", "c"], run_fn,
+                    measure=measure, cache=c)
+    assert best == "b"
+    assert set(ran) == {"a", "b", "c"}
+    # second call: cache hit, no sweeps
+    ran.clear()
+    best2 = autotune("mykernel", "shape1", ["a", "b", "c"], run_fn,
+                     measure=measure, cache=c)
+    assert best2 == "b" and ran == []
+
+
+def test_autotune_skips_failing_candidates(tmp_path):
+    c = make_cache(tmp_path)
+
+    def run_fn(cfg):
+        if cfg == "bad":
+            raise RuntimeError("unsupported shape")
+        return cfg
+
+    best = autotune("k2", "s", ["bad", "ok"], run_fn,
+                    measure=lambda run: (run(), 1.0)[1], cache=c)
+    assert best == "ok"
+
+
+def test_pick_blocks_consults_cache(tmp_path, monkeypatch):
+    from paddle_tpu.ops._pallas import autotune as at
+    from paddle_tpu.ops._pallas import flash_attention as fa
+    c = make_cache(tmp_path)
+    c.put("flash_attention", "sq4096_sk4096_d128", [512, 2048], 2.0)
+    monkeypatch.setattr(at, "_cache", c)
+    assert fa._pick_blocks(4096, 4096, 128) == (512, 2048)
+    # untuned shape falls back to the static table
+    assert fa._pick_blocks(1024, 1024, 128) == (1024, 1024)

@@ -177,3 +177,36 @@ class TestProfiler:
         from paddle_tpu.profiler import monitor
         monitor.stat_add("subsystems.test", 2)
         assert monitor.stat_get("subsystems.test") >= 2
+
+
+class TestProfilerStatistics:
+    def test_host_statistics_aggregates(self):
+        from paddle_tpu.profiler.statistic import host_statistics
+        events = [("matmul", 0, 1000), ("matmul", 1000, 3000),
+                  ("relu", 0, 500)]
+        stats = host_statistics(events)
+        assert stats[0].name == "matmul"
+        assert stats[0].calls == 2
+        assert stats[0].total_ns == 3000
+        assert stats[0].max_ns == 2000
+        assert stats[1].name == "relu"
+
+    def test_summary_report_with_record_events(self, tmp_path):
+        import paddle_tpu.profiler as profiler
+        prof = profiler.Profiler(timer_only=True, log_dir=str(tmp_path))
+        prof.start()
+        with profiler.RecordEvent("forward"):
+            pass
+        with profiler.RecordEvent("backward"):
+            pass
+        prof.step()
+        prof.step()
+        prof.stop()
+        rep = prof.summary()
+        assert "Overview" in rep
+        assert "OperatorView" in rep
+        assert "forward" in rep or "backward" in rep
+
+    def test_device_statistics_none_when_no_trace(self, tmp_path):
+        from paddle_tpu.profiler.statistic import device_statistics
+        assert device_statistics(str(tmp_path)) is None
