@@ -1,0 +1,149 @@
+"""Deterministic-loss mode: bitwise-identical training across dp layouts.
+
+BASELINE.md's north star demands "bitwise-identical loss curves vs CPU
+reference"; SURVEY §7 hard part (d) pins the obstacles: floating-point
+reduction REASSOCIATION and RNG discipline. Plain GSPMD data parallelism
+cannot be bitwise-stable across layouts — dp=1 reduces a batch in one
+kernel while dp=8 psums partials in topology order, and XLA is free to
+reassociate both. This module makes the reduction ORDER part of the
+program contract instead:
+
+1. **Fixed group decomposition.** The global batch is always split into
+   ``groups`` equal microgroups. Each group's loss/grads are computed by
+   the SAME per-group program (same shapes) whether groups live on one
+   device (lax.scan over groups) or one-per-device (shard_map over dp).
+2. **Gather-then-sum, never psum.** Cross-group reduction stacks the
+   per-group partials [G, ...] and reduces with a single jnp.sum(axis=0)
+   — one kernel, one shape, both layouts — instead of an all-reduce whose
+   combining order follows the collective algorithm.
+3. **Pinned matmul precision** ('highest') so the MXU/CPU dot path does
+   not vary with layout heuristics.
+4. **Group-keyed RNG.** Dropout keys fold in the GROUP index, not the
+   device id, so masks match across layouts (ref mpu/random.py
+   RNGStatesTracker discipline).
+
+Scope contract (documented, tested): the per-example forward must be
+batch-shape-independent (no BatchNorm-style cross-example stats; LayerNorm
+etc. are fine). This is a debugging/validation mode — it trades the fused
+allreduce for a gather, like the reference's check_nan_inf-class tools.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import flags as _flags
+
+__all__ = ["deterministic_mode", "is_deterministic",
+           "make_deterministic_dp_step"]
+
+try:
+    _flags.flag("deterministic")
+except KeyError:
+    _flags.define_flag("deterministic", 0,
+                       "fixed-order reductions + pinned matmul precision")
+
+
+def deterministic_mode(on: bool = True) -> None:
+    _flags.set_flags({"deterministic": 1 if on else 0})
+
+
+def is_deterministic() -> bool:
+    return bool(_flags.flag("deterministic"))
+
+
+def _group_step(loss_fn, params, batch_g, key_g):
+    """Loss + grads for ONE microgroup — the shared per-group program."""
+    def lf(p):
+        return loss_fn(p, batch_g, key_g)
+    loss, grads = jax.value_and_grad(lf)(params)
+    return loss, grads
+
+
+def make_deterministic_dp_step(loss_fn: Callable, optimizer, groups: int,
+                               mesh: Optional[Mesh] = None,
+                               dp_axis: str = "dp"):
+    """Build a train step bitwise-identical across dp layouts.
+
+    loss_fn(params, batch_group, key) -> scalar loss (MEAN over the group;
+    the step averages group losses, so any group count yields the same
+    global mean). Returns step(params, opt_state, batch, step_idx) ->
+    (loss, params, opt_state). With ``mesh`` (dp axis of size == groups)
+    the groups run one-per-device under shard_map; without, sequentially
+    under lax.scan. Both reduce gathered [G, ...] stacks with a single
+    fixed jnp.sum(axis=0).
+    """
+
+    def reduce_stacked(stacked):
+        return jax.tree_util.tree_map(
+            lambda s: jnp.sum(s, axis=0) / groups, stacked)
+
+    def apply_update(params, opt_state, loss_stack, grad_stack, lr):
+        loss = jnp.sum(loss_stack, axis=0) / groups
+        grads = reduce_stacked(grad_stack)
+        new_p, new_st = optimizer.apply_gradients(params, grads, opt_state,
+                                                  lr)
+        return loss, new_p, new_st
+
+    lr = getattr(optimizer, "learning_rate", 1e-3)
+    if callable(lr):
+        lr = 1e-3
+
+    if mesh is None:
+        @jax.jit
+        def step(params, opt_state, batch, step_idx):
+            with jax.default_matmul_precision("highest"):
+                def body(_, g):
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(0), step_idx * groups + g)
+                    bg = jax.tree_util.tree_map(
+                        lambda a: a.reshape((groups, -1) + a.shape[1:])[g],
+                        batch)
+                    return None, _group_step(loss_fn, params, bg, key)
+
+                _, (loss_stack, grad_stack) = lax.scan(
+                    body, None, jnp.arange(groups))
+                return apply_update(params, opt_state, loss_stack,
+                                    grad_stack, lr)
+
+        return step
+
+    if mesh.shape[dp_axis] != groups:
+        raise ValueError(
+            f"deterministic dp step: mesh axis {dp_axis!r} has size "
+            f"{mesh.shape[dp_axis]} but groups={groups}")
+
+    batch_spec = P(dp_axis)
+
+    def sharded(params, opt_state, batch, step_idx):
+        with jax.default_matmul_precision("highest"):
+            def per_shard(params, opt_state, batch, step_idx):
+                g = lax.axis_index(dp_axis)
+                key = jax.random.fold_in(
+                    jax.random.PRNGKey(0), step_idx * groups + g)
+                loss_g, grads_g = _group_step(loss_fn, params, batch, key)
+                # gather-then-sum: every shard sees the SAME [G, ...] stack
+                # and performs the same single-kernel reduction.
+                loss_stack = lax.all_gather(loss_g, dp_axis)
+                grad_stack = jax.tree_util.tree_map(
+                    lambda g_: lax.all_gather(g_, dp_axis), grads_g)
+                return apply_update(params, opt_state, loss_stack,
+                                    grad_stack, lr)
+
+            from jax.sharding import PartitionSpec
+            rep = PartitionSpec()
+            return jax.shard_map(
+                per_shard, mesh=mesh,
+                in_specs=(rep, rep, batch_spec, rep),
+                out_specs=(rep, rep, rep),
+                axis_names={dp_axis}, check_vma=False,
+            )(params, opt_state, batch, step_idx)
+
+    return jax.jit(sharded)
