@@ -25,7 +25,9 @@ class LayerDesc:
         self.layer_cls = layer_cls
         self.args = args
         self.kwargs = kwargs
-        if not issubclass(layer_cls, Layer) and not callable(layer_cls):
+        is_layer_cls = isinstance(layer_cls, type) and \
+            issubclass(layer_cls, Layer)
+        if not is_layer_cls and not callable(layer_cls):
             raise TypeError("LayerDesc needs a Layer subclass or factory")
 
     def build_layer(self) -> Layer:
