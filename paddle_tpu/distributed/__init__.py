@@ -8,6 +8,7 @@ from .collective import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
                          in_axis_context, ppermute_next)
 from .parallel import DataParallel, shard_batch, replicate, scale_loss  # noqa: F401
 from . import fleet  # noqa: F401
+from .fleet.dataset import InMemoryDataset, QueueDataset  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401

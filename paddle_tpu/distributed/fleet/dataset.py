@@ -1,0 +1,225 @@
+"""Massive-ingest Dataset (ref fluid/framework/data_set.cc InMemoryDataset
++ data_feed.cc MultiSlotInMemoryDataFeed; python surface
+python/paddle/distributed/fleet/dataset/dataset.py).
+
+The reference's CTR-scale ingest path: a C++ multi-slot parser consumes
+text files on a thread pool into in-memory slot records; the dataset then
+supports local/global shuffle and feeds trainers batch-wise. Here the
+parser is the native ``data_feed.cpp`` (two-pass ctypes ABI — no Python
+per-token work), file loading fans out on a thread pool, and batches come
+out as padded device-ready arrays per slot (sparse slots ragged→padded
+uint64 + per-record lengths; dense float slots likewise).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset", "MultiSlotDataFeed"]
+
+
+class _Slot:
+    __slots__ = ("name", "is_float")
+
+    def __init__(self, name: str, is_float: bool):
+        self.name = name
+        self.is_float = is_float
+
+
+class MultiSlotDataFeed:
+    """Native multi-slot text parser (ref data_feed.cc
+    MultiSlotInMemoryDataFeed::ParseOneInstance). Line format: for each
+    slot in order, ``<n> <v_1> ... <v_n>`` — uint64 feasigns for sparse
+    slots, floats for dense."""
+
+    def __init__(self, slots: Sequence[_Slot]):
+        self._slots = list(slots)
+
+    def parse_bytes(self, buf: bytes):
+        from ...native import load_library
+        lib = load_library()
+        lib.dfeed_count.restype = ctypes.c_longlong
+        lib.dfeed_parse.restype = ctypes.c_longlong
+        ns = len(self._slots)
+        counts = (ctypes.c_longlong * ns)()
+        n_inst = lib.dfeed_count(buf, ctypes.c_longlong(len(buf)),
+                                 ctypes.c_int(ns), counts)
+        if n_inst < 0:
+            raise ValueError("malformed multi-slot record")
+        if n_inst == 0:
+            return (np.zeros((0, ns), np.int64),
+                    [np.zeros(0, np.float32 if s.is_float else np.uint64)
+                     for s in self._slots])
+        lens = np.zeros((n_inst, ns), np.int64)
+        is_float = (ctypes.c_int * ns)(*[int(s.is_float)
+                                         for s in self._slots])
+        vals = [np.zeros(counts[i],
+                         np.float32 if self._slots[i].is_float
+                         else np.uint64) for i in range(ns)]
+        u64_ptrs = (ctypes.POINTER(ctypes.c_uint64) * ns)()
+        f32_ptrs = (ctypes.POINTER(ctypes.c_float) * ns)()
+        for i, v in enumerate(vals):
+            if self._slots[i].is_float:
+                f32_ptrs[i] = v.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float))
+                u64_ptrs[i] = ctypes.POINTER(ctypes.c_uint64)()
+            else:
+                u64_ptrs[i] = v.ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_uint64))
+                f32_ptrs[i] = ctypes.POINTER(ctypes.c_float)()
+        got = lib.dfeed_parse(
+            buf, ctypes.c_longlong(len(buf)), ctypes.c_int(ns), is_float,
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            u64_ptrs, f32_ptrs)
+        if got != n_inst:
+            raise ValueError(
+                f"parse pass disagreed with count pass ({got} vs {n_inst})")
+        return lens, vals
+
+
+class InMemoryDataset:
+    """ref data_set.cc InMemoryDataset: load_into_memory ->
+    local_shuffle/global_shuffle -> batched iteration."""
+
+    def __init__(self, batch_size: int = 1, thread_num: int = 4,
+                 use_var: Optional[Sequence[str]] = None,
+                 float_slots: Optional[Sequence[str]] = None,
+                 pipe_command: Optional[str] = None, **kwargs):
+        slots = list(use_var or [])
+        fl = set(float_slots or [])
+        self._slots = [_Slot(s, s in fl) for s in slots]
+        self.batch_size = batch_size
+        self.thread_num = thread_num
+        self.pipe_command = pipe_command  # accepted for parity; unused
+        self._filelist: List[str] = []
+        self._lens: Optional[np.ndarray] = None      # [N, num_slots]
+        self._values: List[np.ndarray] = []          # per-slot concatenated
+        self._order: Optional[np.ndarray] = None
+
+    # -- configuration (reference API names) -------------------------------
+    def init(self, **kwargs):
+        """ref dataset.init(batch_size=, thread_num=, use_var=, ...)."""
+        if "batch_size" in kwargs:
+            self.batch_size = int(kwargs["batch_size"])
+        if "thread_num" in kwargs:
+            self.thread_num = int(kwargs["thread_num"])
+        if "use_var" in kwargs:
+            self.set_use_var(kwargs["use_var"],
+                             kwargs.get("float_slots"))
+        if "pipe_command" in kwargs:
+            self.pipe_command = kwargs["pipe_command"]
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._filelist = list(filelist)
+
+    def set_batch_size(self, batch_size: int):
+        self.batch_size = batch_size
+
+    def set_thread(self, thread_num: int):
+        self.thread_num = thread_num
+
+    def set_use_var(self, names: Sequence[str],
+                    float_slots: Optional[Sequence[str]] = None):
+        fl = set(float_slots or [])
+        self._slots = [_Slot(s, s in fl) for s in names]
+
+    # -- ingest -------------------------------------------------------------
+    def _parse_file(self, path: str):
+        with open(path, "rb") as f:
+            return MultiSlotDataFeed(self._slots).parse_bytes(f.read())
+
+    def load_into_memory(self):
+        """Parallel file ingest (ref LoadIntoMemory: one DataFeed thread
+        per file shard)."""
+        if not self._slots:
+            raise ValueError("set_use_var before load_into_memory")
+        with ThreadPoolExecutor(max_workers=max(1, self.thread_num)) as ex:
+            parts = list(ex.map(self._parse_file, self._filelist))
+        ns = len(self._slots)
+        lens = np.concatenate([p[0] for p in parts]) if parts else \
+            np.zeros((0, ns), np.int64)
+        values = []
+        for s in range(ns):
+            if parts:
+                values.append(np.concatenate([p[1][s] for p in parts]))
+            else:
+                values.append(np.zeros(
+                    0, np.float32 if self._slots[s].is_float else np.uint64))
+        self._lens = lens
+        self._values = values
+        self._order = np.arange(lens.shape[0])
+
+    def get_memory_data_size(self, fleet=None) -> int:
+        return 0 if self._lens is None else int(self._lens.shape[0])
+
+    def local_shuffle(self, seed: Optional[int] = None):
+        rng = np.random.default_rng(seed)
+        rng.shuffle(self._order)
+
+    def global_shuffle(self, fleet=None, thread_num: Optional[int] = None,
+                       seed: Optional[int] = None):
+        """Single-controller form of data_set.cc GlobalShuffle: every rank
+        derives the same full permutation from a shared seed and reads its
+        own contiguous stripe — the TPU-native equivalent of the
+        reference's brpc record exchange, with zero data motion."""
+        n = self.get_memory_data_size()
+        rng = np.random.default_rng(0 if seed is None else seed)
+        self._order = rng.permutation(n)
+        try:
+            from .. import env as dist_env
+            rank = dist_env.get_rank()
+            world = dist_env.get_world_size()
+        except Exception:
+            rank, world = 0, 1
+        if world > 1:
+            stripe = n // world
+            self._order = self._order[rank * stripe:(rank + 1) * stripe]
+
+    # -- iteration ----------------------------------------------------------
+    def _slot_offsets(self, s: int) -> np.ndarray:
+        off = np.zeros(self._lens.shape[0] + 1, np.int64)
+        np.cumsum(self._lens[:, s], out=off[1:])
+        return off
+
+    def batches(self, drop_last: bool = True):
+        """Yield {slot: padded [B, max_len] array, slot+'.lens': [B]}."""
+        if self._lens is None:
+            raise RuntimeError("call load_into_memory first")
+        offs = [self._slot_offsets(s) for s in range(len(self._slots))]
+        n = len(self._order)
+        bs = self.batch_size
+        stop = n - (n % bs) if drop_last else n
+        for start in range(0, stop, bs):
+            idx = self._order[start:start + bs]
+            out: Dict[str, np.ndarray] = {}
+            for s, slot in enumerate(self._slots):
+                lens = self._lens[idx, s]
+                width = max(int(lens.max()), 1) if len(lens) else 1
+                pad = np.zeros((len(idx), width),
+                               np.float32 if slot.is_float else np.uint64)
+                for j, rec in enumerate(idx):
+                    a, b = offs[s][rec], offs[s][rec + 1]
+                    pad[j, :b - a] = self._values[s][a:b]
+                out[slot.name] = pad
+                out[slot.name + ".lens"] = lens.astype(np.int64)
+            yield out
+
+    def release_memory(self):
+        self._lens, self._values, self._order = None, [], None
+
+
+class QueueDataset(InMemoryDataset):
+    """ref data_set.cc QueueDataset: streaming variant — same parser, no
+    shuffle (iteration order = file order)."""
+
+    def local_shuffle(self, seed=None):
+        raise RuntimeError("QueueDataset does not support shuffle "
+                           "(ref data_set.cc QueueDataset)")
+
+    def global_shuffle(self, fleet=None, thread_num=None, seed=None):
+        raise RuntimeError("QueueDataset does not support shuffle")

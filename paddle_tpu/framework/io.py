@@ -50,9 +50,27 @@ def save(obj: Any, path: str, protocol: int = 4) -> None:
         os.makedirs(dirname, exist_ok=True)
     with open(path, "wb") as f:
         pickle.dump(_to_host(obj), f, protocol=protocol)
+    # Forward-compat sidecar (ref phi/api/yaml/op_version.yaml): record the
+    # op-version map so future loads can replay registered upgrades.
+    try:
+        import json
+        from ..core.op_version import op_version_map
+        with open(path + ".opver", "w") as f:
+            json.dump(op_version_map(), f)
+    except OSError:
+        pass
 
 
 def load(path: str, return_numpy: bool = False) -> Any:
     with open(path, "rb") as f:
         obj = pickle.load(f)
+    try:
+        import json
+        with open(path + ".opver") as f:
+            saved_versions = json.load(f)
+    except (OSError, ValueError):
+        saved_versions = {}  # pre-registry checkpoint: version 0 everywhere
+    from ..core.op_version import apply_upgrades, op_version_map
+    if isinstance(obj, dict) and saved_versions != op_version_map():
+        obj = apply_upgrades(obj, saved_versions)
     return obj if return_numpy else _to_device(obj)

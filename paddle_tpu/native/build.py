@@ -13,7 +13,7 @@ import subprocess
 import threading
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
-_SOURCES = ["shm_queue.cpp"]
+_SOURCES = ["shm_queue.cpp", "data_feed.cpp"]
 _LIB = os.path.join(_HERE, "libpaddle_tpu_native.so")
 _lock = threading.Lock()
 

@@ -1,0 +1,154 @@
+// Massive-ingest data feed: multi-slot record parser.
+//
+// Reference parity: paddle/fluid/framework/data_feed.cc
+// (MultiSlotInMemoryDataFeed::ParseOneInstance) + data_set.cc ingestion —
+// the C++ fast path that turns CTR-style text records into slot tensors
+// without touching the Python interpreter per token.
+//
+// Record format (the reference's MultiSlot text format): one instance per
+// line; for each slot, in configured order:
+//     <n> <v_1> ... <v_n>
+// where values are uint64 feasign ids for sparse slots and floats for
+// dense slots. Escaped newlines are not supported (same as the reference).
+//
+// Two-pass ctypes ABI: pass 1 (count_fn) sizes the outputs, pass 2
+// (parse_fn) fills caller-allocated buffers. All functions return the
+// number of instances parsed, or a negative errno-style code.
+
+#include <cctype>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+
+namespace {
+
+struct Cursor {
+  const char* p;
+  const char* end;
+};
+
+inline void skip_ws(Cursor& c) {
+  while (c.p < c.end && (*c.p == ' ' || *c.p == '\t')) ++c.p;
+}
+
+inline bool at_eol(const Cursor& c) {
+  return c.p >= c.end || *c.p == '\n' || *c.p == '\r';
+}
+
+inline bool read_u64(Cursor& c, uint64_t* out) {
+  skip_ws(c);
+  if (at_eol(c) || !isdigit((unsigned char)*c.p)) return false;
+  uint64_t v = 0;
+  while (c.p < c.end && isdigit((unsigned char)*c.p)) {
+    v = v * 10 + (uint64_t)(*c.p - '0');
+    ++c.p;
+  }
+  *out = v;
+  return true;
+}
+
+inline bool read_f32(Cursor& c, float* out) {
+  skip_ws(c);
+  if (at_eol(c)) return false;
+  char* endp = nullptr;
+  float v = strtof(c.p, &endp);
+  if (endp == c.p || endp > c.end) return false;
+  c.p = endp;
+  *out = v;
+  return true;
+}
+
+inline void next_line(Cursor& c) {
+  while (c.p < c.end && *c.p != '\n') ++c.p;
+  if (c.p < c.end) ++c.p;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pass 1: count instances and total values per slot.
+// out_counts: int64[num_slots] — total value count per slot (sum of n's).
+// Returns #instances, or -1 on malformed input (line with missing slots).
+long long dfeed_count(const char* buf, long long len, int num_slots,
+                      long long* out_counts) {
+  Cursor c{buf, buf + len};
+  for (int s = 0; s < num_slots; ++s) out_counts[s] = 0;
+  long long inst = 0;
+  while (c.p < c.end) {
+    skip_ws(c);
+    if (at_eol(c)) {  // blank line
+      next_line(c);
+      continue;
+    }
+    for (int s = 0; s < num_slots; ++s) {
+      uint64_t n = 0;
+      if (!read_u64(c, &n)) return -1;
+      out_counts[s] += (long long)n;
+      // skip n values (validated lexically in pass 2)
+      for (uint64_t i = 0; i < n; ++i) {
+        skip_ws(c);
+        if (at_eol(c)) return -1;
+        while (c.p < c.end && *c.p != ' ' && *c.p != '\t' && *c.p != '\n' &&
+               *c.p != '\r')
+          ++c.p;
+      }
+    }
+    ++inst;
+    next_line(c);
+  }
+  return inst;
+}
+
+// Pass 2: fill per-slot ragged arrays.
+// slot_is_float: int[num_slots] — 1 = dense float slot, 0 = sparse uint64.
+// lens: int64[num_instances * num_slots] — per (instance, slot) value count.
+// For each slot s: values go to u64_out[s] or f32_out[s] (arrays of
+// pointers), appended in instance order.
+long long dfeed_parse(const char* buf, long long len, int num_slots,
+                      const int* slot_is_float, long long* lens,
+                      uint64_t** u64_out, float** f32_out) {
+  Cursor c{buf, buf + len};
+  long long inst = 0;
+  long long* fill = (long long*)calloc((size_t)num_slots, sizeof(long long));
+  if (!fill) return -2;
+  while (c.p < c.end) {
+    skip_ws(c);
+    if (at_eol(c)) {
+      next_line(c);
+      continue;
+    }
+    for (int s = 0; s < num_slots; ++s) {
+      uint64_t n = 0;
+      if (!read_u64(c, &n)) {
+        free(fill);
+        return -1;
+      }
+      lens[inst * num_slots + s] = (long long)n;
+      for (uint64_t i = 0; i < n; ++i) {
+        if (slot_is_float[s]) {
+          float v;
+          if (!read_f32(c, &v)) {
+            free(fill);
+            return -1;
+          }
+          f32_out[s][fill[s]] = v;
+        } else {
+          uint64_t v;
+          if (!read_u64(c, &v)) {
+            free(fill);
+            return -1;
+          }
+          u64_out[s][fill[s]] = v;
+        }
+        ++fill[s];
+      }
+    }
+    ++inst;
+    next_line(c);
+  }
+  free(fill);
+  return inst;
+}
+
+}  // extern "C"

@@ -1,0 +1,153 @@
+"""StringTensor + strings kernels.
+
+Reference parity: ``paddle/phi/core/string_tensor.h:1`` (StringTensor — a
+TensorBase holding variable-length pstrings) and the strings kernel set
+``paddle/phi/kernels/strings/`` (``strings_empty_kernel.h``,
+``strings_lower_upper_kernel.h`` with ASCII and UTF-8 variants backed by
+``unicode.h`` case tables).
+
+TPU-native design: accelerators do not execute string compute — in the
+reference every strings kernel is CPU/host-side too (the GPU variants
+round-trip through host memory). Here the StringTensor is a host-resident,
+shape-carrying container over a numpy object array; case kernels use
+Python's unicode-aware str methods (the analog of the reference's
+``use_utf8 = true`` path; ``use_utf8 = false`` reproduces the bytewise
+ASCII kernels). Conversions to device tensors go through explicit
+encode/decode ops (bytes <-> uint8), keeping the device side static-shape.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = ["StringTensor", "empty", "lower", "upper", "to_string_tensor",
+           "encode_utf8", "decode_utf8"]
+
+
+class StringTensor:
+    """Host string tensor (ref string_tensor.h StringTensor).
+
+    Holds a numpy object ndarray of ``str``; exposes the TensorBase-like
+    surface the reference defines: shape/dims/numel/valid/initialized.
+    """
+
+    def __init__(self, data: Union[np.ndarray, Sequence, str, None] = None,
+                 shape: Optional[Tuple[int, ...]] = None):
+        if data is None:
+            arr = np.empty(shape or (0,), dtype=object)
+            arr.fill("")
+        else:
+            if isinstance(data, str):
+                data = [data]
+            arr = np.array(data, dtype=object)
+            if shape is not None:
+                arr = arr.reshape(shape)
+        self._data = arr
+
+    # -- TensorBase surface (string_tensor.h numel/dims/valid/initialized) --
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    def dims(self) -> Tuple[int, ...]:
+        return self.shape
+
+    def numel(self) -> int:
+        return int(self._data.size)
+
+    def initialized(self) -> bool:
+        return True
+
+    def valid(self) -> bool:
+        return True
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def reshape(self, *shape) -> "StringTensor":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return StringTensor(self._data.reshape(shape))
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, str):
+            return out
+        return StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        if isinstance(other, StringTensor):
+            other = other._data
+        return np.asarray(self._data == other)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+
+def to_string_tensor(data, shape=None) -> StringTensor:
+    return data if isinstance(data, StringTensor) else StringTensor(data, shape)
+
+
+def empty(shape: Sequence[int]) -> StringTensor:
+    """ref strings_empty_kernel.h EmptyKernel: allocate, fill with ''."""
+    return StringTensor(None, tuple(shape))
+
+
+def _map(x: StringTensor, fn) -> StringTensor:
+    out = np.empty(x.shape, dtype=object)
+    flat_in = x.numpy().reshape(-1)
+    flat_out = out.reshape(-1)
+    for i, s in enumerate(flat_in):
+        flat_out[i] = fn(s)
+    return StringTensor(out)
+
+
+def lower(x, use_utf8: bool = True) -> StringTensor:
+    """ref strings_lower_upper_kernel.h StringLowerKernel. use_utf8=False
+    reproduces the bytewise ASCII kernel (non-ASCII passes through)."""
+    x = to_string_tensor(x)
+    if use_utf8:
+        return _map(x, str.lower)
+    return _map(x, lambda s: "".join(
+        c.lower() if ord(c) < 128 else c for c in s))
+
+
+def upper(x, use_utf8: bool = True) -> StringTensor:
+    """ref strings_lower_upper_kernel.h StringUpperKernel."""
+    x = to_string_tensor(x)
+    if use_utf8:
+        return _map(x, str.upper)
+    return _map(x, lambda s: "".join(
+        c.upper() if ord(c) < 128 else c for c in s))
+
+
+def encode_utf8(x, max_bytes: int) -> "np.ndarray":
+    """StringTensor -> device-shippable uint8 [.., max_bytes] (padded) +
+    the static-shape bridge onto the accelerator."""
+    import jax.numpy as jnp
+    x = to_string_tensor(x)
+    out = np.zeros(x.shape + (max_bytes,), np.uint8)
+    flat = x.numpy().reshape(-1)
+    view = out.reshape(-1, max_bytes)
+    for i, s in enumerate(flat):
+        b = s.encode("utf-8")[:max_bytes]
+        view[i, :len(b)] = np.frombuffer(b, np.uint8)
+    return jnp.asarray(out)
+
+
+def decode_utf8(arr) -> StringTensor:
+    """uint8 [.., max_bytes] -> StringTensor (zero-byte padding stripped)."""
+    a = np.asarray(arr)
+    flat = a.reshape(-1, a.shape[-1])
+    out = np.empty((flat.shape[0],), dtype=object)
+    for i, row in enumerate(flat):
+        out[i] = bytes(row[row != 0]).decode("utf-8", errors="replace")
+    return StringTensor(out.reshape(a.shape[:-1]))

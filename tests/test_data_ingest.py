@@ -1,0 +1,99 @@
+"""Massive-ingest dataset tests (ref data_feed.cc / data_set.cc)."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import InMemoryDataset, QueueDataset
+
+
+def _write_files(tmp_path, n_files=3, per_file=5):
+    """Slot layout: label (dense float, 1 value) + ids (sparse uint64)."""
+    paths = []
+    rng = np.random.default_rng(0)
+    truth = []
+    for f in range(n_files):
+        lines = []
+        for r in range(per_file):
+            label = float(f * per_file + r)
+            n_ids = int(rng.integers(1, 5))
+            ids = rng.integers(0, 1 << 40, n_ids).tolist()
+            truth.append((label, ids))
+            lines.append(f"1 {label:.1f} {n_ids} " +
+                         " ".join(str(i) for i in ids))
+        p = tmp_path / f"part-{f}.txt"
+        p.write_text("\n".join(lines) + "\n")
+        paths.append(str(p))
+    return paths, truth
+
+
+def make_ds(paths, batch_size=4):
+    ds = InMemoryDataset(batch_size=batch_size, thread_num=3,
+                         use_var=["label", "ids"], float_slots=["label"])
+    ds.set_filelist(paths)
+    return ds
+
+
+def test_load_and_iterate(tmp_path):
+    paths, truth = _write_files(tmp_path)
+    ds = make_ds(paths, batch_size=5)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 15
+    batches = list(ds.batches())
+    assert len(batches) == 3
+    got = []
+    for b in batches:
+        assert b["label"].dtype == np.float32
+        assert b["ids"].dtype == np.uint64
+        for j in range(b["label"].shape[0]):
+            n = int(b["ids.lens"][j])
+            got.append((float(b["label"][j, 0]),
+                        b["ids"][j, :n].astype(np.int64).tolist()))
+    assert got == [(l, ids) for l, ids in truth]
+
+
+def test_local_shuffle_permutes(tmp_path):
+    paths, truth = _write_files(tmp_path)
+    ds = make_ds(paths, batch_size=15)
+    ds.load_into_memory()
+    ds.local_shuffle(seed=1)
+    b = next(ds.batches())
+    labels = b["label"][:, 0].tolist()
+    assert sorted(labels) == [t[0] for t in truth]
+    assert labels != [t[0] for t in truth]
+
+
+def test_global_shuffle_deterministic(tmp_path):
+    paths, _ = _write_files(tmp_path)
+    ds1, ds2 = make_ds(paths), make_ds(paths)
+    ds1.load_into_memory(); ds2.load_into_memory()
+    ds1.global_shuffle(seed=7); ds2.global_shuffle(seed=7)
+    np.testing.assert_array_equal(ds1._order, ds2._order)
+
+
+def test_malformed_input_raises(tmp_path):
+    p = tmp_path / "bad.txt"
+    p.write_text("1 2.0 3 11 22\n")  # claims 3 ids, provides 2
+    ds = make_ds([str(p)])
+    with pytest.raises(ValueError):
+        ds.load_into_memory()
+
+
+def test_queue_dataset_rejects_shuffle(tmp_path):
+    paths, _ = _write_files(tmp_path, n_files=1)
+    ds = QueueDataset(batch_size=2, use_var=["label", "ids"],
+                      float_slots=["label"])
+    ds.set_filelist(paths)
+    ds.load_into_memory()
+    with pytest.raises(RuntimeError):
+        ds.local_shuffle()
+
+
+def test_empty_and_blank_lines(tmp_path):
+    p = tmp_path / "sparse.txt"
+    p.write_text("\n1 1.0 0\n\n1 2.0 2 5 6\n")
+    ds = make_ds([str(p)], batch_size=2)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 2
+    b = next(ds.batches())
+    assert int(b["ids.lens"][0]) == 0
+    assert int(b["ids.lens"][1]) == 2

@@ -1,0 +1,91 @@
+"""StringTensor/strings kernels + op-version compat map tests."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import strings
+from paddle_tpu.core.op_version import (OpVersionRegistry, apply_upgrades,
+                                        op_version_map, registry)
+
+
+class TestStrings:
+    def test_empty(self):
+        t = strings.empty([2, 3])
+        assert t.shape == (2, 3)
+        assert t.numel() == 6
+        assert t[0, 0] == ""
+
+    def test_lower_upper_utf8(self):
+        t = strings.StringTensor(["HeLLo", "WÖRLD", "ÅßÇ"])
+        low = strings.lower(t)
+        up = strings.upper(t)
+        assert low.tolist() == ["hello", "wörld", "åßç"]
+        assert up.tolist() == ["HELLO", "WÖRLD", "ÅSSÇ"]
+
+    def test_lower_ascii_mode_passes_nonascii(self):
+        t = strings.StringTensor(["AbÖ"])
+        assert strings.lower(t, use_utf8=False).tolist() == ["abÖ"]
+
+    def test_reshape_index_eq(self):
+        t = strings.StringTensor(["a", "b", "c", "d"], shape=(2, 2))
+        assert t[1, 0] == "c"
+        flat = t.reshape(4)
+        assert flat.tolist() == ["a", "b", "c", "d"]
+        assert (t == strings.StringTensor([["a", "x"], ["c", "d"]])
+                ).tolist() == [[True, False], [True, True]]
+
+    def test_encode_decode_roundtrip(self):
+        t = strings.StringTensor([["hi", "wörld"], ["", "xyz"]])
+        enc = strings.encode_utf8(t, max_bytes=16)
+        assert enc.shape == (2, 2, 16)
+        back = strings.decode_utf8(enc)
+        assert back.tolist() == t.tolist()
+
+
+class TestOpVersion:
+    def test_registry_versions(self):
+        r = OpVersionRegistry()
+        assert r.version_of("myop") == 0
+        r.register("myop", "add attr x", actions=[{"add_attr": "x",
+                                                  "default": 1}])
+        r.register("myop", "rename x->y",
+                   actions=[{"rename_attr": ("x", "y")}])
+        assert r.version_of("myop") == 2
+        assert len(r.checkpoints("myop")) == 2
+
+    def test_upgrade_replays_actions(self):
+        r = OpVersionRegistry()
+        r.register("op", "v1", actions=[{"add_attr": "a", "default": 5}])
+        r.register("op", "v2", actions=[{"rename_attr": ("old", "new")}])
+        payload = {"old": 7}
+        out = r.upgrade("op", payload, from_version=0)
+        assert out == {"a": 5, "new": 7}
+        # already at v1: only v2 replays
+        out2 = r.upgrade("op", {"old": 3, "a": 9}, from_version=1)
+        assert out2 == {"a": 9, "new": 3}
+
+    def test_apply_upgrades_only_touches_op_tagged_dicts(self):
+        saved = {}  # ancient checkpoint, version 0 for everything
+        payload = {
+            "fc.weight": np.ones(3),
+            "opt": {"__op__": "adamw", "lr": 0.1},
+        }
+        out = apply_upgrades(payload, saved)
+        assert out["opt"]["multi_precision"] is False  # upgraded
+        assert "multi_precision" not in [k for k in out if k != "opt"]
+        assert out["fc.weight"] is payload["fc.weight"]
+
+    def test_save_load_sidecar_roundtrip(self, tmp_path):
+        from paddle_tpu.framework.io import load, save
+        p = str(tmp_path / "ckpt.pdparams")
+        save({"opt": {"__op__": "adamw", "lr": 0.1}, "w": np.zeros(2)}, p)
+        import json
+        with open(p + ".opver") as f:
+            side = json.load(f)
+        assert side == op_version_map()
+        # simulate loading with an OLDER sidecar: upgrade replays
+        with open(p + ".opver", "w") as f:
+            json.dump({k: 0 for k in side}, f)
+        obj = load(p, return_numpy=True)
+        assert obj["opt"]["multi_precision"] is False
