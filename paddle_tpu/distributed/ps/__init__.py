@@ -16,6 +16,6 @@ push per-row gradients back, applied server-side with SGD/AdaGrad rules
 ``id % n_servers``, the reference's default hash routing.
 """
 
-from .table import DenseTable, SparseTable  # noqa: F401
+from .table import DenseTable, SparseTable, SSDSparseTable  # noqa: F401
 from .server import ParameterServer, run_server  # noqa: F401
 from .client import PSClient, PSEmbedding  # noqa: F401

@@ -129,3 +129,145 @@ class SparseTable:
         with self._mu:
             self._rows = dict(sd["rows"])
             self._state = dict(sd["state"])
+
+
+class SSDSparseTable(SparseTable):
+    """Disk-backed sparse table: hot rows in memory, cold rows on SSD
+    (ref paddle/fluid/distributed/ps/table/ssd_sparse_table.h:30 — RocksDB
+    behind an in-memory cache for beyond-RAM embedding tables).
+
+    TPU-native substitution: sqlite (stdlib, WAL mode) stands in for the
+    vendored RocksDB — same contract: bounded resident rows (LRU eviction
+    of ``cache_rows``), transparent faulting on pull/push, deterministic
+    lazy init for never-seen ids, and ``shrink()`` dropping rows whose
+    unseen-duration exceeds a threshold (the reference's CTR decay shrink).
+    """
+
+    def __init__(self, dim: int, path: Optional[str] = None,
+                 cache_rows: int = 65536, **kwargs):
+        super().__init__(dim, **kwargs)
+        import sqlite3
+        import tempfile
+        self.cache_rows = int(cache_rows)
+        self._path = path or tempfile.mktemp(suffix=".ssdtable")
+        self._db = sqlite3.connect(self._path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS rows ("
+            "fid INTEGER PRIMARY KEY, row BLOB, state BLOB, tick INTEGER)")
+        self._tick = 0
+        from collections import OrderedDict
+        self._rows = OrderedDict()  # LRU: most-recent at the end
+
+    # -- disk plumbing ------------------------------------------------------
+    def _evict_if_needed(self):
+        while len(self._rows) > self.cache_rows:
+            fid, row = self._rows.popitem(last=False)
+            st = self._state.pop(fid, None)
+            self._db.execute(
+                "REPLACE INTO rows VALUES (?, ?, ?, ?)",
+                (int(fid), row.tobytes(),
+                 None if st is None else np.asarray(st).tobytes(),
+                 self._tick))
+        self._db.commit()
+
+    def _fault_in(self, fid: int):
+        cur = self._db.execute(
+            "SELECT row, state FROM rows WHERE fid = ?", (int(fid),))
+        hit = cur.fetchone()
+        if hit is None:
+            return None
+        row = np.frombuffer(hit[0], np.float32).copy()
+        if hit[1] is not None:
+            self._state[fid] = np.frombuffer(hit[1], np.float32).copy()
+        self._rows[fid] = row
+        return row
+
+    def _get_row(self, fid: int, create: bool = True):
+        row = self._rows.get(fid)
+        if row is not None:
+            self._rows.move_to_end(fid)
+            return row
+        row = self._fault_in(fid)
+        if row is None and create:
+            row = self._rows[fid] = self._init_row(fid)
+        return row
+
+    # -- table API ----------------------------------------------------------
+    def pull(self, ids) -> np.ndarray:
+        with self._mu:
+            self._tick += 1
+            out = np.empty((len(ids), self.dim), dtype=np.float32)
+            for k, fid in enumerate(ids):
+                out[k] = self._get_row(int(fid))
+            self._evict_if_needed()
+            return out
+
+    def push(self, ids, grads: np.ndarray) -> None:
+        with self._mu:
+            self._tick += 1
+            merged: Dict[int, np.ndarray] = {}
+            for k, fid in enumerate(ids):
+                fid = int(fid)
+                merged[fid] = merged.get(fid, 0) + grads[k]
+            for fid, g in merged.items():
+                row = self._get_row(fid)
+                new_state = self._rule.apply(row, g, self._state.get(fid))
+                if new_state is not None:
+                    self._state[fid] = new_state
+            self._evict_if_needed()
+
+    def __len__(self):
+        with self._mu:
+            n_disk = self._db.execute(
+                "SELECT COUNT(*) FROM rows").fetchone()[0]
+            # resident rows may shadow disk copies; count distinct
+            resident = set(self._rows)
+            on_disk = {r[0] for r in self._db.execute(
+                "SELECT fid FROM rows")}
+            del n_disk
+            return len(resident | on_disk)
+
+    def shrink(self, max_age: int) -> int:
+        """Drop disk rows not touched in the last ``max_age`` evict ticks
+        (ref ssd_sparse_table Shrink). Returns rows dropped."""
+        with self._mu:
+            cur = self._db.execute(
+                "DELETE FROM rows WHERE tick < ?",
+                (self._tick - int(max_age),))
+            self._db.commit()
+            return cur.rowcount
+
+    def flush(self):
+        """Spill every resident row to disk (checkpoint helper)."""
+        with self._mu:
+            keep = self.cache_rows
+            self.cache_rows = 0
+            self._evict_if_needed()
+            self.cache_rows = keep
+
+    def state_dict(self):
+        self.flush()
+        with self._mu:
+            rows = {}
+            state = {}
+            for fid, rb, sb, _ in self._db.execute(
+                    "SELECT fid, row, state, tick FROM rows"):
+                rows[fid] = np.frombuffer(rb, np.float32).copy()
+                if sb is not None:
+                    state[fid] = np.frombuffer(sb, np.float32).copy()
+            return {"rows": rows, "state": state}
+
+    def load_state_dict(self, sd):
+        with self._mu:
+            self._rows.clear()
+            self._state = {}
+            self._db.execute("DELETE FROM rows")
+            for fid, row in sd["rows"].items():
+                st = sd.get("state", {}).get(fid)
+                self._db.execute(
+                    "REPLACE INTO rows VALUES (?, ?, ?, 0)",
+                    (int(fid), np.asarray(row, np.float32).tobytes(),
+                     None if st is None else
+                     np.asarray(st, np.float32).tobytes()))
+            self._db.commit()

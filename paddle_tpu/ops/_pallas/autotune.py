@@ -117,15 +117,44 @@ def get_cache() -> AutotuneCache:
     return _cache
 
 
+def _device_ms_from_trace(log_dir: str) -> Optional[float]:
+    """Total device self-time (ms) of the newest captured trace."""
+    try:
+        from ...profiler.statistic import device_statistics
+        stats = device_statistics(log_dir, top=1)
+        if stats is None:
+            return None
+        by_cat, _ = stats
+        return sum(by_cat.values())
+    except Exception:
+        return None
+
+
 def _measure(run: Callable[[], Any], warmup: int, iters: int) -> float:
-    """Time an eager kernel launch; a forced device->host sum is the only
-    reliable sync through the axon tunnel (PERF.md measurement note)."""
+    """Measure DEVICE time of a kernel launch via a profiler trace —
+    host-side wall clock is useless through the axon tunnel (per-dispatch
+    latency dwarfs single-kernel device time; PERF.md measurement note).
+    Falls back to walled enqueue-then-sync when no trace parser exists."""
+    import shutil
+    import tempfile
+
     def sync(r):
         leaves = jax.tree_util.tree_leaves(r)
         return float(jnp.sum(leaves[0].astype(jnp.float32)))
 
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):
         sync(run())
+    tmp = tempfile.mkdtemp(prefix="paddle_tpu_autotune_")
+    try:
+        with jax.profiler.trace(tmp):
+            for _ in range(iters):
+                r = run()
+            sync(r)
+        dev_ms = _device_ms_from_trace(tmp)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if dev_ms is not None:
+        return dev_ms / iters
     t0 = time.perf_counter()
     for _ in range(iters):
         r = run()

@@ -318,13 +318,18 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                     segq_ref, segk_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale, causal, segmented, block_q, block_k,
-                    seq_q, seq_k):
+                    seq_q, seq_k, num_q_blocks=None):
     kj = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    t = pl.program_id(2)
+    nt = pl.num_programs(2)
+    # Grouped-query: the last grid axis runs rep * num_q_blocks steps —
+    # every query head sharing this KV head streams through, and dk/dv
+    # accumulate across the whole group IN the scratch (no per-query-head
+    # dk/dv materialization, no post-kernel fold).
+    qi = t if num_q_blocks is None else t % num_q_blocks
     offset = seq_k - seq_q
 
-    @pl.when(qi == 0)
+    @pl.when(t == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -352,7 +357,7 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - delta) * scale).astype(qb.dtype)
         dk_scr[...] = dk_scr[...] + _dot(ds, qb, ((0,), (0,)))
 
-    @pl.when(qi == nq - 1)
+    @pl.when(t == nt - 1)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -399,45 +404,55 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
     )(q, k, v, do, lse, delta, seg_q, seg_k)
 
-    def kv_index_t(b, j, i):
-        return kv_index(b, i, j)
+    # dk/dv are emitted per KV head ([B*HK, Sk, D]): for GQA (rep > 1) the
+    # last grid axis streams rep * num_q_blocks steps — every query head of
+    # the group — and the group sum happens in the accumulation scratch, so
+    # no rep-times dk/dv ever hits HBM (true zero-copy KV in backward too).
+    nq_blocks = sq // block_q
+    bhk = b_ * hk
 
-    # dk/dv are emitted per QUERY head ([BH, Sk, D]) — each program owns its
-    # output block — and the query-head groups fold into the true KV heads
-    # after the call (zero-cost for the dense rep == 1 case).
+    def q_head(bkv, t):
+        # flat query-head row for grid coords (kv-head bkv, stream step t)
+        return (bkv // hk) * h + (bkv % hk) * rep + t // nq_blocks
+
+    def q_spec(width):
+        return pl.BlockSpec(
+            (1, width, d), lambda b, j, t: (q_head(b, t), t % nq_blocks, 0))
+
+    def stat_spec():
+        return pl.BlockSpec(
+            (1, 1, block_q), lambda b, j, t: (q_head(b, t), 0, t % nq_blocks))
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           segmented=segmented, block_q=block_q,
-                          block_k=block_k, seq_q=sq, seq_k=sk),
-        grid=(bh, sk // block_k, sq // block_q),
+                          block_k=block_k, seq_q=sq, seq_k=sk,
+                          num_q_blocks=nq_blocks),
+        grid=(bhk, sk // block_k, rep * nq_blocks),
         in_specs=[
-            pl.BlockSpec((1, block_k, d), kv_index_t),
-            pl.BlockSpec((1, block_k, d), kv_index_t),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_k), lambda b, j, i: (b, 0, j)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            q_spec(block_q),
+            q_spec(block_q),
+            stat_spec(),
+            stat_spec(),
+            stat_spec(),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, j, t: (q_head(b, t), 0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bhk, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bhk, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
     )(k, v, q, do, lse, delta, seg_q, seg_k)
-    if rep > 1:
-        dk = dk.reshape(b_, hk, rep, sk, d).sum(axis=2).reshape(b_ * hk,
-                                                                sk, d)
-        dv = dv.reshape(b_, hk, rep, sk, d).sum(axis=2).reshape(b_ * hk,
-                                                                sk, d)
     return dq, dk, dv
 
 

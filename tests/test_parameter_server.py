@@ -191,3 +191,49 @@ def test_ps_training_multiprocess():
         s.join(timeout=30)  # stop_worker (worker 0) stops the servers
     for wid, first, last in results:
         assert last < first * 0.5, (wid, first, last)
+
+
+class TestSSDSparseTable:
+    def _mk(self, tmp_path, cache_rows=4):
+        from paddle_tpu.distributed.ps.table import SSDSparseTable
+        return SSDSparseTable(dim=8, path=str(tmp_path / "t.db"),
+                              cache_rows=cache_rows, rule="sgd", lr=0.5,
+                              seed=3)
+
+    def test_pull_faults_and_evicts(self, tmp_path):
+        t = self._mk(tmp_path, cache_rows=4)
+        ids = list(range(10))
+        first = t.pull(ids)            # 10 rows through a 4-row cache
+        assert len(t._rows) <= 4       # LRU bounded
+        assert len(t) == 10            # all live (mem + disk)
+        again = t.pull(ids)            # cold rows fault back from disk
+        np.testing.assert_array_equal(first, again)
+
+    def test_push_updates_persist_through_eviction(self, tmp_path):
+        t = self._mk(tmp_path, cache_rows=2)
+        base = t.pull([1])[0].copy()
+        g = np.ones((1, 8), np.float32)
+        t.push([1], g)
+        t.pull([10, 11, 12])           # force id 1 out of the cache
+        got = t.pull([1])[0]
+        np.testing.assert_allclose(got, base - 0.5 * 1.0, atol=1e-6)
+
+    def test_shrink_drops_stale(self, tmp_path):
+        t = self._mk(tmp_path, cache_rows=1)
+        t.pull([1, 2, 3])
+        t.flush()
+        for _ in range(50):
+            t.pull([99])
+        dropped = t.shrink(max_age=10)
+        assert dropped >= 3
+
+    def test_state_dict_roundtrip(self, tmp_path):
+        t = self._mk(tmp_path)
+        t.push([5], np.full((1, 8), 2.0, np.float32))
+        sd = t.state_dict()
+        t2 = self._mk(tmp_path / "other" if False else tmp_path)
+        from paddle_tpu.distributed.ps.table import SSDSparseTable
+        t2 = SSDSparseTable(dim=8, path=str(tmp_path / "t2.db"),
+                            cache_rows=4, rule="sgd", lr=0.5, seed=3)
+        t2.load_state_dict(sd)
+        np.testing.assert_array_equal(t.pull([5]), t2.pull([5]))
