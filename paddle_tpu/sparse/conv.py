@@ -1,0 +1,141 @@
+"""Sparse 3-D convolution (ref paddle/phi/kernels/sparse/conv_kernel.h:1 —
+Conv3dCooKernel / submanifold variant; python surface
+paddle.sparse.nn.functional.conv3d / subm_conv3d).
+
+TPU-native design: the reference builds a gather-GEMM-scatter "rulebook"
+(per kernel offset: which input nnz hits which output position) in CUDA.
+Here the rulebook is the per-offset neighbor-match matrix built with
+vectorized coordinate compares (static nnz => static shapes => jittable),
+and the compute is one MXU matmul per kernel offset over the matched
+values:
+
+    out[j] += sum_off  match_off[j, i] * (vals[i] @ W[off])
+
+- **subm_conv3d** (submanifold): output positions == input positions —
+  fully jit/grad-compatible (the hot path for point-cloud backbones).
+- **conv3d** (standard): output positions are data-dependent (union of
+  shifted inputs), so the output index set is computed host-side eagerly
+  (like the reference's rulebook build on the stream) and the value
+  compute stays traceable.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["subm_conv3d", "conv3d"]
+
+
+def _triple(v):
+    if isinstance(v, (tuple, list)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * 3
+
+
+def _offsets(ks):
+    kd, kh, kw = ks
+    return [(d - kd // 2, h - kh // 2, w - kw // 2)
+            for d in range(kd) for h in range(kh) for w in range(kw)]
+
+
+def _gather_gemm_scatter(in_idx, out_idx, values, weight, ks, strides):
+    """Σ_off match(out, in+off) (vals @ W[off]); idx [nnz, 4] = (n,d,h,w)."""
+    kd, kh, kw = ks
+    w_flat = weight.reshape(kd * kh * kw, weight.shape[3], weight.shape[4])
+    sd, sh, sw = strides
+    out = jnp.zeros((out_idx.shape[0], weight.shape[4]), values.dtype)
+    for o, (od, oh, ow) in enumerate(_offsets(ks)):
+        # input point i contributes to output j when
+        # out_pos * stride + offset == in_pos (VALID-style centre align)
+        tgt_d = out_idx[:, 1] * sd + od
+        tgt_h = out_idx[:, 2] * sh + oh
+        tgt_w = out_idx[:, 3] * sw + ow
+        match = ((out_idx[:, 0][:, None] == in_idx[:, 0][None, :]) &
+                 (tgt_d[:, None] == in_idx[:, 1][None, :]) &
+                 (tgt_h[:, None] == in_idx[:, 2][None, :]) &
+                 (tgt_w[:, None] == in_idx[:, 3][None, :]))
+        contrib = values @ w_flat[o].astype(values.dtype)
+        out = out + match.astype(values.dtype) @ contrib
+    return out
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups: int = 1, data_format: str = "NDHWC", key=None):
+    """Submanifold sparse conv: output sparsity pattern == input pattern
+    (ref conv_kernel.h subm=true). x: SparseCooTensor [N, D, H, W] sparse
+    dims with dense channel values [nnz, C]; weight [kd, kh, kw, C, M]."""
+    from . import SparseCooTensor, _unwrap, sparse_coo_tensor
+
+    if _triple(stride) != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride 1 (pattern-preserving)")
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    t = _unwrap(x)
+    idx = t.indices  # [nnz, 4] (n, d, h, w)
+    vals = t.data
+    ks = tuple(int(s) for s in weight.shape[:3])
+    out_vals = _gather_gemm_scatter(idx, idx, vals, jnp.asarray(weight),
+                                    ks, (1, 1, 1))
+    if bias is not None:
+        out_vals = out_vals + jnp.asarray(bias, out_vals.dtype)
+    shape = t.shape[:-1] + (int(weight.shape[4]),)
+    return sparse_coo_tensor(idx.T, out_vals, shape)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NDHWC", key=None):
+    """Standard sparse conv3d (ref Conv3dCooKernel, subm=false): output
+    positions are every stride-aligned site reached by the kernel support.
+    The output index set is built host-side (data-dependent shape); the
+    value computation is jit-traceable given those indices."""
+    from . import sparse_coo_tensor, _unwrap
+
+    if groups != 1:
+        raise NotImplementedError("sparse conv groups > 1")
+    strides = _triple(stride)
+    pads = _triple(padding)
+    t = _unwrap(x)
+    idx = np.asarray(jax.device_get(t.indices))  # host rulebook build
+    vals = t.data
+    ks = tuple(int(s) for s in weight.shape[:3])
+    n, d, h, w, _ = t.shape
+    out_sp = tuple(
+        (dim + 2 * p - k) // s + 1
+        for dim, p, k, s in zip((d, h, w), pads, ks, strides))
+
+    # candidate outputs: for each input nnz and kernel offset, the output
+    # site whose receptive field covers it
+    cand = set()
+    for od, oh, ow in _offsets(ks):
+        for row in idx:
+            zd = row[1] + pads[0] - (od + ks[0] // 2)
+            zh = row[2] + pads[1] - (oh + ks[1] // 2)
+            zw = row[3] + pads[2] - (ow + ks[2] // 2)
+            if zd % strides[0] or zh % strides[1] or zw % strides[2]:
+                continue
+            zd //= strides[0]; zh //= strides[1]; zw //= strides[2]
+            if 0 <= zd < out_sp[0] and 0 <= zh < out_sp[1] \
+                    and 0 <= zw < out_sp[2]:
+                cand.add((int(row[0]), int(zd), int(zh), int(zw)))
+    out_idx = np.asarray(sorted(cand), np.int32).reshape(-1, 4)
+
+    # shift output coords back to input frame for matching: the offset o
+    # hits input position out*stride - pad + (o + k//2)
+    shifted = jnp.asarray(out_idx, jnp.int32)
+    shifted = shifted.at[:, 1].set(out_idx[:, 1] * strides[0] - pads[0]
+                                   + ks[0] // 2)
+    shifted = shifted.at[:, 2].set(out_idx[:, 2] * strides[1] - pads[1]
+                                   + ks[1] // 2)
+    shifted = shifted.at[:, 3].set(out_idx[:, 3] * strides[2] - pads[2]
+                                   + ks[2] // 2)
+    shifted = shifted.at[:, 0].set(out_idx[:, 0])
+    out_vals = _gather_gemm_scatter(
+        t.indices, shifted, vals, jnp.asarray(weight), ks, (1, 1, 1))
+    if bias is not None:
+        out_vals = out_vals + jnp.asarray(bias, out_vals.dtype)
+    shape = (n,) + out_sp + (int(weight.shape[4]),)
+    return sparse_coo_tensor(jnp.asarray(out_idx.T), out_vals, shape)

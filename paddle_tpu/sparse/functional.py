@@ -1,0 +1,8 @@
+"""paddle.sparse.nn.functional parity (ref python/paddle/sparse/nn/
+functional/): sparse conv + value-wise activations."""
+
+from __future__ import annotations
+
+from .conv import conv3d, subm_conv3d  # noqa: F401
+
+__all__ = ["conv3d", "subm_conv3d"]

@@ -55,6 +55,7 @@ class Softmax:
         return SparseCooTensor(jsparse.BCOO((out, t.indices), shape=t.shape))
 
 
+from ..nn.layer import Layer
 from ..nn.layers import _BatchNormBase
 
 
@@ -99,4 +100,51 @@ class BatchNorm(_BatchNormBase):
         return sparse_coo_tensor(x.indices(), out_vals, x.shape)
 
 
-__all__ = [n for n in dir() if n[0].isupper()]
+
+
+class _SparseConvBase(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        from ..nn import initializer as I
+        super().__init__()
+        ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
+            else (kernel_size,) * 3
+        self.stride = stride
+        self.padding = padding
+        self.subm = subm
+        fan_in = in_channels * ks[0] * ks[1] * ks[2]
+        self.weight = self.create_parameter(
+            tuple(ks) + (in_channels, out_channels), attr=weight_attr,
+            default_initializer=I.Uniform(-(fan_in ** -0.5), fan_in ** -0.5))
+        if bias_attr is not False:
+            self.bias = self.create_parameter((out_channels,),
+                                              attr=bias_attr, is_bias=True)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        from .conv import conv3d, subm_conv3d
+        fn = subm_conv3d if self.subm else conv3d
+        return fn(x, self.weight, self.bias, stride=self.stride,
+                  padding=self.padding)
+
+
+class Conv3D(_SparseConvBase):
+    """ref paddle.sparse.nn.Conv3D (conv_kernel.h Conv3dCooKernel)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size,
+                         subm=False, **kw)
+
+
+class SubmConv3D(_SparseConvBase):
+    """ref paddle.sparse.nn.SubmConv3D — submanifold (pattern-preserving)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size,
+                         subm=True, **kw)
+
+
+from . import functional  # noqa: F401,E402
+__all__ = [n for n in dir() if n[0].isupper()] + ["functional"]

@@ -10,8 +10,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 __all__ = [
+    "cov", "corrcoef", "matrix_exp", "pdist", "householder_product",
     "cholesky_solve", "eigvals", "eigvalsh", "lu", "lu_unpack",
     "matmul", "mm", "bmm", "dot", "t", "norm", "dist", "cross", "cholesky",
     "qr", "svd", "eig", "eigh", "inv", "pinv", "det", "slogdet", "solve",
@@ -174,3 +176,106 @@ def lu_unpack(lu_data, pivots, unpack_ludata: bool = True,
     P = jnp.eye(n, dtype=lu_data.dtype)[perms]          # [B, n, n] rows=perm
     P = jnp.swapaxes(P, -1, -2).reshape(*batch, n, n)
     return P, L, U
+
+
+# ---------------------------------------------------------------------------
+# Round-3 tail (ref python/paddle/tensor/linalg.py cov/corrcoef + the
+# modern-paddle matrix_exp/pdist/householder_product surface)
+# ---------------------------------------------------------------------------
+
+def cov(x, rowvar: bool = True, ddof: bool = True, fweights=None,
+        aweights=None, name=None):
+    """ref tensor/linalg.py:1196 — covariance of rows (rowvar) or columns,
+    with optional frequency/importance weights."""
+    x = jnp.asarray(x)
+    if x.ndim == 1:
+        x = x[None, :]
+    if not rowvar:
+        x = x.T
+    n = x.shape[1]
+    w = None
+    if fweights is not None:
+        w = jnp.asarray(fweights, jnp.float32)
+    if aweights is not None:
+        aw = jnp.asarray(aweights, jnp.float32)
+        w = aw if w is None else w * aw
+    if w is None:
+        w = jnp.ones((n,), x.dtype)
+    w_sum = jnp.sum(w)
+    avg = (x * w).sum(axis=1) / w_sum
+    xc = x - avg[:, None]
+    if not ddof:
+        norm = w_sum
+    elif aweights is None:
+        norm = w_sum - 1
+    else:
+        norm = w_sum - jnp.sum(w * jnp.asarray(aweights, jnp.float32)) / w_sum
+    c = (xc * w) @ jnp.conj(xc.T) / norm
+    return c.squeeze() if c.shape == (1, 1) else c
+
+
+def corrcoef(x, rowvar: bool = True, name=None):
+    """ref tensor/linalg.py:3526 — normalized covariance, clipped to
+    [-1, 1]."""
+    c = cov(x, rowvar)
+    if c.ndim == 0:
+        return c / c
+    d = jnp.sqrt(jnp.diag(c))
+    c = c / d[:, None] / d[None, :]
+    return jnp.clip(c.real, -1, 1) if jnp.iscomplexobj(c) else \
+        jnp.clip(c, -1, 1)
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential via scaling-and-squaring Padé (jax.scipy expm —
+    the same algorithm family as the reference kernel)."""
+    import jax.scipy.linalg as jsl
+    x = jnp.asarray(x)
+    if x.ndim == 2:
+        return jsl.expm(x)
+    batch = x.shape[:-2]
+    flat = x.reshape((-1,) + x.shape[-2:])
+    out = jax.vmap(jsl.expm)(flat)
+    return out.reshape(batch + x.shape[-2:])
+
+
+def pdist(x, p: float = 2.0, name=None):
+    """Condensed pairwise distances of [N, D] -> [N*(N-1)/2] (row-major
+    upper triangle, matching scipy/torch/paddle ordering)."""
+    x = jnp.asarray(x)
+    n = x.shape[0]
+    iu, ju = np.triu_indices(n, k=1)
+    diff = x[iu] - x[ju]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1))
+    if p == float("inf"):
+        return jnp.max(jnp.abs(diff), axis=-1)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def householder_product(x, tau, name=None):
+    """Q = H_1 H_2 ... H_k from geqrf-style reflectors (ref
+    householder_product / LAPACK orgqr): x [*, m, n] holds the reflector
+    vectors below the diagonal, tau [*, k] the scalar factors; returns the
+    first n columns of the product [*, m, n]."""
+    x = jnp.asarray(x)
+    tau = jnp.asarray(tau)
+
+    def one(a, t):
+        m, n = a.shape
+        k = t.shape[0]
+        q = jnp.eye(m, n, dtype=a.dtype)
+        rows = jnp.arange(m)
+        # apply reflectors in reverse: Q = H_0 (H_1 (... H_{k-1} I))
+        for i in reversed(range(k)):
+            v = jnp.where(rows < i, 0.0,
+                          jnp.where(rows == i, 1.0, a[:, i]))
+            q = q - t[i] * jnp.outer(v, v @ q)
+        return q
+
+    if x.ndim == 2:
+        return one(x, tau)
+    batch = x.shape[:-2]
+    out = jax.vmap(one)(x.reshape((-1,) + x.shape[-2:]),
+                        tau.reshape((-1, tau.shape[-1])))
+    return out.reshape(batch + out.shape[-2:])

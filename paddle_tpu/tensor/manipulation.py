@@ -13,6 +13,7 @@ import numpy as np
 from ..core import dtype as dtypes
 
 __all__ = [
+    "masked_scatter",
     "reshape", "flatten", "transpose", "concat", "stack", "unstack", "split",
     "chunk", "squeeze", "unsqueeze", "expand", "expand_as", "tile",
     "broadcast_to", "flip", "roll", "gather", "gather_nd", "scatter",
@@ -343,3 +344,16 @@ def unique_consecutive(x, return_inverse: bool = False,
         counts = np.diff(np.append(positions, len(keep)))
         results.append(jnp.asarray(counts))
     return results[0] if len(results) == 1 else tuple(results)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Copy ``value`` elements (in row-major order) into the True
+    positions of ``mask`` (paddle.masked_scatter). Jit-safe: the k-th True
+    position takes value.flatten()[k] via a cumsum-built gather index."""
+    x = jnp.asarray(x)
+    mask = jnp.broadcast_to(jnp.asarray(mask, bool), x.shape)
+    vflat = jnp.asarray(value).reshape(-1).astype(x.dtype)
+    mflat = mask.reshape(-1)
+    idx = jnp.clip(jnp.cumsum(mflat) - 1, 0, vflat.shape[0] - 1)
+    out = jnp.where(mflat, vflat[idx], x.reshape(-1))
+    return out.reshape(x.shape)

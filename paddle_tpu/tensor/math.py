@@ -8,6 +8,7 @@ import jax.numpy as jnp
 from ..core import dtype as dtypes
 
 __all__ = [
+    "gammainc", "gammaincc", "igamma", "igammac", "multigammaln",
     "add", "subtract", "multiply", "divide", "floor_divide", "mod", "pow",
     "sqrt", "rsqrt", "square", "abs", "exp", "expm1", "log", "log2", "log10",
     "log1p", "sin", "cos", "tan", "asin", "acos", "atan", "sinh", "cosh",
@@ -280,3 +281,42 @@ def add_n(inputs):
     for t in inputs[1:]:
         out = out + t
     return out
+
+
+# ---------------------------------------------------------------------------
+# Round-3 tail: incomplete-gamma family + multivariate gammaln
+# ---------------------------------------------------------------------------
+
+def gammainc(x, y, name=None):
+    """Regularized LOWER incomplete gamma P(x, y) (paddle.gammainc)."""
+    from jax.scipy.special import gammainc as _gi
+    return _gi(jnp.asarray(x), jnp.asarray(y))
+
+
+def gammaincc(x, y, name=None):
+    """Regularized UPPER incomplete gamma Q(x, y) (paddle.gammaincc)."""
+    from jax.scipy.special import gammaincc as _gic
+    return _gic(jnp.asarray(x), jnp.asarray(y))
+
+
+def igamma(x, y, name=None):
+    """paddle.igamma = regularized upper incomplete gamma Q(x, y)."""
+    return gammaincc(x, y)
+
+
+def igammac(x, y, name=None):
+    """paddle.igammac = regularized lower incomplete gamma P(x, y)."""
+    return gammainc(x, y)
+
+
+def multigammaln(x, p: int, name=None):
+    """Log multivariate gamma ln Γ_p(x) = p(p-1)/4 ln π +
+    Σ_{i=1..p} ln Γ(x + (1-i)/2) (paddle.multigammaln)."""
+    from jax.scipy.special import gammaln
+    x = jnp.asarray(x)
+    i = jnp.arange(1, p + 1, dtype=x.dtype if
+                   jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+                   else jnp.float32)
+    xf = x.astype(i.dtype)
+    return (p * (p - 1) / 4.0) * jnp.log(jnp.pi) + \
+        jnp.sum(gammaln(xf[..., None] + (1.0 - i) / 2.0), axis=-1)

