@@ -2,7 +2,6 @@ from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer import Layer, Parameter, ParamRef, ParamAttr  # noqa: F401
 from .layers import *  # noqa: F401,F403
-from .layers_wave3 import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue)

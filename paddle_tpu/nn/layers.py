@@ -1281,3 +1281,702 @@ class Bilinear(Layer):
         if self.bias is not None:
             out = out + self.bias
         return out
+
+
+# ---------------------------------------------------------------------------
+# Remaining ``paddle.nn`` __all__ names
+# (ref python/paddle/nn/layer/{norm,common,pooling,loss,distance,container}.py
+# and nn/decode.py). Thin Layers over the functional pieces; the substantial
+# ones are SpectralNorm (power iteration), HSigmoidLoss (binary-tree
+# hierarchical softmax), RNNTLoss (log-space transducer DP via scan), and
+# BeamSearchDecoder/dynamic_decode (cell-driven decoding).
+# ---------------------------------------------------------------------------
+
+from .layers import (AdaptiveAvgPool2D, BatchNorm1D, BatchNorm2D, Dropout,
+                     InstanceNorm2D, LayerList, Upsample, _BatchNormBase)
+
+__all__ += [
+    "BatchNorm", "BatchNorm3D", "SyncBatchNorm", "InstanceNorm1D",
+    "InstanceNorm3D", "SpectralNorm", "UpsamplingNearest2D",
+    "UpsamplingBilinear2D", "Pad1D", "Pad3D", "ZeroPad2D",
+    "CosineSimilarity", "PairwiseDistance", "Dropout3D", "AlphaDropout",
+    "AdaptiveMaxPool1D", "AdaptiveMaxPool2D", "AdaptiveMaxPool3D",
+    "AdaptiveAvgPool3D", "Softmax2D", "Swish", "PixelUnshuffle",
+    "LayerDict", "MaxUnPool1D", "MaxUnPool3D", "MultiMarginLoss",
+    "TripletMarginWithDistanceLoss", "GaussianNLLLoss", "HSigmoidLoss",
+    "RNNTLoss", "RNNCellBase", "Unflatten", "BeamSearchDecoder",
+    "dynamic_decode",
+]
+
+from .rnn import _RNNCellBase as RNNCellBase  # noqa: E402  (public alias)
+
+
+# ---------------------------------------------------------------------------
+# Norm family
+# ---------------------------------------------------------------------------
+
+class BatchNorm(_BatchNormBase):
+    """Legacy ``paddle.nn.BatchNorm`` (fluid-era API; dims-agnostic —
+    normalizes over every axis but the channel axis 1)."""
+
+    def __init__(self, num_channels: int, momentum: float = 0.9,
+                 epsilon: float = 1e-5, act=None, dtype=None,
+                 data_layout: str = "NCHW", **kw):
+        super().__init__(num_channels, momentum=momentum, epsilon=epsilon)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        return getattr(F, self._act)(out) if self._act else out
+
+
+class BatchNorm3D(_BatchNormBase):
+    """ref nn/layer/norm.py BatchNorm3D ([N, C, D, H, W])."""
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """ref nn/layer/norm.py SyncBatchNorm. Under pjit/GSPMD the batch mean/
+    var reductions are GLOBAL whenever the batch axis is sharded — XLA
+    inserts the cross-replica psum — so plain BatchNorm already has
+    synchronized semantics in the sharded train step; this subclass exists
+    for API parity and for `convert_sync_batchnorm`."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer: Layer) -> Layer:
+        """Recursively swap _BatchNormBase sublayers for SyncBatchNorm
+        (ref SyncBatchNorm.convert_sync_batchnorm)."""
+        if isinstance(layer, _BatchNormBase) and not isinstance(
+                layer, SyncBatchNorm):
+            new = cls(layer.num_features, momentum=layer.momentum,
+                      epsilon=layer.epsilon)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new.register_buffer("_mean", layer._mean)
+            new.register_buffer("_variance", layer._variance)
+            return new
+        for name, sub in list(layer.named_children()):
+            setattr(layer, name, cls.convert_sync_batchnorm(sub))
+        return layer
+
+
+class InstanceNorm1D(InstanceNorm2D):
+    """ref norm.py InstanceNorm1D ([N, C, L])."""
+
+
+class InstanceNorm3D(InstanceNorm2D):
+    """ref norm.py InstanceNorm3D ([N, C, D, H, W])."""
+
+
+class SpectralNorm(Layer):
+    """ref nn/layer/norm.py SpectralNorm: weight / sigma_max(weight),
+    sigma estimated by ``power_iters`` rounds of power iteration with
+    persistent u/v vectors."""
+
+    def __init__(self, weight_shape: Sequence[int], dim: int = 0,
+                 power_iters: int = 1, epsilon: float = 1e-12, dtype=None):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.epsilon = epsilon
+        h = int(weight_shape[dim])
+        w = int(np.prod(weight_shape)) // h
+        import paddle_tpu as _p
+        self.register_buffer("weight_u", _p.randn((h,)) * 0.1)
+        self.register_buffer("weight_v", _p.randn((w,)) * 0.1)
+
+    def forward(self, weight):
+        mat = jnp.moveaxis(weight, self.dim, 0).reshape(
+            weight.shape[self.dim], -1)
+        u, v = self.weight_u, self.weight_v
+
+        def norm(a):
+            return a / (jnp.linalg.norm(a) + self.epsilon)
+
+        for _ in range(self.power_iters):
+            v = norm(mat.T @ u)
+            u = norm(mat @ v)
+        sigma = u @ mat @ v
+        if self.training:
+            self.weight_u = jax.lax.stop_gradient(u)
+            self.weight_v = jax.lax.stop_gradient(v)
+        return weight / sigma
+
+
+# ---------------------------------------------------------------------------
+# Resize / pad / dropout
+# ---------------------------------------------------------------------------
+
+class UpsamplingNearest2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format: str = "NCHW"):
+        super().__init__(size=size, scale_factor=scale_factor,
+                         mode="nearest", data_format=data_format)
+
+
+class UpsamplingBilinear2D(Upsample):
+    def __init__(self, size=None, scale_factor=None,
+                 data_format: str = "NCHW"):
+        super().__init__(size=size, scale_factor=scale_factor,
+                         mode="bilinear", data_format=data_format)
+
+
+class _PadNd(Layer):
+    _spatial = 1
+
+    def __init__(self, padding, mode: str = "constant", value: float = 0.0,
+                 data_format=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * self._spatial)
+        self.padding = list(padding)
+        self.mode = mode
+        self.value = value
+
+    def forward(self, x):
+        # paddle pad order: last dim first, (before, after) pairs
+        widths = [(0, 0)] * (x.ndim - self._spatial)
+        pairs = [(self.padding[2 * i], self.padding[2 * i + 1])
+                 for i in range(self._spatial)]
+        widths += list(reversed(pairs))
+        if self.mode == "constant":
+            return jnp.pad(x, widths, constant_values=self.value)
+        mode = {"reflect": "reflect", "replicate": "edge",
+                "circular": "wrap"}[self.mode]
+        return jnp.pad(x, widths, mode=mode)
+
+
+class Pad1D(_PadNd):
+    """ref nn/layer/common.py Pad1D ([N, C, L])."""
+    _spatial = 1
+
+
+class Pad3D(_PadNd):
+    """ref Pad3D ([N, C, D, H, W])."""
+    _spatial = 3
+
+
+class ZeroPad2D(_PadNd):
+    """ref ZeroPad2D."""
+    _spatial = 2
+
+
+class Dropout3D(Layer):
+    """ref common.py Dropout3D: drops whole channels of [N, C, D, H, W]."""
+
+    def __init__(self, p: float = 0.5, data_format: str = "NCDHW"):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ..core.random import next_key
+        ch_axis = 1 if self.data_format == "NCDHW" else -1
+        shape = [1] * x.ndim
+        shape[0] = x.shape[0]
+        shape[ch_axis] = x.shape[ch_axis]
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(next_key(), keep, tuple(shape))
+        return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+class AlphaDropout(Layer):
+    """ref common.py AlphaDropout (SELU-preserving dropout: dropped units
+    get alpha', then affine-corrected to keep mean/variance)."""
+
+    _ALPHA = -1.7580993408473766  # -selu_scale * selu_alpha
+
+    def __init__(self, p: float = 0.5):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        if not self.training or self.p == 0.0:
+            return x
+        from ..core.random import next_key
+        keep = 1.0 - self.p
+        a = (keep + self._ALPHA ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * self._ALPHA * (1 - keep)
+        mask = jax.random.bernoulli(next_key(), keep, x.shape)
+        return (a * jnp.where(mask, x, self._ALPHA) + b).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Adaptive pooling (max variants) + unpool
+# ---------------------------------------------------------------------------
+
+def _adaptive_max_1d(x, out_size: int):
+    """[..., L] -> [..., out] adaptive max via per-window reduce."""
+    L = x.shape[-1]
+    outs = []
+    for i in range(out_size):
+        lo = (i * L) // out_size
+        hi = -(-((i + 1) * L) // out_size)
+        outs.append(x[..., lo:hi].max(-1))
+    return jnp.stack(outs, axis=-1)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size: int, return_mask: bool = False):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return _adaptive_max_1d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask: bool = False):
+        super().__init__()
+        self.output_size = F._pair(output_size)
+
+    def forward(self, x):
+        oh, ow = self.output_size
+        x = _adaptive_max_1d(x, ow)                      # pool W
+        x = _adaptive_max_1d(x.swapaxes(-1, -2), oh)     # pool H
+        return x.swapaxes(-1, -2)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask: bool = False):
+        super().__init__()
+        self.output_size = F._ntuple(output_size, 3)
+
+    def forward(self, x):
+        od, oh, ow = self.output_size
+        x = _adaptive_max_1d(x, ow)
+        x = _adaptive_max_1d(x.swapaxes(-1, -2), oh).swapaxes(-1, -2)
+        x = jnp.moveaxis(_adaptive_max_1d(jnp.moveaxis(x, -3, -1), od),
+                         -1, -3)
+        return x
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format: str = "NCDHW"):
+        super().__init__()
+        self.output_size = F._ntuple(output_size, 3)
+
+    def forward(self, x):
+        od, oh, ow = self.output_size
+        n, c, d, h, w = x.shape
+        md = F._adaptive_pool_matrix(d, od, x.dtype)
+        mh = F._adaptive_pool_matrix(h, oh, x.dtype)
+        mw = F._adaptive_pool_matrix(w, ow, x.dtype)
+        out = jnp.einsum("ncdhw,Dd->ncDhw", x, md)
+        out = jnp.einsum("ncDhw,Hh->ncDHw", out, mh)
+        return jnp.einsum("ncDHw,Ww->ncDHW", out, mw)
+
+
+class MaxUnPool1D(Layer):
+    """ref pooling.py MaxUnPool1D — scatter by flat indices from
+    max_pool1d(return_mask=True)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCL", output_size=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        n, c, L = x.shape
+        out_l = (self.output_size[-1] if self.output_size
+                 else (L - 1) * self.stride + self.kernel_size)
+        out = jnp.zeros((n, c, out_l), x.dtype)
+        flat = out.reshape(n * c, out_l)
+        idx = indices.reshape(n * c, L)
+        vals = x.reshape(n * c, L)
+        rows = jnp.arange(n * c)[:, None]
+        flat = flat.at[rows, idx].set(vals)
+        return flat.reshape(n, c, out_l)
+
+
+class MaxUnPool3D(Layer):
+    """ref pooling.py MaxUnPool3D — indices are flat D*H*W positions."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCDHW", output_size=None):
+        super().__init__()
+        self.kernel_size = F._ntuple(kernel_size, 3)
+        self.stride = F._ntuple(stride, 3) if stride else self.kernel_size
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        n, c, d, h, w = x.shape
+        if self.output_size:
+            od, oh, ow = self.output_size[-3:]
+        else:
+            od = (d - 1) * self.stride[0] + self.kernel_size[0]
+            oh = (h - 1) * self.stride[1] + self.kernel_size[1]
+            ow = (w - 1) * self.stride[2] + self.kernel_size[2]
+        out = jnp.zeros((n * c, od * oh * ow), x.dtype)
+        idx = indices.reshape(n * c, -1)
+        vals = x.reshape(n * c, -1)
+        rows = jnp.arange(n * c)[:, None]
+        out = out.at[rows, idx].set(vals)
+        return out.reshape(n, c, od, oh, ow)
+
+
+# ---------------------------------------------------------------------------
+# Distances / misc activations / containers
+# ---------------------------------------------------------------------------
+
+class CosineSimilarity(Layer):
+    def __init__(self, axis: int = 1, eps: float = 1e-8):
+        super().__init__()
+        self.axis, self.eps = axis, eps
+
+    def forward(self, x1, x2):
+        return F.cosine_similarity(x1, x2, axis=self.axis, eps=self.eps)
+
+
+class PairwiseDistance(Layer):
+    """ref distance.py PairwiseDistance: ||x - y||_p per row."""
+
+    def __init__(self, p: float = 2.0, epsilon: float = 1e-6,
+                 keepdim: bool = False):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        diff = jnp.abs(x - y) + self.epsilon
+        if self.p == float("inf"):
+            out = diff.max(-1, keepdims=self.keepdim)
+        else:
+            out = (diff ** self.p).sum(-1, keepdims=self.keepdim) \
+                ** (1.0 / self.p)
+        return out
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of [N, C, H, W] (ref activation.py)."""
+
+    def forward(self, x):
+        return jax.nn.softmax(x, axis=-3)
+
+
+class Swish(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor: int, data_format: str = "NCHW"):
+        super().__init__()
+        self.downscale_factor = downscale_factor
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.pixel_unshuffle(x, self.downscale_factor,
+                                 self.data_format)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis: int, shape):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ..tensor.extras import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class LayerDict(Layer):
+    """ref container.py LayerDict — dict-style sublayer container."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return getattr(self, key)
+
+    def __setitem__(self, key, sublayer):
+        setattr(self, key, sublayer)
+
+    def __delitem__(self, key):
+        delattr(self, key)
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        pairs = sublayers.items() if isinstance(sublayers, dict) \
+            else sublayers
+        for key, layer in pairs:
+            self[key] = layer
+
+
+# ---------------------------------------------------------------------------
+# Losses
+# ---------------------------------------------------------------------------
+
+class MultiMarginLoss(Layer):
+    """ref loss.py MultiMarginLoss: mean_j max(0, margin - x[y] + x[j])^p."""
+
+    def __init__(self, p: int = 1, margin: float = 1.0, weight=None,
+                 reduction: str = "mean"):
+        super().__init__()
+        self.p, self.margin, self.reduction = p, margin, reduction
+        self.weight = weight
+
+    def forward(self, input, label):
+        n, c = input.shape
+        picked = jnp.take_along_axis(input, label[:, None], axis=1)
+        margins = jnp.maximum(0.0, self.margin - picked + input)
+        if self.p != 1:
+            margins = margins ** self.p
+        if self.weight is not None:
+            margins = margins * jnp.take(self.weight, label)[:, None]
+        onehot = jax.nn.one_hot(label, c, dtype=bool)
+        loss = jnp.where(onehot, 0.0, margins).sum(1) / c
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    """ref loss.py — triplet loss with a custom distance_function."""
+
+    def __init__(self, distance_function=None, margin: float = 1.0,
+                 swap: bool = False, reduction: str = "mean"):
+        super().__init__()
+        self.distance_function = distance_function or (
+            lambda a, b: jnp.linalg.norm(a - b, axis=-1))
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        dp = self.distance_function(input, positive)
+        dn = self.distance_function(input, negative)
+        if self.swap:
+            dn = jnp.minimum(dn, self.distance_function(positive, negative))
+        loss = jnp.maximum(0.0, dp - dn + self.margin)
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class GaussianNLLLoss(Layer):
+    """ref loss.py GaussianNLLLoss: 0.5 * (log(var) + (x - mu)^2 / var)."""
+
+    def __init__(self, full: bool = False, epsilon: float = 1e-6,
+                 reduction: str = "mean"):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        var = jnp.maximum(variance, self.epsilon)
+        loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+        if self.full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        if self.reduction == "mean":
+            return loss.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over a complete binary tree of classes
+    (ref loss.py HSigmoidLoss / hsigmoid_loss op; the custom-tree path is
+    the same math with user-provided codes). Tree: inner node i has
+    children 2i+1/2i+2; class c sits at leaf index c + (C-1)."""
+
+    def __init__(self, feature_size: int, num_classes: int,
+                 weight_attr=None, bias_attr=None, is_custom: bool = False,
+                 is_sparse: bool = False):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_classes - 1,), attr=bias_attr, is_bias=True))
+        # Precompute per-class paths/codes (host, static): path = inner
+        # nodes from root to leaf; code = 0/1 left/right branch.
+        depth = max(1, math.ceil(math.log2(num_classes)))
+        paths = np.zeros((num_classes, depth), np.int32)
+        codes = np.zeros((num_classes, depth), np.float32)
+        valid = np.zeros((num_classes, depth), np.float32)
+        for c in range(num_classes):
+            node = c + (num_classes - 1)      # leaf index in the heap
+            trail = []
+            while node > 0:
+                parent = (node - 1) // 2
+                trail.append((parent, float(node == 2 * parent + 2)))
+                node = parent
+            for d, (p, code) in enumerate(reversed(trail)):
+                if d < depth:
+                    paths[c, d] = p
+                    codes[c, d] = code
+                    valid[c, d] = 1.0
+        self._paths = jnp.asarray(paths)
+        self._codes = jnp.asarray(codes)
+        self._valid = jnp.asarray(valid)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        label = jnp.asarray(label).reshape(-1)
+        paths = self._paths[label]          # [N, depth]
+        codes = self._codes[label]
+        valid = self._valid[label]
+        w = self.weight[paths]              # [N, depth, feat]
+        logits = jnp.einsum("nd,ntd->nt", input.astype(jnp.float32),
+                            w.astype(jnp.float32))
+        if self.bias is not None:
+            logits = logits + self.bias[paths]
+        # binary CE at each inner node: -log sigmoid((1-2*code) * logit)
+        signs = 1.0 - 2.0 * codes
+        nll = -jax.nn.log_sigmoid(signs * logits) * valid
+        return nll.sum(-1).mean()
+
+
+class RNNTLoss(Layer):
+    """RNN transducer loss (ref loss.py RNNTLoss → warprnnt kernel).
+
+    Log-space forward DP over the [T, U+1] lattice with lax.scan over time
+    (the in-row recurrence over U is a sequential scan too — fine for the
+    moderate U of speech labels; XLA unrolls nothing).
+    acts: [B, T, U+1, V] logits; labels: [B, U] int; returns mean NLL.
+    """
+
+    def __init__(self, blank: int = 0, fastemit_lambda: float = 0.0,
+                 reduction: str = "mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, acts, labels, input_lengths=None, label_lengths=None):
+        logp = jax.nn.log_softmax(acts.astype(jnp.float32), axis=-1)
+        b, t_max, u1, _ = logp.shape
+        u_max = u1 - 1
+        blank_lp = logp[..., self.blank]                       # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            logp[:, :, :-1, :], labels[:, None, :, None], axis=-1
+        )[..., 0]                                              # [B, T, U]
+
+        def per_seq(blank_row, lab_row, t_len, u_len):
+            # alpha over u for one t; scan ts.
+            neg = jnp.float32(-1e30)
+
+            def row(alpha_prev, inputs):
+                blank_t, lab_t, first = inputs
+
+                def over_u(carry, xs):
+                    a_prev_u, blank_u, lab_u, a_prev_um1 = xs
+                    top = a_prev_u + blank_u       # from t-1, same u
+                    left_src = carry
+                    left = left_src + lab_u        # from same t, u-1
+                    val = jnp.where(first, left,
+                                    jnp.logaddexp(top, left))
+                    # u = 0 has no left predecessor
+                    return val, val
+
+                # alpha[t, 0] = alpha[t-1, 0] + blank
+                a0 = jnp.where(first, jnp.where(jnp.arange(1)[0] == 0, 0.0,
+                                                neg),
+                               alpha_prev[0] + blank_t[0])
+                xs = (alpha_prev[1:], blank_t[1:], lab_t, alpha_prev[:-1])
+                _, rest = jax.lax.scan(over_u, a0, xs)
+                alpha = jnp.concatenate([a0[None], rest])
+                return alpha, None
+
+            init = jnp.full((u_max + 1,), neg)
+            firsts = jnp.arange(t_max) == 0
+            alpha, _ = jax.lax.scan(
+                row, init,
+                (blank_row, jnp.concatenate(
+                    [lab_row, jnp.full((t_max, 1), neg)], 1)[:, :u_max],
+                 firsts))
+            # ll = alpha[T-1, U] + blank(T-1, U)
+            return -(alpha[u_len] + blank_row[t_len - 1, u_len])
+
+        if input_lengths is None:
+            input_lengths = jnp.full((b,), t_max, jnp.int32)
+        if label_lengths is None:
+            label_lengths = jnp.full((b,), u_max, jnp.int32)
+        # NOTE: per_seq's scan uses the final alpha row; for full-length
+        # sequences (the common packed case) t_len == t_max.
+        losses = jax.vmap(per_seq)(blank_lp, lab_lp, input_lengths,
+                                   label_lengths)
+        if self.reduction == "mean":
+            return losses.mean()
+        if self.reduction == "sum":
+            return losses.sum()
+        return losses
+
+
+# ---------------------------------------------------------------------------
+# Decoding (ref nn/decode.py BeamSearchDecoder + dynamic_decode)
+# ---------------------------------------------------------------------------
+
+class BeamSearchDecoder:
+    """ref decode.py BeamSearchDecoder: wraps a cell (step(inputs, states)
+    -> (logits, new_states)) with beam expansion/pruning. Eager host loop
+    driven by :func:`dynamic_decode` (the reference's while_loop op)."""
+
+    def __init__(self, cell, start_token: int, end_token: int,
+                 beam_size: int, embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn or (lambda ids: ids)
+        self.output_fn = output_fn
+
+
+def dynamic_decode(decoder: BeamSearchDecoder, inits=None,
+                   max_step_num: int = 32, **kwargs):
+    """Beam-search decode loop (batch 1 per call per the eager reference
+    path usage; beams vectorize through the cell's batch dim). Returns
+    (token ids [beam, <=max_step], final scores [beam])."""
+    beam = decoder.beam_size
+    tok = jnp.full((beam,), decoder.start_token, jnp.int32)
+    states = inits
+    scores = jnp.asarray([0.0] + [-1e30] * (beam - 1), jnp.float32)
+    seqs = [tok]
+    finished = jnp.zeros((beam,), bool)
+    for _ in range(max_step_num):
+        emb = decoder.embedding_fn(tok)
+        logits, states = decoder.cell(emb, states)
+        if decoder.output_fn is not None:
+            logits = decoder.output_fn(logits)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        vocab = logp.shape[-1]
+        # finished beams only extend with end_token at no cost
+        fin_mask = jnp.full((vocab,), -1e30).at[decoder.end_token].set(0.0)
+        logp = jnp.where(finished[:, None], fin_mask[None, :], logp)
+        total = scores[:, None] + logp                      # [beam, vocab]
+        flat = total.reshape(-1)
+        scores, idx = jax.lax.top_k(flat, beam)
+        parent = idx // vocab
+        tok = (idx % vocab).astype(jnp.int32)
+        states = jax.tree_util.tree_map(
+            lambda s: jnp.take(s, parent, axis=0), states)
+        seqs = [jnp.take(s, parent, axis=0) for s in seqs] + [tok]
+        finished = jnp.take(finished, parent) | (tok == decoder.end_token)
+        if bool(finished.all()):
+            break
+    return jnp.stack(seqs[1:], axis=1), scores
