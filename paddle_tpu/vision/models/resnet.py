@@ -90,10 +90,37 @@ class BottleneckBlock(nn.Layer):
         return self.relu(out + identity)
 
 
+def _space_to_depth(x):
+    """[N, H, W, C] -> [N, H/2, W/2, 4C], channel order (hb, wb, C)."""
+    import jax.numpy as jnp
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // 2, 2, w // 2, 2, c).transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // 2, w // 2, 4 * c)
+
+
+def _fold_stem_weight(w):
+    """conv1 [O, C, 7, 7] -> the equivalent 4x4 kernel [O, 4C, 4, 4] over
+    space-to-depth input (pad to 8x8 top-left; split each spatial dim into
+    (block, phase); phases become input channels)."""
+    import jax.numpy as jnp
+    o, c = w.shape[0], w.shape[1]
+    w8 = jnp.pad(w, ((0, 0), (0, 0), (1, 0), (1, 0)))
+    w8 = w8.reshape(o, c, 4, 2, 4, 2)            # (o, c, a, hb, b, wb)
+    w2 = w8.transpose(0, 3, 5, 1, 2, 4)          # (o, hb, wb, c, a, b)
+    return w2.reshape(o, 4 * c, 4, 4)
+
+
 class ResNet(nn.Layer):
+    """stem_mode='space_to_depth' (NHWC only) rewrites the 7x7/s2 stem conv
+    as an exactly-equivalent 4x4/s1 conv on 2x2 space-to-depth input — the
+    MLPerf TPU trick: 12 input channels instead of 3 stop the MXU padding
+    waste of the C=3 convolution (weights folded on the fly, bitwise the
+    same module parameters)."""
+
     def __init__(self, block, depth: int = 50, width: int = 64,
                  num_classes: int = 1000, with_pool: bool = True,
-                 groups: int = 1, data_format: str = "NCHW"):
+                 groups: int = 1, data_format: str = "NCHW",
+                 stem_mode: str = "conv"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -107,6 +134,11 @@ class ResNet(nn.Layer):
         # NHWC puts channels on the TPU's 128-lane minor dim — convs tile
         # directly onto the MXU with no layout canonicalization passes.
         self.data_format = data_format
+        if stem_mode not in ("conv", "space_to_depth"):
+            raise ValueError(f"stem_mode {stem_mode!r}")
+        if stem_mode == "space_to_depth" and data_format != "NHWC":
+            raise ValueError("space_to_depth stem requires NHWC")
+        self.stem_mode = stem_mode
 
         df = data_format
         self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
@@ -141,7 +173,17 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        if self.stem_mode == "space_to_depth":
+            import jax.numpy as jnp
+            from ...nn import functional as F
+            xs = _space_to_depth(x)
+            xs = jnp.pad(xs, ((0, 0), (2, 1), (2, 1), (0, 0)))
+            w2 = _fold_stem_weight(self.conv1.weight)
+            x = F.conv2d(xs, w2.astype(xs.dtype), stride=1, padding=0,
+                         data_format="NHWC")
+        else:
+            x = self.conv1(x)
+        x = self.maxpool(self.relu(self.bn1(x)))
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)

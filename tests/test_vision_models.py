@@ -87,3 +87,19 @@ def test_mobilenet_trains():
         opt.clear_grad()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_space_to_depth_stem_equivalent():
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import ResNet
+    from paddle_tpu.vision.models.resnet import BasicBlock
+    paddle.seed(0)
+    m1 = ResNet(BasicBlock, 18, num_classes=10, data_format="NHWC")
+    paddle.seed(0)
+    m2 = ResNet(BasicBlock, 18, num_classes=10, data_format="NHWC",
+                stem_mode="space_to_depth")
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 32, 32, 3)),
+                    jnp.float32)
+    m1.eval(); m2.eval()
+    np.testing.assert_array_equal(np.asarray(m1(x)), np.asarray(m2(x)))
