@@ -132,10 +132,11 @@ class QuantedConv2D(Layer):
         from ..nn import functional as F
         w = self.weight_quanter(self.inner.weight)
         return F.conv2d(x, w, getattr(self.inner, "bias", None),
-                        stride=self.inner._stride,
-                        padding=self.inner._padding,
-                        dilation=self.inner._dilation,
-                        groups=self.inner._groups)
+                        stride=self.inner.stride,
+                        padding=self.inner.padding,
+                        dilation=self.inner.dilation,
+                        groups=self.inner.groups,
+                        data_format=self.inner.data_format)
 
 
 _WRAPPERS: Dict[type, type] = {}
@@ -245,3 +246,6 @@ class PTQ:
                             fq.eval()
                             setattr(child, attr, fq)
         return model
+
+
+from .deploy import Int8Conv2D, Int8Linear, convert_to_int8  # noqa: F401,E402

@@ -1,0 +1,65 @@
+"""int8 deployment path tests (PTQ -> convert -> real int8 execution)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (PTQ, QuantConfig, convert_to_int8,
+                                     Int8Linear, Int8Conv2D)
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(3, 8, 3, padding=1)
+        self.fc = nn.Linear(8 * 4 * 4, 10)
+
+    def forward(self, x):
+        h = jax.nn.relu(self.conv(x))
+        return self.fc(h.reshape(x.shape[0], -1))
+
+
+def _calibrated_int8():
+    paddle.seed(0)
+    net = Net()
+    rng = np.random.default_rng(0)
+    calib = jnp.asarray(rng.standard_normal((8, 3, 4, 4)), jnp.float32)
+    fp_out = np.asarray(net(calib))
+    ptq = PTQ(QuantConfig())
+    q = ptq.quantize(net)
+    q(calib)  # observe activation/weight ranges
+    ptq.convert(q)
+    q8 = convert_to_int8(q)
+    return q8, calib, fp_out
+
+
+def test_convert_swaps_to_int8_layers():
+    q8, _, _ = _calibrated_int8()
+    kinds = {type(l).__name__ for l in q8.sublayers()}
+    assert "Int8Conv2D" in kinds and "Int8Linear" in kinds
+
+
+def test_int8_weights_are_int8():
+    q8, _, _ = _calibrated_int8()
+    for l in q8.sublayers():
+        if isinstance(l, (Int8Linear, Int8Conv2D)):
+            assert l.weight_q.dtype == jnp.int8
+
+
+def test_int8_output_close_to_fp32():
+    q8, calib, fp_out = _calibrated_int8()
+    out = np.asarray(q8(calib))
+    denom = np.abs(fp_out).max() or 1.0
+    rel = np.abs(out - fp_out).max() / denom
+    assert rel < 0.1, f"int8 deviates {rel:.3f} from fp32"
+
+
+def test_int8_model_is_jittable_and_exportable():
+    q8, calib, _ = _calibrated_int8()
+    from paddle_tpu.framework.functional import functional_call, get_buffers
+    buffers = get_buffers(q8)
+    out = jax.jit(lambda b, x: functional_call(
+        q8, {}, x, buffers=b))(buffers, calib)
+    assert out.shape == (8, 10)
