@@ -450,14 +450,18 @@ def bench_gpt(small: bool):
 def main():
     small = os.environ.get("BENCH_SMALL") == "1"
     which = os.environ.get("BENCH_CONFIGS", "all")
-    if which == "all":
-        for fn in (bench_resnet, bench_bert, bench_ernie):
+    selected = {w.strip() for w in which.split(",")}
+    by_name = {"resnet": bench_resnet, "bert": bench_bert,
+               "ernie": bench_ernie}
+    for name, fn in by_name.items():
+        if "all" in selected or name in selected:
             try:
                 fn(small)
             except Exception as e:  # secondary configs must not kill the run
                 print(json.dumps({"metric": f"{fn.__name__}_FAILED",
                                   "error": str(e)[:500]}), flush=True)
-    bench_gpt(small)  # primary: printed last
+    if "all" in selected or "gpt" in selected:
+        bench_gpt(small)  # primary: printed last
 
 
 if __name__ == "__main__":

@@ -596,6 +596,15 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     b, sq, h, d = query.shape
     sk = key.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # Fast path: the Pallas flash kernel whenever no explicit mask /
+    # attention dropout is involved (r3: BERT's encoder took the dense
+    # path and materialized [B,H,S,S] f32 scores per layer).
+    if attn_mask is None and not (dropout_p > 0.0 and training):
+        from ..ops.flash_attention import _use_pallas
+        if _use_pallas(query, key) and key.shape[2] == h:
+            from ..ops._pallas.flash_attention import flash_attention_pallas
+            return flash_attention_pallas(query, key, value,
+                                          causal=is_causal, scale=scale)
     q = jnp.einsum("bshd->bhsd", query)
     k = jnp.einsum("bshd->bhsd", key)
     v = jnp.einsum("bshd->bhsd", value)

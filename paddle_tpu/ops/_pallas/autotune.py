@@ -25,6 +25,11 @@ from ...core import flags as _flags
 
 __all__ = ["AutotuneCache", "get_cache", "autotune", "chip_kind"]
 
+# Bumped when the measurement methodology changes; entries from older
+# schemes are ignored (a wall-clock-era cache entry silently regressed the
+# GPT bench by 22% in round 3 — never trust stale measurements).
+CACHE_SCHEMA = 2
+
 for _n, _d, _h in [
     ("kernel_autotune", 1, "consult the persistent kernel-autotune cache"),
     ("kernel_autotune_cache_path", "",
@@ -91,7 +96,9 @@ class AutotuneCache:
             return None
         self.load()
         ent = self._data.get(self._key(kernel, key))
-        return ent["config"] if ent else None
+        if not ent or ent.get("schema") != CACHE_SCHEMA:
+            return None
+        return ent["config"]
 
     def put(self, kernel: str, key, config, measured_ms: float):
         self.load()
@@ -99,6 +106,7 @@ class AutotuneCache:
             "config": config,
             "measured_ms": round(measured_ms, 4),
             "tuned_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+            "schema": CACHE_SCHEMA,
         }
         self.save()
 
