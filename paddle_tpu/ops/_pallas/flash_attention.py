@@ -145,23 +145,48 @@ def _seg_mask(s, segq_ref, segk_ref):
     return jnp.where(seg_q == seg_k, s, NEG_INF)
 
 
+def _paired_qi_kj(p, t, nq):
+    """FlashAttention-2-style triangular enumeration for causal sq == sk:
+    pair row p (p+1 in-band key blocks) with row nq-1-p (nq-p blocks) —
+    every pair runs exactly nq+1 steps, and NO fully-masked block is ever
+    fetched. Step t <= p works on (row p, key t); later steps on
+    (row nq-1-p, key t-p-1). Arithmetic-only so it can serve as a
+    BlockSpec index map."""
+    c = (t <= p).astype(jnp.int32) if hasattr(t <= p, "astype") else \
+        jnp.int32(t <= p)
+    qi = c * p + (1 - c) * (nq - 1 - p)
+    kj = c * t + (1 - c) * (t - p - 1)
+    return qi, kj
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
                 m_scr, l_scr, acc_scr,
-                *, scale, causal, segmented, block_q, block_k, seq_q, seq_k):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
+                *, scale, causal, segmented, block_q, block_k, seq_q, seq_k,
+                paired_nq=None):
+    if paired_nq is None:
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        nk = pl.num_programs(2)
+        first = kj == 0
+        last = kj == nk - 1
+    else:
+        p = pl.program_id(1)
+        t = pl.program_id(2)
+        qi, kj = _paired_qi_kj(p, t, paired_nq)
+        first = jnp.logical_or(t == 0, t == p + 1)
+        last = jnp.logical_or(t == p, t == paired_nq)
     offset = seq_k - seq_q
 
-    @pl.when(kj == 0)
+    @pl.when(first)
     def _init():
         m_scr[...] = jnp.full_like(m_scr, NEG_INF)
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # Causal: key blocks fully above the diagonal contribute nothing.
-    in_band = jnp.asarray(True) if not causal else \
-        kj * block_k <= (qi + 1) * block_q - 1 + offset
+    # Causal: key blocks fully above the diagonal contribute nothing (the
+    # paired enumeration never visits them at all).
+    in_band = jnp.asarray(True) if not causal or paired_nq is not None \
+        else kj * block_k <= (qi + 1) * block_q - 1 + offset
 
     @pl.when(in_band)
     def _step():
@@ -188,7 +213,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
         acc_scr[...] = acc_scr[...] * alpha + _dot(p.astype(vb.dtype), vb,
                                                    ((1,), (0,)))
 
-    @pl.when(kj == nk - 1)
+    @pl.when(last)
     def _finish():
         l = jnp.maximum(l_scr[...], 1e-30)
         o_ref[0] = (acc_scr[...] / l[:, :1]).astype(o_ref.dtype)
@@ -231,25 +256,63 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     segmented, seg_q, seg_k = _segments_or_dummy(seg_q, seg_k, bh, sq, sk)
-    grid = (bh, sq // block_q, sk // block_k)
+    nq, nk = sq // block_q, sk // block_k
+    # Triangular enumeration for causal equal-length attention: pair rows
+    # so no fully-masked key block is ever DMA'd (grid nq*nk ->
+    # (nq/2)*(nq+1), a ~2x program cut at large nq, 25% at nq=2).
+    paired = causal and sq == sk and nq == nk and nq % 2 == 0 and nq >= 2
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              segmented=segmented, block_q=block_q,
-                             block_k=block_k, seq_q=sq, seq_k=sk)
+                             block_k=block_k, seq_q=sq, seq_k=sk,
+                             paired_nq=nq if paired else None)
     kv_index = _kv_index(h, hk)
-    o, lse = pl.pallas_call(
-        kern,
-        grid=grid,
-        in_specs=[
+    if paired:
+        grid = (bh, nq // 2, nq + 1)
+
+        def qi_of(b, p, t):
+            return _paired_qi_kj(p, t, nq)[0]
+
+        def kj_of(b, p, t):
+            return _paired_qi_kj(p, t, nq)[1]
+
+        in_specs = [
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, p, t: (b, qi_of(b, p, t), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, p, t: kv_index(b, qi_of(b, p, t),
+                                                  kj_of(b, p, t))),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, p, t: kv_index(b, qi_of(b, p, t),
+                                                  kj_of(b, p, t))),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, p, t: (b, 0, qi_of(b, p, t))),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, p, t: (b, 0, kj_of(b, p, t))),
+        ]
+        out_specs = [
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, p, t: (b, qi_of(b, p, t), 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, p, t: (b, 0, qi_of(b, p, t))),
+        ]
+    else:
+        grid = (bh, nq, nk)
+        in_specs = [
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
-        ],
-        out_specs=[
+        ]
+        out_specs = [
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-        ],
+        ]
+    o, lse = pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=out_specs,
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             jax.ShapeDtypeStruct((bh, 1, sq), jnp.float32),
