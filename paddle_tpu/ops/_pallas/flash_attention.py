@@ -338,18 +338,27 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                    segq_ref, segk_ref, dq_ref, dq_scr,
                    *, scale, causal, segmented, block_q, block_k,
-                   seq_q, seq_k):
-    qi = pl.program_id(1)
-    kj = pl.program_id(2)
-    nk = pl.num_programs(2)
+                   seq_q, seq_k, paired_nq=None):
+    if paired_nq is None:
+        qi = pl.program_id(1)
+        kj = pl.program_id(2)
+        nk = pl.num_programs(2)
+        first = kj == 0
+        last = kj == nk - 1
+    else:
+        p = pl.program_id(1)
+        t = pl.program_id(2)
+        qi, kj = _paired_qi_kj(p, t, paired_nq)
+        first = jnp.logical_or(t == 0, t == p + 1)
+        last = jnp.logical_or(t == p, t == paired_nq)
     offset = seq_k - seq_q
 
-    @pl.when(kj == 0)
+    @pl.when(first)
     def _init():
         dq_scr[...] = jnp.zeros_like(dq_scr)
 
-    in_band = jnp.asarray(True) if not causal else \
-        kj * block_k <= (qi + 1) * block_q - 1 + offset
+    in_band = jnp.asarray(True) if not causal or paired_nq is not None \
+        else kj * block_k <= (qi + 1) * block_q - 1 + offset
 
     @pl.when(in_band)
     def _step():
@@ -369,7 +378,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - delta) * scale).astype(kb.dtype)
         dq_scr[...] = dq_scr[...] + _dot(ds, kb, ((1,), (0,)))
 
-    @pl.when(kj == nk - 1)
+    @pl.when(last)
     def _finish():
         dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
 
@@ -378,27 +387,47 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 # Backward dk/dv: grid (bh, num_k_blocks, num_q_blocks), q streamed.
 # ---------------------------------------------------------------------------
 
+def _paired_kj_qi(p, t, nq):
+    """Column pairing for the dkv kernel (causal, sq == sk): column p
+    (nq-p in-band query blocks) pairs with column nq-1-p (p+1 blocks) —
+    nq+1 steps per pair, no masked block fetched."""
+    ci = (t < nq - p).astype(jnp.int32) if hasattr(t < nq - p, "astype") \
+        else jnp.int32(t < nq - p)
+    kj = ci * p + (1 - ci) * (nq - 1 - p)
+    qi = ci * (p + t) + (1 - ci) * (t - 1)
+    return kj, qi
+
+
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                     segq_ref, segk_ref, dk_ref, dv_ref, dk_scr, dv_scr,
                     *, scale, causal, segmented, block_q, block_k,
-                    seq_q, seq_k, num_q_blocks=None):
-    kj = pl.program_id(1)
-    t = pl.program_id(2)
-    nt = pl.num_programs(2)
-    # Grouped-query: the last grid axis runs rep * num_q_blocks steps —
-    # every query head sharing this KV head streams through, and dk/dv
-    # accumulate across the whole group IN the scratch (no per-query-head
-    # dk/dv materialization, no post-kernel fold).
-    qi = t if num_q_blocks is None else t % num_q_blocks
+                    seq_q, seq_k, num_q_blocks=None, paired_nq=None):
+    if paired_nq is not None:
+        p = pl.program_id(1)
+        t = pl.program_id(2)
+        kj, qi = _paired_kj_qi(p, t, paired_nq)
+        first = jnp.logical_or(t == 0, t == paired_nq - p)
+        last = jnp.logical_or(t == paired_nq - p - 1, t == paired_nq)
+    else:
+        kj = pl.program_id(1)
+        t = pl.program_id(2)
+        nt = pl.num_programs(2)
+        # Grouped-query: the last grid axis runs rep * num_q_blocks steps —
+        # every query head sharing this KV head streams through, and dk/dv
+        # accumulate across the whole group IN the scratch (no per-query-
+        # head dk/dv materialization, no post-kernel fold).
+        qi = t if num_q_blocks is None else t % num_q_blocks
+        first = t == 0
+        last = t == nt - 1
     offset = seq_k - seq_q
 
-    @pl.when(t == 0)
+    @pl.when(first)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    in_band = jnp.asarray(True) if not causal else \
-        (qi + 1) * block_q - 1 + offset >= kj * block_k
+    in_band = jnp.asarray(True) if not causal or paired_nq is not None \
+        else (qi + 1) * block_q - 1 + offset >= kj * block_k
 
     @pl.when(in_band)
     def _step():
@@ -420,7 +449,7 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         ds = (p * (dp - delta) * scale).astype(qb.dtype)
         dk_scr[...] = dk_scr[...] + _dot(ds, qb, ((0,), (0,)))
 
-    @pl.when(t == nt - 1)
+    @pl.when(last)
     def _finish():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -447,22 +476,55 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
         delta = delta - dlse.astype(jnp.float32)
     kv_index = _kv_index(h, hk)
 
+    nqb, nkb = sq // block_q, sk // block_k
+    dq_paired = causal and sq == sk and nqb == nkb and nqb % 2 == 0 and \
+        nqb >= 2
+
+    if dq_paired:
+        def row_of(b, p, t):
+            return _paired_qi_kj(p, t, nqb)[0]
+
+        def col_of(b, p, t):
+            return _paired_qi_kj(p, t, nqb)[1]
+
+        dq_grid = (bh, nqb // 2, nqb + 1)
+    else:
+        def row_of(b, i, j):
+            return i
+
+        def col_of(b, i, j):
+            return j
+
+        dq_grid = (bh, nqb, nkb)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           segmented=segmented, block_q=block_q,
-                          block_k=block_k, seq_q=sq, seq_k=sk),
-        grid=(bh, sq // block_q, sk // block_k),
+                          block_k=block_k, seq_q=sq, seq_k=sk,
+                          paired_nq=nqb if dq_paired else None),
+        grid=dq_grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: kv_index(b, i, j)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: kv_index(b, i, j)),
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, i, j: (b, row_of(b, i, j), 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: kv_index(b, row_of(b, i, j),
+                                                  col_of(b, i, j))),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: kv_index(b, row_of(b, i, j),
+                                                  col_of(b, i, j))),
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, i, j: (b, row_of(b, i, j), 0)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, i, j: (b, 0, row_of(b, i, j))),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, i, j: (b, 0, row_of(b, i, j))),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda b, i, j: (b, 0, row_of(b, i, j))),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, i, j: (b, 0, col_of(b, i, j))),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d),
+                               lambda b, i, j: (b, row_of(b, i, j), 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
     )(q, k, v, do, lse, delta, seg_q, seg_k)
@@ -476,7 +538,27 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
     nq_blocks = sq // block_q
     bhk = b_ * hk
 
-    if rep == 1:
+    dkv_paired = rep == 1 and dq_paired
+    if dkv_paired:
+        # Column pairing (causal, sq == sk, dense heads): grid
+        # (bhk, nq/2, nq+1) never fetches a masked query block.
+        def q_head(bkv, t):
+            return bkv
+
+        def q_index(b, j, t):
+            return (b, _paired_kj_qi(j, t, nq_blocks)[1], 0)
+
+        def stat_index(b, j, t):
+            return (b, 0, _paired_kj_qi(j, t, nq_blocks)[1])
+
+        def dkv_col(b, j, t):
+            return (b, _paired_kj_qi(j, t, nq_blocks)[0], 0)
+
+        def segk_index(b, j, t):
+            return (q_head(b, t), 0, _paired_kj_qi(j, t, nq_blocks)[0])
+
+        dkv_grid = (bhk, nq_blocks // 2, nq_blocks + 1)
+    elif rep == 1:
         def q_head(bkv, t):
             return bkv
 
@@ -485,6 +567,14 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
 
         def stat_index(b, j, t):
             return (b, 0, t)
+
+        def dkv_col(b, j, t):
+            return (b, j, 0)
+
+        def segk_index(b, j, t):
+            return (q_head(b, t), 0, j)
+
+        dkv_grid = (bhk, sk // block_k, rep * nq_blocks)
     else:
         def q_head(bkv, t):
             # flat query-head row for grid coords (kv-head bkv, step t)
@@ -496,6 +586,14 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
         def stat_index(b, j, t):
             return (q_head(b, t), 0, t % nq_blocks)
 
+        def dkv_col(b, j, t):
+            return (b, j, 0)
+
+        def segk_index(b, j, t):
+            return (q_head(b, t), 0, j)
+
+        dkv_grid = (bhk, sk // block_k, rep * nq_blocks)
+
     def q_spec(width):
         return pl.BlockSpec((1, width, d), q_index)
 
@@ -506,22 +604,22 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           segmented=segmented, block_q=block_q,
                           block_k=block_k, seq_q=sq, seq_k=sk,
-                          num_q_blocks=nq_blocks),
-        grid=(bhk, sk // block_k, rep * nq_blocks),
+                          num_q_blocks=nq_blocks,
+                          paired_nq=nq_blocks if dkv_paired else None),
+        grid=dkv_grid,
         in_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), dkv_col),
+            pl.BlockSpec((1, block_k, d), dkv_col),
             q_spec(block_q),
             q_spec(block_q),
             stat_spec(),
             stat_spec(),
             stat_spec(),
-            pl.BlockSpec((1, 1, block_k),
-                         lambda b, j, t: (q_head(b, t), 0, j)),
+            pl.BlockSpec((1, 1, block_k), segk_index),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), dkv_col),
+            pl.BlockSpec((1, block_k, d), dkv_col),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bhk, sk, d), k.dtype),
