@@ -145,6 +145,19 @@ def _seg_mask(s, segq_ref, segk_ref):
     return jnp.where(seg_q == seg_k, s, NEG_INF)
 
 
+def _ind01(cond):
+    """bool -> {0,1} int32 for arithmetic-only index maps (works on both
+    traced scalars and Python bools)."""
+    return cond.astype(jnp.int32) if hasattr(cond, "astype") \
+        else jnp.int32(cond)
+
+
+def _can_pair(causal, sq, sk, nq, nk):
+    """Shared fwd/bwd gate for the triangular enumeration — the two
+    directions must pair under exactly the same condition."""
+    return causal and sq == sk and nq == nk and nq % 2 == 0 and nq >= 2
+
+
 def _paired_qi_kj(p, t, nq):
     """FlashAttention-2-style triangular enumeration for causal sq == sk:
     pair row p (p+1 in-band key blocks) with row nq-1-p (nq-p blocks) —
@@ -152,8 +165,7 @@ def _paired_qi_kj(p, t, nq):
     fetched. Step t <= p works on (row p, key t); later steps on
     (row nq-1-p, key t-p-1). Arithmetic-only so it can serve as a
     BlockSpec index map."""
-    c = (t <= p).astype(jnp.int32) if hasattr(t <= p, "astype") else \
-        jnp.int32(t <= p)
+    c = _ind01(t <= p)
     qi = c * p + (1 - c) * (nq - 1 - p)
     kj = c * t + (1 - c) * (t - p - 1)
     return qi, kj
@@ -260,7 +272,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
     # Triangular enumeration for causal equal-length attention: pair rows
     # so no fully-masked key block is ever DMA'd (grid nq*nk ->
     # (nq/2)*(nq+1), a ~2x program cut at large nq, 25% at nq=2).
-    paired = causal and sq == sk and nq == nk and nq % 2 == 0 and nq >= 2
+    paired = _can_pair(causal, sq, sk, nq, nk)
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              segmented=segmented, block_q=block_q,
                              block_k=block_k, seq_q=sq, seq_k=sk,
@@ -391,8 +403,7 @@ def _paired_kj_qi(p, t, nq):
     """Column pairing for the dkv kernel (causal, sq == sk): column p
     (nq-p in-band query blocks) pairs with column nq-1-p (p+1 blocks) —
     nq+1 steps per pair, no masked block fetched."""
-    ci = (t < nq - p).astype(jnp.int32) if hasattr(t < nq - p, "astype") \
-        else jnp.int32(t < nq - p)
+    ci = _ind01(t < nq - p)
     kj = ci * p + (1 - ci) * (nq - 1 - p)
     qi = ci * (p + t) + (1 - ci) * (t - 1)
     return kj, qi
@@ -477,8 +488,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
     kv_index = _kv_index(h, hk)
 
     nqb, nkb = sq // block_q, sk // block_k
-    dq_paired = causal and sq == sk and nqb == nkb and nqb % 2 == 0 and \
-        nqb >= 2
+    dq_paired = _can_pair(causal, sq, sk, nqb, nkb)
 
     if dq_paired:
         def row_of(b, p, t):
