@@ -1,0 +1,57 @@
+"""dy2static: data-dependent Python control flow under jit.
+
+Pure tracing cannot jit a function that branches on a tensor; to_static's
+AST conversion rewrites the branch/loop into lax control flow while the
+same function keeps plain-Python behavior eagerly.
+
+Run: python examples/dy2static_control_flow.py
+"""
+
+import os
+import sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+
+
+@paddle.jit.to_static
+def clipped_newton_sqrt(y):
+    """Newton iterations with a tensor-valued stopping condition AND a
+    tensor `if` — impossible to jit by tracing alone."""
+    x = y / 2.0 + 0.5
+    while jnp.abs(x * x - y).max() > 1e-6:
+        x = 0.5 * (x + y / x)
+    if x.sum() > 10.0:
+        out = x / x.sum() * 10.0       # renormalize large results
+    else:
+        out = x
+    return out
+
+
+def main():
+    y = jnp.asarray([2.0, 9.0, 16.0])
+    print("sqrt:", clipped_newton_sqrt(y))          # small: untouched
+    y_big = jnp.asarray([100.0, 400.0, 900.0])
+    out = clipped_newton_sqrt(y_big)
+    print("renormalized:", out, "sum:", float(out.sum()))
+
+    # the converted function also works under explicit jax.jit
+    from paddle_tpu.jit.dy2static import convert_to_static
+
+    def count_doublings(x, limit):
+        n = jnp.asarray(0)
+        while x.sum() < limit:
+            x = x * 2
+            n = n + 1
+        return n
+
+    jitted = jax.jit(convert_to_static(count_doublings))
+    print("doublings to reach 100:",
+          int(jitted(jnp.asarray([1.0]), jnp.asarray(100.0))))
+
+
+if __name__ == "__main__":
+    main()
