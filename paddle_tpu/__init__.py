@@ -199,6 +199,7 @@ def _install_inplace_aliases():
         "scatter_", "sigmoid_", "sin_", "sinh_", "sqrt_", "square_",
         "squeeze_", "subtract_", "tan_", "tanh_", "tril_", "triu_",
         "trunc_", "uniform_", "unsqueeze_", "where_", "zero_",
+        "index_add_", "index_put_",
     ]
     g = globals()
     for alias in ref_inplace:
