@@ -2,6 +2,13 @@ from .env import (init_parallel_env, get_rank, get_world_size,  # noqa: F401
                   ParallelEnv, is_initialized, parallel_device_count)
 from .topology import (CommunicateTopology, HybridCommunicateGroup,  # noqa: F401
                        create_hybrid_mesh, get_hybrid_mesh, set_hybrid_mesh)
+from . import io  # noqa: F401
+from .compat import (gather, alltoall, alltoall_single, wait, isend,  # noqa: F401
+                     irecv, ParallelMode, is_available, get_backend,
+                     destroy_process_group, gloo_init_parallel_env,
+                     gloo_barrier, gloo_release, ProbabilityEntry,
+                     CountFilterEntry, ShowClickEntry, split, DistAttr)
+from .collective import get_group, send, recv  # noqa: F401
 from .collective import (ReduceOp, Group, new_group, all_reduce,  # noqa: F401
                          all_gather, reduce_scatter, all_to_all, broadcast,
                          reduce, scatter, barrier, world_group, axis_rank,

@@ -1,0 +1,230 @@
+"""Reference-parity tail of ``paddle.distributed.__all__``: collective
+aliases, process-group introspection, gloo (host CPU) shims, PS entry
+configs, and the model-parallel ``split`` helper.
+
+Reference: python/paddle/distributed/__init__.py exports; communication/
+(gather/alltoall), parallel.py (gloo_*), fleet entry configs
+(CountFilterEntry etc. — ps table accessor policies), collective.py split.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from . import collective as C
+
+__all__ = ["gather", "alltoall", "alltoall_single", "wait", "isend",
+           "irecv", "ParallelMode", "is_available", "get_backend",
+           "destroy_process_group", "gloo_init_parallel_env",
+           "gloo_barrier", "gloo_release", "ProbabilityEntry",
+           "CountFilterEntry", "ShowClickEntry", "split", "DistAttr"]
+
+
+def gather(tensor, gather_list=None, dst: int = 0, group=None,
+           sync_op: bool = True):
+    """Collective gather (ref communication/gather.py). Single-controller
+    XLA note: the gathered stack is computed via all_gather (every shard
+    produces it); ``gather_list`` is filled for the dst-rank contract."""
+    out = C.all_gather(tensor, group=group)
+    if gather_list is not None:
+        n = (group or C.world_group()).nranks
+        parts = jnp.split(out, n, axis=0)
+        gather_list.clear()
+        gather_list.extend(parts)
+    return out
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None,
+             sync_op: bool = True):
+    """ref communication/all_to_all.py: rank r sends in_tensor_list[j] to
+    rank j. List form over the stacked-ranks eager convention."""
+    x = jnp.stack(list(in_tensor_list))
+    out = C.all_to_all(x, group=group)
+    parts = list(out)
+    if out_tensor_list is not None:
+        out_tensor_list.clear()
+        out_tensor_list.extend(parts)
+    return parts
+
+
+def alltoall_single(in_tensor, out_tensor=None,
+                    in_split_sizes=None, out_split_sizes=None,
+                    group=None, sync_op: bool = True):
+    """ref communication/all_to_all.py alltoall_single (equal splits; the
+    unequal-split variant is not expressible as a single XLA a2a)."""
+    if in_split_sizes is not None or out_split_sizes is not None:
+        raise NotImplementedError(
+            "alltoall_single with unequal splits: pad to equal splits "
+            "(XLA all_to_all is equal-split)")
+    return C.all_to_all(in_tensor, group=group)
+
+
+def wait(tensor, group=None, use_calc_stream: bool = True):
+    """ref communication/wait.py: block until the tensor's producing work
+    completes (XLA async collectives resolve on use; this forces it)."""
+    jax.block_until_ready(tensor)
+    return tensor
+
+
+def isend(tensor, dst: int, group=None):
+    return C.send(tensor, dst, group=group)
+
+
+def irecv(tensor, src: int = 0, group=None):
+    return C.recv(tensor, src, group=group)
+
+
+class ParallelMode:
+    """ref fleet/base/topology.py ParallelMode constants."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+def is_available() -> bool:
+    """ref distributed.is_available — collectives usable?"""
+    try:
+        return jax.device_count() >= 1
+    except Exception:
+        return False
+
+
+def get_backend(group=None) -> str:
+    """The single backend is XLA collectives over ICI/DCN."""
+    return "XCCL_XLA"
+
+
+def destroy_process_group(group=None):
+    """ref communication/group.py destroy_process_group: drop the cached
+    group registry (meshes themselves are just Python objects)."""
+    if hasattr(C, "_groups"):
+        if group is None:
+            C._groups.clear()
+        else:
+            C._groups.pop(getattr(group, "id", None), None)
+
+
+# -- gloo shims: the host control-plane already runs over TCPStore ---------
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int, server_endpoint: str):
+    """ref parallel.py gloo_init_parallel_env — CPU barrier env over the
+    TCPStore (the gloo analog in this build IS the host store)."""
+    from .store import get_global_store
+    get_global_store()
+    return None
+
+
+def gloo_barrier():
+    from . import env as dist_env
+    if dist_env.get_world_size() > 1:
+        C.barrier()
+
+
+def gloo_release():
+    return None
+
+
+# -- PS table entry configs (ref fleet entry.py accessor policies) ---------
+
+class ProbabilityEntry:
+    """Sparse-feature admission by probability (ref distributed/entry_attr)."""
+
+    def __init__(self, probability: float):
+        if not 0 < probability <= 1:
+            raise ValueError("probability must be in (0, 1]")
+        self.probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class CountFilterEntry:
+    """Admit a sparse feature after `count_filter` occurrences."""
+
+    def __init__(self, count_filter: int):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ShowClickEntry:
+    """CTR show/click-rate driven admission (named stat slots)."""
+
+    def __init__(self, show_name: str, click_name: str):
+        self.show_name = show_name
+        self.click_name = click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+def split(x, size, operation: str = "linear", axis: int = 0,
+          num_partitions: int = 1, gather_out: bool = True,
+          weight=None, bias=None, weight_attr=None, bias_attr=None,
+          name=None):
+    """Model-parallel op splitter (ref collective.py split): run a linear
+    or embedding with its weight partitioned over the mp mesh axis.
+
+    Functional-JAX form: pass ``weight`` (and ``bias``) explicitly — the
+    GSPMD sharding constraint partitions them over 'mp' exactly as the
+    reference partitions across ranks; axis 0 = row parallel (input
+    parallel for linear / vocab parallel for embedding), axis 1 = column
+    parallel. gather_out=False leaves the column-parallel output sharded.
+    """
+    from jax.sharding import PartitionSpec as P
+    from .fleet.layers.mpu.mp_layers import _constrain
+    x = jnp.asarray(x)
+    if operation == "linear":
+        if weight is None:
+            raise ValueError("split(operation='linear') needs an explicit "
+                             "weight in the functional build")
+        w = jnp.asarray(weight)
+        if axis == 1:      # column parallel: [in, out_sharded]
+            w = _constrain(w, P(None, "mp"))
+            out = x @ w
+            if bias is not None:
+                out = out + jnp.asarray(bias)
+            if gather_out:
+                out = _constrain(out, P())
+            else:
+                out = _constrain(out, P(None, "mp"))
+            return out
+        # axis == 0: row parallel — input dim sharded, psum by GSPMD
+        w = _constrain(w, P("mp", None))
+        out = x @ w
+        if bias is not None:
+            out = out + jnp.asarray(bias)
+        return _constrain(out, P())
+    if operation == "embedding":
+        if weight is None:
+            raise ValueError("split(operation='embedding') needs weight")
+        w = _constrain(jnp.asarray(weight), P("mp", None))
+        return _constrain(jnp.take(w, x, axis=0), P())
+    raise ValueError(f"unknown split operation {operation!r}")
+
+
+class DistAttr:
+    """ref auto_parallel DistAttr: (mesh, dims_mapping) pair describing a
+    tensor's placement; bridges to NamedSharding."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def to_named_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.process_mesh
+        jmesh = getattr(mesh, "jax_mesh", None) or mesh
+        return NamedSharding(jmesh, P(*self.sharding_specs))
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")

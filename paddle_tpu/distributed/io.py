@@ -1,0 +1,58 @@
+"""paddle.distributed.io parity (ref python/paddle/distributed/io.py:
+save/load for distributed programs — persistables per rank).
+
+TPU-native form: thin wrappers over framework.io + the sharded orbax
+checkpoint path; per-rank artifacts carry a rank suffix like the
+reference's per-trainer files.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+__all__ = ["save_persistables", "load_persistables", "is_persistable"]
+
+
+def _rank_path(dirname: str, filename: Optional[str]) -> str:
+    from . import env as dist_env
+    rank = dist_env.get_rank()
+    base = filename or "persistables"
+    suffix = f".rank{rank}" if dist_env.get_world_size() > 1 else ""
+    return os.path.join(dirname, base + suffix)
+
+
+def save_persistables(executor_or_state: Any, dirname: str, main_program=None,
+                      filename: Optional[str] = None):
+    """Save a state_dict (or Layer) per rank (ref io.py save_persistables).
+    Accepts a Layer, a dict, or (parity) an ignored executor + program
+    whose state comes from ``main_program.state_dict()``."""
+    from ..framework.io import save
+    state = executor_or_state
+    if main_program is not None and hasattr(main_program, "state_dict"):
+        state = main_program.state_dict()
+    elif hasattr(state, "state_dict"):
+        state = state.state_dict()
+    os.makedirs(dirname, exist_ok=True)
+    save(state, _rank_path(dirname, filename))
+
+
+def load_persistables(executor_or_target: Any, dirname: str,
+                      main_program=None, filename: Optional[str] = None):
+    """Load the per-rank artifact; applies to a Layer/program when one is
+    given, else returns the raw state dict."""
+    from ..framework.io import load
+    state = load(_rank_path(dirname, filename))
+    target = main_program if main_program is not None else executor_or_target
+    if hasattr(target, "set_state_dict"):
+        target.set_state_dict(state)
+        return target
+    if hasattr(target, "load_state_dict"):
+        target.load_state_dict(state)
+        return target
+    return state
+
+
+def is_persistable(var) -> bool:
+    """ref io.py is_persistable: parameters and buffers persist."""
+    return getattr(var, "persistable", True)

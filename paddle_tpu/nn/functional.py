@@ -1466,3 +1466,21 @@ from ..ops.flash_attention import (flash_attention,  # noqa: E402,F401
                                    flash_attn_unpadded)
 
 __all__ += ["flash_attention", "flash_attn_unpadded"]
+
+
+# Wave-4 names (remaining reference nn.functional.__all__) + the in-place
+# activation aliases (JAX arrays are immutable: these return the result,
+# see paddle_tpu.__init__._install_inplace_aliases for the contract).
+from .functional_wave4 import *  # noqa: F401,F403,E402
+from .functional_wave4 import __all__ as _w4_all  # noqa: E402
+
+elu_ = elu
+hardtanh_ = hardtanh
+leaky_relu_ = leaky_relu
+relu_ = relu
+softmax_ = softmax
+tanh_ = tanh
+thresholded_relu_ = thresholded_relu
+
+__all__ += _w4_all + ["elu_", "hardtanh_", "leaky_relu_", "relu_",
+                      "softmax_", "tanh_", "thresholded_relu_"]

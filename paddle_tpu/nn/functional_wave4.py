@@ -1,0 +1,406 @@
+"""nn.functional wave 4: the remaining reference ``nn.functional.__all__``
+names (ref python/paddle/nn/functional/__init__.py). Distances, channel
+dropouts, adaptive max pools, unpool 1d/3d, remaining losses, and the
+functional forms of wave-3 layers (hsigmoid/rnnt/gather_tree)."""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.random import next_key
+
+__all__ = [
+    "pairwise_distance", "diag_embed", "dropout2d", "dropout3d",
+    "alpha_dropout", "zeropad2d", "bilinear", "max_unpool1d", "max_unpool3d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d", "hsigmoid_loss", "sigmoid_focal_loss",
+    "rnnt_loss", "gather_tree", "sparse_attention",
+    "triplet_margin_with_distance_loss", "multi_margin_loss",
+    "gaussian_nll_loss",
+]
+
+
+def pairwise_distance(x, y, p: float = 2.0, epsilon: float = 1e-6,
+                      keepdim: bool = False, name=None):
+    """ref nn/functional/distance.py: ||x - y + eps||_p along the last dim."""
+    d = jnp.asarray(x) - jnp.asarray(y) + epsilon
+    if p == float("inf"):
+        out = jnp.max(jnp.abs(d), axis=-1, keepdims=keepdim)
+    elif p == 1.0:
+        out = jnp.sum(jnp.abs(d), axis=-1, keepdims=keepdim)
+    else:
+        out = jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return out
+
+
+def diag_embed(input, offset: int = 0, dim1: int = -2, dim2: int = -1,
+               name=None):
+    """Batched vectors -> batched diagonal matrices (ref creation.py)."""
+    x = jnp.asarray(input)
+    n = x.shape[-1] + abs(offset)
+    base = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    idx = jnp.arange(x.shape[-1])
+    r = idx + max(-offset, 0)
+    c = idx + max(offset, 0)
+    out = base.at[..., r, c].set(x)
+    nd = out.ndim
+    d1, d2 = dim1 % nd, dim2 % nd
+    if (d1, d2) != (nd - 2, nd - 1):
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for dst, src in order:
+            perm.insert(dst, src)
+        out = out.transpose(perm)
+    return out
+
+
+def _channel_dropout(x, p, training, spatial_dims, data_format_channel_axis):
+    if not training or p == 0.0:
+        return x
+    if p == 1.0:
+        return jnp.zeros_like(x)
+    shape = list(x.shape)
+    for d in spatial_dims:
+        shape[d] = 1
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, tuple(shape))
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+def dropout2d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCHW", name=None):
+    """Whole-channel dropout (ref functional/common.py dropout2d)."""
+    sp = (2, 3) if data_format == "NCHW" else (1, 2)
+    return _channel_dropout(x, p, training, sp, None)
+
+
+def dropout3d(x, p: float = 0.5, training: bool = True,
+              data_format: str = "NCDHW", name=None):
+    sp = (2, 3, 4) if data_format == "NCDHW" else (1, 2, 3)
+    return _channel_dropout(x, p, training, sp, None)
+
+
+def alpha_dropout(x, p: float = 0.5, training: bool = True, name=None):
+    """SELU-preserving dropout (ref functional/common.py alpha_dropout):
+    dropped units take the negative saturation value and the output is
+    affinely rescaled to preserve mean/variance."""
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = jax.random.bernoulli(next_key(), 1.0 - p, x.shape)
+    a = ((1.0 - p) * (1 + p * alpha_p ** 2)) ** -0.5
+    b = -a * alpha_p * p
+    out = jnp.where(keep, x, alpha_p)
+    return (a * out + b).astype(x.dtype)
+
+
+def zeropad2d(x, padding, data_format: str = "NCHW", name=None):
+    from .functional import pad
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """y_k = x1 W_k x2^T (+ b) (ref functional/common.py bilinear);
+    weight [out, in1, in2]."""
+    out = jnp.einsum("bi,oij,bj->bo", jnp.asarray(x1), jnp.asarray(weight),
+                     jnp.asarray(x2))
+    if bias is not None:
+        out = out + jnp.asarray(bias)
+    return out
+
+
+def _unpool(x, indices, kernel_size, stride, padding, output_size, nd):
+    """Shared max_unpool core: scatter values to their argmax positions."""
+    x = jnp.asarray(x)
+    indices = jnp.asarray(indices)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * nd
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(padding, int):
+        padding = (padding,) * nd
+    spatial_in = x.shape[2:]
+    if output_size is None:
+        output_size = tuple(
+            (spatial_in[i] - 1) * stride[i] - 2 * padding[i] + kernel_size[i]
+            for i in range(nd))
+    else:
+        output_size = tuple(output_size)[-nd:]
+    n, c = x.shape[0], x.shape[1]
+    flat_sz = 1
+    for s in output_size:
+        flat_sz *= s
+    out = jnp.zeros((n, c, flat_sz), x.dtype)
+    xi = x.reshape(n, c, -1)
+    ii = indices.reshape(n, c, -1)
+    out = jax.vmap(jax.vmap(
+        lambda o, idx, v: o.at[idx].set(v)))(out, ii, xi)
+    return out.reshape((n, c) + output_size)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCL", output_size=None, name=None):
+    """ref functional/pooling.py max_unpool1d (indices from
+    max_pool1d(..., return_mask=True))."""
+    if data_format != "NCL":
+        raise NotImplementedError("max_unpool1d supports NCL")
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format: str = "NCDHW", output_size=None, name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError("max_unpool3d supports NCDHW")
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 3)
+
+
+def _adaptive_pool(x, output_size, nd, op):
+    """Adaptive pooling over the trailing nd spatial dims (NC...)."""
+    x = jnp.asarray(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * nd
+    output_size = tuple(s if s is not None else x.shape[2 + i]
+                        for i, s in enumerate(output_size))
+    out = x
+    for d in range(nd):
+        axis = 2 + d
+        in_sz, out_sz = out.shape[axis], output_size[d]
+        pieces = []
+        for i in range(out_sz):
+            lo = (i * in_sz) // out_sz
+            hi = -(-((i + 1) * in_sz) // out_sz)
+            sl = [slice(None)] * out.ndim
+            sl[axis] = slice(lo, hi)
+            pieces.append(op(out[tuple(sl)], axis=axis, keepdims=True))
+        out = jnp.concatenate(pieces, axis=axis)
+    return out
+
+
+def adaptive_avg_pool3d(x, output_size, data_format: str = "NCDHW",
+                        name=None):
+    if data_format != "NCDHW":
+        raise NotImplementedError("adaptive_avg_pool3d supports NCDHW")
+    return _adaptive_pool(x, output_size, 3, jnp.mean)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask: bool = False,
+                        name=None):
+    out = _adaptive_pool(x, output_size, 1, jnp.max)
+    if return_mask:
+        return out, _adaptive_argmax(x, output_size, 1)
+    return out
+
+
+def adaptive_max_pool2d(x, output_size, return_mask: bool = False,
+                        name=None):
+    out = _adaptive_pool(x, output_size, 2, jnp.max)
+    if return_mask:
+        return out, _adaptive_argmax(x, output_size, 2)
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask: bool = False,
+                        name=None):
+    out = _adaptive_pool(x, output_size, 3, jnp.max)
+    if return_mask:
+        return out, _adaptive_argmax(x, output_size, 3)
+    return out
+
+
+def _adaptive_argmax(x, output_size, nd):
+    """Flat spatial indices of the maxima (paddle's return_mask payload)."""
+    x = jnp.asarray(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * nd
+    spatial = x.shape[2:]
+    n, c = x.shape[:2]
+    flat = x.reshape(n, c, -1)
+    out_idx = jnp.zeros((n, c) + tuple(output_size), jnp.int32)
+    import itertools
+    import numpy as np
+    strides = np.cumprod((spatial + (1,))[::-1])[::-1][1:]
+    for cell in itertools.product(*(range(s) for s in output_size)):
+        los, his = [], []
+        for d, i in enumerate(cell):
+            in_sz, out_sz = spatial[d], output_size[d]
+            los.append((i * in_sz) // out_sz)
+            his.append(-(-((i + 1) * in_sz) // out_sz))
+        sl = tuple([slice(None), slice(None)] +
+                   [slice(lo, hi) for lo, hi in zip(los, his)])
+        window = x[sl].reshape(n, c, -1)
+        local = jnp.argmax(window, axis=-1)
+        # unravel local back to global flat index
+        wshape = tuple(hi - lo for lo, hi in zip(los, his))
+        coords = jnp.unravel_index(local, wshape)
+        gflat = jnp.zeros_like(local)
+        for d in range(nd):
+            gflat = gflat + (coords[d] + los[d]) * int(strides[d])
+        out_idx = out_idx.at[(slice(None), slice(None)) + cell].set(gflat)
+    return out_idx
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse: bool = False,
+                  name=None):
+    """Functional form of the wave-3 HSigmoidLoss (default complete binary
+    tree; ref functional/loss.py hsigmoid_loss). Caller supplies the
+    [num_classes-1, feature] weight (+ optional bias); the layer instance
+    substitutes them so the path/code math lives in one place."""
+    from .layers import HSigmoidLoss
+    x = jnp.asarray(input)
+    layer = HSigmoidLoss(x.shape[-1], num_classes, bias_attr=bias is None
+                         and False)
+    layer.weight = jnp.asarray(weight)
+    if bias is not None:
+        layer.bias = jnp.asarray(bias)
+    else:
+        layer.bias = None
+    return layer(x, jnp.asarray(label), path_table, path_code)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha: float = 0.25,
+                       gamma: float = 2.0, reduction: str = "sum",
+                       name=None):
+    """ref functional/loss.py sigmoid_focal_loss (RetinaNet)."""
+    logit = jnp.asarray(logit).astype(jnp.float32)
+    label = jnp.asarray(label).astype(jnp.float32)
+    p = jax.nn.sigmoid(logit)
+    ce = -(label * jax.nn.log_sigmoid(logit) +
+           (1 - label) * jax.nn.log_sigmoid(-logit))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1 - p_t) ** gamma) * ce
+    if normalizer is not None:
+        loss = loss / jnp.asarray(normalizer)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    return loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank: int = 0,
+              fastemit_lambda: float = 0.001, reduction: str = "mean",
+              name=None):
+    """Functional form of wave-3 RNNTLoss (log-space transducer DP)."""
+    from .layers import RNNTLoss
+    layer = RNNTLoss(blank=blank, fastemit_lambda=fastemit_lambda,
+                     reduction=reduction)
+    return layer(jnp.asarray(input), jnp.asarray(label),
+                 jnp.asarray(input_lengths), jnp.asarray(label_lengths))
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (re-export; ref functional gather_tree)."""
+    from ..text.ops import gather_tree as _gt
+    return _gt(ids, parents)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention over a per-row CSR column pattern (ref
+    incubate sparse_attention op). q/k/v: [B, H, S, D]; offset
+    [B, H, S+1]; columns [B, H, nnz]. Computes softmax(QK^T/sqrt(d)) V
+    restricted to each row's column list. Dense-gather formulation:
+    rows gather their permitted keys (padded to the max row degree) —
+    correct for any pattern, efficient for bounded-degree patterns."""
+    q = jnp.asarray(query)
+    k = jnp.asarray(key)
+    v = jnp.asarray(value)
+    off = jnp.asarray(sparse_csr_offset, jnp.int32)
+    cols = jnp.asarray(sparse_csr_columns, jnp.int32)
+    b, h, s, d = q.shape
+    deg = off[..., 1:] - off[..., :-1]              # [B, H, S]
+    max_deg = int(jnp.max(deg)) if deg.size else 0
+    max_deg = max(max_deg, 1)
+    scale = 1.0 / math.sqrt(d)
+
+    def row(qrow, krows, vrows, o0, dg):
+        idx = o0 + jnp.arange(max_deg)
+        valid = jnp.arange(max_deg) < dg
+        ci = jnp.take(cols_flat, jnp.clip(idx, 0, cols_flat.shape[0] - 1))
+        kk = krows[ci]                               # [max_deg, D]
+        vv = vrows[ci]
+        sc = (kk @ qrow) * scale
+        sc = jnp.where(valid, sc, -jnp.inf)
+        p = jax.nn.softmax(sc)
+        p = jnp.where(valid, p, 0.0)
+        return p @ vv
+
+    out = jnp.zeros_like(q)
+    outs = []
+    for bi in range(b):
+        houts = []
+        for hi in range(h):
+            cols_flat = cols[bi, hi]
+            r = jax.vmap(row, in_axes=(0, None, None, 0, 0))(
+                q[bi, hi], k[bi, hi], v[bi, hi], off[bi, hi, :-1],
+                deg[bi, hi])
+            houts.append(r)
+        outs.append(jnp.stack(houts))
+    return jnp.stack(outs)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None,
+                                      margin: float = 1.0,
+                                      swap: bool = False,
+                                      reduction: str = "mean", name=None):
+    """ref functional/loss.py triplet_margin_with_distance_loss."""
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    loss = jnp.maximum(dp - dn + margin, 0.0)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def multi_margin_loss(input, label, p: int = 1, margin: float = 1.0,
+                      weight=None, reduction: str = "mean", name=None):
+    """ref functional/loss.py multi_margin_loss (multi-class hinge)."""
+    x = jnp.asarray(input)
+    label = jnp.asarray(label)
+    n, c = x.shape
+    correct = jnp.take_along_axis(x, label[:, None], axis=1)  # [N, 1]
+    margin_term = jnp.maximum(margin - correct + x, 0.0) ** p
+    if weight is not None:
+        w = jnp.asarray(weight)[label][:, None]
+        margin_term = margin_term * w
+    mask = jax.nn.one_hot(label, c, dtype=x.dtype)
+    loss = jnp.sum(margin_term * (1 - mask), axis=1) / c
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def gaussian_nll_loss(input, label, variance, full: bool = False,
+                      epsilon: float = 1e-6, reduction: str = "mean",
+                      name=None):
+    """ref functional/loss.py gaussian_nll_loss."""
+    x = jnp.asarray(input).astype(jnp.float32)
+    y = jnp.asarray(label).astype(jnp.float32)
+    var = jnp.maximum(jnp.asarray(variance).astype(jnp.float32), epsilon)
+    loss = 0.5 * (jnp.log(var) + (x - y) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * math.log(2 * math.pi)
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

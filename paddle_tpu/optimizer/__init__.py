@@ -1,3 +1,4 @@
 from . import lr  # noqa: F401
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW,  # noqa: F401
-                        Adagrad, RMSProp, Lamb, Lars)
+                        Adagrad, RMSProp, Lamb, Lars, Adamax, Adadelta,
+                        LBFGS)

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..nn.layer import ParamRef
 from .lr import LRScheduler
@@ -430,3 +431,135 @@ class Lars(Optimizer):
         st = dict(st)
         st["velocity"] = v
         return p32 - v, st
+
+
+class Adamax(Adam):
+    """Adamax: infinity-norm Adam variant (ref optimizer/adamax.py —
+    u_t = max(beta2 * u, |g|); no bias correction on u)."""
+
+    def _init_param_state(self, p):
+        return {"moment": jnp.zeros(p.shape, jnp.float32),
+                "inf_norm": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        g32 = self._decay(p32, g32)
+        m = self.beta1 * st["moment"] + (1 - self.beta1) * g32
+        u = jnp.maximum(self.beta2 * st["inf_norm"], jnp.abs(g32))
+        stepf = step.astype(jnp.float32)
+        bc1 = 1 - self.beta1 ** stepf
+        new_p = p32 - lr / bc1 * m / (u + self.epsilon)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adadelta(Optimizer):
+    """ref optimizer/adadelta.py: unit-consistent accumulated-delta rule."""
+
+    def __init__(self, learning_rate=0.001, epsilon: float = 1e-6,
+                 rho: float = 0.95, parameters=None, weight_decay=0.0,
+                 grad_clip=None, multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.epsilon, self.rho = epsilon, rho
+
+    def _init_param_state(self, p):
+        return {"avg_squared_grad": jnp.zeros(p.shape, jnp.float32),
+                "avg_squared_update": jnp.zeros(p.shape, jnp.float32)}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        if self.weight_decay:
+            g32 = g32 + self.weight_decay * p32
+        eg = self.rho * st["avg_squared_grad"] + \
+            (1 - self.rho) * jnp.square(g32)
+        delta = -jnp.sqrt((st["avg_squared_update"] + self.epsilon) /
+                          (eg + self.epsilon)) * g32
+        eu = self.rho * st["avg_squared_update"] + \
+            (1 - self.rho) * jnp.square(delta)
+        return p32 + lr * delta, {"avg_squared_grad": eg,
+                                  "avg_squared_update": eu}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (ref optimizer/lbfgs.py). Functional-JAX form:
+    the two-loop recursion over a rolling (s, y) history of size
+    ``history_size``, with fixed learning-rate steps (strong-Wolfe line
+    search needs closure re-evaluation, which the pure
+    ``apply_gradients`` contract cannot do — pass ``line_search_fn=None``
+    exactly like the reference's default 'None' mode). History buffers
+    live in opt state, so the step stays jittable."""
+
+    def __init__(self, learning_rate=1.0, max_iter: int = 20,
+                 history_size: int = 10, epsilon: float = 1e-8,
+                 parameters=None, weight_decay=0.0, grad_clip=None,
+                 line_search_fn=None, multi_precision=True, name=None,
+                 tolerance_grad: float = 1e-7,
+                 tolerance_change: float = 1e-9):
+        if line_search_fn not in (None, "None"):
+            raise NotImplementedError(
+                "LBFGS(line_search_fn='strong_wolfe') needs closure "
+                "re-evaluation; use the default fixed-step mode")
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision)
+        self.history_size = int(history_size)
+        self.epsilon = epsilon
+
+    def _init_param_state(self, p):
+        h = self.history_size
+        flat = int(np.prod(p.shape))
+        return {"s_hist": jnp.zeros((h, flat), jnp.float32),
+                "y_hist": jnp.zeros((h, flat), jnp.float32),
+                "rho_hist": jnp.zeros((h,), jnp.float32),
+                "prev_flat_p": jnp.zeros((flat,), jnp.float32),
+                "prev_flat_g": jnp.zeros((flat,), jnp.float32),
+                "n_hist": jnp.zeros((), jnp.int32)}
+
+    def _update(self, name, p32, g32, st, lr, step):
+        if self.weight_decay:
+            g32 = g32 + self.weight_decay * p32
+        h = self.history_size
+        flat_p = p32.reshape(-1).astype(jnp.float32)
+        flat_g = g32.reshape(-1).astype(jnp.float32)
+
+        # Update history with (s, y) from the PREVIOUS step (skip at t=1).
+        s = flat_p - st["prev_flat_p"]
+        y = flat_g - st["prev_flat_g"]
+        sy = jnp.dot(s, y)
+        have_pair = jnp.logical_and(step > 1, sy > 1e-10)
+        roll = lambda a, new: jnp.concatenate([a[1:], new[None]], axis=0)
+        s_hist = jnp.where(have_pair, roll(st["s_hist"], s), st["s_hist"])
+        y_hist = jnp.where(have_pair, roll(st["y_hist"], y), st["y_hist"])
+        rho_hist = jnp.where(
+            have_pair, roll(st["rho_hist"], 1.0 / jnp.maximum(sy, 1e-10)),
+            st["rho_hist"])
+        n_hist = jnp.where(have_pair,
+                           jnp.minimum(st["n_hist"] + 1, h), st["n_hist"])
+
+        # Two-loop recursion (oldest entries have rho == 0 -> no-ops).
+        def bwd(carry, i):
+            q, alphas = carry
+            idx = h - 1 - i
+            rho = rho_hist[idx]
+            alpha = rho * jnp.dot(s_hist[idx], q)
+            q = q - alpha * y_hist[idx]
+            return (q, alphas.at[idx].set(alpha)), None
+
+        (q, alphas), _ = jax.lax.scan(
+            bwd, (flat_g, jnp.zeros((h,), jnp.float32)), jnp.arange(h))
+        # Initial Hessian scale gamma = sy / yy of the newest pair.
+        yy = jnp.dot(y_hist[-1], y_hist[-1])
+        gamma = jnp.where(n_hist > 0,
+                          (1.0 / jnp.maximum(rho_hist[-1], 1e-10)) /
+                          jnp.maximum(yy, self.epsilon), 1.0)
+        r = gamma * q
+
+        def fwd(r, i):
+            rho = rho_hist[i]
+            beta = rho * jnp.dot(y_hist[i], r)
+            r = r + s_hist[i] * (alphas[i] - beta)
+            return r, None
+
+        r, _ = jax.lax.scan(fwd, r, jnp.arange(h))
+        new_flat = flat_p - lr * r
+        new_st = {"s_hist": s_hist, "y_hist": y_hist, "rho_hist": rho_hist,
+                  "prev_flat_p": flat_p, "prev_flat_g": flat_g,
+                  "n_hist": n_hist}
+        return new_flat.reshape(p32.shape), new_st

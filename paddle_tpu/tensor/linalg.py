@@ -13,7 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = [
-    "cov", "corrcoef", "matrix_exp", "pdist", "householder_product",
+    "cond", "pca_lowrank", "cov", "corrcoef", "matrix_exp", "pdist", "householder_product",
     "cholesky_solve", "eigvals", "eigvalsh", "lu", "lu_unpack",
     "matmul", "mm", "bmm", "dot", "t", "norm", "dist", "cross", "cholesky",
     "qr", "svd", "eig", "eigh", "inv", "pinv", "det", "slogdet", "solve",
@@ -279,3 +279,55 @@ def householder_product(x, tau, name=None):
     out = jax.vmap(one)(x.reshape((-1,) + x.shape[-2:]),
                         tau.reshape((-1, tau.shape[-1])))
     return out.reshape(batch + out.shape[-2:])
+
+
+def cond(x, p=None, name=None):
+    """Matrix condition number (ref linalg.py cond): p in {None/2, 'fro',
+    'nuc', 1, -1, 2, -2, inf, -inf}. None/±2 use singular values; others
+    ||A||_p * ||A^-1||_p."""
+    x = jnp.asarray(x)
+    if p is None or p == 2 or p == -2:
+        s = jnp.linalg.svd(x, compute_uv=False)
+        smax, smin = s[..., 0], s[..., -1]
+        return smax / smin if (p is None or p == 2) else smin / smax
+    inv = jnp.linalg.inv(x)
+
+    def norm_p(a):
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.abs(a) ** 2, axis=(-2, -1)))
+        if p == "nuc":
+            return jnp.sum(jnp.linalg.svd(a, compute_uv=False), axis=-1)
+        if p in (1, -1):
+            colsums = jnp.sum(jnp.abs(a), axis=-2)
+            return jnp.max(colsums, -1) if p == 1 else jnp.min(colsums, -1)
+        if p in (float("inf"), -float("inf")):
+            rowsums = jnp.sum(jnp.abs(a), axis=-1)
+            return jnp.max(rowsums, -1) if p > 0 else jnp.min(rowsums, -1)
+        raise ValueError(f"unsupported p={p!r}")
+
+    return norm_p(x) * norm_p(inv)
+
+
+def pca_lowrank(x, q=None, center: bool = True, niter: int = 2, name=None):
+    """Randomized low-rank PCA (ref linalg.py pca_lowrank, Halko et al.):
+    returns (U, S, V) with x ~ U diag(S) V^T, V's columns the principal
+    directions."""
+    x = jnp.asarray(x)
+    m, n = x.shape[-2], x.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        x = x - jnp.mean(x, axis=-2, keepdims=True)
+    from ..core.random import next_key
+    omega = jax.random.normal(next_key(), x.shape[:-2] + (n, q), x.dtype)
+    y = x @ omega
+    qmat, _ = jnp.linalg.qr(y)
+    for _ in range(niter):
+        z = jnp.swapaxes(x, -1, -2) @ qmat
+        w, _ = jnp.linalg.qr(z)
+        y = x @ w
+        qmat, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(qmat, -1, -2) @ x
+    u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+    u = qmat @ u_b
+    return u, s, jnp.swapaxes(vt, -1, -2)
