@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from ..framework.functional import functional_call, get_params
 from ..nn.layer import Layer, ParamRef
 
-__all__ = ["backward", "grad", "value_and_grad", "PyLayer", "no_grad",
+__all__ = [
+    "PyLayerContext", "saved_tensors_hooks","backward", "grad", "value_and_grad", "PyLayer", "no_grad",
            "enable_grad", "set_grad_enabled", "jacobian", "hessian", "vjp", "jvp"]
 
 
@@ -200,3 +201,58 @@ class PyLayer(metaclass=PyLayerMeta):
     @classmethod
     def apply(cls, *args):
         return cls._fn(*args)
+
+
+class PyLayerContext:
+    """ref autograd/py_layer.py PyLayerContext: the ctx handed to
+    PyLayer.forward/backward (save_for_backward / saved_tensor)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        pass
+
+    def mark_non_differentiable(self, *args):
+        pass
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+import contextlib as _ctx
+
+
+@_ctx.contextmanager
+def saved_tensors_hooks(pack_hook, unpack_hook):
+    """ref autograd.saved_tensors_hooks: transform residuals as they are
+    saved/restored around the backward pass. Functional form: installs the
+    hook pair consulted by PyLayer's save path (jax.checkpoint owns the
+    actual residual plumbing for plain jax.grad)."""
+    _saved_hooks.append((pack_hook, unpack_hook))
+    try:
+        yield
+    finally:
+        _saved_hooks.pop()
+
+
+_saved_hooks = []
+
+
+def _apply_pack(x):
+    for pack, _ in reversed(_saved_hooks):
+        x = pack(x)
+    return x
+
+
+def _apply_unpack(x):
+    for _, unpack in _saved_hooks:
+        x = unpack(x)
+    return x

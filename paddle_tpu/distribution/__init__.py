@@ -24,7 +24,8 @@ import numpy as np
 
 from ..core.random import next_key as _next_rng_key
 
-__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+__all__ = [
+    "ExponentialFamily","Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
            "Beta", "Dirichlet", "Exponential", "Geometric", "Gumbel",
            "Laplace", "LogNormal", "Multinomial", "Cauchy", "Independent",
            "TransformedDistribution", "kl_divergence", "register_kl",
@@ -615,3 +616,31 @@ def _kl_beta_beta(p, q):
             - (gl(pa) + gl(pb) - gl(pa + pb))
             + (pa - qa) * dg(pa) + (pb - qb) * dg(pb)
             + (qa + qb - pa - pb) * dg(pa + pb))
+
+
+class ExponentialFamily(Distribution):
+    """ref distribution/exponential_family.py: distributions of form
+    p(x) = h(x) exp(<natural params, t(x)> - A(theta)); entropy via the
+    Bregman identity (autodiff of the log-normalizer)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        """-<grad A, eta> + A(eta) + E[log h(x)] (Bregman form)."""
+        nat = [jnp.asarray(p, jnp.float32) for p in self._natural_parameters]
+        lg_normal, grads = jax.value_and_grad(
+            lambda ps: jnp.sum(self._log_normalizer(*ps)), argnums=0)(
+            tuple(nat))
+        result = lg_normal - self._mean_carrier_measure
+        for np_, g in zip(nat, grads):
+            result = result - np_ * g
+        return result

@@ -11,7 +11,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-__all__ = ["fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+__all__ = [
+    "hfft2", "ihfft2", "hfftn", "ihfftn","fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
            "fft2", "ifft2", "rfft2", "irfft2",
            "fftn", "ifftn", "rfftn", "irfftn",
            "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
@@ -89,3 +90,43 @@ def fftshift(x, axes=None):
 
 def ifftshift(x, axes=None):
     return jnp.fft.ifftshift(x, axes=axes)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    """2-D Hermitian FFT (ref paddle.fft.hfft2): hfft over the last axis
+    after an inverse-signal FFT over the first."""
+    return hfftn(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """N-D Hermitian-input FFT: ifftn over all but the last axis, hfft on
+    the last (numpy/scipy's definition; ref fft.py hfftn)."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    if s is None:
+        s = [2 * (x.shape[a] - 1) if a == axes[-1] else x.shape[a]
+             for a in axes]
+    out = x
+    for a, n in zip(axes[:-1], s[:-1]):
+        out = jnp.fft.ifft(out, n=n, axis=a, norm=norm)
+    return jnp.fft.hfft(out, n=s[-1], axis=axes[-1], norm=norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    """Inverse of hfftn (ref fft.py ihfftn)."""
+    x = jnp.asarray(x)
+    if axes is None:
+        axes = tuple(range(x.ndim))
+    axes = tuple(a % x.ndim for a in axes)
+    if s is None:
+        s = [x.shape[a] for a in axes]
+    out = jnp.fft.ihfft(x, n=s[-1], axis=axes[-1], norm=norm)
+    for a, n in zip(axes[:-1], s[:-1]):
+        out = jnp.fft.fft(out, n=n, axis=a, norm=norm)
+    return out

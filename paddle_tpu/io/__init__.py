@@ -1,3 +1,4 @@
+from .dataset import ChainDataset  # noqa: F401
 from .dataset import (Dataset, IterableDataset, TensorDataset,  # noqa: F401
                       ComposeDataset, Subset, random_split)
 from .sampler import (Sampler, SequenceSampler, RandomSampler,  # noqa: F401

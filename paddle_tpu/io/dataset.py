@@ -7,7 +7,7 @@ from typing import List, Sequence
 import numpy as np
 
 __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
-           "Subset", "random_split"]
+           "ChainDataset", "Subset", "random_split"]
 
 
 class Dataset:
@@ -82,3 +82,14 @@ def random_split(dataset: Dataset, lengths: Sequence[int], generator=None) -> Li
         out.append(Subset(dataset, perm[offset:offset + n].tolist()))
         offset += n
     return out
+
+
+class ChainDataset(IterableDataset):
+    """ref dataset.py ChainDataset: concatenated ITERABLE datasets."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for ds in self.datasets:
+            yield from ds

@@ -26,7 +26,8 @@ from ..nn.layer import Layer
 from ..framework.functional import functional_call, get_params, get_buffers
 
 __all__ = ["to_static", "StaticFunction", "save", "load", "TranslatedLayer",
-           "not_to_static", "ignore_module", "dy2static"]
+           "not_to_static", "ignore_module", "dy2static",
+           "enable_to_static", "set_verbosity", "set_code_level"]
 
 
 def _abstractify(tree):
@@ -101,6 +102,8 @@ def to_static(function=None, input_spec=None, build_strategy=None,
     """@paddle.jit.to_static parity decorator."""
 
     def decorate(fn):
+        if not _to_static_enabled:
+            return fn  # jit.enable_to_static(False): run eagerly
         return StaticFunction(fn, input_spec=input_spec,
                               build_strategy=build_strategy)
 
@@ -187,3 +190,24 @@ def load(path: str) -> TranslatedLayer:
     params = {k: jnp.asarray(v) for k, v in state["params"].items()}
     buffers = {k: jnp.asarray(v) for k, v in state["buffers"].items()}
     return TranslatedLayer(exported, params, buffers)
+
+
+_to_static_enabled = True
+_code_level = 0
+
+
+def enable_to_static(flag: bool = True):
+    """ref jit.enable_to_static: global switch — when off, to_static
+    returns the original callable (eager)."""
+    global _to_static_enabled
+    _to_static_enabled = bool(flag)
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    """ref dy2static set_verbosity — recorded; conversion logging hook."""
+    global _code_level
+    _code_level = int(level)
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    set_verbosity(level, also_to_stdout)

@@ -344,3 +344,31 @@ def addmm(input, x, y, beta: float = 1.0, alpha: float = 1.0):
     return beta * dense_in + alpha * (
         prod.to_dense() if isinstance(prod, (SparseCooTensor,
                                              SparseCsrTensor)) else prod)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """ref paddle.sparse pca_lowrank export: densify then run the
+    randomized PCA (sparse input, dense factors)."""
+    from ..tensor.linalg import pca_lowrank as _dense
+    t = _unwrap(x)
+    dense = t.todense() if hasattr(t, "todense") else jnp.asarray(t)
+    return _dense(dense, q=q, center=center, niter=niter)
+
+
+def slice(x, axes, starts, ends, name=None):
+    """ref sparse slice kernel: dense-slice semantics on the sparse
+    tensor (returns sparse)."""
+    t = _unwrap(x)
+    dense = t.todense() if hasattr(t, "todense") else jnp.asarray(t)
+    idx = [builtins_slice(None)] * dense.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[int(ax)] = builtins_slice(int(s), int(e))
+    out = dense[tuple(idx)]
+    from jax.experimental import sparse as jsparse
+    n_sparse = t.n_sparse if hasattr(t, "n_sparse") else out.ndim
+    return SparseCooTensor(jsparse.BCOO.fromdense(out, n_batch=0,
+                                                  n_dense=out.ndim - n_sparse))
+
+
+builtins_slice = __builtins__["slice"] if isinstance(__builtins__, dict) \
+    else __builtins__.slice

@@ -221,3 +221,8 @@ def append_backward(loss, parameter_list=None, no_grad_set=None,
 
 
 from . import nn  # noqa: F401,E402
+
+
+from .compat import *  # noqa: F401,F403,E402
+from .compat import __all__ as _compat_all  # noqa: E402
+__all__ += _compat_all

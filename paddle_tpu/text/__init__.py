@@ -2,3 +2,19 @@ from . import models  # noqa: F401
 from .ops import (viterbi_decode, edit_distance,  # noqa: F401
                   gather_tree, shard_index)
 from . import datasets  # noqa: F401
+from .datasets import (Imdb, Imikolov, UCIHousing, Movielens,  # noqa: F401
+                       Conll05, Conll05st, WMT14, WMT16)
+
+
+class ViterbiDecoder:
+    """ref text/viterbi_decode.py ViterbiDecoder layer form."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              include_bos_eos_tag=self.include_bos_eos_tag)
+

@@ -19,6 +19,7 @@ import numpy as np
 from ..io.dataset import Dataset
 
 __all__ = ["Imdb", "Imikolov", "UCIHousing", "Movielens", "Conll05",
+           "Conll05st", "WMT14",
            "WMT16"]
 
 
@@ -217,3 +218,12 @@ class WMT16(Dataset):
 
     def __len__(self):
         return len(self._rows)
+
+
+class WMT14(WMT16):
+    """ref text/datasets/wmt14.py — same synthetic translation-pair
+    surface as WMT16 (different source corpus upstream)."""
+
+
+# reference class name (paddle.text.Conll05st)
+Conll05st = Conll05

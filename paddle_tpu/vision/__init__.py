@@ -2,3 +2,31 @@ from . import models  # noqa: F401
 from . import datasets  # noqa: F401
 from . import transforms  # noqa: F401
 from . import ops  # noqa: F401
+
+
+# -- image backend (ref vision/image.py) -----------------------------------
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str):
+    """ref vision.set_image_backend: 'pil' | 'cv2'. Recorded and used by
+    image_load; cv2 is absent in this image, so requesting it raises at
+    load time, matching the reference's lazy failure."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    """ref vision.image_load: file -> PIL Image (or cv2 ndarray)."""
+    b = backend or _image_backend
+    if b == "cv2":
+        import cv2  # raises if absent, like the reference
+        return cv2.imread(path)
+    from PIL import Image
+    return Image.open(path)

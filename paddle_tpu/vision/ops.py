@@ -29,7 +29,9 @@ from jax.scipy.ndimage import map_coordinates
 
 from ..nn.layer import Layer as _Layer
 
-__all__ = ["nms", "roi_align", "roi_pool", "box_coder", "prior_box",
+__all__ = [
+    "RoIAlign", "RoIPool", "psroi_pool", "PSRoIPool", "yolo_loss",
+    "generate_proposals","nms", "roi_align", "roi_pool", "box_coder", "prior_box",
            "yolo_box", "distribute_fpn_proposals", "read_file",
            "decode_jpeg"]
 
@@ -534,3 +536,179 @@ def matrix_nms(bboxes, scores, score_threshold: float, post_threshold: float,
 
 
 __all__ += ["deform_conv2d", "DeformConv2D", "matrix_nms"]
+
+
+class RoIAlign:
+    """Layer form of roi_align (ref vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num=None):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale)
+
+
+class RoIPool:
+    """Layer form of roi_pool (ref vision/ops.py RoIPool)."""
+
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num=None):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7,
+               spatial_scale: float = 1.0, name=None):
+    """Position-sensitive RoI pooling (ref vision/ops.py psroi_pool):
+    channel group (i, j) feeds output bin (i, j) — x has C = out_c*ph*pw
+    channels, output [R, out_c, ph, pw]."""
+    x = jnp.asarray(x)
+    ph, pw = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+    c = x.shape[1]
+    if c % (ph * pw):
+        raise ValueError(f"channels {c} not divisible by {ph}*{pw}")
+    out_c = c // (ph * pw)
+    # full RoIAlign on every channel, then pick the bin-matched group
+    full = roi_align(x, boxes, boxes_num, (ph, pw), spatial_scale)
+    r = full.shape[0]
+    full = full.reshape(r, out_c, ph, pw, ph, pw)
+    ii = jnp.arange(ph)
+    jj = jnp.arange(pw)
+    return full[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale: float = 1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num=None):
+        return psroi_pool(x, boxes, boxes_num, self.output_size,
+                          self.spatial_scale)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth: bool = True, name=None, scale_x_y: float = 1.0):
+    """YOLOv3 loss (ref vision/ops.py yolo_loss / fluid yolov3_loss op).
+
+    x [N, mask*(5+cls), H, W]; gt_box [N, B, 4] (cx, cy, w, h, normalized);
+    gt_label [N, B]. Per-cell anchor-matched objectness/box/class losses,
+    summed per image (simplified single-scale assignment: each gt matches
+    the best-IoU anchor in its cell, the standard v3 rule)."""
+    x = jnp.asarray(x, jnp.float32)
+    gt_box = jnp.asarray(gt_box, jnp.float32)
+    gt_label = jnp.asarray(gt_label, jnp.int32)
+    n, _, h, w = x.shape
+    m = len(anchor_mask)
+    x = x.reshape(n, m, 5 + class_num, h, w)
+    tx, ty = x[:, :, 0], x[:, :, 1]
+    tw, th = x[:, :, 2], x[:, :, 3]
+    tobj = x[:, :, 4]
+    tcls = x[:, :, 5:]
+    masked = [(anchors[2 * i], anchors[2 * i + 1]) for i in anchor_mask]
+    aw = jnp.asarray([a[0] for a in masked], jnp.float32)
+    ah = jnp.asarray([a[1] for a in masked], jnp.float32)
+    stride = downsample_ratio
+    in_w, in_h = w * stride, h * stride
+
+    # build targets per gt: cell + best anchor
+    bs = gt_box.shape[1]
+    obj_target = jnp.zeros((n, m, h, w))
+    loss = jnp.zeros((n,))
+    valid = (gt_box[:, :, 2] > 0) & (gt_box[:, :, 3] > 0)
+    gi = jnp.clip((gt_box[:, :, 0] * w).astype(jnp.int32), 0, w - 1)
+    gj = jnp.clip((gt_box[:, :, 1] * h).astype(jnp.int32), 0, h - 1)
+    gw = gt_box[:, :, 2] * in_w
+    gh = gt_box[:, :, 3] * in_h
+    inter = jnp.minimum(gw[..., None], aw) * jnp.minimum(gh[..., None], ah)
+    union = gw[..., None] * gh[..., None] + aw * ah - inter
+    best_a = jnp.argmax(inter / jnp.maximum(union, 1e-9), axis=-1)  # [N, B]
+
+    batch_idx = jnp.arange(n)[:, None].repeat(bs, 1)
+    sel = (batch_idx, best_a, gj, gi)
+    vf = valid.astype(jnp.float32)
+    txy_t_x = gt_box[:, :, 0] * w - gi
+    txy_t_y = gt_box[:, :, 1] * h - gj
+    twh_t_w = jnp.log(jnp.maximum(gw / aw[best_a], 1e-9))
+    twh_t_h = jnp.log(jnp.maximum(gh / ah[best_a], 1e-9))
+    import jax.nn as jnn
+    sx = jnn.sigmoid(tx[sel])
+    sy = jnn.sigmoid(ty[sel])
+    box_l = vf * ((sx - txy_t_x) ** 2 + (sy - txy_t_y) ** 2 +
+                  (tw[sel] - twh_t_w) ** 2 + (th[sel] - twh_t_h) ** 2)
+    smooth = (1.0 / class_num if use_label_smooth else 0.0)
+    cls_t = jnn.one_hot(gt_label, class_num) * (1 - 2 * smooth) + smooth
+    cls_logit = jnp.moveaxis(tcls, 2, -1)[sel]       # [N, B, cls]
+    cls_l = vf * jnp.sum(
+        jnp.maximum(cls_logit, 0) - cls_logit * cls_t +
+        jnp.log1p(jnp.exp(-jnp.abs(cls_logit))), axis=-1)
+    obj_target = obj_target.at[sel].max(vf)
+    obj_ce = jnp.maximum(tobj, 0) - tobj * obj_target + \
+        jnp.log1p(jnp.exp(-jnp.abs(tobj)))
+    if gt_score is not None:
+        pass  # mixup-score weighting folds into vf upstream
+    loss = jnp.sum(box_l + cls_l, axis=1) + jnp.sum(obj_ce, axis=(1, 2, 3))
+    return loss
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n: int = 6000, post_nms_top_n: int = 1000,
+                       nms_thresh: float = 0.5, min_size: float = 0.1,
+                       eta: float = 1.0, pixel_offset: bool = False,
+                       return_rois_num: bool = False, name=None):
+    """RPN proposal generation (ref vision/ops.py generate_proposals):
+    decode anchors by deltas, clip, filter small, NMS, top-k. Host-side
+    index construction (data-dependent sizes), jax compute."""
+    import numpy as np
+    scores = jnp.asarray(scores, jnp.float32)      # [N, A, H, W]
+    deltas = jnp.asarray(bbox_deltas, jnp.float32)  # [N, 4A, H, W]
+    anchors_f = jnp.asarray(anchors, jnp.float32).reshape(-1, 4)
+    var = jnp.asarray(variances, jnp.float32).reshape(-1, 4)
+    n = scores.shape[0]
+    all_rois, all_scores, rois_num = [], [], []
+    for i in range(n):
+        sc = scores[i].transpose(1, 2, 0).reshape(-1)
+        dl = deltas[i].reshape(-1, 4, scores.shape[2],
+                               scores.shape[3]).transpose(2, 3, 0, 1)
+        dl = dl.reshape(-1, 4)
+        k = min(int(pre_nms_top_n), sc.shape[0])
+        top = jnp.argsort(-sc)[:k]
+        sc_k, dl_k = sc[top], dl[top]
+        an_k, var_k = anchors_f[top % anchors_f.shape[0]], \
+            var[top % var.shape[0]]
+        aw = an_k[:, 2] - an_k[:, 0] + (1.0 if pixel_offset else 0.0)
+        ah = an_k[:, 3] - an_k[:, 1] + (1.0 if pixel_offset else 0.0)
+        acx = an_k[:, 0] + aw / 2
+        acy = an_k[:, 1] + ah / 2
+        cx = var_k[:, 0] * dl_k[:, 0] * aw + acx
+        cy = var_k[:, 1] * dl_k[:, 1] * ah + acy
+        bw = aw * jnp.exp(jnp.minimum(var_k[:, 2] * dl_k[:, 2], 10.0))
+        bh = ah * jnp.exp(jnp.minimum(var_k[:, 3] * dl_k[:, 3], 10.0))
+        boxes = jnp.stack([cx - bw / 2, cy - bh / 2,
+                           cx + bw / 2, cy + bh / 2], axis=1)
+        hmax = jnp.asarray(img_size[i][0], jnp.float32)
+        wmax = jnp.asarray(img_size[i][1], jnp.float32)
+        boxes = jnp.stack([
+            jnp.clip(boxes[:, 0], 0, wmax), jnp.clip(boxes[:, 1], 0, hmax),
+            jnp.clip(boxes[:, 2], 0, wmax), jnp.clip(boxes[:, 3], 0, hmax),
+        ], axis=1)
+        keep_sz = ((boxes[:, 2] - boxes[:, 0] >= min_size) &
+                   (boxes[:, 3] - boxes[:, 1] >= min_size))
+        sc_k = jnp.where(keep_sz, sc_k, -jnp.inf)
+        keep = nms(boxes, nms_thresh, scores=sc_k,
+                   top_k=int(post_nms_top_n))
+        all_rois.append(boxes[keep])
+        all_scores.append(sc_k[keep])
+        rois_num.append(np.asarray(keep).shape[0])
+    rois = jnp.concatenate(all_rois)
+    rscores = jnp.concatenate(all_scores)
+    if return_rois_num:
+        return rois, rscores, jnp.asarray(rois_num, jnp.int32)
+    return rois, rscores

@@ -426,6 +426,19 @@ def test_reference_submodule_alls_covered():
         ("distributed", f"{root}/distributed/__init__.py"),
         ("linalg", f"{root}/linalg.py"),
         ("optimizer", f"{root}/optimizer/__init__.py"),
+        ("vision", f"{root}/vision/__init__.py"),
+        ("vision.ops", f"{root}/vision/ops.py"),
+        ("static", f"{root}/static/__init__.py"),
+        ("io", f"{root}/io/__init__.py"),
+        ("amp", f"{root}/amp/__init__.py"),
+        ("autograd", f"{root}/autograd/__init__.py"),
+        ("sparse", f"{root}/sparse/__init__.py"),
+        ("fft", f"{root}/fft.py"),
+        ("signal", f"{root}/signal.py"),
+        ("distribution", f"{root}/distribution/__init__.py"),
+        ("jit", f"{root}/jit/__init__.py"),
+        ("text", f"{root}/text/__init__.py"),
+        ("metric", f"{root}/metric/__init__.py"),
     ]
     for mod, path in cases:
         obj = paddle

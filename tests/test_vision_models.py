@@ -102,4 +102,7 @@ def test_space_to_depth_stem_equivalent():
     x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 32, 32, 3)),
                     jnp.float32)
     m1.eval(); m2.eval()
-    np.testing.assert_array_equal(np.asarray(m1(x)), np.asarray(m2(x)))
+    # mathematically exact; tiny fp tolerance because XLA may partition
+    # the conv differently on the multi-device CPU test mesh
+    np.testing.assert_allclose(np.asarray(m1(x)), np.asarray(m2(x)),
+                               atol=1e-5)
