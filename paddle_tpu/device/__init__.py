@@ -189,3 +189,79 @@ class _AcceleratorNamespace:
 
 tpu = _AcceleratorNamespace()
 cuda = tpu  # accelerator alias: cuda-namespace calls land on the TPU backend
+
+
+# -- compile-flag predicates + place shims (ref device/__init__.py) --------
+
+def get_cudnn_version():
+    """No CUDA in the TPU build (reference returns None when absent)."""
+    return None
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    """XPU = the accelerator family slot; the TPU fills it here."""
+    import jax
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    """XLA is this build's tensor compiler (CINN's role)."""
+    return True
+
+
+def is_compiled_with_custom_device(device_type: str = None) -> bool:
+    """PJRT is the custom-device plugin ABI; the tunneled TPU registers
+    through it."""
+    import jax
+    try:
+        return len(jax.devices()) > 0
+    except Exception:
+        return False
+
+
+def get_all_custom_device_type():
+    import jax
+    try:
+        return sorted({d.platform for d in jax.devices()})
+    except Exception:
+        return []
+
+
+def get_available_custom_device():
+    import jax
+    try:
+        return [str(d) for d in jax.devices()]
+    except Exception:
+        return []
+
+
+class XPUPlace:
+    """ref XPUPlace(dev_id) — accelerator placement token."""
+
+    def __init__(self, dev_id: int = 0):
+        self.dev_id = int(dev_id)
+
+    def __repr__(self):
+        return f"XPUPlace({self.dev_id})"
+
+
+class IPUPlace:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU hardware is not part of this build")
+
+
+def set_stream(stream=None):
+    """Streams are XLA-managed; accepted for call-site parity."""
+    return stream

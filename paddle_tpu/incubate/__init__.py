@@ -3,3 +3,87 @@ from . import asp  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import autotune  # noqa: F401
+
+
+# -- top-level incubate exports (ref incubate/__init__.py __all__) ---------
+from .optimizer import LookAhead, ModelAverage  # noqa: F401,E402
+from ..geometric import (segment_sum, segment_mean, segment_max,  # noqa: F401,E402
+                         segment_min)
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """ref incubate softmax_mask_fuse: softmax(x + mask) in one pass
+    (XLA fuses; the op exists for call-site parity)."""
+    import jax
+    import jax.numpy as jnp
+    return jax.nn.softmax(jnp.asarray(x) + jnp.asarray(mask), axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """ref softmax_mask_fuse_upper_triangle: causal-masked softmax on
+    [B, H, S, S] scores (upper triangle masked)."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.asarray(x)
+    s = x.shape[-1]
+    mask = jnp.tril(jnp.ones((s, s), bool))
+    return jax.nn.softmax(jnp.where(mask, x, -1e9), axis=-1)
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type: str = "sum",
+                    out_size=None, name=None):
+    """ref incubate graph_send_recv (now geometric.send_u_recv)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                           eids=None, return_eids=False, perm_buffer=None,
+                           flag_perm_buffer=False, name=None):
+    """ref incubate graph_sample_neighbors (now geometric.sample_neighbors)."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """ref incubate graph_reindex (now geometric.reindex_graph)."""
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """ref incubate graph_khop_sampler: chained neighbor sampling over
+    k hops (composed from sample_neighbors)."""
+    from ..geometric import sample_neighbors
+    import numpy as np
+    nodes = np.asarray(input_nodes)
+    all_rows, all_counts = [], []
+    frontier = nodes
+    for k in sample_sizes:
+        out_neighbors, out_count = sample_neighbors(row, colptr, frontier,
+                                                    sample_size=k)[:2]
+        all_rows.append(out_neighbors)
+        all_counts.append(out_count)
+        frontier = np.unique(np.asarray(out_neighbors))
+    import jax.numpy as jnp
+    return (jnp.concatenate([jnp.asarray(r) for r in all_rows]),
+            jnp.concatenate([jnp.asarray(c) for c in all_counts]),
+            jnp.asarray(frontier))
+
+
+def identity_loss(x, reduction="none"):
+    """ref incubate.identity_loss (IPU loss anchor op): marks x as the
+    loss; reduction in {none(0), sum(1), mean(2)}."""
+    import jax.numpy as jnp
+    x = jnp.asarray(x)
+    red = {0: "sum", 1: "mean", 2: "none", "sum": "sum", "mean": "mean",
+           "none": "none"}[reduction]
+    if red == "sum":
+        return jnp.sum(x)
+    if red == "mean":
+        return jnp.mean(x)
+    return x

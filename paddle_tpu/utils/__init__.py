@@ -130,3 +130,21 @@ def flops(net, input_size=None, inputs=None, custom_ops=None,
     if print_detail:
         print(f"Total Flops: {total} (XLA compiled cost analysis)")
     return total
+
+
+def require_version(min_version: str, max_version=None):
+    """ref utils.require_version: assert the installed framework version
+    is within [min_version, max_version]."""
+    from .. import __version__
+
+    def parse(v):
+        return tuple(int(p) for p in str(v).split(".")[:3] if p.isdigit())
+
+    cur = parse(__version__)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"paddle_tpu >= {min_version} required, found {__version__}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"paddle_tpu <= {max_version} required, found {__version__}")
+    return True

@@ -241,3 +241,42 @@ class ImageFolder(Dataset):
 
     def __len__(self):
         return len(self.samples)
+
+
+class VOC2012(Dataset):
+    """ref vision/datasets/voc2012.py: segmentation pairs (image, label
+    mask). No network in this environment — deterministic synthetic scenes
+    (colored rectangles with matching class masks), same API."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 transform=None, download: bool = True,
+                 backend: str = "numpy", synthetic_size: Optional[int] = None):
+        self.mode = mode
+        self.transform = transform
+        n = synthetic_size or (100 if mode == "train" else 20)
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        self._images = []
+        self._labels = []
+        for _ in range(n):
+            img = rng.integers(0, 64, (64, 64, 3)).astype(np.uint8)
+            mask = np.zeros((64, 64), np.uint8)
+            for _ in range(int(rng.integers(1, 4))):
+                cls = int(rng.integers(1, 21))
+                y0, x0 = rng.integers(0, 40, 2)
+                hh, ww = rng.integers(8, 24, 2)
+                img[y0:y0 + hh, x0:x0 + ww] = (cls * 12) % 255
+                mask[y0:y0 + hh, x0:x0 + ww] = cls
+            self._images.append(img)
+            self._labels.append(mask)
+
+    def __getitem__(self, idx):
+        img, mask = self._images[idx], self._labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self._images)
+
+
+__all__ += ["VOC2012"]

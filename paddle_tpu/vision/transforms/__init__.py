@@ -294,3 +294,100 @@ class RandomErasing:
                 j = int(self._rng.integers(0, w - ew + 1))
                 return F.erase(arr, i, j, eh, ew, self.value)
         return arr
+
+
+# -- reference top-level functional re-exports + remaining classes ---------
+from .functional import (to_tensor, hflip, vflip, resize, pad, affine,  # noqa: F401,E402
+                         rotate, perspective, to_grayscale, crop,
+                         center_crop, adjust_brightness, adjust_contrast,
+                         adjust_hue, normalize, erase)
+
+
+class BaseTransform:
+    """ref transforms/transforms.py BaseTransform: keys-aware transform
+    base — subclasses implement _apply_image (and optionally _apply_*)."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+    def __call__(self, inputs):
+        if not isinstance(inputs, (list, tuple)):
+            return self._apply_image(inputs)
+        outs = []
+        for key, data in zip(self.keys, inputs):
+            fn = getattr(self, f"_apply_{key}", None)
+            outs.append(fn(data) if fn else data)
+        return tuple(outs)
+
+
+class RandomAffine(BaseTransform):
+    """ref RandomAffine: random rotation/translate/scale/shear."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        rng = np.random.default_rng()
+        angle = rng.uniform(*self.degrees)
+        arr = np.asarray(img)
+        h, w = (arr.shape[:2] if arr.ndim == 2 or arr.shape[-1] <= 4
+                else arr.shape[1:3])
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = rng.uniform(-self.translate[0], self.translate[0]) * w
+            ty = rng.uniform(-self.translate[1], self.translate[1]) * h
+        sc = rng.uniform(*self.scale) if self.scale else 1.0
+        sh = rng.uniform(*self.shear) if self.shear else 0.0
+        return affine(img, angle, (tx, ty), sc, sh,
+                      interpolation=self.interpolation, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """ref RandomPerspective: random corner displacement warp."""
+
+    def __init__(self, prob: float = 0.5, distortion_scale: float = 0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        rng = np.random.default_rng()
+        if rng.random() >= self.prob:
+            return img
+        arr = np.asarray(img)
+        h, w = (arr.shape[:2] if arr.ndim == 2 or arr.shape[-1] <= 4
+                else arr.shape[1:3])
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(rng.integers(0, dx + 1), rng.integers(0, dy + 1)),
+               (w - 1 - rng.integers(0, dx + 1), rng.integers(0, dy + 1)),
+               (w - 1 - rng.integers(0, dx + 1),
+                h - 1 - rng.integers(0, dy + 1)),
+               (rng.integers(0, dx + 1), h - 1 - rng.integers(0, dy + 1))]
+        return perspective(img, start, end,
+                           interpolation=self.interpolation, fill=self.fill)
+
+
+__all__ += ["BaseTransform", "RandomAffine", "RandomPerspective",
+            "to_tensor", "hflip", "vflip", "resize", "pad", "affine",
+            "rotate", "perspective", "to_grayscale", "crop", "center_crop",
+            "adjust_brightness", "adjust_contrast", "adjust_hue",
+            "normalize", "erase"]

@@ -294,3 +294,83 @@ def erase(img, i: int, j: int, h: int, w: int, v, inplace: bool = False):
     else:
         arr[i:i + h, j:j + w] = v
     return arr
+
+
+def _inverse_sample(arr, inv_map, interpolation, fill):
+    """Sample arr at inverse-mapped coords (shared by affine/perspective)."""
+    h, w = arr.shape[:2]
+    oh, ow = h, w
+    yy, xx = np.meshgrid(np.arange(oh, dtype=np.float64),
+                         np.arange(ow, dtype=np.float64), indexing="ij")
+    sy, sx = inv_map(yy, xx)
+    syi = np.round(sy).astype(int)
+    sxi = np.round(sx).astype(int)
+    valid = (syi >= 0) & (syi < h) & (sxi >= 0) & (sxi < w)
+    out = np.full((oh, ow, arr.shape[2]), fill, dtype=arr.dtype)
+    out[valid] = arr[syi[valid], sxi[valid]]
+    return out, valid
+
+
+def affine(img, angle: float, translate, scale: float, shear,
+           interpolation: str = "nearest", fill=0, center=None):
+    """Affine transform (ref transforms/functional.py affine): rotate +
+    translate + scale + shear about the image centre."""
+    arr, was_chw = _as_hwc(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2, (w - 1) / 2) if center is None else \
+        (center[1], center[0])
+    if np.isscalar(shear):
+        shear = (float(shear), 0.0)
+    rad = np.deg2rad(angle)
+    sx_r, sy_r = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # forward matrix: R @ Shear * scale
+    a = np.cos(rad + sy_r) * scale
+    b = -np.sin(rad + sy_r) * scale
+    c = np.sin(rad + sx_r) * scale
+    d = np.cos(rad + sx_r) * scale
+    m = np.array([[d, -b], [-c, a]]) / (a * d - b * c)  # inverse
+
+    def inv(yy, xx):
+        ty, tx = translate[1], translate[0]
+        ry = yy - cy - ty
+        rx = xx - cx - tx
+        sy = m[0, 0] * ry + m[0, 1] * rx + cy
+        sxx = m[1, 0] * ry + m[1, 1] * rx + cx
+        return sy, sxx
+
+    out, _ = _inverse_sample(arr, inv, interpolation, fill)
+    if np.asarray(img).ndim == 2:
+        return out[..., 0]
+    return _restore(out, was_chw)
+
+
+def perspective(img, startpoints, endpoints, interpolation: str = "nearest",
+                fill=0):
+    """Perspective warp mapping startpoints -> endpoints (ref
+    transforms/functional.py perspective): solve the 8-dof homography,
+    inverse-sample."""
+    arr, was_chw = _as_hwc(img)
+    sp = np.asarray(startpoints, np.float64)   # [(x, y)] * 4
+    ep = np.asarray(endpoints, np.float64)
+    # homography H with ep = H @ sp; build from endpoint->startpoint for
+    # inverse sampling
+    A = []
+    bvec = []
+    for (xs, ys), (xe, ye) in zip(sp, ep):
+        A.append([xe, ye, 1, 0, 0, 0, -xs * xe, -xs * ye])
+        bvec.append(xs)
+        A.append([0, 0, 0, xe, ye, 1, -ys * xe, -ys * ye])
+        bvec.append(ys)
+    coef = np.linalg.solve(np.asarray(A), np.asarray(bvec))
+    hmat = np.append(coef, 1.0).reshape(3, 3)
+
+    def inv(yy, xx):
+        denom = hmat[2, 0] * xx + hmat[2, 1] * yy + hmat[2, 2]
+        sx = (hmat[0, 0] * xx + hmat[0, 1] * yy + hmat[0, 2]) / denom
+        sy = (hmat[1, 0] * xx + hmat[1, 1] * yy + hmat[1, 2]) / denom
+        return sy, sx
+
+    out, _ = _inverse_sample(arr, inv, interpolation, fill)
+    if np.asarray(img).ndim == 2:
+        return out[..., 0]
+    return _restore(out, was_chw)

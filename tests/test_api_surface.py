@@ -439,6 +439,13 @@ def test_reference_submodule_alls_covered():
         ("jit", f"{root}/jit/__init__.py"),
         ("text", f"{root}/text/__init__.py"),
         ("metric", f"{root}/metric/__init__.py"),
+        ("incubate", f"{root}/incubate/__init__.py"),
+        ("utils", f"{root}/utils/__init__.py"),
+        ("device", f"{root}/device/__init__.py"),
+        ("onnx", f"{root}/onnx/__init__.py"),
+        ("vision.transforms", f"{root}/vision/transforms/__init__.py"),
+        ("vision.models", f"{root}/vision/models/__init__.py"),
+        ("vision.datasets", f"{root}/vision/datasets/__init__.py"),
     ]
     for mod, path in cases:
         obj = paddle
