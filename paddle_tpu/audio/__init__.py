@@ -2,3 +2,5 @@ from . import functional  # noqa: F401
 from . import features  # noqa: F401
 from . import backends  # noqa: F401
 from .backends import load, save, info  # noqa: F401
+
+from . import datasets  # noqa: F401,E402

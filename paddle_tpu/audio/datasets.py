@@ -1,0 +1,54 @@
+"""paddle.audio.datasets parity (ref python/paddle/audio/datasets/):
+TESS and ESC50 — synthetic waveform fallbacks (no network), same API."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["TESS", "ESC50"]
+
+
+class _SyntheticAudio(Dataset):
+    n_classes = 10
+    sample_rate = 16000
+
+    def __init__(self, mode: str = "train", feat_type: str = "raw",
+                 archive=None, synthetic_size: Optional[int] = None,
+                 **kwargs):
+        self.mode = mode
+        self.feat_type = feat_type
+        n = synthetic_size or (80 if mode == "train" else 20)
+        rng = np.random.default_rng(0 if mode == "train" else 1)
+        self._labels = rng.integers(0, self.n_classes, n)
+        t = np.arange(self.sample_rate) / self.sample_rate
+        self._waves = [
+            (0.5 * np.sin(2 * np.pi * (220 + 40 * lbl) * t)
+             + 0.05 * rng.standard_normal(self.sample_rate)
+             ).astype(np.float32)
+            for lbl in self._labels]
+
+    def __getitem__(self, idx):
+        wav = self._waves[idx]
+        if self.feat_type != "raw":
+            from .features import LogMelSpectrogram
+            import jax.numpy as jnp
+            wav = np.asarray(LogMelSpectrogram(
+                sr=self.sample_rate)(jnp.asarray(wav[None]))[0])
+        return wav, int(self._labels[idx])
+
+    def __len__(self):
+        return len(self._waves)
+
+
+class TESS(_SyntheticAudio):
+    """Toronto emotional speech set surface (ref audio/datasets/tess.py)."""
+    n_classes = 7
+
+
+class ESC50(_SyntheticAudio):
+    """ESC-50 environmental sounds surface (ref audio/datasets/esc50.py)."""
+    n_classes = 50

@@ -8,3 +8,106 @@ from .role_maker import (PaddleCloudRoleMaker,  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import utils  # noqa: F401
 from .layers import mpu  # noqa: F401
+from ..topology import (CommunicateTopology,  # noqa: F401,E402
+                        HybridCommunicateGroup)
+
+
+class Fleet:
+    """ref fleet/base/fleet_base.py Fleet: the class behind the module-
+    level singleton — methods delegate to the module functions (this build
+    keeps the functional surface primary)."""
+
+    def __init__(self):
+        from . import fleet as _f
+        self._m = _f
+        self.util = UtilBase()
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        return self._m.init(role_maker, is_collective, strategy)
+
+    def distributed_model(self, model):
+        return self._m.distributed_model(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        return self._m.distributed_optimizer(optimizer)
+
+    def worker_index(self):
+        return self._m.worker_index()
+
+    def worker_num(self):
+        return self._m.worker_num()
+
+    def is_first_worker(self):
+        return self._m.is_first_worker()
+
+    def is_server(self):
+        return self._m.is_server()
+
+    def barrier_worker(self):
+        self.util.barrier()
+
+
+class UtilBase:
+    """ref fleet/base/util_factory.py UtilBase: rank-0 helpers over the
+    host collective plane."""
+
+    def all_reduce(self, input, mode: str = "sum", comm_world: str = "worker"):
+        from .. import collective as C
+        import numpy as np
+        out = C.all_reduce(np.asarray(input), op=mode)
+        return np.asarray(out)
+
+    def barrier(self, comm_world: str = "worker"):
+        from .. import collective as C
+        C.barrier()
+
+    def all_gather(self, input, comm_world: str = "worker"):
+        from .. import collective as C
+        import numpy as np
+        return list(np.asarray(C.all_gather(np.asarray(input))))
+
+    def get_file_shard(self, files):
+        from .. import env as dist_env
+        rank, world = dist_env.get_rank(), dist_env.get_world_size()
+        return [f for i, f in enumerate(sorted(files)) if i % world == rank]
+
+    def print_on_rank(self, message: str, rank_id: int = 0):
+        from .. import env as dist_env
+        if dist_env.get_rank() == rank_id:
+            print(message, flush=True)
+
+
+class MultiSlotDataGenerator:
+    """ref distributed/fleet/data_generator: user subclasses implement
+    generate_sample(line) yielding [(slot_name, [values]), ...]; run()
+    streams stdin lines to stdout in the MultiSlot text format consumed
+    by the native data_feed parser."""
+
+    def __init__(self):
+        self._proto_info = None
+
+    def generate_sample(self, line):
+        raise NotImplementedError
+
+    def _format_value(self, v):
+        return str(v)
+
+    def _emit(self, sample):
+        parts = []
+        for _name, values in sample:
+            parts.append(str(len(values)))
+            parts.extend(self._format_value(v) for v in values)
+        return " ".join(parts)
+
+    def run_from_stdin(self):
+        import sys
+        for line in sys.stdin:
+            gen = self.generate_sample(line)
+            for sample in (gen() if callable(gen) else gen):
+                sys.stdout.write(self._emit(sample) + "\n")
+
+    run = run_from_stdin
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    """String-slot variant (values pass through verbatim)."""

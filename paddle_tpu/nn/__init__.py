@@ -5,3 +5,4 @@ from .layers import *  # noqa: F401,F403
 from .rnn import *  # noqa: F401,F403
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm,  # noqa: F401
                    ClipGradByValue)
+from . import utils  # noqa: F401,E402

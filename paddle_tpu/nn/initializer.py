@@ -20,6 +20,7 @@ from ..core import dtype as dtypes
 from ..core.random import next_key
 
 __all__ = [
+    "Orthogonal", "Dirac", "Bilinear", "set_global_initializer",
     "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
     "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
     "Assign", "calculate_gain",
@@ -156,3 +157,74 @@ class Assign(Initializer):
         if tuple(arr.shape) != tuple(shape):
             arr = arr.reshape(shape)
         return arr
+
+
+class Orthogonal(Initializer):
+    """ref initializer/orthogonal.py: QR-orthogonal init (gain-scaled)."""
+
+    def __init__(self, gain: float = 1.0, name=None):
+        self.gain = gain
+
+    def __call__(self, shape, dtype=None):
+        dtype = dtype or dtypes.get_default_dtype()
+        rows = shape[0]
+        cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
+        flat = jax.random.normal(next_key(), (max(rows, cols),
+                                              min(rows, cols)))
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diag(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """ref initializer/dirac.py: identity-preserving conv init (channel i
+    passes through at the kernel centre)."""
+
+    def __init__(self, groups: int = 1, name=None):
+        self.groups = groups
+
+    def __call__(self, shape, dtype=None):
+        dtype = dtype or dtypes.get_default_dtype()
+        out = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        centre = tuple(s // 2 for s in shape[2:])
+        per = max(oc // self.groups, 1)
+        for g in range(self.groups):
+            for i in range(min(per, ic)):
+                if g * per + i < oc:
+                    out[(g * per + i, i) + centre] = 1.0
+        return jnp.asarray(out).astype(dtype)
+
+
+class Bilinear(Initializer):
+    """ref initializer/Bilinear: upsampling-kernel init for transposed
+    convolutions."""
+
+    def __call__(self, shape, dtype=None):
+        dtype = dtype or dtypes.get_default_dtype()
+        kh, kw = shape[-2], shape[-1]
+        f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
+        c_h = f_h - 1 if kh % 2 == 1 else f_h - 0.5
+        c_w = f_w - 1 if kw % 2 == 1 else f_w - 0.5
+        og = np.ogrid[:kh, :kw]
+        filt = (1 - np.abs(og[0] - c_h) / f_h) * \
+               (1 - np.abs(og[1] - c_w) / f_w)
+        out = np.zeros(shape, np.float32)
+        out[...] = filt
+        return jnp.asarray(out).astype(dtype)
+
+
+_global_initializer = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """ref initializer/set_global_initializer: defaults consulted by
+    create_parameter when a layer supplies none."""
+    _global_initializer["weight"] = weight_init
+    _global_initializer["bias"] = bias_init
+
+
+def get_global_initializer(kind: str = "weight"):
+    return _global_initializer.get(kind)

@@ -1,0 +1,140 @@
+"""paddle.nn.utils parity (ref python/paddle/nn/utils/): weight_norm,
+spectral_norm wrapper, parameter/vector flattening, gradient clipping
+helpers.
+
+Functional-JAX adaptation: weight/spectral norm REPARAMETERIZE a layer's
+weight; here the reparameterization installs a compute hook on the Layer
+(weight_g/weight_v become the registered parameters; forward recomputes
+weight = g * v / ||v||), which the functional_call machinery traces like
+any other parameter use.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layer import Layer
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def _norm_except(v, dim: int):
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
+    """ref utils/weight_norm_hook.py: w = g * v / ||v|| with g = ||w||
+    along every axis but `dim`. Registers weight_g/weight_v and installs
+    a pre-forward recompute."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = 0
+    g = _norm_except(w, dim)
+    # register the reparameterized pair; drop the original parameter
+    layer._parameters.pop(name, None)
+    setattr(layer, name + "_g", g)
+    setattr(layer, name + "_v", w)
+    layer._weight_norm_cfg = (name, dim)
+
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        v = getattr(layer, name + "_v")
+        gg = getattr(layer, name + "_g")
+        object.__setattr__(layer, "_wn_weight",
+                           gg * v / jnp.maximum(_norm_except(v, dim), 1e-12))
+        # expose under the original name as a plain attribute (not a param)
+        layer.__dict__[name] = layer._wn_weight
+        return orig_forward(*args, **kwargs)
+
+    layer.forward = forward
+    layer.__dict__[name] = g * w / jnp.maximum(_norm_except(w, dim), 1e-12)
+    return layer
+
+
+def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
+    """Fold g*v/||v|| back into a single parameter."""
+    if not hasattr(layer, name + "_v"):
+        raise ValueError(f"layer has no weight norm on {name!r}")
+    v = getattr(layer, name + "_v")
+    g = getattr(layer, name + "_g")
+    cfg = getattr(layer, "_weight_norm_cfg", (name, 0))
+    w = g * v / jnp.maximum(_norm_except(v, cfg[1]), 1e-12)
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    layer.__dict__.pop(name, None)
+    setattr(layer, name, w)
+    if "forward" in layer.__dict__:
+        del layer.__dict__["forward"]  # restore the class forward
+    return layer
+
+
+def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0) -> Layer:
+    """ref utils/spectral_norm_hook.py: wraps the layer's weight with the
+    SpectralNorm layer's power iteration at forward time."""
+    from .layers import SpectralNorm
+    w = getattr(layer, name)
+    sn = SpectralNorm(w.shape, dim=dim, power_iters=n_power_iterations,
+                      epsilon=eps)
+    layer._spectral_norm = sn
+    orig_forward = layer.forward
+
+    def forward(*args, **kwargs):
+        layer.__dict__[name] = sn(getattr(layer, name + "_orig"))
+        return orig_forward(*args, **kwargs)
+
+    layer._parameters.pop(name, None)
+    setattr(layer, name + "_orig", w)
+    layer.__dict__[name] = w
+    layer.forward = forward
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    """ref utils/transform_parameters.py: flatten params into one vector."""
+    ps = list(parameters)
+    return jnp.concatenate([jnp.ravel(jnp.asarray(p)) for p in ps])
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Inverse of parameters_to_vector; returns the new parameter list
+    (functional: caller rebinds them)."""
+    ps = list(parameters)
+    out = []
+    off = 0
+    for p in ps:
+        n = int(np.prod(p.shape))
+        out.append(jnp.reshape(vec[off:off + n], p.shape).astype(p.dtype))
+        off += n
+    return out
+
+
+def clip_grad_norm_(parameters, max_norm: float, norm_type: float = 2.0,
+                    error_if_nonfinite: bool = False):
+    """ref utils/clip_grad_norm_: returns (clipped_grads, total_norm) —
+    functional form of the in-place torch-style API (grads are the
+    'parameters' here, matching how jax training loops hold them)."""
+    gs = list(parameters)
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray([jnp.max(jnp.abs(g)) for g in gs]))
+    else:
+        total = jnp.sum(jnp.asarray(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in gs])) ** (1.0 / norm_type)
+    if error_if_nonfinite:
+        if not bool(jnp.isfinite(total)):
+            raise RuntimeError("non-finite gradient norm")
+    scale = jnp.minimum(1.0, max_norm / jnp.maximum(total, 1e-12))
+    return [g * scale for g in gs], total
+
+
+def clip_grad_value_(parameters, clip_value: float):
+    """ref utils/clip_grad_value_: elementwise clamp to ±clip_value."""
+    return [jnp.clip(g, -clip_value, clip_value) for g in parameters]

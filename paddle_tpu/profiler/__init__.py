@@ -21,7 +21,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 import jax
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerState", "make_scheduler",
-           "export_chrome_tracing", "load_profiler_result", "SummaryView",
+           "export_chrome_tracing", "export_protobuf", "ProfilerTarget",
+           "SortedKeys", "load_profiler_result", "SummaryView",
            "monitor"]
 
 from . import monitor  # noqa: E402,F401  (stat registry + rank logger)
@@ -205,3 +206,51 @@ class SummaryView(Enum):
     KernelView = 4
     OperatorView = 5
     MemoryView = 6
+
+
+class ProfilerTarget(Enum):
+    """ref profiler.ProfilerTarget: what to trace. CPU + the accelerator
+    (the XLA device fills the GPU/CUSTOM_DEVICE slots)."""
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class SortedKeys(Enum):
+    """ref profiler.SortedKeys: summary-table sort orders."""
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    """ref profiler.export_protobuf: on_trace_ready handler writing the
+    raw trace payload (the XPlane protobuf jax.profiler already produced
+    in log_dir, plus the host-span dump)."""
+    import json
+    import shutil
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        # host spans as a JSON sidecar; device XPlane files are already
+        # protobuf — copy them over
+        events = [{"name": n, "begin_ns": b, "end_ns": e}
+                  for n, b, e in _host_events]
+        with open(os.path.join(
+                dir_name, f"{worker_name or 'worker'}_host.pb.json"),
+                "w") as f:
+            json.dump(events, f)
+        src_dir = os.path.join(prof.log_dir, "plugins", "profile")
+        if os.path.isdir(src_dir):
+            for sess in os.listdir(src_dir):
+                for fn in os.listdir(os.path.join(src_dir, sess)):
+                    if fn.endswith(".xplane.pb"):
+                        shutil.copy(os.path.join(src_dir, sess, fn),
+                                    os.path.join(dir_name, fn))
+    return handler

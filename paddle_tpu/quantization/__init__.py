@@ -26,6 +26,7 @@ from ..nn.layer import Layer
 from .. import nn
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "FakeQuanterWithAbsMax",
+           "BaseQuanter", "BaseObserver", "quanter",
            "AbsmaxObserver", "quant_dequant", "QuantedLinear",
            "QuantedConv2D"]
 
@@ -249,3 +250,53 @@ class PTQ:
 
 
 from .deploy import Int8Conv2D, Int8Linear, convert_to_int8  # noqa: F401,E402
+
+
+class BaseQuanter(Layer):
+    """ref quantization/base_quanter.py: abstract fake-quant module."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+    def quant_axis(self):
+        return None
+
+    def bit_length(self):
+        return 8
+
+
+class BaseObserver(BaseQuanter):
+    """ref quantization/base_observer.py: observers are quanters that also
+    watch ranges during calibration."""
+
+    def cal_thresholds(self):
+        raise NotImplementedError
+
+
+def quanter(class_name: str):
+    """ref quantization/factory.py quanter decorator: registers a quanter
+    class and synthesizes a same-named config factory."""
+    def decorate(cls):
+        import sys
+        mod = sys.modules[cls.__module__]
+
+        class _Factory:
+            def __init__(self, **kwargs):
+                self._kwargs = kwargs
+
+            def _instance(self):
+                return cls(**self._kwargs)
+
+            def __call__(self):
+                return self._instance()
+
+        _Factory.__name__ = class_name
+        setattr(mod, class_name, _Factory)
+        return cls
+    return decorate

@@ -446,6 +446,13 @@ def test_reference_submodule_alls_covered():
         ("vision.transforms", f"{root}/vision/transforms/__init__.py"),
         ("vision.models", f"{root}/vision/models/__init__.py"),
         ("vision.datasets", f"{root}/vision/datasets/__init__.py"),
+        ("nn.initializer", f"{root}/nn/initializer/__init__.py"),
+        ("nn.utils", f"{root}/nn/utils/__init__.py"),
+        ("distributed.fleet", f"{root}/distributed/fleet/__init__.py"),
+        ("distributed.sharding", f"{root}/distributed/sharding/__init__.py"),
+        ("profiler", f"{root}/profiler/__init__.py"),
+        ("quantization", f"{root}/quantization/__init__.py"),
+        ("audio", f"{root}/audio/__init__.py"),
     ]
     for mod, path in cases:
         obj = paddle
