@@ -29,7 +29,7 @@ class Fleet:
         return self._m.distributed_model(model)
 
     def distributed_optimizer(self, optimizer, strategy=None):
-        return self._m.distributed_optimizer(optimizer)
+        return self._m.distributed_optimizer(optimizer, strategy=strategy)
 
     def worker_index(self):
         return self._m.worker_index()

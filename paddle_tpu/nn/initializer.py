@@ -165,12 +165,10 @@ class Orthogonal(Initializer):
     def __init__(self, gain: float = 1.0, name=None):
         self.gain = gain
 
-    def __call__(self, shape, dtype=None):
-        dtype = dtype or dtypes.get_default_dtype()
+    def _init(self, shape, dtype, key):
         rows = shape[0]
         cols = int(np.prod(shape[1:])) if len(shape) > 1 else 1
-        flat = jax.random.normal(next_key(), (max(rows, cols),
-                                              min(rows, cols)))
+        flat = jax.random.normal(key, (max(rows, cols), min(rows, cols)))
         q, r = jnp.linalg.qr(flat)
         q = q * jnp.sign(jnp.diag(r))
         if rows < cols:
@@ -185,8 +183,7 @@ class Dirac(Initializer):
     def __init__(self, groups: int = 1, name=None):
         self.groups = groups
 
-    def __call__(self, shape, dtype=None):
-        dtype = dtype or dtypes.get_default_dtype()
+    def _init(self, shape, dtype, key):
         out = np.zeros(shape, np.float32)
         oc, ic = shape[0], shape[1]
         centre = tuple(s // 2 for s in shape[2:])
@@ -202,8 +199,7 @@ class Bilinear(Initializer):
     """ref initializer/Bilinear: upsampling-kernel init for transposed
     convolutions."""
 
-    def __call__(self, shape, dtype=None):
-        dtype = dtype or dtypes.get_default_dtype()
+    def _init(self, shape, dtype, key):
         kh, kw = shape[-2], shape[-1]
         f_h, f_w = (kh + 1) // 2, (kw + 1) // 2
         c_h = f_h - 1 if kh % 2 == 1 else f_h - 0.5

@@ -221,7 +221,12 @@ class Layer:
         register (paddle parity: Layer.create_parameter)."""
         attr = ParamAttr._to_attr(attr)
         dtype = dtypes.to_dtype(dtype) if dtype is not None else self._dtype
-        init = attr.initializer or default_initializer
+        # Priority (ref set_global_initializer semantics): explicit
+        # ParamAttr initializer > global override > the layer's default.
+        from .initializer import get_global_initializer
+        init = attr.initializer \
+            or get_global_initializer("bias" if is_bias else "weight") \
+            or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
         value = init(shape, dtype=dtype, key=key)

@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .layer import Layer
+from .layer import Layer, Parameter
 
 __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
            "parameters_to_vector", "vector_to_parameters",
@@ -37,10 +37,11 @@ def weight_norm(layer: Layer, name: str = "weight", dim: int = 0) -> Layer:
     if dim is None:
         dim = 0
     g = _norm_except(w, dim)
-    # register the reparameterized pair; drop the original parameter
+    # register the reparameterized pair AS PARAMETERS (trainable, in
+    # state_dict); drop the original parameter
     layer._parameters.pop(name, None)
-    setattr(layer, name + "_g", g)
-    setattr(layer, name + "_v", w)
+    setattr(layer, name + "_g", Parameter(g))
+    setattr(layer, name + "_v", Parameter(w))
     layer._weight_norm_cfg = (name, dim)
 
     orig_forward = layer.forward
@@ -70,7 +71,7 @@ def remove_weight_norm(layer: Layer, name: str = "weight") -> Layer:
     layer._parameters.pop(name + "_g", None)
     layer._parameters.pop(name + "_v", None)
     layer.__dict__.pop(name, None)
-    setattr(layer, name, w)
+    setattr(layer, name, Parameter(w))
     if "forward" in layer.__dict__:
         del layer.__dict__["forward"]  # restore the class forward
     return layer
@@ -92,7 +93,7 @@ def spectral_norm(layer: Layer, name: str = "weight", n_power_iterations: int = 
         return orig_forward(*args, **kwargs)
 
     layer._parameters.pop(name, None)
-    setattr(layer, name + "_orig", w)
+    setattr(layer, name + "_orig", Parameter(w))
     layer.__dict__[name] = w
     layer.forward = forward
     return layer

@@ -197,3 +197,44 @@ class TestNewOptimizers:
         l0, l1 = self._descend(paddle.optimizer.LBFGS, 0.5,
                                history_size=6, steps=40)
         assert l1 < 1e-6 * l0
+
+
+class TestReviewFixesWave3:
+    def test_orthogonal_via_param_attr(self):
+        from paddle_tpu.nn import initializer as I
+        paddle.seed(0)
+        from paddle_tpu import nn as _nn
+        lin = _nn.Linear(4, 4,
+                         weight_attr=paddle.ParamAttr(
+                             initializer=I.Orthogonal()))
+        w = np.asarray(lin.weight)
+        np.testing.assert_allclose(w @ w.T, np.eye(4), atol=1e-5)
+
+    def test_weight_norm_registers_trainable_params(self):
+        from paddle_tpu import nn as _nn
+        paddle.seed(0)
+        lin = _nn.Linear(4, 3)
+        x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 4)),
+                        jnp.float32)
+        before = np.asarray(lin(x))
+        _nn.utils.weight_norm(lin)
+        assert set(lin._parameters) == {"bias", "weight_g", "weight_v"}
+        np.testing.assert_allclose(np.asarray(lin(x)), before, atol=1e-5)
+        sd = lin.state_dict()
+        assert "weight_g" in sd and "weight_v" in sd
+        _nn.utils.remove_weight_norm(lin)
+        assert "weight" in lin._parameters
+        np.testing.assert_allclose(np.asarray(lin(x)), before, atol=1e-5)
+
+    def test_set_global_initializer_honored_and_reset(self):
+        from paddle_tpu import nn as _nn
+        from paddle_tpu.nn import initializer as I
+        I.set_global_initializer(I.Constant(3.5))
+        try:
+            lin = _nn.Linear(2, 2)
+            assert float(np.asarray(lin.weight)[0, 0]) == 3.5
+        finally:
+            I.set_global_initializer(None)
+        paddle.seed(0)
+        lin2 = _nn.Linear(2, 2)
+        assert float(np.asarray(lin2.weight)[0, 0]) != 3.5
