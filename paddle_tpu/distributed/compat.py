@@ -39,9 +39,19 @@ def gather(tensor, gather_list=None, dst: int = 0, group=None,
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None,
              sync_op: bool = True):
-    """ref communication/all_to_all.py: rank r sends in_tensor_list[j] to
-    rank j. List form over the stacked-ranks eager convention."""
-    x = jnp.stack(list(in_tensor_list))
+    """ref communication/all_to_all.py: rank r sends chunk j to rank j.
+
+    Single-controller stacked-ranks convention (as for every eager
+    collective here): ``in_tensor_list[s]`` is rank s's payload whose
+    LEADING dim is the group size (its per-destination chunks). Returns
+    the received lists, one per rank."""
+    x = jnp.stack([jnp.asarray(t) for t in in_tensor_list])
+    n = x.shape[0]
+    if x.ndim < 2 or x.shape[1] != n:
+        raise ValueError(
+            f"alltoall stacked convention: each rank's payload needs "
+            f"leading dim == group size {n}; got {x.shape[1:]} — see the "
+            f"eager-collective layout contract")
     out = C.all_to_all(x, group=group)
     parts = list(out)
     if out_tensor_list is not None:

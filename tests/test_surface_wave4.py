@@ -238,3 +238,36 @@ class TestReviewFixesWave3:
         paddle.seed(0)
         lin2 = _nn.Linear(2, 2)
         assert float(np.asarray(lin2.weight)[0, 0]) != 3.5
+
+
+class TestCompatCollectives:
+    """Eager stacked-ranks conventions of the compat wrappers."""
+
+    def test_alltoall_list_form(self):
+        from paddle_tpu import distributed as dist
+        g = dist.world_group()
+        n = g.nranks
+        # rank s's payload: chunk d carries value 10*s + d
+        ins = [jnp.asarray([[10.0 * s + d] for d in range(n)])
+               for s in range(n)]
+        outs = dist.alltoall(ins)
+        assert len(outs) == n
+        # rank r receives chunk r of every source: value 10*s + r
+        for r, o in enumerate(outs):
+            np.testing.assert_allclose(
+                np.asarray(o).reshape(-1),
+                [10.0 * s + r for s in range(n)])
+
+    def test_gather_fills_list(self):
+        from paddle_tpu import distributed as dist
+        g = dist.world_group()
+        x = jnp.ones((g.nranks, 3))
+        bucket = []
+        dist.gather(x, gather_list=bucket)
+        assert len(bucket) == g.nranks
+
+    def test_alltoall_single_equal_splits_only(self):
+        import pytest
+        from paddle_tpu import distributed as dist
+        with pytest.raises(NotImplementedError):
+            dist.alltoall_single(jnp.ones((4, 2)), in_split_sizes=[1, 3])
