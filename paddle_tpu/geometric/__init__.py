@@ -19,7 +19,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+__all__ = ["reindex_heter_graph", "weighted_sample_neighbors",
+           "segment_sum", "segment_mean", "segment_min", "segment_max",
            "send_u_recv", "send_ue_recv", "send_uv"]
 
 
@@ -205,3 +206,48 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
 
 
 __all__ += ["sample_neighbors", "reindex_graph"]
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """ref geometric/reindex.py reindex_heter_graph: reindex neighbors
+    from MULTIPLE edge types against one shared node mapping (the
+    heterogeneous variant of reindex_graph — same map, concatenated
+    neighbor lists)."""
+    cat_neighbors = jnp.concatenate([jnp.asarray(n) for n in neighbors])
+    cat_count = jnp.concatenate([jnp.asarray(c) for c in count])
+    return reindex_graph(x, cat_neighbors, cat_count)
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size: int = -1, eids=None,
+                              return_eids: bool = False, name=None):
+    """ref geometric/sampling/neighbors.py weighted_sample_neighbors:
+    neighbor sampling with per-edge selection weights (weighted
+    reservoir: keys = u^(1/w), top-k per node)."""
+    import numpy as np
+    row_np = np.asarray(row)
+    colptr_np = np.asarray(colptr)
+    w = np.asarray(edge_weight, np.float64)
+    nodes = np.asarray(input_nodes)
+    rng = np.random.default_rng(0)
+    out_n, out_c, out_e = [], [], []
+    for v in nodes:
+        lo, hi = int(colptr_np[v]), int(colptr_np[v + 1])
+        neigh = row_np[lo:hi]
+        ww = np.maximum(w[lo:hi], 1e-12)
+        if sample_size < 0 or len(neigh) <= sample_size:
+            pick = np.arange(len(neigh))
+        else:
+            keys = rng.random(len(neigh)) ** (1.0 / ww)
+            pick = np.argsort(-keys)[:sample_size]
+        out_n.append(neigh[pick])
+        out_c.append(len(pick))
+        out_e.append(lo + pick)
+    out_neighbors = jnp.asarray(np.concatenate(out_n) if out_n else
+                                np.zeros(0, row_np.dtype))
+    out_count = jnp.asarray(np.asarray(out_c, np.int64))
+    if return_eids:
+        return out_neighbors, out_count, jnp.asarray(
+            np.concatenate(out_e) if out_e else np.zeros(0, np.int64))
+    return out_neighbors, out_count

@@ -405,3 +405,74 @@ class FusedMultiTransformer(Layer):
 
 
 __all__ += ["FusedMultiTransformer"]
+
+
+class FusedDropoutAdd(Layer):
+    """ref incubate/nn/layer/fused_dropout_add.py: dropout(x) + y in one
+    fused op (XLA fuses the pair; the layer exists for call-site parity
+    and the seed/mode contract)."""
+
+    def __init__(self, p: float = 0.5, mode: str = "upscale_in_train",
+                 name=None):
+        super().__init__()
+        if mode not in ("upscale_in_train", "downscale_in_infer"):
+            raise ValueError(f"unknown dropout mode {mode!r}")
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from ...nn import functional as F
+        return F.dropout(x, self.p, training=self.training,
+                         mode=self.mode) + y
+
+
+class FusedEcMoe(Layer):
+    """ref incubate/nn/layer/fused_ec_moe.py FusedEcMoe: expert-choice
+    MoE — experts pick their top-C tokens (capacity-bounded, no token
+    dropping decisions by tokens). One batched einsum pair over the
+    expert dimension; gating via top-C per EXPERT."""
+
+    def __init__(self, hidden_size: int, inter_size: int, num_experts: int,
+                 act_type: str = "gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ...nn import initializer as I
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type!r}")
+        self.act_type = act_type
+        self.num_experts = num_experts
+        self.gate = self.create_parameter((hidden_size, num_experts),
+                                          attr=weight_attr)
+        self.w1 = self.create_parameter((num_experts, hidden_size,
+                                         inter_size), attr=weight_attr)
+        self.b1 = self.create_parameter((num_experts, 1, inter_size),
+                                        attr=bias_attr, is_bias=True)
+        self.w2 = self.create_parameter((num_experts, inter_size,
+                                         hidden_size), attr=weight_attr)
+        self.b2 = self.create_parameter((num_experts, 1, hidden_size),
+                                        attr=bias_attr, is_bias=True)
+
+    def forward(self, x, gate_logits=None):
+        import jax
+        import jax.numpy as jnp
+        b, s, h = x.shape
+        tokens = x.reshape(b * s, h)
+        logits = gate_logits.reshape(b * s, self.num_experts) \
+            if gate_logits is not None else tokens @ self.gate
+        n_tok = tokens.shape[0]
+        capacity = max(n_tok // self.num_experts, 1)
+        # expert-choice: each expert takes its top-capacity tokens
+        scores = jax.nn.softmax(logits, axis=-1).T        # [E, T]
+        top_s, top_idx = jax.lax.top_k(scores, capacity)  # [E, C]
+        picked = tokens[top_idx]                          # [E, C, H]
+        act = jax.nn.gelu if self.act_type == "gelu" else jax.nn.relu
+        hidden = act(jnp.einsum("ech,ehi->eci", picked, self.w1) + self.b1)
+        out_e = jnp.einsum("eci,eih->ech", hidden, self.w2) + self.b2
+        out_e = out_e * top_s[..., None]
+        # scatter-add expert outputs back to token slots
+        out = jnp.zeros_like(tokens)
+        out = out.at[top_idx.reshape(-1)].add(
+            out_e.reshape(-1, h).astype(tokens.dtype))
+        return out.reshape(b, s, h)
+
+
+__all__ += ["FusedDropoutAdd", "FusedEcMoe"]

@@ -18,7 +18,9 @@ import jax.numpy as jnp
 
 from ...optimizer.optimizer import Optimizer
 
-__all__ = ["LookAhead", "ModelAverage"]
+from ...optimizer import LBFGS  # noqa: F401
+
+__all__ = ["LookAhead", "ModelAverage", "LBFGS"]
 
 
 class LookAhead:

@@ -453,6 +453,11 @@ def test_reference_submodule_alls_covered():
         ("profiler", f"{root}/profiler/__init__.py"),
         ("quantization", f"{root}/quantization/__init__.py"),
         ("audio", f"{root}/audio/__init__.py"),
+        ("audio.functional", f"{root}/audio/functional/__init__.py"),
+        ("audio.features", f"{root}/audio/features/__init__.py"),
+        ("geometric", f"{root}/geometric/__init__.py"),
+        ("incubate.nn", f"{root}/incubate/nn/__init__.py"),
+        ("incubate.optimizer", f"{root}/incubate/optimizer/__init__.py"),
     ]
     for mod, path in cases:
         obj = paddle
