@@ -92,13 +92,19 @@ def make_deterministic_dp_step(loss_fn: Callable, optimizer, groups: int,
                                                   lr)
         return loss, new_p, new_st
 
-    lr = getattr(optimizer, "learning_rate", 1e-3)
-    if callable(lr):
-        lr = 1e-3
+    def current_lr():
+        # honour the optimizer's configured LR / schedule at call time
+        # (the schedule's own step counter advances via scheduler.step(),
+        # exactly as in non-deterministic training)
+        get = getattr(optimizer, "get_lr", None)
+        if callable(get):
+            return float(get())
+        lr = getattr(optimizer, "learning_rate", 1e-3)
+        return float(lr() if callable(lr) else lr)
 
     if mesh is None:
         @jax.jit
-        def step(params, opt_state, batch, step_idx):
+        def _step(params, opt_state, batch, step_idx, lr):
             with jax.default_matmul_precision("highest"):
                 def body(_, g):
                     key = jax.random.fold_in(
@@ -113,6 +119,10 @@ def make_deterministic_dp_step(loss_fn: Callable, optimizer, groups: int,
                 return apply_update(params, opt_state, loss_stack,
                                     grad_stack, lr)
 
+        def step(params, opt_state, batch, step_idx, lr=None):
+            return _step(params, opt_state, batch, step_idx,
+                         current_lr() if lr is None else lr)
+
         return step
 
     if mesh.shape[dp_axis] != groups:
@@ -122,9 +132,9 @@ def make_deterministic_dp_step(loss_fn: Callable, optimizer, groups: int,
 
     batch_spec = P(dp_axis)
 
-    def sharded(params, opt_state, batch, step_idx):
+    def sharded(params, opt_state, batch, step_idx, lr):
         with jax.default_matmul_precision("highest"):
-            def per_shard(params, opt_state, batch, step_idx):
+            def per_shard(params, opt_state, batch, step_idx, lr):
                 g = lax.axis_index(dp_axis)
                 key = jax.random.fold_in(
                     jax.random.PRNGKey(0), step_idx * groups + g)
@@ -141,9 +151,15 @@ def make_deterministic_dp_step(loss_fn: Callable, optimizer, groups: int,
             rep = PartitionSpec()
             return jax.shard_map(
                 per_shard, mesh=mesh,
-                in_specs=(rep, rep, batch_spec, rep),
+                in_specs=(rep, rep, batch_spec, rep, rep),
                 out_specs=(rep, rep, rep),
                 axis_names={dp_axis}, check_vma=False,
-            )(params, opt_state, batch, step_idx)
+            )(params, opt_state, batch, step_idx, lr)
 
-    return jax.jit(sharded)
+    _sharded = jax.jit(sharded)
+
+    def step(params, opt_state, batch, step_idx, lr=None):
+        return _sharded(params, opt_state, batch, step_idx,
+                        current_lr() if lr is None else lr)
+
+    return step

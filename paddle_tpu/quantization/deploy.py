@@ -89,17 +89,19 @@ class Int8Conv2D(Layer):
         from jax import lax
         xq = _quantize_tensor(x.astype(jnp.float32), self.act_scale,
                               self.bits)
-        stride = self.stride if isinstance(self.stride, tuple) \
-            else (self.stride, self.stride)
-        pad = self.padding if isinstance(self.padding, tuple) \
-            else (self.padding, self.padding)
+        def _pair(v):
+            return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+        stride = _pair(self.stride)
+        pad = _pair(self.padding)
         dn = lax.conv_dimension_numbers(
             x.shape, self.weight_q.shape,
             ("NCHW", "OIHW", "NCHW") if self.data_format == "NCHW"
             else ("NHWC", "OIHW", "NHWC"))
+        dil = _pair(self.dilation)
         acc = lax.conv_general_dilated(
             xq, self.weight_q, window_strides=stride,
             padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+            rhs_dilation=dil,
             dimension_numbers=dn, feature_group_count=self.groups,
             preferred_element_type=jnp.int32)
         deq = acc.astype(jnp.float32) * (

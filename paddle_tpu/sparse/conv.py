@@ -74,6 +74,10 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
         raise ValueError("subm_conv3d requires stride 1 (pattern-preserving)")
     if groups != 1:
         raise NotImplementedError("sparse conv groups > 1")
+    if _triple(dilation) != (1, 1, 1):
+        raise NotImplementedError("sparse conv dilation != 1")
+    if data_format != "NDHWC":
+        raise NotImplementedError("sparse conv supports NDHWC only")
     t = _unwrap(x)
     idx = t.indices  # [nnz, 4] (n, d, h, w)
     vals = t.data
@@ -96,6 +100,10 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
 
     if groups != 1:
         raise NotImplementedError("sparse conv groups > 1")
+    if _triple(dilation) != (1, 1, 1):
+        raise NotImplementedError("sparse conv dilation != 1")
+    if data_format != "NDHWC":
+        raise NotImplementedError("sparse conv supports NDHWC only")
     strides = _triple(stride)
     pads = _triple(padding)
     t = _unwrap(x)

@@ -112,6 +112,9 @@ class _SparseConvBase(Layer):
             else (kernel_size,) * 3
         self.stride = stride
         self.padding = padding
+        self.dilation = dilation
+        self.groups = groups
+        self.data_format = data_format
         self.subm = subm
         fan_in = in_channels * ks[0] * ks[1] * ks[2]
         self.weight = self.create_parameter(
@@ -127,7 +130,8 @@ class _SparseConvBase(Layer):
         from .conv import conv3d, subm_conv3d
         fn = subm_conv3d if self.subm else conv3d
         return fn(x, self.weight, self.bias, stride=self.stride,
-                  padding=self.padding)
+                  padding=self.padding, dilation=self.dilation,
+                  groups=self.groups, data_format=self.data_format)
 
 
 class Conv3D(_SparseConvBase):

@@ -634,11 +634,16 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     batch_idx = jnp.arange(n)[:, None].repeat(bs, 1)
     sel = (batch_idx, best_a, gj, gi)
     vf = valid.astype(jnp.float32)
+    if gt_score is not None:
+        # mixup score weighting (ref yolov3_loss: every positive-sample
+        # loss term is scaled by the gt's mixup score)
+        vf = vf * jnp.asarray(gt_score, jnp.float32)
     txy_t_x = gt_box[:, :, 0] * w - gi
     txy_t_y = gt_box[:, :, 1] * h - gj
     twh_t_w = jnp.log(jnp.maximum(gw / aw[best_a], 1e-9))
     twh_t_h = jnp.log(jnp.maximum(gh / ah[best_a], 1e-9))
     import jax.nn as jnn
+    from jax import lax
     sx = jnn.sigmoid(tx[sel])
     sy = jnn.sigmoid(ty[sel])
     box_l = vf * ((sx - txy_t_x) ** 2 + (sy - txy_t_y) ** 2 +
@@ -650,10 +655,34 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         jnp.maximum(cls_logit, 0) - cls_logit * cls_t +
         jnp.log1p(jnp.exp(-jnp.abs(cls_logit))), axis=-1)
     obj_target = obj_target.at[sel].max(vf)
-    obj_ce = jnp.maximum(tobj, 0) - tobj * obj_target + \
-        jnp.log1p(jnp.exp(-jnp.abs(tobj)))
-    if gt_score is not None:
-        pass  # mixup-score weighting folds into vf upstream
+
+    # ignore mask (ref yolov3_loss CalcObjnessLoss): a non-matched cell
+    # whose decoded box overlaps ANY gt with IoU > ignore_thresh is
+    # excluded from the negative objectness BCE
+    grid_x = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+    grid_y = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+    px = lax.stop_gradient((grid_x + jnn.sigmoid(tx)) / w)
+    py = lax.stop_gradient((grid_y + jnn.sigmoid(ty)) / h)
+    pw = lax.stop_gradient(aw[None, :, None, None] * jnp.exp(tw) / in_w)
+    phh = lax.stop_gradient(ah[None, :, None, None] * jnp.exp(th) / in_h)
+    gx1 = (gt_box[:, :, 0] - gt_box[:, :, 2] / 2)[:, None, None, None, :]
+    gy1 = (gt_box[:, :, 1] - gt_box[:, :, 3] / 2)[:, None, None, None, :]
+    gx2 = (gt_box[:, :, 0] + gt_box[:, :, 2] / 2)[:, None, None, None, :]
+    gy2 = (gt_box[:, :, 1] + gt_box[:, :, 3] / 2)[:, None, None, None, :]
+    iw = jnp.clip(jnp.minimum((px + pw / 2)[..., None], gx2)
+                  - jnp.maximum((px - pw / 2)[..., None], gx1), 0)
+    ih = jnp.clip(jnp.minimum((py + phh / 2)[..., None], gy2)
+                  - jnp.maximum((py - phh / 2)[..., None], gy1), 0)
+    inter_p = iw * ih
+    union_p = (pw * phh)[..., None] + (gt_box[:, :, 2] * gt_box[:, :, 3]
+                                       )[:, None, None, None, :] - inter_p
+    iou_p = jnp.where(valid[:, None, None, None, :],
+                      inter_p / jnp.maximum(union_p, 1e-9), 0.0)
+    best_iou = jnp.max(iou_p, axis=-1)               # [N, m, h, w]
+    obj_weight = jnp.where((best_iou > ignore_thresh) & (obj_target <= 0),
+                           0.0, 1.0)
+    obj_ce = obj_weight * (jnp.maximum(tobj, 0) - tobj * obj_target +
+                           jnp.log1p(jnp.exp(-jnp.abs(tobj))))
     loss = jnp.sum(box_l + cls_l, axis=1) + jnp.sum(obj_ce, axis=(1, 2, 3))
     return loss
 
@@ -704,6 +733,9 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
         sc_k = jnp.where(keep_sz, sc_k, -jnp.inf)
         keep = nms(boxes, nms_thresh, scores=sc_k,
                    top_k=int(post_nms_top_n))
+        # drop sub-min_size boxes that survived only because fewer than
+        # post_nms_top_n valid candidates existed (their score is -inf)
+        keep = keep[np.asarray(sc_k[keep]) > -np.inf]
         all_rois.append(boxes[keep])
         all_scores.append(sc_k[keep])
         rois_num.append(np.asarray(keep).shape[0])

@@ -256,3 +256,92 @@ def test_resnet_custom_norm_layer_without_data_format():
     blk = BasicBlock(8, 8, norm_layer=lambda c: nn.GroupNorm(4, c))
     out = blk(jnp.ones((1, 8, 8, 8)))
     assert out.shape == (1, 8, 8, 8)
+
+
+# ---- round-3 advisor findings ----
+
+def test_sparse_conv_layer_forwards_groups_and_dilation():
+    import pytest
+    from paddle_tpu.sparse.nn import SubmConv3D
+    layer = SubmConv3D(4, 8, 3, groups=2)
+    sp = paddle.sparse.sparse_coo_tensor(
+        np.array([[0, 0], [1, 2], [1, 1], [2, 3]]),
+        np.asarray(np.random.default_rng(0).standard_normal((2, 4)),
+                   np.float32), (1, 4, 4, 4, 4))
+    with pytest.raises(NotImplementedError):
+        layer(sp)
+    layer = SubmConv3D(4, 8, 3, dilation=2)
+    with pytest.raises(NotImplementedError):
+        layer(sp)
+
+
+def test_int8_conv2d_honours_dilation():
+    from paddle_tpu.quantization.deploy import Int8Conv2D
+    conv = nn.Conv2D(3, 4, 3, dilation=2, bias_attr=False)
+    x = jnp.asarray(np.random.default_rng(1).standard_normal((1, 3, 12, 12)),
+                    jnp.float32)
+    ref = conv(x)
+    q = Int8Conv2D(conv, weight_scale=jnp.abs(conv.weight).max(),
+                   act_scale=jnp.abs(x).max())
+    out = q(x)
+    assert out.shape == ref.shape
+    # int8 quantization noise, but same conv geometry/semantics
+    assert float(jnp.corrcoef(out.ravel(), ref.ravel())[0, 1]) > 0.99
+
+
+def test_yolo_loss_ignore_thresh_masks_negatives():
+    from paddle_tpu.vision.ops import yolo_loss
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((1, 3 * 7, 4, 4)), jnp.float32)
+    gt_box = jnp.asarray([[[0.5, 0.5, 0.4, 0.4]]], jnp.float32)
+    gt_label = jnp.asarray([[1]], jnp.int32)
+    anchors = [10, 13, 16, 30, 33, 23]
+    common = dict(anchors=anchors, anchor_mask=[0, 1, 2], class_num=2,
+                  downsample_ratio=32)
+    # strict threshold (ignore everything overlapping at all) must not
+    # penalize more than the no-ignore loss
+    l_strict = yolo_loss(x, gt_box, gt_label, ignore_thresh=0.0, **common)
+    l_loose = yolo_loss(x, gt_box, gt_label, ignore_thresh=1.0, **common)
+    assert float(l_strict[0]) <= float(l_loose[0])
+    # gt_score scales the positive-sample losses
+    l_half = yolo_loss(x, gt_box, gt_label, ignore_thresh=1.0,
+                       gt_score=jnp.asarray([[0.5]], jnp.float32), **common)
+    assert float(l_half[0]) < float(l_loose[0])
+
+
+def test_deterministic_step_honours_lr_schedule():
+    from paddle_tpu.framework.determinism import make_deterministic_dp_step
+    from paddle_tpu import optimizer as opt
+
+    sched = opt.lr.StepDecay(learning_rate=0.5, step_size=1, gamma=0.1)
+    params = {"w": jnp.ones((4,))}
+    o = opt.SGD(learning_rate=sched, parameters=params)
+
+    def loss_fn(p, batch, key):
+        return jnp.mean((batch @ p["w"]) ** 2)
+
+    step = make_deterministic_dp_step(loss_fn, o, groups=2)
+    state = o.init(params)
+    batch = jnp.ones((4, 4))
+    _, p1, state = step(params, state, batch, 0)
+    # lr=0.5 applied, not the old hard-coded 1e-3
+    g = jax.grad(lambda p: loss_fn(p, batch[:2], None))(params)["w"]
+    manual = params["w"] - 0.5 * jax.grad(
+        lambda p: loss_fn(p, batch, None))(params)["w"]
+    np.testing.assert_allclose(np.asarray(p1["w"]), np.asarray(manual),
+                               rtol=1e-5)
+    del g
+
+
+def test_generate_proposals_drops_neg_inf_boxes():
+    from paddle_tpu.vision.ops import generate_proposals
+    rng = np.random.default_rng(3)
+    scores = jnp.asarray(rng.standard_normal((1, 2, 3, 3)), jnp.float32)
+    deltas = jnp.zeros((1, 8, 3, 3), jnp.float32)
+    anchors = jnp.asarray(rng.uniform(0, 5, (2 * 3 * 3, 4)), jnp.float32)
+    rois, rscores, n = generate_proposals(
+        scores, deltas, [(32, 32)], anchors,
+        jnp.ones((2 * 3 * 3, 4)), min_size=100.0, post_nms_top_n=10,
+        return_rois_num=True)
+    # every box is sub-min_size -> all filtered, none returned with -inf
+    assert not np.isinf(np.asarray(rscores)).any()
