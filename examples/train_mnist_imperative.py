@@ -1,0 +1,68 @@
+"""Reference-style imperative (dygraph) MNIST training.
+
+This is the PaddlePaddle quick-start training loop written exactly as a
+reference user writes it — ``model(x)``, ``loss.backward()``, ``opt.step()``,
+``opt.clear_grad()`` — with ONLY the import changed from ``paddle`` to
+``paddle_tpu``. It exercises the eager Tensor tape
+(``paddle_tpu/framework/eager.py``; ref
+``python/paddle/fluid/dygraph/tensor_patch_methods.py:231`` ``backward``).
+
+    python examples/train_mnist_imperative.py
+"""
+
+import os, sys
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+class LeNet(nn.Layer):
+    def __init__(self, num_classes=10):
+        super().__init__()
+        self.conv1 = nn.Conv2D(1, 6, 5, padding=2)
+        self.conv2 = nn.Conv2D(6, 16, 5)
+        self.fc1 = nn.Linear(16 * 5 * 5, 120)
+        self.fc2 = nn.Linear(120, 84)
+        self.fc3 = nn.Linear(84, num_classes)
+
+    def forward(self, x):
+        x = F.max_pool2d(F.relu(self.conv1(x)), 2)
+        x = F.max_pool2d(F.relu(self.conv2(x)), 2)
+        x = paddle.flatten(x, start_axis=1)
+        x = F.relu(self.fc1(x))
+        x = F.relu(self.fc2(x))
+        return self.fc3(x)
+
+
+def main():
+    paddle.seed(0)
+    train_dataset = paddle.vision.datasets.MNIST(mode="train",
+                                                 synthetic_size=2048)
+    train_loader = paddle.io.DataLoader(train_dataset, batch_size=64,
+                                        shuffle=True)
+    model = LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=model.parameters())
+    model.train()
+    for epoch in range(2):
+        for batch_id, data in enumerate(train_loader):
+            x = paddle.to_tensor(data[0])
+            y = paddle.to_tensor(data[1])
+            logits = model(x)
+            loss = F.cross_entropy(logits, y)
+            avg_loss = paddle.mean(loss)
+            acc = paddle.metric.accuracy(logits, y)
+            avg_loss.backward()
+            opt.step()
+            opt.clear_grad()
+            if batch_id % 10 == 0:
+                print(f"epoch {epoch} batch {batch_id}: "
+                      f"loss {float(avg_loss):.4f} acc {float(acc):.4f}")
+    return float(avg_loss)
+
+
+if __name__ == "__main__":
+    final = main()
+    print("final loss:", final)

@@ -65,9 +65,12 @@ from . import reader  # noqa: F401
 from .batch import batch  # noqa: F401
 from . import _C_ops  # noqa: F401
 
-# paddle.Tensor alias: a Tensor IS a jax.Array.
 import jax as _jax
-Tensor = _jax.Array
+
+# paddle.Tensor: the imperative eager Tensor (loss.backward(), .grad,
+# method parity — ref tensor_patch_methods.py). Functional/jit code keeps
+# working on raw jax.Array; ops accept both.
+from .framework.eager import Tensor  # noqa: E402
 
 # --- paddle parity shims (ref python/paddle/__init__.py __all__) ----------
 
@@ -212,3 +215,11 @@ _install_inplace_aliases()
 
 from .nn.layer import ParamAttr  # noqa: F401
 from .framework.dataparallel_api import DataParallel  # noqa: F401
+
+# Route Tensor-carrying calls through the eager tape across the public op
+# surface (the reference's tensor_patch_methods setattr loop, inverted).
+# Must run LAST so every exported function is in the namespace.
+from .framework import eager as _eager_mod  # noqa: E402
+import sys as _sys  # noqa: E402
+_eager_mod.install(_sys.modules[__name__])
+_eager_mod.install(nn.functional)

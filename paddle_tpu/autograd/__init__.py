@@ -33,18 +33,34 @@ __all__ = [
            "enable_grad", "set_grad_enabled", "jacobian", "hessian", "vjp", "jvp"]
 
 
-def backward(model: Layer, loss_fn: Callable[[], jax.Array] = None, *,
+def backward(model: Layer = None, loss_fn: Callable[[], jax.Array] = None, *,
              loss_closure: Optional[Callable[[Layer], jax.Array]] = None,
-             accumulate: bool = True):
+             accumulate: bool = True, tensors=None, grad_tensors=None,
+             retain_graph: bool = False):
     """Populate ``param.grad`` for all trainable params of `model`.
 
-    Usage (imperative parity path):
+    Two forms:
+    - reference ``paddle.autograd.backward(tensors, grad_tensors)``: when
+      the first argument is an eager Tensor (or list of them), run the tape
+      backward (same engine as ``loss.backward()``).
+    - closure form (functional parity path):
         loss = autograd.backward(model, lambda: loss_of(model(x), y))
         opt.step()
-
-    The closure must compute the loss by calling `model` (the call is re-run
-    under jax.grad with parameters substituted).
+      The closure must compute the loss by calling `model` (the call is
+      re-run under jax.grad with parameters substituted).
     """
+    from ..framework.eager import Tensor as _ET
+    if tensors is None and (isinstance(model, _ET) or
+                            (isinstance(model, (list, tuple)) and model and
+                             isinstance(model[0], _ET))):
+        tensors, model = model, None
+    if tensors is not None:
+        from ..framework.eager import backward_multi
+        ts = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+        gs = grad_tensors if isinstance(grad_tensors, (list, tuple)) \
+            else [grad_tensors] * len(ts)
+        backward_multi(ts, list(gs), retain_graph=retain_graph)
+        return None
     fn = loss_closure if loss_closure is not None else (lambda _m: loss_fn())
     params = get_params(model, trainable_only=True)
     from ..framework.functional import _swapped_state, get_buffers, set_buffers
@@ -74,9 +90,22 @@ def backward(model: Layer, loss_fn: Callable[[], jax.Array] = None, *,
     return loss
 
 
-def grad(outputs_fn: Callable, inputs, create_graph: bool = False,
-         allow_unused: bool = False):
-    """paddle.grad-style: d outputs_fn(inputs) / d inputs (inputs a pytree)."""
+def grad(outputs_fn, inputs, grad_outputs=None, retain_graph=None,
+         create_graph: bool = False, only_inputs: bool = True,
+         allow_unused: bool = False, no_grad_vars=None):
+    """paddle.grad. Two forms:
+
+    - reference imperative form: ``paddle.grad(outputs, inputs)`` where
+      `outputs`/`inputs` are eager Tensors → tape backward
+      (ref python/paddle/autograd — imperative paddle.grad).
+    - functional form: first arg is a callable; returns
+      d outputs_fn(inputs) / d inputs (inputs a pytree).
+    """
+    from ..framework.eager import Tensor as _ET, tape_grad
+    if not callable(outputs_fn) or isinstance(outputs_fn, _ET):
+        return tape_grad(outputs_fn, inputs, grad_outputs,
+                         retain_graph=bool(retain_graph),
+                         allow_unused=allow_unused)
     g = jax.grad(lambda x: jnp.sum(outputs_fn(x)))(inputs)
     return g
 

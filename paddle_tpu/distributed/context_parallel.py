@@ -96,6 +96,55 @@ def _ring_use_flash(s_local: int, d: int, dtype) -> bool:
     return s_local % 128 == 0 and d in (64, 128, 256)
 
 
+def _inner_mesh(mesh):
+    """Mesh to hand a nested shard_map: when already inside a shard_map /
+    use_mesh scope (e.g. the pipeline runtime's manual pp axis), jax
+    requires the AMBIENT abstract mesh, not the concrete one."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return mesh
+    if am is not None and len(getattr(am, "axis_names", ())):
+        return am
+    return mesh
+
+
+def _ambient_manual_axes():
+    """Axis names already bound manual by an enclosing shard_map (e.g. the
+    pipeline runtime's pp axis)."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+    except Exception:
+        return ()
+    if am is None:
+        return ()
+    return tuple(n for n, t in zip(am.axis_names,
+                                   getattr(am, "axis_types", ()))
+                 if "Manual" in str(t))
+
+
+def _auto_mode_attention(query, key, value, axis, causal, scale):
+    """CP inside a partial-manual region (nested in the pipeline's pp
+    shard_map): `axis` is an AUTO axis there, so the manual ppermute ring
+    cannot be nested (sdy rejects re-binding/mixed-vma operands). Instead
+    constrain the seq dim over `axis` and let GSPMD schedule the gathers —
+    same math, compiler-chosen communication."""
+    from ..ops.flash_attention import flash_attention
+    spec = P(P.UNCONSTRAINED, axis, P.UNCONSTRAINED, P.UNCONSTRAINED)
+    try:
+        query = jax.lax.with_sharding_constraint(query, spec)
+        key = jax.lax.with_sharding_constraint(key, spec)
+        value = jax.lax.with_sharding_constraint(value, spec)
+    except Exception:
+        pass  # constraint is an optimization hint; the math is identical
+    out = flash_attention(query, key, value, causal=causal, scale=scale)
+    try:
+        out = jax.lax.with_sharding_constraint(out, spec)
+    except Exception:
+        pass
+    return out
+
+
 def ring_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
                    causal: bool = False, scale: Optional[float] = None,
                    remat: bool = True):
@@ -116,14 +165,19 @@ def ring_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
     if n == 1:
         from ..ops.flash_attention import flash_attention
         return flash_attention(query, key, value, causal=causal, scale=scale)
+    if _ambient_manual_axes():
+        return _auto_mode_attention(query, key, value, axis, causal, scale)
     s_local = s_global // n
     perm = [(i, (i + 1) % n) for i in range(n)]
     use_flash = _ring_use_flash(s_local, d, query.dtype)
     if use_flash:
         from ..ops._pallas.flash_attention import flash_attention_with_lse
 
-    def fn(q, k, v):
-        rank = lax.axis_index(axis)
+    def fn(q, k, v, ranks):
+        # rank from a sep-sharded arange, NOT lax.axis_index: axis_index
+        # fails MLIR verification when this shard_map is nested inside
+        # another manual axis (the pipeline runtime's pp shard_map)
+        rank = ranks[0]
         q_off = rank * s_local
 
         def block_olse(q, k_blk, v_blk, src):
@@ -171,16 +225,33 @@ def ring_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
 
         lse0 = jnp.full((b, s_local, h), NEG_INF, jnp.float32)
         o0 = jnp.zeros((b, s_local, h, d), jnp.float32)
-        lse0, o0 = (lax.pcast(x, (axis,), to="varying")
-                    for x in (lse0, o0))
+        # the scan carry must be varying over every manual axis the inputs
+        # vary over (just `axis` standalone; axis + pp when nested inside
+        # the pipeline runtime's manual shard_map)
+        target_vma = (set(jax.typeof(q).vma) | set(jax.typeof(k).vma)
+                      | {axis})
+
+        def _match_vma(x):
+            missing = tuple(a for a in target_vma
+                            if a not in jax.typeof(x).vma)
+            return lax.pcast(x, missing, to="varying") if missing else x
+
+        lse0, o0 = _match_vma(lse0), _match_vma(o0)
         (_, _, o, lse), _ = lax.scan(
             step_fn, (k, v, o0, lse0), jnp.arange(n))
         return o.astype(query.dtype)
 
     spec = P(None, axis, None, None)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    ranks = jnp.arange(n, dtype=jnp.int32)
+    outer_vma = tuple(getattr(jax.typeof(query), "vma", ()))
+    if outer_vma:
+        # match the enclosing manual axes (nested-in-pipeline case): all
+        # operands of one shard_map must agree on their varying axes
+        ranks = lax.pcast(ranks, outer_vma, to="varying")
+    return jax.shard_map(fn, mesh=_inner_mesh(mesh),
+                         in_specs=(spec, spec, spec, P(axis)),
                          out_specs=spec, axis_names={axis},
-                         check_vma=True)(query, key, value)
+                         check_vma=True)(query, key, value, ranks)
 
 
 def ulysses_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
@@ -195,6 +266,8 @@ def ulysses_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
     from ..ops.flash_attention import flash_attention
     if n == 1:
         return flash_attention(query, key, value, causal=causal, scale=scale)
+    if _ambient_manual_axes():
+        return _auto_mode_attention(query, key, value, axis, causal, scale)
     if query.shape[2] % n:
         raise ValueError(f"heads {query.shape[2]} not divisible by "
                          f"{axis}={n}")
@@ -230,6 +303,7 @@ def ulysses_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
         return to_seq(out)
 
     spec = P(None, axis, None, None)
-    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+    return jax.shard_map(fn, mesh=_inner_mesh(mesh),
+                         in_specs=(spec, spec, spec),
                          out_specs=spec, axis_names={axis},
                          check_vma=True)(query, key, value)

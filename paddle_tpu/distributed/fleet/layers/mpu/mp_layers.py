@@ -44,12 +44,20 @@ SP_AXIS = "sep"
 
 def _spec_axes(spec: P):
     for entry in spec:
-        if entry is None:
+        if entry is None or entry is P.UNCONSTRAINED:
             continue
         if isinstance(entry, tuple):
             yield from entry
         else:
             yield entry
+
+
+def _lead_unconstrained(ndim: int, last) -> P:
+    """Spec constraining only the LAST dim; leading dims (batch/seq) stay
+    UNCONSTRAINED so an incoming dp/sep sharding is preserved — pinning them
+    to None forces the compiler into replicate-then-repartition resharding
+    (the "involuntary full rematerialization" SPMD warning)."""
+    return P(*([P.UNCONSTRAINED] * (ndim - 1)), last)
 
 
 def _constrain(x, spec: P):
@@ -128,9 +136,9 @@ class ColumnParallelLinear(Layer):
                                    getattr(self, "bias", None))
         y = F.linear(x, w, b)
         if self.gather_output:
-            y = _constrain(y, P(*([None] * y.ndim)))
+            y = _constrain(y, _lead_unconstrained(y.ndim, None))
         else:
-            y = _constrain(y, P(*([None] * (y.ndim - 1)), MP_AXIS))
+            y = _constrain(y, _lead_unconstrained(y.ndim, MP_AXIS))
         return y
 
 
@@ -163,9 +171,9 @@ class RowParallelLinear(Layer):
         x, w, b = maybe_cast_input("linear", x, self.weight,
                                    getattr(self, "bias", None))
         if self.input_is_parallel:
-            x = _constrain(x, P(*([None] * (x.ndim - 1)), MP_AXIS))
+            x = _constrain(x, _lead_unconstrained(x.ndim, MP_AXIS))
         y = jnp.matmul(x, w)
-        y = _constrain(y, P(*([None] * y.ndim)))
+        y = _constrain(y, _lead_unconstrained(y.ndim, None))
         if b is not None:
             y = y + b
         return y

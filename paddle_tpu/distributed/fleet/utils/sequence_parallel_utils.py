@@ -38,8 +38,14 @@ def all_gather(x, axis: str = MP_AXIS):
 
 
 def sequence_parallel_constraint(x, seq_dim: int = 1):
-    """GSPMD: constrain activations [B, S, H] to shard S over mp."""
-    spec = [None] * x.ndim
+    """GSPMD: constrain activations [B, S, H] to shard S over mp.
+
+    Every OTHER dim is left UNCONSTRAINED, not pinned to replicated: a
+    dp-sharded batch dim must keep its dp sharding, or the compiler has to
+    replicate-then-repartition ("involuntary full rematerialization", the
+    r3 dryrun[5] warning) — a full batch allgather over ICI per constraint.
+    """
+    spec = [P.UNCONSTRAINED] * x.ndim
     spec[seq_dim] = MP_AXIS
     return _constrain(x, P(*spec))
 

@@ -1,0 +1,750 @@
+"""Imperative eager Tensor with ``loss.backward()`` — the dygraph surface.
+
+The reference patches the full method surface onto its eager Tensor and runs
+reverse-mode AD through a C++ GradNode graph engine
+(``python/paddle/fluid/dygraph/tensor_patch_methods.py:231`` ``backward``;
+``paddle/fluid/eager/backward.cc:104`` RunBackward queue traversal). This
+module provides the same *user contract* — ``t = paddle.to_tensor(...)``,
+``out = model(t)``, ``loss.backward()``, ``param.grad``, ``opt.step()`` —
+as a thin tape over JAX's functional autodiff:
+
+- :class:`Tensor` wraps a ``jax.Array`` and records provenance: every paddle
+  API call whose arguments contain Tensors appends a tape node (op + arg
+  snapshot). Forward runs eagerly on the raw arrays (no tracing overhead on
+  the hot path).
+- ``backward()`` walks the tape in reverse creation order; each node's
+  gradient is derived on demand with ``jax.vjp`` over a replay of that node
+  (JAX re-derives what the reference's generated GradNode classes hard-code).
+  Leaf Tensors (``stop_gradient=False``) and Layer parameters accumulate
+  ``.grad``, so the existing imperative ``Optimizer.step()`` applies.
+- :func:`eager_layer_call` records a whole ``Layer.__call__`` as ONE node
+  over the layer's functional view (``functional_call``): the reference
+  records a GradNode per op; one node per layer call gives identical
+  gradients with a fraction of the bookkeeping, and the inner ops still run
+  as plain JAX.
+
+This is a compatibility surface, not the performance path: training loops
+that want XLA-fused steps should use ``jax.jit`` over the functional API
+(``functional_call`` / ``optimizer.apply_gradients``), exactly as the
+reference steers hot paths into static graphs.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Tensor", "to_tensor_value", "has_eager_tensor",
+           "eager_layer_call", "record_call", "install", "tape_grad"]
+
+_counter = itertools.count()
+_suppress = []
+
+
+def _suppress_param_grads() -> bool:
+    return bool(_suppress)
+
+
+def _is_float_array(x) -> bool:
+    return isinstance(x, jax.Array) and jnp.issubdtype(x.dtype, jnp.inexact)
+
+
+def _float0(x):
+    """Zero cotangent for a non-float primal output (jax.vjp contract)."""
+    return np.zeros(x.shape, jax.dtypes.float0)
+
+
+class _Node:
+    """One tape entry: a recorded paddle API (or Layer) call."""
+
+    __slots__ = ("counter", "fn", "treedef", "leaf_vals", "diff_pos",
+                 "parents", "out_tensors", "layer", "frozen_params",
+                 "buffers0", "rng_state0", "released")
+
+    def __init__(self):
+        self.counter = next(_counter)
+        self.layer = None
+        self.released = False
+
+    # -- forward-time construction ----------------------------------------
+
+    @staticmethod
+    def _flatten_call(args, kwargs):
+        leaves, treedef = jax.tree_util.tree_flatten(
+            (args, kwargs), is_leaf=lambda x: isinstance(x, Tensor))
+        return leaves, treedef
+
+    def _vals(self, leaves):
+        return [l._value if isinstance(l, Tensor) else l for l in leaves]
+
+    # -- backward ----------------------------------------------------------
+
+    def _replay(self, diff_vals):
+        """Re-run this node as a pure function of its diff inputs, returning
+        the float output leaves (same order as ``out_tensors``)."""
+        from ..core.random import get_rng_state, set_rng_state
+        vals = list(self.leaf_vals)
+        start = 0
+        if self.layer is not None:
+            params = diff_vals[0]
+            start = 1
+        for i, v in zip(self.diff_pos, diff_vals[start:]):
+            vals[i] = v
+        args, kwargs = jax.tree_util.tree_unflatten(self.treedef, vals)
+        saved = get_rng_state()
+        set_rng_state(self.rng_state0)
+        try:
+            if self.layer is not None:
+                from .functional import functional_call
+                merged = dict(self.frozen_params)
+                merged.update(params)
+                out = functional_call(self.layer, merged, *args,
+                                      buffers=dict(self.buffers0), **kwargs)
+            else:
+                out = self.fn(*args, **kwargs)
+        finally:
+            set_rng_state(saved)
+        leaves = [l for l in jax.tree_util.tree_leaves(out)
+                  if _is_float_array(l)]
+        return leaves
+
+    def run_backward(self, acc: Dict[int, jax.Array], needed: Dict[int, "_Node"]):
+        if self.released:
+            raise RuntimeError(
+                "Trying to backward through the graph a second time: the "
+                "tape was freed. Call backward(retain_graph=True) to keep it.")
+        diff_vals: List[Any] = []
+        if self.layer is not None:
+            diff_vals.append({n: self._param_value(n)
+                              for n in self.frozen_trainable_names})
+        diff_vals += [self.leaf_vals[i] for i in self.diff_pos]
+        _, pull = jax.vjp(lambda *dv: self._replay(dv), *diff_vals)
+        cts = [acc.get(id(t), None) for t in self.out_tensors]
+        cts = [jnp.zeros_like(t._value) if c is None else c
+               for c, t in zip(cts, self.out_tensors)]
+        grads = pull(cts)
+        gi = 0
+        if self.layer is not None:
+            self._write_param_grads(grads[0])
+            gi = 1
+        for parent, g in zip(self.parents, grads[gi:]):
+            pnode = parent._node
+            if pnode is not None and id(pnode) in needed:
+                prev = acc.get(id(parent))
+                acc[id(parent)] = g if prev is None else prev + g
+            elif not parent.stop_gradient:
+                if _suppress and id(parent) not in _suppress[-1]:
+                    continue  # paddle.grad: grads only for requested inputs
+                parent._accumulate_grad(g)
+
+    # layer-node plumbing: trainable params are re-read at backward time so
+    # repeated backward() calls after opt.step() see fresh values is NOT
+    # paddle semantics — grads must match the forward-time values. Snapshot.
+    @property
+    def frozen_trainable_names(self):
+        return self._trainable_names
+
+    def _param_value(self, name):
+        return self._trainable_snapshot[name]
+
+    def _write_param_grads(self, gdict: Dict[str, jax.Array]):
+        if _suppress_param_grads():
+            return
+        refs = dict(self.layer.named_parameters())
+        for name, g in gdict.items():
+            ref = refs[name]
+            ref.grad = g if ref.grad is None else ref.grad + g
+
+    def release(self):
+        self.released = True
+        self.leaf_vals = None
+        self.parents = ()
+        self.out_tensors = ()
+        if self.layer is not None:
+            self._trainable_snapshot = None
+            self.frozen_params = None
+            self.buffers0 = None
+            self.layer = None
+
+
+class _LayerNode(_Node):
+    __slots__ = ("_trainable_names", "_trainable_snapshot")
+
+
+class Tensor:
+    """paddle.Tensor parity wrapper over ``jax.Array``.
+
+    ``stop_gradient`` follows paddle semantics: True by default for
+    ``to_tensor`` results; outputs of recorded ops inherit
+    ``stop_gradient = not any(input requires grad)``. ``backward()`` fills
+    ``.grad`` on leaves and on Layer parameters reached through the tape.
+    """
+
+    __slots__ = ("_value", "stop_gradient", "_node", "_grad", "name",
+                 "persistable", "__weakref__")
+
+    def __init__(self, value, stop_gradient: bool = True, node=None,
+                 name: Optional[str] = None):
+        self._value = value if isinstance(value, jax.Array) \
+            else jnp.asarray(value)
+        self.stop_gradient = bool(stop_gradient)
+        self._node = node
+        self._grad = None
+        self.persistable = False
+        self.name = name or f"eager_tmp_{next(_counter)}"
+
+    # -- interop protocols --------------------------------------------------
+
+    def __jax_array__(self):
+        return self._value
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __repr__(self):
+        vals = np.asarray(self._value)
+        return (f"Tensor(shape={list(self._value.shape)}, "
+                f"dtype={self._value.dtype.name}, "
+                f"stop_gradient={self.stop_gradient},\n       {vals})")
+
+    # -- basic properties ---------------------------------------------------
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._value.shape)
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    ndimension = rank = lambda self: self._value.ndim
+
+    @property
+    def size(self) -> int:
+        return int(self._value.size)
+
+    @property
+    def T(self):
+        return record_call(jnp.transpose, (self,), {})
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def place(self):
+        d = list(self._value.devices())[0]
+        return f"Place({d.platform}:{d.id})"
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is None:
+            self._grad = None
+        else:
+            self._grad = g if isinstance(g, Tensor) \
+                else Tensor(jnp.asarray(g))
+
+    def _accumulate_grad(self, g: jax.Array):
+        if self._grad is None:
+            self._grad = Tensor(g)
+        else:
+            self._grad = Tensor(self._grad._value + g)
+
+    # -- conversion ---------------------------------------------------------
+
+    def numpy(self):
+        return np.asarray(self._value)
+
+    def item(self, *args):
+        return np.asarray(self._value).item(*args)
+
+    def tolist(self):
+        return np.asarray(self._value).tolist()
+
+    def __float__(self):
+        return float(np.asarray(self._value))
+
+    def __int__(self):
+        return int(np.asarray(self._value))
+
+    def __bool__(self):
+        return bool(np.asarray(self._value))
+
+    def __index__(self):
+        return int(np.asarray(self._value))
+
+    def __len__(self):
+        return self._value.shape[0]
+
+    def __format__(self, spec):
+        if self._value.ndim == 0:
+            return format(np.asarray(self._value).item(), spec)
+        return format(str(self), spec)
+
+    # -- autograd surface ---------------------------------------------------
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        """ref tensor_patch_methods.py:231 — reverse pass from this tensor."""
+        if self._node is None:
+            if not self.stop_gradient:
+                # backward on a leaf: grad is the seed itself (ref semantics:
+                # scalar leaf accumulates ones)
+                seed = jnp.ones_like(self._value) if grad_tensor is None \
+                    else to_tensor_value(grad_tensor)
+                self._accumulate_grad(seed)
+            return
+        backward_multi([self], [grad_tensor], retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True)
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        return record_call(lambda v: v + 0, (self,), {})
+
+    def register_hook(self, hook):  # grad-hook stub (functional AD)
+        return hook
+
+    def retain_grads(self):
+        self.stop_gradient = False
+
+    def stop_gradient_(self, v: bool):
+        self.stop_gradient = v
+        return self
+
+    # -- value mutation -----------------------------------------------------
+
+    def set_value(self, value):
+        self._value = jnp.asarray(to_tensor_value(value), self._value.dtype)
+        self._node = None
+        return self
+
+    def copy_(self, other, *a):
+        return self.set_value(other)
+
+    def _rebind(self, out: "Tensor") -> "Tensor":
+        """In-place op result: this Tensor becomes the op output."""
+        self._value = out._value
+        self._node = out._node
+        if out._node is not None:
+            # the node's output list must point at *self* for cotangent
+            # routing (the freshly created wrapper is discarded)
+            outs = list(out._node.out_tensors)
+            outs[outs.index(out)] = self
+            out._node.out_tensors = outs
+        self.stop_gradient = out.stop_gradient
+        return self
+
+    # -- dtype / device -----------------------------------------------------
+
+    def astype(self, dtype):
+        from ..core import dtype as dtypes
+        dt = dtypes.to_dtype(dtype)
+        return record_call(lambda v: v.astype(dt), (self,), {})
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def cpu(self):
+        return self
+
+    def cuda(self, *a, **k):
+        return self
+
+    def to(self, *args, **kwargs):
+        for a in args:
+            if isinstance(a, str) and ("float" in a or "int" in a
+                                       or "bool" in a or "bfloat" in a):
+                return self.astype(a)
+        if "dtype" in kwargs and kwargs["dtype"] is not None:
+            return self.astype(kwargs["dtype"])
+        return self
+
+    def pin_memory(self):
+        return self
+
+    # -- indexing -----------------------------------------------------------
+
+    def __getitem__(self, key):
+        key = jax.tree_util.tree_map(
+            lambda k: k._value if isinstance(k, Tensor) else k, key,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        return record_call(lambda v: v[key], (self,), {})
+
+    def __setitem__(self, key, value):
+        key = jax.tree_util.tree_map(
+            lambda k: k._value if isinstance(k, Tensor) else k, key,
+            is_leaf=lambda x: isinstance(x, Tensor))
+        out = record_call(lambda v, val: v.at[key].set(
+            jnp.asarray(val, v.dtype)), (self, value), {})
+        self._rebind(out)
+
+    def __iter__(self):
+        for i in range(self._value.shape[0]):
+            yield self[i]
+
+    # -- generic method fallback -------------------------------------------
+
+    def __getattr__(self, name):
+        if name.startswith("__"):
+            raise AttributeError(name)
+        fn, inplace = _resolve_method(name)
+        if fn is None:
+            raise AttributeError(
+                f"'Tensor' object has no attribute {name!r}")
+        base = getattr(fn, "__wrapped__", fn)
+        if inplace:
+            def method(*args, **kwargs):
+                return self._rebind(
+                    record_call(base, (self,) + args, kwargs))
+        else:
+            def method(*args, **kwargs):
+                return record_call(base, (self,) + args, kwargs)
+        method.__name__ = name
+        return method
+
+
+def _binop(fn):
+    def op(self, other):
+        return record_call(fn, (self, other), {})
+    return op
+
+
+def _rbinop(fn):
+    def op(self, other):
+        return record_call(fn, (other, self), {})
+    return op
+
+
+for _name, _fn in {
+    "__add__": lambda a, b: a + b, "__sub__": lambda a, b: a - b,
+    "__mul__": lambda a, b: a * b, "__truediv__": lambda a, b: a / b,
+    "__floordiv__": lambda a, b: a // b, "__mod__": lambda a, b: a % b,
+    "__pow__": lambda a, b: a ** b, "__matmul__": lambda a, b: a @ b,
+    "__and__": lambda a, b: a & b, "__or__": lambda a, b: a | b,
+    "__xor__": lambda a, b: a ^ b,
+    "__eq__": lambda a, b: a == b, "__ne__": lambda a, b: a != b,
+    "__lt__": lambda a, b: a < b, "__le__": lambda a, b: a <= b,
+    "__gt__": lambda a, b: a > b, "__ge__": lambda a, b: a >= b,
+}.items():
+    setattr(Tensor, _name, _binop(_fn))
+for _name, _fn in {
+    "__radd__": lambda a, b: a + b, "__rsub__": lambda a, b: a - b,
+    "__rmul__": lambda a, b: a * b, "__rtruediv__": lambda a, b: a / b,
+    "__rpow__": lambda a, b: a ** b, "__rmatmul__": lambda a, b: a @ b,
+    "__rmod__": lambda a, b: a % b, "__rfloordiv__": lambda a, b: a // b,
+}.items():
+    setattr(Tensor, _name, _rbinop(_fn))
+Tensor.__neg__ = lambda self: record_call(lambda a: -a, (self,), {})
+Tensor.__abs__ = lambda self: record_call(lambda a: jnp.abs(a), (self,), {})
+Tensor.__invert__ = lambda self: record_call(
+    lambda a: jnp.logical_not(a), (self,), {})
+Tensor.__hash__ = object.__hash__
+
+jax.tree_util.register_pytree_node(
+    Tensor,
+    lambda t: ((t._value,), (t.stop_gradient,)),
+    lambda meta, children: Tensor(children[0], stop_gradient=meta[0]))
+
+
+# ---------------------------------------------------------------------------
+# method-name resolution for the generic fallback
+
+
+_METHOD_CACHE: Dict[str, Tuple[Optional[Any], bool]] = {}
+
+
+def _resolve_method(name: str) -> Tuple[Optional[Any], bool]:
+    if name in _METHOD_CACHE:
+        return _METHOD_CACHE[name]
+    import paddle_tpu as _p
+    inplace = False
+    lookup = name
+    if name.endswith("_") and not name.endswith("__"):
+        inplace = True
+        lookup = name[:-1]
+    fn = None
+    for src in (_p, _p.nn.functional, _p.linalg if hasattr(_p, "linalg")
+                else _p):
+        cand = getattr(src, lookup, None)
+        if callable(cand) and not isinstance(cand, type):
+            fn = cand
+            break
+    _METHOD_CACHE[name] = (fn, inplace)
+    return fn, inplace
+
+
+# ---------------------------------------------------------------------------
+# recording
+
+
+def to_tensor_value(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def has_eager_tensor(args, kwargs) -> bool:
+    for a in args:
+        if isinstance(a, Tensor):
+            return True
+        if isinstance(a, (list, tuple)):
+            for e in a:
+                if isinstance(e, Tensor):
+                    return True
+    for v in kwargs.values():
+        if isinstance(v, Tensor):
+            return True
+        if isinstance(v, (list, tuple)):
+            for e in v:
+                if isinstance(e, Tensor):
+                    return True
+    return False
+
+
+def _wrap_outputs(out, node: Optional[_Node], requires_grad: bool):
+    """Wrap array leaves of `out` in Tensors; register float leaves on the
+    node (cotangent slots, in replay order)."""
+    float_tensors: List[Tensor] = []
+
+    def wrap_leaf(l):
+        if isinstance(l, Tensor):  # fn may pass inputs through
+            l = l._value
+        if isinstance(l, jax.Array):
+            diff = _is_float_array(l)
+            t = Tensor(l, stop_gradient=not (requires_grad and diff),
+                       node=node if diff else None)
+            if diff:
+                float_tensors.append(t)
+            return t
+        return l
+
+    wrapped = jax.tree_util.tree_map(
+        wrap_leaf, out, is_leaf=lambda x: isinstance(x, Tensor))
+    if node is not None:
+        node.out_tensors = float_tensors
+    return wrapped
+
+
+def record_call(fn, args: tuple, kwargs: dict):
+    """Run `fn` eagerly on unwrapped values; record a tape node when any
+    Tensor input requires grad and the output contains float arrays."""
+    from ..core.random import get_rng_state
+    leaves, treedef = _Node._flatten_call(args, kwargs)
+    vals = [to_tensor_value(l) for l in leaves]
+    diff_pos = [i for i, l in enumerate(leaves)
+                if isinstance(l, Tensor) and not l.stop_gradient
+                and _is_float_array(l._value)]
+    rng0 = get_rng_state()
+    uargs, ukwargs = jax.tree_util.tree_unflatten(treedef, vals)
+    out = fn(*uargs, **ukwargs)
+    requires = bool(diff_pos)
+    node = None
+    if requires and any(_is_float_array(l) or (isinstance(l, Tensor)
+                                               and _is_float_array(l._value))
+                        for l in jax.tree_util.tree_leaves(
+                            out, is_leaf=lambda x: isinstance(x, Tensor))):
+        node = _Node()
+        node.fn = fn
+        node.treedef = treedef
+        node.leaf_vals = vals
+        node.diff_pos = diff_pos
+        node.parents = [leaves[i] for i in diff_pos]
+        node.rng_state0 = rng0
+    return _wrap_outputs(out, node, requires)
+
+
+def eager_layer_call(layer, args: tuple, kwargs: dict):
+    """Record one tape node for a whole Layer call (see module docstring)."""
+    from ..core.random import get_rng_state, set_rng_state
+    from .functional import get_params, get_buffers
+
+    leaves, treedef = _Node._flatten_call(args, kwargs)
+    vals = [to_tensor_value(l) for l in leaves]
+    diff_pos = [i for i, l in enumerate(leaves)
+                if isinstance(l, Tensor) and not l.stop_gradient
+                and _is_float_array(l._value)]
+    trainable = get_params(layer, trainable_only=True)
+    all_params = get_params(layer)
+    frozen = {k: v for k, v in all_params.items() if k not in trainable}
+    buffers0 = get_buffers(layer)
+    rng0 = get_rng_state()
+
+    uargs, ukwargs = jax.tree_util.tree_unflatten(treedef, vals)
+    out = layer(*uargs, **ukwargs)  # plain imperative path (hooks, buffers)
+
+    requires = bool(diff_pos) or bool(trainable)
+    node = None
+    if requires:
+        node = _LayerNode()
+        node.fn = None
+        node.layer = layer
+        node.treedef = treedef
+        node.leaf_vals = vals
+        node.diff_pos = diff_pos
+        node.parents = [leaves[i] for i in diff_pos]
+        node.frozen_params = frozen
+        node._trainable_names = list(trainable)
+        node._trainable_snapshot = trainable
+        node.buffers0 = buffers0
+        node.rng_state0 = rng0
+    return _wrap_outputs(out, node, requires)
+
+
+def backward_multi(tensors, seeds=None, retain_graph: bool = False):
+    """One reverse pass seeded from several roots (ref backward.cc:421
+    accepts a tensor list): a shared subgraph is traversed once, so
+    ``paddle.autograd.backward([a, b])`` works on overlapping tapes."""
+    seeds = seeds or [None] * len(tensors)
+    nodes: Dict[int, _Node] = {}
+    acc: Dict[int, jax.Array] = {}
+    for t, s in zip(tensors, seeds):
+        seed = jnp.ones_like(t._value) if s is None else to_tensor_value(s)
+        if t._node is None:
+            if not t.stop_gradient:
+                t._accumulate_grad(seed)
+            continue
+        nodes.update(_collect_nodes(t._node))
+        prev = acc.get(id(t))
+        acc[id(t)] = seed if prev is None else prev + seed
+    for node in sorted(nodes.values(), key=lambda n: -n.counter):
+        node.run_backward(acc, nodes)
+    if not retain_graph:
+        for node in nodes.values():
+            node.release()
+
+
+def _collect_nodes(root: _Node) -> Dict[int, _Node]:
+    needed: Dict[int, _Node] = {}
+    stack = [root]
+    while stack:
+        n = stack.pop()
+        if id(n) in needed:
+            continue
+        needed[id(n)] = n
+        for p in n.parents:
+            if p._node is not None and id(p._node) not in needed:
+                stack.append(p._node)
+    return needed
+
+
+def tape_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
+              allow_unused: bool = True):
+    """paddle.grad over the tape: d(outputs)/d(inputs) without touching
+    ``.grad`` (ref python/paddle/autograd — imperative paddle.grad)."""
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    seeds = grad_outputs if isinstance(grad_outputs, (list, tuple)) \
+        else [grad_outputs] * len(outs)
+    acc: Dict[int, jax.Array] = {}
+    nodes: Dict[int, _Node] = {}
+    for o, s in zip(outs, seeds):
+        if o._node is None:
+            continue
+        nodes.update(_collect_nodes(o._node))
+        seed = jnp.ones_like(o._value) if s is None else to_tensor_value(s)
+        prev = acc.get(id(o))
+        acc[id(o)] = seed if prev is None else prev + seed
+    # capture leaf grads without mutating .grad: temporarily swap the
+    # accumulation sink
+    captured: Dict[int, jax.Array] = {}
+    originals = {}
+    for t in ins:
+        originals[id(t)] = (t, t._grad, t.stop_gradient)
+        t.stop_gradient = False
+        t._grad = None
+    # paddle.grad must not touch param.grad or unrelated leaves' .grad
+    _suppress.append({id(t) for t in ins})
+    try:
+        for node in sorted(nodes.values(), key=lambda n: -n.counter):
+            node.run_backward(acc, nodes)
+        for t in ins:
+            g = t._grad
+            # non-leaf input: grad is its accumulated cotangent
+            if g is None and id(t) in acc:
+                g = Tensor(acc[id(t)])
+            captured[id(t)] = g
+    finally:
+        _suppress.pop()
+        for t, g0, sg0 in originals.values():
+            t._grad = g0
+            t.stop_gradient = sg0
+        if not retain_graph:
+            for node in nodes.values():
+                node.release()
+    result = []
+    for t in ins:
+        g = captured.get(id(t))
+        if g is None and not allow_unused:
+            raise ValueError("an input tensor is unused in the graph")
+        result.append(g)
+    return result
+
+
+# ---------------------------------------------------------------------------
+# API-surface installation
+
+
+_WRAPPED = {}
+
+
+def _make_wrapper(fn):
+    def wrapper(*args, **kwargs):
+        if not has_eager_tensor(args, kwargs):
+            return fn(*args, **kwargs)
+        return record_call(fn, args, kwargs)
+    wrapper.__name__ = getattr(fn, "__name__", "op")
+    wrapper.__doc__ = fn.__doc__
+    wrapper.__qualname__ = getattr(fn, "__qualname__", wrapper.__name__)
+    wrapper.__wrapped__ = fn
+    wrapper.__module__ = getattr(fn, "__module__", None)
+    return wrapper
+
+
+# functions that must see Tensor objects raw (they drive the tape itself
+# or move whole state dicts around), never unwrapped by the generic wrapper
+_NO_WRAP = {"grad", "to_tensor", "is_tensor", "save", "load", "batch",
+            "summary", "functional_call", "backward", "seed", "flops",
+            "iinfo", "finfo"}
+
+
+def install(module, names=None):
+    """Wrap the callables of `module` so Tensor args route through the tape
+    (the reference's setattr loop over tensor_patch_methods, inverted: we
+    patch the op surface once instead of the Tensor class per-method)."""
+    import types
+    ns = vars(module)
+    for name in list(names if names is not None else ns):
+        fn = ns.get(name)
+        is_ufunc = isinstance(fn, jnp.ufunc)
+        if not (isinstance(fn, types.FunctionType) or is_ufunc):
+            continue
+        if name.startswith("_") or name in _NO_WRAP \
+                or getattr(fn, "__wrapped__", None) is not None:
+            continue
+        mod = getattr(fn, "__module__", "") or ""
+        if not is_ufunc and not mod.startswith("paddle_tpu"):
+            continue
+        w = _make_wrapper(fn)
+        _WRAPPED[f"{module.__name__}.{name}"] = fn
+        setattr(module, name, w)

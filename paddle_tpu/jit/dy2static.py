@@ -21,9 +21,21 @@ What converts:
 - ``and`` / ``or`` / ``not`` over tensors → ``jnp.logical_*`` (both sides
   evaluate — short-circuit semantics are Python-only).
 
-Out of scope (loud errors, matching the reference's supported envelope):
-``break``/``continue`` under a tensor condition, ``return`` from only one
-branch of a tensor ``if``.
+- ``break``/``continue`` → loop-carried boolean guard flags (ref
+  ``jit/dy2static/break_continue_transformer.py``): the flag is set where
+  the statement stood, every later statement is guarded by ``not flag``,
+  a ``while`` test gains ``and not break_flag``, and a ``for`` body is
+  fully guarded (remaining fori iterations become no-ops);
+- early ``return`` → return-flag + return-value variables (ref
+  ``early_return_transformer.py`` / ``return_transformer.py``); the
+  return-value slot starts as ``None`` and is materialized to zeros of the
+  other branch's abstract shape inside ``lax.cond``/``lax.while_loop``
+  (only for generated ``__jst_rv_*`` names — user variables assigned in
+  one branch still raise the structural error);
+- ``assert`` → runtime check via ``jax.debug.callback`` when traced (ref
+  ``assert_transformer.py``);
+- ``int()``/``float()``/``bool()``/``len()`` on traced tensors → dtype
+  casts / shape reads (ref ``cast_transformer.py``).
 """
 
 from __future__ import annotations
@@ -40,7 +52,9 @@ from jax import lax
 
 __all__ = ["convert_to_static", "Undefined", "UNDEFINED",
            "convert_ifelse", "convert_while", "convert_for_range",
-           "convert_logical_and", "convert_logical_or", "convert_logical_not"]
+           "convert_logical_and", "convert_logical_or", "convert_logical_not",
+           "convert_assert", "convert_len", "convert_int", "convert_float",
+           "convert_bool"]
 
 
 class Undefined:
@@ -78,13 +92,63 @@ def _is_traced(x) -> bool:
 # Runtime converters (the generated code calls these)
 # ---------------------------------------------------------------------------
 
-def convert_ifelse(cond, true_fn, false_fn, operands: tuple):
+def _no_leaves(x) -> bool:
+    return len(jax.tree_util.tree_leaves(x)) == 0
+
+
+def _spec_zeros(spec):
+    return jax.tree_util.tree_map(
+        lambda s: jnp.zeros(s.shape, s.dtype)
+        if hasattr(s, "shape") and hasattr(s, "dtype")
+        else jnp.zeros_like(jnp.asarray(s)), spec)
+
+
+def _evalable(x):
+    """eval_shape needs shape/dtype on every leaf; lift python scalars."""
+    return jax.tree_util.tree_map(
+        lambda l: l if hasattr(l, "shape") and hasattr(l, "dtype")
+        else jnp.asarray(l), x)
+
+
+def _materialize_undef(operands, out_spec, undef_ok):
+    """Replace empty-pytree operands (None/UNDEFINED) in `undef_ok` slots
+    with zeros of the loop body's abstract output — the return-value slot
+    of a rewritten early return (never read while its flag is False)."""
+    ops = list(operands)
+    for i in undef_ok:
+        if _no_leaves(ops[i]) and not _no_leaves(out_spec[i]):
+            ops[i] = _spec_zeros(out_spec[i])
+    return tuple(ops)
+
+
+def convert_ifelse(cond, true_fn, false_fn, operands: tuple,
+                   undef_ok: tuple = ()):
     """Dispatch an ``if``: lax.cond for traced conditions, Python otherwise."""
     if _is_traced(cond) or any(_is_traced(o) for o in operands):
         if not _is_traced(cond):
             # Concrete cond with traced operands: still take one branch
             # eagerly — matches Python semantics and avoids tracing both.
             return true_fn(*operands) if cond else false_fn(*operands)
+        if undef_ok:
+            ev = _evalable(operands)
+            ot = jax.eval_shape(true_fn, *ev)
+            of = jax.eval_shape(false_fn, *ev)
+
+            def _fix(fn, mine, other):
+                idxs = [i for i in undef_ok
+                        if _no_leaves(mine[i]) and not _no_leaves(other[i])]
+                if not idxs:
+                    return fn
+
+                def wrapped(*ops):
+                    out = list(fn(*ops))
+                    for i in idxs:
+                        out[i] = _spec_zeros(other[i])
+                    return tuple(out)
+                return wrapped
+
+            true_fn = _fix(true_fn, ot, of)
+            false_fn = _fix(false_fn, of, ot)
         try:
             return lax.cond(cond, true_fn, false_fn, *operands)
         except TypeError as e:
@@ -99,10 +163,13 @@ def convert_ifelse(cond, true_fn, false_fn, operands: tuple):
     return true_fn(*operands) if cond else false_fn(*operands)
 
 
-def convert_while(cond_fn, body_fn, operands: tuple):
+def convert_while(cond_fn, body_fn, operands: tuple, undef_ok: tuple = ()):
     """Dispatch a ``while``: lax.while_loop when the condition traces."""
     probe = cond_fn(*operands)
     if _is_traced(probe) or any(_is_traced(o) for o in operands):
+        if undef_ok:
+            out_spec = jax.eval_shape(body_fn, *_evalable(operands))
+            operands = _materialize_undef(operands, out_spec, undef_ok)
         for o in operands:
             if o is UNDEFINED:
                 raise ValueError(
@@ -117,12 +184,19 @@ def convert_while(cond_fn, body_fn, operands: tuple):
     return operands
 
 
-def convert_for_range(start, stop, step, body_fn, operands: tuple):
+def convert_for_range(start, stop, step, body_fn, operands: tuple,
+                      undef_ok: tuple = ()):
     """Dispatch ``for i in range(...)``: lax.fori_loop (step 1, traced
     bounds) / lax.while_loop (general step) / Python range otherwise."""
     traced = any(_is_traced(x) for x in (start, stop, step)) or \
         any(_is_traced(o) for o in operands)
     if traced:
+        if undef_ok:
+            i_spec = jnp.asarray(start)
+            out_spec = jax.eval_shape(
+                lambda i, *ops: body_fn(i, *ops), i_spec,
+                *_evalable(operands))
+            operands = _materialize_undef(operands, out_spec, undef_ok)
         for o in operands:
             if o is UNDEFINED:
                 raise ValueError(
@@ -163,6 +237,56 @@ def convert_logical_not(x):
     if _is_traced(x) or isinstance(x, jax.Array):
         return jnp.logical_not(x)
     return not x
+
+
+def resolve_return(v):
+    """Final value of a rewritten function: the UNDEFINED placeholder means
+    no `return` statement ever fired — Python's implicit None."""
+    return None if v is UNDEFINED else v
+
+
+def concrete_true(x) -> bool:
+    """True only for a CONCRETE truthy flag — used to really `break` out of
+    python-iterated loops; traced flags fall back to guarded no-ops."""
+    return (not _is_traced(x)) and bool(x)
+
+
+def convert_assert(cond, msg=None):
+    """``assert`` over a traced condition (ref assert_transformer.py →
+    static Assert op): checked at run time via a host callback."""
+    if _is_traced(cond):
+        def _check(c):
+            if not bool(c):
+                raise AssertionError(
+                    msg if msg is not None else "dy2static assert failed")
+        jax.debug.callback(_check, cond)
+        return
+    assert cond, msg
+
+
+def convert_len(x):
+    if _is_traced(x) or isinstance(x, jax.Array):
+        return x.shape[0]
+    return len(x)
+
+
+def convert_int(x):
+    if _is_traced(x) or isinstance(x, jax.Array):
+        return jnp.asarray(x).astype(jnp.int32)
+    return int(x)
+
+
+def convert_float(x):
+    if _is_traced(x) or isinstance(x, jax.Array):
+        from ..core.dtype import get_default_dtype
+        return jnp.asarray(x).astype(get_default_dtype())
+    return float(x)
+
+
+def convert_bool(x):
+    if _is_traced(x) or isinstance(x, jax.Array):
+        return jnp.asarray(x).astype(jnp.bool_)
+    return bool(x)
 
 
 # ---------------------------------------------------------------------------
@@ -214,6 +338,31 @@ def _contains(nodes: Sequence[ast.stmt], kinds) -> bool:
     return False
 
 
+def _iter_owned_break_continue(body: Sequence[ast.stmt]):
+    """Yield Break/Continue nodes belonging to THIS loop body — nested
+    loops own theirs, nested function defs are separate scopes. The SINGLE
+    ownership walker: the rewriter (collect) and the converters'
+    leave-eager guards (test) must agree on ownership."""
+    for s in body:
+        if isinstance(s, (ast.While, ast.For, ast.FunctionDef,
+                          ast.AsyncFunctionDef)):
+            continue
+        if isinstance(s, (ast.Break, ast.Continue)):
+            yield s
+            continue
+        for fld in ("body", "orelse", "finalbody"):
+            sub = getattr(s, fld, None)
+            if sub:
+                yield from _iter_owned_break_continue(sub)
+        if isinstance(s, ast.Try):
+            for h in s.handlers:
+                yield from _iter_owned_break_continue(h.body)
+
+
+def _owned_break_continue(body: Sequence[ast.stmt]) -> bool:
+    return any(True for _ in _iter_owned_break_continue(body))
+
+
 def _has_top_level_return(nodes: Sequence[ast.stmt]) -> bool:
     """Return statements excluding those inside nested function defs."""
     for n in nodes:
@@ -234,12 +383,17 @@ def _fresh(prefix: str) -> str:
     return f"__jst_{prefix}_{_CTR[0]}"
 
 
+_FN_PREFIXES = ("__jst_true_fn", "__jst_false_fn", "__jst_cond_fn",
+                "__jst_body_fn", "__jst_for_body")
+
+
 class _GeneratedNames:
-    """`some_set - _GENERATED` filters out generated helper names, which
-    must never join a carried-variable set (they are functions)."""
+    """`some_set - _GENERATED` filters out generated helper FUNCTION names,
+    which must never join a carried-variable set. Generated DATA names
+    (break/continue/return flags, return values) stay carried."""
 
     def __rsub__(self, other):
-        return {n for n in other if not n.startswith("__jst_")}
+        return {n for n in other if not n.startswith(_FN_PREFIXES)}
 
 
 _GENERATED = _GeneratedNames()
@@ -249,12 +403,52 @@ def _name(id_, ctx=None):
     return ast.Name(id=id_, ctx=ctx or ast.Load())
 
 
+def _written_before_read(stmts: Sequence[ast.stmt], name: str,
+                         pre_reads: Sequence[ast.AST] = ()) -> bool:
+    """True when `name` is unconditionally assigned at the top level of
+    `stmts` before any possible read — its entry value is provably dead, so
+    a zeros placeholder is safe for the loop-carry."""
+    if name in _read_names(list(pre_reads)):
+        return False
+    for s in stmts:
+        reads = _read_names([s])
+        if isinstance(s, ast.Assign) and name not in reads and any(
+                isinstance(t, ast.Name) and t.id == name for t in s.targets):
+            return True
+        if name in reads:
+            return False
+        if name in _assigned_names([s]):
+            return False  # conditional / compound write
+    return False
+
+
+def _undef_ok_kw(carried: Sequence[str], body: Sequence[ast.stmt] = (),
+                 pre_reads: Sequence[ast.AST] = ()) -> List[ast.keyword]:
+    """keyword for carried slots whose entry value may be a None/UNDEFINED
+    placeholder materialized to zeros: generated return-value vars, plus
+    user vars provably written before read in the loop body."""
+    idxs = [i for i, c in enumerate(carried)
+            if c.startswith("__jst_rv")
+            or (body and _written_before_read(body, c, pre_reads))]
+    if not idxs:
+        return []
+    return [ast.keyword(arg="undef_ok", value=ast.Tuple(
+        elts=[ast.Constant(value=i) for i in idxs], ctx=ast.Load()))]
+
+
 def _undefined_default(names: Sequence[str]) -> List[ast.stmt]:
     """`name = __jst.UNDEFINED if '<name>' not in dir() else name` — cheaper:
     we emit  try/except NameError guards so names missing on entry carry the
-    sentinel."""
+    sentinel. Generated guard flags default to False (they are always
+    re-initialized before being read) and return-value slots to None, so an
+    inner rewritten loop composes with an enclosing converted loop."""
     stmts = []
     for nm in names:
+        if nm.startswith(("__jst_brk", "__jst_cont", "__jst_rf")):
+            default: ast.expr = ast.Constant(value=False)
+        else:
+            default = ast.Attribute(value=_name("__jst"), attr="UNDEFINED",
+                                    ctx=ast.Load())
         stmts.append(ast.Try(
             body=[ast.Assign(targets=[_name(nm, ast.Store())],
                              value=_name(nm))],
@@ -264,11 +458,240 @@ def _undefined_default(names: Sequence[str]) -> List[ast.stmt]:
                                ctx=ast.Load()),
                 name=None,
                 body=[ast.Assign(
-                    targets=[_name(nm, ast.Store())],
-                    value=ast.Attribute(value=_name("__jst"),
-                                        attr="UNDEFINED", ctx=ast.Load()))])],
+                    targets=[_name(nm, ast.Store())], value=default)])],
             orelse=[], finalbody=[]))
     return stmts
+
+
+def _assign(name: str, value: ast.expr) -> ast.stmt:
+    return ast.Assign(targets=[_name(name, ast.Store())], value=value)
+
+
+def _const(v) -> ast.expr:
+    return ast.Constant(value=v)
+
+
+def _not_flags(flags: Sequence[str]) -> ast.expr:
+    """`not (f1 or f2 or ...)` — converted later by the BoolOp/Not visitors
+    so it works for both python and traced flags."""
+    test: ast.expr = _name(flags[0])
+    for f in flags[1:]:
+        test = ast.BoolOp(op=ast.Or(), values=[test, _name(f)])
+    return ast.UnaryOp(op=ast.Not(), operand=test)
+
+
+class _BreakContinueRewriter(ast.NodeTransformer):
+    """break/continue → loop-carried guard flags (ref
+    break_continue_transformer.py).
+
+    Runs BEFORE the control-flow transformer: the output is flag-based pure
+    Python, which the main pass then lowers (flag `if`s → lax.cond, the
+    augmented `while` test → lax.while_loop condition). A `for range` loop
+    keeps its trip count — iterations after a `break` are fully guarded
+    no-ops, which is exactly the lax.fori_loop-compatible lowering.
+    """
+
+    def _loop_stmts(self, body: Sequence[ast.stmt], kinds):
+        """Break/Continue nodes belonging to THIS loop (nested loops were
+        already rewritten bottom-up, and python-only nested loops own their
+        own break/continue). Shares the ownership walker with the
+        converters' leave-eager guards."""
+        return [s for s in _iter_owned_break_continue(body)
+                if isinstance(s, kinds)]
+
+    def _process(self, stmts, bflag, cflag, flags):
+        """Replace break/continue with flag sets; guard trailing statements
+        at every nesting level. Returns (new_stmts, may_set_flag)."""
+        out: List[ast.stmt] = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Break):
+                out.append(_assign(bflag, _const(True)))
+                return out, True
+            if isinstance(s, ast.Continue):
+                out.append(_assign(cflag, _const(True)))
+                return out, True
+            may = False
+            if isinstance(s, ast.If):
+                nb, mb = self._process(s.body, bflag, cflag, flags)
+                no, mo = self._process(s.orelse, bflag, cflag, flags)
+                s.body = nb or [ast.Pass()]
+                s.orelse = no
+                may = mb or mo
+            out.append(s)
+            if may:
+                rest, _ = self._process(stmts[idx + 1:], bflag, cflag, flags)
+                if rest:
+                    guard = ast.If(test=_not_flags(flags), body=rest,
+                                   orelse=[])
+                    out.append(guard)
+                return out, True
+        return out, False
+
+    def _rewrite_loop(self, node):
+        self.generic_visit(node)
+        owned = self._loop_stmts(node.body, (ast.Break, ast.Continue))
+        if not owned:
+            return node
+        has_break = any(isinstance(s, ast.Break) for s in owned)
+        has_cont = any(isinstance(s, ast.Continue) for s in owned)
+        bflag = _fresh("brk") if has_break else _fresh("brk_unused")
+        cflag = _fresh("cont") if has_cont else _fresh("cont_unused")
+        flags = ([bflag] if has_break else []) + \
+            ([cflag] if has_cont else [])
+        body, _ = self._process(node.body, bflag, cflag, flags)
+        pre: List[ast.stmt] = []
+        if has_break:
+            pre.append(_assign(bflag, _const(False)))
+        if has_cont:
+            # the flag is reset at each iteration start, but it is also a
+            # loop-carried operand, so it needs a pre-loop binding
+            pre.append(_assign(cflag, _const(False)))
+        reset = [_assign(cflag, _const(False))] if has_cont else []
+        if isinstance(node, ast.While):
+            node.body = reset + body
+            if has_break:
+                node.test = ast.BoolOp(
+                    op=ast.And(),
+                    values=[ast.UnaryOp(op=ast.Not(), operand=_name(bflag)),
+                            node.test])
+        else:  # For: guard whole body; trip count is preserved
+            inner = reset + body
+            if has_break:
+                guarded = [ast.If(test=ast.UnaryOp(op=ast.Not(),
+                                                   operand=_name(bflag)),
+                                  body=inner, orelse=[])]
+                is_range = (isinstance(node.iter, ast.Call)
+                            and isinstance(node.iter.func, ast.Name)
+                            and node.iter.func.id == "range")
+                if not is_range:
+                    # python-iterated loop: a concrete break flag should
+                    # actually stop the iterator, not no-op through it
+                    guarded.insert(0, ast.If(
+                        test=ast.Call(
+                            func=ast.Attribute(value=_name("__jst"),
+                                               attr="concrete_true",
+                                               ctx=ast.Load()),
+                            args=[_name(bflag)], keywords=[]),
+                        body=[ast.Break()], orelse=[]))
+                node.body = guarded
+            else:
+                node.body = inner
+        out = pre + [node]
+        for s in out:
+            ast.copy_location(s, node)
+            ast.fix_missing_locations(s)
+        return out
+
+    visit_While = _rewrite_loop
+    visit_For = _rewrite_loop
+
+
+def _contains_return(node_or_list) -> bool:
+    nodes = node_or_list if isinstance(node_or_list, list) else [node_or_list]
+    return _has_top_level_return(nodes)
+
+
+def _returns_ok(stmts: Sequence[ast.stmt]) -> bool:
+    """True when every return is in tail position (the form the plain
+    if-transformer already supports) — no rewrite needed."""
+    if not stmts:
+        return True
+    for s in stmts[:-1]:
+        if _contains_return([s]):
+            return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        b, o = _contains_return(last.body), _contains_return(last.orelse)
+        if not (b or o):
+            return True
+        if b != o:
+            return False  # single-branch tail return = early return
+        return _returns_ok(last.body) and _returns_ok(last.orelse)
+    return not _contains_return([last])
+
+
+class _ReturnRewriter:
+    """Early returns → return-flag + return-value vars (ref
+    early_return_transformer.py / return_transformer.py). Applied to the
+    top-level function only; the value var is named ``__jst_rv_*`` so the
+    lax converters may materialize its None placeholder as zeros."""
+
+    def rewrite(self, fdef):
+        if _returns_ok(fdef.body):
+            return
+        self.rf = _fresh("rf")
+        self.rv = _fresh("rv")
+        body, _ = self._process(fdef.body)
+        # rv starts as the UNDEFINED placeholder (NOT None): an explicit
+        # user `return None` assigns real None, which then structurally
+        # mismatches an array-returning branch instead of being silently
+        # materialized to zeros; the final resolve maps a never-fired
+        # placeholder back to Python's implicit None.
+        undef = ast.Attribute(value=_name("__jst"), attr="UNDEFINED",
+                              ctx=ast.Load())
+        resolve = ast.Call(
+            func=ast.Attribute(value=_name("__jst"), attr="resolve_return",
+                               ctx=ast.Load()),
+            args=[_name(self.rv)], keywords=[])
+        fdef.body = ([_assign(self.rf, _const(False)),
+                      _assign(self.rv, undef)] + body +
+                     [ast.Return(value=resolve)])
+        for s in fdef.body:
+            ast.copy_location(s, fdef)
+            ast.fix_missing_locations(s)
+
+    def _process(self, stmts) -> Tuple[List[ast.stmt], bool]:
+        out: List[ast.stmt] = []
+        for idx, s in enumerate(stmts):
+            if isinstance(s, ast.Return):
+                out.append(_assign(self.rf, _const(True)))
+                out.append(_assign(self.rv,
+                                   s.value if s.value is not None
+                                   else _const(None)))
+                return out, True
+            may = False
+            if isinstance(s, ast.If):
+                nb, mb = self._process(s.body)
+                no, mo = self._process(s.orelse)
+                s.body = nb or [ast.Pass()]
+                s.orelse = no
+                may = mb or mo
+            elif isinstance(s, ast.While):
+                nb, mb = self._process(s.body)
+                if mb:
+                    s.body = nb
+                    s.test = ast.BoolOp(
+                        op=ast.And(),
+                        values=[ast.UnaryOp(op=ast.Not(),
+                                            operand=_name(self.rf)),
+                                s.test])
+                    may = True
+            elif isinstance(s, ast.For):
+                nb, mb = self._process(s.body)
+                if mb:
+                    s.body = [ast.If(
+                        test=ast.UnaryOp(op=ast.Not(),
+                                         operand=_name(self.rf)),
+                        body=nb, orelse=[])]
+                    may = True
+            elif isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                pass  # nested scopes own their returns
+            elif _contains_return([s]):
+                raise NotImplementedError(
+                    "dy2static: `return` inside "
+                    f"{type(s).__name__} is not convertible")
+            out.append(s)
+            if may:
+                rest, _ = self._process(stmts[idx + 1:])
+                if rest:
+                    out.append(ast.If(test=ast.UnaryOp(
+                        op=ast.Not(), operand=_name(self.rf)),
+                        body=rest, orelse=[]))
+                return out, True
+        return out, False
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -278,6 +701,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_If(self, node: ast.If):
         self.generic_visit(node)
+        if _owned_break_continue(node.body) or \
+                _owned_break_continue(node.orelse or []):
+            # a residual python break/continue (e.g. the concrete-break
+            # shim in python-iterated loops) cannot move into a hoisted
+            # branch function — leave the `if` eager
+            return node
         body, orelse = node.body, node.orelse or [ast.Pass()]
         t_ret = _has_top_level_return(body)
         f_ret = _has_top_level_return(orelse)
@@ -313,7 +742,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             args=[node.test, _name(tf), _name(ff),
                   ast.Tuple(elts=[_name(c) for c in carried],
                             ctx=ast.Load())],
-            keywords=[])
+            keywords=_undef_ok_kw(carried))
         assign = ast.Assign(
             targets=[ast.Tuple(elts=[_name(c, ast.Store()) for c in carried],
                                ctx=ast.Store())],
@@ -351,9 +780,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         self.generic_visit(node)
         if node.orelse:
             raise NotImplementedError("dy2static: while/else not supported")
-        if _contains(node.body, (ast.Break, ast.Continue)):
+        if _owned_break_continue(node.body):
             # Leave untransformed: valid for Python-valued conditions;
             # tensor conditions will fail in jax with a clear tracer error.
+            # (break/continue are normally consumed by the rewriter pass —
+            # this only triggers for unconverted constructs.)
             return node
         if _has_top_level_return(node.body):
             raise NotImplementedError(
@@ -378,7 +809,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             args=[_name(cf), _name(bf),
                   ast.Tuple(elts=[_name(c) for c in carried],
                             ctx=ast.Load())],
-            keywords=[])
+            keywords=_undef_ok_kw(carried, node.body, [node.test]))
         assign = ast.Assign(
             targets=[ast.Tuple(elts=[_name(c, ast.Store()) for c in carried],
                                ctx=ast.Store())],
@@ -398,7 +829,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                     and node.iter.func.id == "range"
                     and not node.orelse
                     and isinstance(node.target, ast.Name)
-                    and not _contains(node.body, (ast.Break, ast.Continue)))
+                    and not _owned_break_continue(node.body))
         if not is_range:
             return node  # plain Python iteration (lists, enumerate, ...)
         if _has_top_level_return(node.body):
@@ -426,7 +857,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             args=[start, stop, step, _name(bf),
                   ast.Tuple(elts=[_name(c) for c in carried],
                             ctx=ast.Load())],
-            keywords=[])
+            keywords=_undef_ok_kw(carried, node.body, rargs))
         assign = ast.Assign(
             targets=[ast.Tuple(elts=[_name(c, ast.Store()) for c in carried],
                                ctx=ast.Store())],
@@ -469,6 +900,38 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         ast.fix_missing_locations(out)
         return out
 
+    # -- assert / casts -----------------------------------------------------
+
+    def visit_Assert(self, node: ast.Assert):
+        self.generic_visit(node)
+        out = ast.Expr(value=ast.Call(
+            func=ast.Attribute(value=_name("__jst"), attr="convert_assert",
+                               ctx=ast.Load()),
+            args=[node.test] + ([node.msg] if node.msg else []),
+            keywords=[]))
+        ast.copy_location(out, node)
+        ast.fix_missing_locations(out)
+        return out
+
+    _CAST_FNS = {"int": "convert_int", "float": "convert_float",
+                 "bool": "convert_bool", "len": "convert_len"}
+
+    def visit_Call(self, node: ast.Call):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name)
+                and node.func.id in self._CAST_FNS
+                and len(node.args) == 1 and not node.keywords
+                and not isinstance(node.args[0], ast.Starred)):
+            out = ast.Call(
+                func=ast.Attribute(value=_name("__jst"),
+                                   attr=self._CAST_FNS[node.func.id],
+                                   ctx=ast.Load()),
+                args=node.args, keywords=[])
+            ast.copy_location(out, node)
+            ast.fix_missing_locations(out)
+            return out
+        return node
+
 
 # ---------------------------------------------------------------------------
 # Entry point
@@ -493,6 +956,11 @@ def convert_to_static(fn: Callable) -> Callable:
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
     fdef.decorator_list = []  # run undecorated; to_static re-wraps
+    # pass order matters: early returns become flags first, then
+    # break/continue become flags, then the flag-based control flow is
+    # lowered to lax (ref: transform_ordering in program_translator.py)
+    _ReturnRewriter().rewrite(fdef)
+    _BreakContinueRewriter().visit(tree)
     new_tree = _ControlFlowTransformer().visit(tree)
     ast.fix_missing_locations(new_tree)
     code = compile(new_tree, filename=f"<dy2static {fn.__qualname__}>",

@@ -406,6 +406,11 @@ class Layer:
             f"{type(self).__name__} must implement forward()")
 
     def __call__(self, *args, **kwargs):
+        from ..framework import eager as _eager
+        if _eager.has_eager_tensor(args, kwargs):
+            # imperative dygraph path: record one tape node for this call
+            # so loss.backward() reaches the layer's parameters
+            return _eager.eager_layer_call(self, args, kwargs)
         for hook in self._forward_pre_hooks.values():
             result = hook(self, args)
             if result is not None:

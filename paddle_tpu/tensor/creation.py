@@ -19,9 +19,13 @@ __all__ = [
 ]
 
 
-def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> jax.Array:
-    """paddle.to_tensor: device placement via jax.device_put (place string
-    like 'tpu:0'); stop_gradient is advisory (grads are explicit in JAX)."""
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
+    """paddle.to_tensor: returns an eager :class:`~paddle_tpu.Tensor`
+    (imperative dygraph surface — ``.backward()``, ``.grad``, method
+    parity); device placement via jax.device_put (place string like
+    'tpu:0')."""
+    from ..framework.eager import Tensor, to_tensor_value
+    data = to_tensor_value(data)
     if dtype is not None:
         dtype = dtypes.to_dtype(dtype)
     elif isinstance(data, (float,)) or (
@@ -34,7 +38,7 @@ def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> jax.A
         if kind is not None:
             target = dev._platform_devices(kind)[idx]
             arr = jax.device_put(arr, target)
-    return arr
+    return Tensor(arr, stop_gradient=stop_gradient)
 
 
 def zeros(shape, dtype=None) -> jax.Array:

@@ -44,7 +44,8 @@ def isclose(x, y, rtol: float = 1e-5, atol: float = 1e-8,
 
 def is_tensor(x) -> bool:
     import jax
-    return isinstance(x, jax.Array)
+    from ..framework.eager import Tensor
+    return isinstance(x, (jax.Array, Tensor))
 
 
 def all(x, axis=None, keepdim: bool = False):

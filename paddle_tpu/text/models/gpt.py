@@ -138,15 +138,19 @@ class GPTAttention(nn.Layer):
     def _project_qkv(self, x):
         """-> q [b,s,H,D], k/v [b,s,KH,D], heads sharded over mp."""
         b, s, _ = x.shape
+        # batch/seq dims stay UNCONSTRAINED: pinning them replicated forces
+        # a replicate-then-repartition when the incoming activation is
+        # dp/sep-sharded (SPMD involuntary-remat warning, dryrun[8])
+        U = P.UNCONSTRAINED
         if self.kv_heads == self.num_heads:
             qkv = self.qkv_proj(x)  # [b, s, 3h] (h sharded over mp)
             qkv = qkv.reshape(b, s, 3, self.num_heads, self.head_dim)
-            qkv = _constrain(qkv, P(None, None, None, MP_AXIS, None))
+            qkv = _constrain(qkv, P(U, U, U, MP_AXIS, U))
             return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         q = self.q_proj(x).reshape(b, s, self.num_heads, self.head_dim)
-        q = _constrain(q, P(None, None, MP_AXIS, None))
+        q = _constrain(q, P(U, U, MP_AXIS, U))
         kv = self.kv_proj(x).reshape(b, s, 2, self.kv_heads, self.head_dim)
-        kv = _constrain(kv, P(None, None, None, MP_AXIS, None))
+        kv = _constrain(kv, P(U, U, U, MP_AXIS, U))
         return q, kv[:, :, 0], kv[:, :, 1]
 
     def _repeat_kv(self, k, v):

