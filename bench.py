@@ -70,6 +70,41 @@ def _timed_steps(step, state, args, steps):
     return lv, dt
 
 
+def _device_step_time(step, state, args, steps):
+    """DEVICE time per step from a profiler trace (hlo_stats total).
+
+    Through the axon tunnel every dispatch costs ~10-15 ms of host latency
+    that no real deployment pays (host-local dispatch pipelines ahead of a
+    >100 ms device step), so wall-clock under-reports chip throughput.
+    Returns (device_dt, state) or (None, state) when xprof is unavailable.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    tracedir = tempfile.mkdtemp(prefix="bench_trace_")
+    try:
+        loss = None
+        with jax.profiler.trace(tracedir):
+            for _ in range(steps):
+                loss, state = step(state, *args)
+            float(loss)  # sync inside the trace window
+        from paddle_tpu.profiler.statistic import device_statistics
+        st = device_statistics(tracedir, top=1)
+        if not st:
+            return None, state
+        by_cat, _ = st
+        total_ms = sum(by_cat.values())
+        if not total_ms:
+            return None, state
+        return total_ms / steps / 1e3, state
+    except Exception:
+        return None, state
+    finally:
+        shutil.rmtree(tracedir, ignore_errors=True)
+
+
 def _emit(name, value, unit, mfu, extra):
     import jax
     peak = _peak_flops(jax.devices()[0])
@@ -286,7 +321,11 @@ def bench_ernie(small: bool):
 # ---------------------------------------------------------------------------
 
 def _gpt_measure(layers, hidden, heads, seq, batch, steps, remat, vocab):
-    """Build + time one GPT train-step config; (dt_s, n_params, loss)."""
+    """Build + time one GPT train-step config.
+
+    Returns (dt_wall_s, dt_device_s_or_None, n_params, loss): wall is
+    min-of-3 chained windows; device comes from an xprof trace when the
+    parser is available."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -312,18 +351,34 @@ def _gpt_measure(layers, hidden, heads, seq, batch, steps, remat, vocab):
     def loss_fn(p, ids, labels):
         return functional_call(model, p, ids, labels, training=True)
 
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def step(state, ids, labels):
+    def one_step(state, ids, labels):
         p, st = state
         loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
         new_p, new_st = opt.apply_gradients(p, grads, st, 1e-4)
         return loss, (new_p, new_st)
 
+    # (a lax.scan over steps — one dispatch — was tried to hide the axon
+    # tunnel's ~10 ms/dispatch host latency, but XLA double-buffers the
+    # multi-GB carry at L=12, costing far more than it saves)
+    step = functools.partial(jax.jit, donate_argnums=(0,))(one_step)
+
     rng = np.random.default_rng(0)
     ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
     labels = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1), jnp.int32)
-    loss, dt = _timed_steps(step, (params, opt_state), (ids, labels), steps)
-    return dt, n_params, loss
+    state = (params, opt_state)
+    loss, state = step(state, ids, labels)  # compile
+    loss, state = step(state, ids, labels)
+    float(loss)
+    best = lv = None
+    for _ in range(3):  # min-of-3 windows: tunnel jitter is one-sided
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss, state = step(state, ids, labels)
+        lv = float(loss)
+        dt = (time.perf_counter() - t0) / steps
+        best = dt if best is None else min(best, dt)
+    dt_dev, state = _device_step_time(step, state, (ids, labels), steps)
+    return best, dt_dev, n_params, lv
 
 
 def _gpt_flops_per_token(n_params, layers, seq, hidden):
@@ -352,10 +407,17 @@ def bench_gpt_13b_extrapolated():
     seq, batch, heads, hidden, vocab = 2048, 4, 16, 2048, 50304
     pts = []
     for L in (6, 12):
-        dt, n_params, loss = _gpt_measure(L, hidden, heads, seq, batch,
-                                          steps=8, remat=True, vocab=vocab)
-        pts.append((L, dt, n_params, loss))
-    (l1, t1, _, loss1), (l2, t2, _, _) = pts
+        dt_wall, dt_dev, n_params, loss = _gpt_measure(
+            L, hidden, heads, seq, batch, steps=8, remat=True, vocab=vocab)
+        pts.append([L, dt_dev, n_params, loss, dt_wall])
+    # headline on DEVICE time when a trace was parsed for BOTH depths (the
+    # axon tunnel's ~10-15 ms/dispatch host latency is a harness artifact,
+    # not chip throughput); otherwise wall time for both — never mixed
+    timing_basis = "device" if all(p[1] for p in pts) else "wall"
+    for p in pts:
+        if timing_basis == "wall":
+            p[1] = p[4]
+    (l1, t1, _, loss1, w1), (l2, t2, _, _, w2) = pts
     per_layer = (t2 - t1) / (l2 - l1)
     fixed = t1 - l1 * per_layer
     t24 = fixed + 24 * per_layer
@@ -376,8 +438,12 @@ def bench_gpt_13b_extrapolated():
            "method": "per-layer extrapolation (1.3B opt state = 18.4 GB "
                      "> 15.75 GB HBM single-chip; BASELINE runs it mp=4)",
            "measured_points": [
-               {"layers": l, "step_ms": round(t * 1e3, 2)}
-               for l, t, _, _ in pts],
+               {"layers": l, "step_ms": round(t * 1e3, 2),
+                "wall_step_ms": round(w * 1e3, 2)}
+               for l, t, _, _, w in pts],
+           "timing": ("device (xprof hlo_stats; wall incl. ~10-15 ms/step "
+                      "axon-tunnel dispatch latency reported alongside)"
+                      if timing_basis == "device" else "wall"),
            "per_layer_ms": round(per_layer * 1e3, 2),
            "fixed_ms": round(fixed * 1e3, 2),
            "step_ms": round(t24 * 1e3, 2), "baseline_config": 4})

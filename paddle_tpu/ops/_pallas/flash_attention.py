@@ -659,6 +659,13 @@ def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
                     num_heads):
     o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
                   seg_q, seg_k)
+    # Residuals carry checkpoint names so a remat policy can elect to SAVE
+    # them: without this, jax.checkpoint re-runs the forward kernel inside
+    # the backward (~0.96 ms/layer at the 1.3B shape) just to regenerate
+    # (o, lse). See RecomputePolicy.DOTS_AND_FLASH.
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse, seg_q, seg_k)
 
 
@@ -686,6 +693,9 @@ def _flash_lse_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q,
                         block_k, num_heads):
     o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
                   seg_q, seg_k)
+    from jax.ad_checkpoint import checkpoint_name
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return (o, lse), (q, k, v, o, lse, seg_q, seg_k)
 
 

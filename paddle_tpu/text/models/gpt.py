@@ -53,7 +53,7 @@ class GPTConfig:
     sequence_parallel: bool = False
     recompute: bool = False
     # jax.checkpoint_policies name used when recompute is on
-    recompute_policy: str = "dots_saveable"
+    recompute_policy: str = "dots_and_flash_saveable"
     # Long-context CP over the 'sep' mesh axis: None | 'ring' | 'ulysses'.
     context_parallel: Optional[str] = None
 
@@ -263,13 +263,17 @@ class GPTBlock(nn.Layer):
 
     def forward(self, x):
         if self.cfg.recompute and self.training:
-            # Policy swept on the 1.3B shape (r3): full recompute
+            # Policy swept on the 1.3B shape (r3/r4): full recompute
             # (dots_with_no_batch_dims_saveable) costs ~25% step time;
             # saving fwd matmul outputs (dots_saveable) trades ~290 MB/
             # layer of bf16 activations for most of that time back — and
-            # the BASELINE layout (mp=4) quarters the per-chip share.
-            policy = getattr(jax.checkpoint_policies,
-                             self.cfg.recompute_policy)
+            # additionally saving the flash kernel's (o, lse) residuals
+            # plus LayerNorm outputs (dots_and_flash_saveable) skips the
+            # in-backward flash re-run (~1 ms/layer) and LN recomputes
+            # (~1.6 ms each) for ≈ +98 MB/layer. The BASELINE layout
+            # (mp=4) quarters the per-chip share.
+            from ...distributed.fleet.utils.recompute import RecomputePolicy
+            policy = RecomputePolicy.resolve(self.cfg.recompute_policy)
             return jax.checkpoint(self._inner, policy=policy)(x)
         return self._inner(x)
 
