@@ -56,18 +56,30 @@ def _peak_flops(dev) -> float:
 def _timed_steps(step, state, args, steps):
     """Run `steps` chained iterations of step(state, *args) -> (loss, state);
     returns (loss, dt_per_step). Syncs via a device->host transfer (see
-    PERF.md: block_until_ready is unreliable through the axon tunnel)."""
-    import jax
+    PERF.md: block_until_ready is unreliable through the axon tunnel).
+    One warm call beyond compile; delegates to the same wall window as
+    _wall_and_device so the sync discipline lives in one place."""
+    loss, state = step(state, *args)  # extra warm step (parity with r3)
+    lv, dt, _, _ = _wall_and_device(step, state, args, steps,
+                                    with_device=False)
+    return lv, dt
 
-    loss, state = step(state, *args)
-    loss, state = step(state, *args)
+
+def _wall_and_device(step, state, args, steps, with_device=True):
+    """Chain-safe timing for donated-state steps: wall window + device
+    trace, threading the live state through. Returns
+    (loss, dt_wall, dt_device_or_None, state)."""
+    loss, state = step(state, *args)  # compile + warm
     float(loss)
     t0 = time.perf_counter()
     for _ in range(steps):
         loss, state = step(state, *args)
     lv = float(loss)
     dt = (time.perf_counter() - t0) / steps
-    return lv, dt
+    dt_dev = None
+    if with_device:
+        dt_dev, state = _device_step_time(step, state, args, steps)
+    return lv, dt, dt_dev, state
 
 
 def _device_step_time(step, state, args, steps):
@@ -236,13 +248,98 @@ def bench_bert(small: bool):
     sop = jnp.asarray(rng.integers(0, 2, (batch, 1)), jnp.int32)
     state = (params, opt_state)
     flops = _compiled_flops(step, state, ids, labels, sop)
-    loss, dt = _timed_steps(step, state, (ids, labels, sop), steps)
-    tok_s = batch * seq / dt
-    mfu = flops / dt / _peak_flops(jax.devices()[0]) if flops else 0.0
+    loss, dt, dt_dev, state = _wall_and_device(step, state,
+                                               (ids, labels, sop), steps)
+    dt_used = dt_dev or dt
+    tok_s = batch * seq / dt_used
+    mfu = flops / dt_used / _peak_flops(jax.devices()[0]) if flops else 0.0
+
+    extra = {"loss": loss, "batch": batch, "seq": seq,
+             "step_ms": round(dt_used * 1e3, 2),
+             "wall_step_ms": round(dt * 1e3, 2),
+             "timing": "device" if dt_dev else "wall",
+             "baseline_config": 3}
+
+    if not small:
+        # VERDICT r4 asks #5/#8: masked attention on the flash path (key-
+        # bias block) and the PACKED varlen path (segment ids), both at a
+        # realistic padding ratio, real-token throughput reported.
+        rng2 = np.random.default_rng(1)
+        lengths = rng2.integers(seq // 4, seq + 1, batch)
+        att = (np.arange(seq)[None, :] < lengths[:, None])
+        real = int(att.sum())
+        att_j = jnp.asarray(att.astype(np.int32))
+        pl_labels = jnp.asarray(np.where(att, np.asarray(labels), -100),
+                                jnp.int32)
+
+        def loss_padded(p, ids, att, labels):
+            return functional_call(model, p, ids, None, att, labels, None,
+                                   training=True)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step_padded(state, ids, att, labels):
+            p, st = state
+            loss, grads = jax.value_and_grad(loss_padded)(p, ids, att,
+                                                          labels)
+            return loss, (*opt.apply_gradients(p, grads, st, 1e-4),)
+
+        _, dtp, dtp_dev, state = _wall_and_device(
+            step_padded, state, (ids, att_j, pl_labels), steps)
+        dtp_used = dtp_dev or dtp
+
+        # pack the SAME real tokens into fewer rows (greedy first-fit)
+        rows, row, used = [], [], 0
+        srow, snext = [], 1
+        for ln in lengths:
+            if used + ln > seq:
+                rows.append((row, srow))
+                row, srow, used, snext = [], [], 0, 1
+            row.append(int(ln))
+            srow.append(snext)
+            used += int(ln)
+            snext += 1
+        if row:
+            rows.append((row, srow))
+        n_rows = len(rows)
+        ids_np = np.asarray(ids)
+        pk_ids = np.zeros((n_rows, seq), np.int32)
+        pk_seg = np.zeros((n_rows, seq), np.int32)
+        pk_lab = np.full((n_rows, seq), -100, np.int32)
+        for r, (lens, segs) in enumerate(rows):
+            off = 0
+            for ln, sg in zip(lens, segs):
+                pk_ids[r, off:off + ln] = ids_np[0, :ln]
+                pk_seg[r, off:off + ln] = sg
+                pk_lab[r, off:off + ln] = np.asarray(labels)[0, :ln]
+                off += ln
+
+        def loss_packed(p, ids, seg, labels):
+            return functional_call(model, p, ids, None, None, labels, None,
+                                   training=True, packed_segment_ids=seg)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step_packed(state, ids, seg, labels):
+            p, st = state
+            loss, grads = jax.value_and_grad(loss_packed)(p, ids, seg,
+                                                          labels)
+            return loss, (*opt.apply_gradients(p, grads, st, 1e-4),)
+
+        pk_args = (jnp.asarray(pk_ids), jnp.asarray(pk_seg),
+                   jnp.asarray(pk_lab))
+        _, dtk, dtk_dev, state = _wall_and_device(step_packed, state,
+                                                  pk_args, steps)
+        dtk_used = dtk_dev or dtk
+        extra.update({
+            "padding_ratio": round(1 - real / (batch * seq), 3),
+            "padded_real_tokens_per_sec": round(real / dtp_used, 1),
+            "packed_real_tokens_per_sec": round(real / dtk_used, 1),
+            "packed_rows": n_rows,
+            "padded_step_ms": round(dtp_used * 1e3, 2),
+            "packed_step_ms": round(dtk_used * 1e3, 2),
+        })
+
     _emit("bert_base_amp_o2_tokens_per_sec_per_chip", tok_s,
-          "tokens/sec/chip", mfu,
-          {"loss": loss, "batch": batch, "seq": seq,
-           "step_ms": round(dt * 1e3, 2), "baseline_config": 3})
+          "tokens/sec/chip", mfu, extra)
 
 
 # ---------------------------------------------------------------------------

@@ -557,7 +557,8 @@ class MultiHeadAttention(Layer):
                           key.dtype)
         return MultiHeadAttention.Cache(empty, empty)
 
-    def forward(self, query, key=None, value=None, attn_mask=None, cache=None):
+    def forward(self, query, key=None, value=None, attn_mask=None, cache=None,
+                segment_ids=None):
         """With ``cache`` (a (k, v) pair from :meth:`gen_cache` or a prior
         step), keys/values are appended to it and ``(out, new_cache)`` is
         returned — paddle's incremental-decode contract. A ``StaticCache``
@@ -577,7 +578,7 @@ class MultiHeadAttention(Layer):
                 v = jnp.concatenate([cv, v], axis=1)
         out = F.scaled_dot_product_attention(
             q, k, v, attn_mask=attn_mask, dropout_p=self.dropout,
-            training=self.training)
+            training=self.training, segment_ids=segment_ids)
         out = self.out_proj(out.reshape(b, sq, self.embed_dim))
         if isinstance(cache, MultiHeadAttention.StaticCache):
             return out, cache
@@ -608,11 +609,12 @@ class TransformerEncoderLayer(Layer):
         self.dropout_act = Dropout(act_dropout if act_dropout is not None else dropout)
         self.activation = {"relu": F.relu, "gelu": F.gelu}[activation]
 
-    def forward(self, src, src_mask=None):
+    def forward(self, src, src_mask=None, segment_ids=None):
         residual = src
         if self.normalize_before:
             src = self.norm1(src)
-        src = self.self_attn(src, attn_mask=src_mask)
+        src = self.self_attn(src, attn_mask=src_mask,
+                             segment_ids=segment_ids)
         src = residual + self.dropout1(src)
         if not self.normalize_before:
             src = self.norm1(src)
@@ -635,10 +637,10 @@ class TransformerEncoder(Layer):
             raise TypeError("pass a factory: TransformerEncoder(lambda: layer, N)")
         self.norm = norm
 
-    def forward(self, src, src_mask=None):
+    def forward(self, src, src_mask=None, segment_ids=None):
         out = src
         for layer in self.layers:
-            out = layer(out, src_mask=src_mask)
+            out = layer(out, src_mask=src_mask, segment_ids=segment_ids)
         if self.norm is not None:
             out = self.norm(out)
         return out

@@ -118,6 +118,52 @@ def _pick_blocks(sq: int, sk: int, d: int) -> tuple:
     return fit(tq, sq), fit(tk, sk)
 
 
+def _mix32(x):
+    """murmur3 finalizer — a stateless uint32 mixer. Used for the dropout
+    mask so forward and both backward kernels regenerate the IDENTICAL
+    mask from (position, seed) with plain vector ops (the reference saves
+    CUDA RNG state for the same purpose, flash_attn_kernel.cu:76; the
+    pltpu hardware PRNG has no interpret-mode lowering, a jnp mixer runs
+    everywhere and is exactly mirrorable in the dense reference)."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> jnp.uint32(16))
+
+
+def _keep_threshold(rate: float) -> int:
+    """uint32 threshold: hash < threshold -> DROP (P = rate)."""
+    return min(int(rate * 4294967296.0), 4294967295)
+
+
+def _dropout_keepf(shape, bh, qi, kj, block_q, block_k, seq_q, seq_k,
+                   seed, rate: float):
+    """[shape] f32 factor: 0 where dropped, 1/keep_prob where kept."""
+    q_pos = (jnp.uint32(qi) * jnp.uint32(block_q)
+             + jax.lax.broadcasted_iota(jnp.uint32, shape, 0))
+    k_pos = (jnp.uint32(kj) * jnp.uint32(block_k)
+             + jax.lax.broadcasted_iota(jnp.uint32, shape, 1))
+    idx = (jnp.uint32(bh) * jnp.uint32(seq_q) + q_pos) \
+        * jnp.uint32(seq_k) + k_pos
+    h = _mix32(idx ^ seed.astype(jnp.uint32))
+    keep = h >= jnp.uint32(_keep_threshold(rate))
+    return keep.astype(jnp.float32) * (1.0 / (1.0 - rate))
+
+
+def dropout_keep_dense(bh, sq, sk, seed, rate: float):
+    """Dense mirror of the in-kernel mask: [BH, Sq, Sk] f32 keep factors.
+    The CPU/reference path uses this so flash-with-dropout is bitwise
+    consistent across backends under a fixed seed."""
+    q_pos = jax.lax.broadcasted_iota(jnp.uint32, (bh, sq, sk), 1)
+    k_pos = jax.lax.broadcasted_iota(jnp.uint32, (bh, sq, sk), 2)
+    b_idx = jax.lax.broadcasted_iota(jnp.uint32, (bh, sq, sk), 0)
+    idx = (b_idx * jnp.uint32(sq) + q_pos) * jnp.uint32(sk) + k_pos
+    h = _mix32(idx ^ jnp.asarray(seed).astype(jnp.uint32))
+    keep = h >= jnp.uint32(_keep_threshold(rate))
+    return keep.astype(jnp.float32) * (1.0 / (1.0 - rate))
+
+
 def _causal_mask(s, qi, kj, block_q, block_k, offset):
     """Bottom-right-aligned causal mask (query i attends keys <= i + offset,
     offset = sk - sq)."""
@@ -171,10 +217,12 @@ def _paired_qi_kj(p, t, nq):
     return qi, kj
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
-                m_scr, l_scr, acc_scr,
+def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, seed_ref,
+                bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale, causal, segmented, block_q, block_k, seq_q, seq_k,
-                paired_nq=None):
+                dropout=0.0, biased=False, paired_nq=None):
+    bh_id = pl.program_id(0)  # hoisted: program_id inside pl.when bodies
+    # has no interpret-mode lowering
     if paired_nq is None:
         qi = pl.program_id(1)
         kj = pl.program_id(2)
@@ -213,6 +261,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
         if segmented:
             s = _seg_mask(s, segq_ref, segk_ref)
+        if biased:
+            s = s + bias_ref[0]  # [1, bk] additive key bias, broadcast
         m_prev = m_scr[...]
         l_prev = l_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -221,8 +271,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, o_ref, lse_ref,
         p = jnp.exp(s - m_new) * (s > NEG_INF / 2)
         alpha = jnp.exp(m_prev - m_new)
         m_scr[...] = m_new
+        # attention-prob dropout: the softmax DENOMINATOR uses the
+        # undropped p; only the PV accumulation is masked+rescaled
+        # (ref flash_attn_kernel.cu:44 — dropout on P, not on the output)
         l_scr[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[...] = acc_scr[...] * alpha + _dot(p.astype(vb.dtype), vb,
+        pv = p
+        if dropout > 0.0:
+            pv = p * _dropout_keepf(p.shape, bh_id, qi, kj,
+                                    block_q, block_k, seq_q, seq_k,
+                                    seed_ref[0], dropout)
+        acc_scr[...] = acc_scr[...] * alpha + _dot(pv.astype(vb.dtype), vb,
                                                    ((1,), (0,)))
 
     @pl.when(last)
@@ -257,8 +315,16 @@ def _kv_index(h: int, hk: int):
     return index
 
 
+def _bias_or_dummy(bias, b, sk):
+    """bias: [B, 1, Sk] f32 additive key bias, or None -> dummy zeros."""
+    biased = bias is not None
+    if not biased:
+        bias = jnp.zeros((b, 1, sk), jnp.float32)
+    return biased, bias
+
+
 def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
-         seg_q=None, seg_k=None):
+         seg_q=None, seg_k=None, dropout=0.0, seed=None, bias=None):
     """q: [BH, S, D]; k,v: [B*HK, S, D] (+ optional [BH, 1, S] int32
     segment ids) -> (o [BH, Sq, D], lse [BH, 1, Sq] fp32)."""
     bh, sq, d = q.shape
@@ -268,6 +334,9 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     segmented, seg_q, seg_k = _segments_or_dummy(seg_q, seg_k, bh, sq, sk)
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    biased, bias = _bias_or_dummy(bias, bh // h, sk)
     nq, nk = sq // block_q, sk // block_k
     # Triangular enumeration for causal equal-length attention: pair rows
     # so no fully-masked key block is ever DMA'd (grid nq*nk ->
@@ -276,6 +345,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
     kern = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                              segmented=segmented, block_q=block_q,
                              block_k=block_k, seq_q=sq, seq_k=sk,
+                             dropout=dropout, biased=biased,
                              paired_nq=nq if paired else None)
     kv_index = _kv_index(h, hk)
     if paired:
@@ -300,6 +370,10 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
                          lambda b, p, t: (b, 0, qi_of(b, p, t))),
             pl.BlockSpec((1, 1, block_k),
                          lambda b, p, t: (b, 0, kj_of(b, p, t))),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, p, t, _h=num_heads:
+                         (b // _h, 0, kj_of(b, p, t))),
         ]
         out_specs = [
             pl.BlockSpec((1, block_q, d),
@@ -315,6 +389,9 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
             pl.BlockSpec((1, block_k, d), kv_index),
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
             pl.BlockSpec((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, i, j, _h=num_heads: (b // _h, 0, j)),
         ]
         out_specs = [
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -339,7 +416,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
             bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
             transcendentals=bh * sq * sk,
         ),
-    )(q, k, v, seg_q, seg_k)
+    )(q, k, v, seg_q, seg_k, seed, bias)
     return o, lse
 
 
@@ -348,9 +425,10 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                   segq_ref, segk_ref, dq_ref, dq_scr,
+                   segq_ref, segk_ref, seed_ref, bias_ref, dq_ref, dq_scr,
                    *, scale, causal, segmented, block_q, block_k,
-                   seq_q, seq_k, paired_nq=None):
+                   seq_q, seq_k, dropout=0.0, biased=False, paired_nq=None):
+    bh_id = pl.program_id(0)
     if paired_nq is None:
         qi = pl.program_id(1)
         kj = pl.program_id(2)
@@ -385,8 +463,16 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
         if segmented:
             s = _seg_mask(s, segq_ref, segk_ref)
+        if biased:
+            s = s + bias_ref[0]
         p = jnp.exp(s - lse) * (s > NEG_INF / 2)
         dp = _dot(do, vb, ((1,), (1,)))
+        if dropout > 0.0:
+            # dP = dPdropped * keepf; delta = rowsum(dO*O) already equals
+            # rowsum(P*dP) under dropout (O was built from the masked P)
+            dp = dp * _dropout_keepf(p.shape, bh_id, qi, kj,
+                                     block_q, block_k, seq_q, seq_k,
+                                     seed_ref[0], dropout)
         ds = (p * (dp - delta) * scale).astype(kb.dtype)
         dq_scr[...] = dq_scr[...] + _dot(ds, kb, ((1,), (0,)))
 
@@ -410,9 +496,11 @@ def _paired_kj_qi(p, t, nq):
 
 
 def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                    segq_ref, segk_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                    segq_ref, segk_ref, seed_ref, bias_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr,
                     *, scale, causal, segmented, block_q, block_k,
-                    seq_q, seq_k, num_q_blocks=None, paired_nq=None):
+                    seq_q, seq_k, num_q_blocks=None, paired_nq=None,
+                    dropout=0.0, biased=False, gqa_dims=None):
     if paired_nq is not None:
         p = pl.program_id(1)
         t = pl.program_id(2)
@@ -431,6 +519,19 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         first = t == 0
         last = t == nt - 1
     offset = seq_k - seq_q
+
+    bkv_id = pl.program_id(0)
+
+    def query_bh():
+        """Flat QUERY-head row for the dropout hash — must match the bh
+        the fwd/dq kernels used for this (q, k) tile."""
+        if gqa_dims is None:
+            return bkv_id
+        h, hk, rep = gqa_dims
+        if rep == 1:
+            return bkv_id
+        return (bkv_id // hk) * h + (bkv_id % hk) * rep \
+            + t // num_q_blocks
 
     @pl.when(first)
     def _init():
@@ -453,10 +554,19 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             s = _causal_mask(s, qi, kj, block_q, block_k, offset)
         if segmented:
             s = _seg_mask(s, segq_ref, segk_ref)
+        if biased:
+            s = s + bias_ref[0]
         p = jnp.exp(s - lse) * (s > NEG_INF / 2)
-        dv_scr[...] = dv_scr[...] + _dot(p.astype(dob.dtype), dob,
-                                         ((0,), (0,)))
+        pv = p
         dp = _dot(dob, vb, ((1,), (1,)))
+        if dropout > 0.0:
+            keepf = _dropout_keepf(p.shape, query_bh(), qi, kj, block_q,
+                                   block_k, seq_q, seq_k, seed_ref[0],
+                                   dropout)
+            pv = p * keepf   # dV uses the MASKED probabilities
+            dp = dp * keepf  # dP = dPdropped * keepf
+        dv_scr[...] = dv_scr[...] + _dot(pv.astype(dob.dtype), dob,
+                                         ((0,), (0,)))
         ds = (p * (dp - delta) * scale).astype(qb.dtype)
         dk_scr[...] = dk_scr[...] + _dot(ds, qb, ((0,), (0,)))
 
@@ -467,7 +577,8 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
-         seg_q=None, seg_k=None, dlse=None):
+         seg_q=None, seg_k=None, dlse=None, dropout=0.0, seed=None,
+         bias=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     h = num_heads
@@ -477,6 +588,9 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     segmented, seg_q, seg_k = _segments_or_dummy(seg_q, seg_k, bh, sq, sk)
+    if seed is None:
+        seed = jnp.zeros((1,), jnp.int32)
+    biased, bias = _bias_or_dummy(bias, b_, sk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)  # [BH, Sq]
     delta = delta[:, None, :]  # [BH, 1, Sq] — matches the slim lse layout
@@ -511,6 +625,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           segmented=segmented, block_q=block_q,
                           block_k=block_k, seq_q=sq, seq_k=sk,
+                          dropout=dropout, biased=biased,
                           paired_nq=nqb if dq_paired else None),
         grid=dq_grid,
         in_specs=[
@@ -532,12 +647,16 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
                          lambda b, i, j: (b, 0, row_of(b, i, j))),
             pl.BlockSpec((1, 1, block_k),
                          lambda b, i, j: (b, 0, col_of(b, i, j))),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, i, j, _h=h: (b // _h, 0,
+                                                col_of(b, i, j))),
         ],
         out_specs=pl.BlockSpec((1, block_q, d),
                                lambda b, i, j: (b, row_of(b, i, j), 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-    )(q, k, v, do, lse, delta, seg_q, seg_k)
+    )(q, k, v, do, lse, delta, seg_q, seg_k, seed, bias)
 
     # dk/dv are emitted per KV head ([B*HK, Sk, D]): for GQA (rep > 1) the
     # last grid axis streams rep * num_q_blocks steps — every query head of
@@ -615,7 +734,9 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
                           segmented=segmented, block_q=block_q,
                           block_k=block_k, seq_q=sq, seq_k=sk,
                           num_q_blocks=nq_blocks,
-                          paired_nq=nq_blocks if dkv_paired else None),
+                          paired_nq=nq_blocks if dkv_paired else None,
+                          dropout=dropout, biased=biased,
+                          gqa_dims=(h, hk, rep)),
         grid=dkv_grid,
         in_specs=[
             pl.BlockSpec((1, block_k, d), dkv_col),
@@ -626,6 +747,10 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
             stat_spec(),
             stat_spec(),
             pl.BlockSpec((1, 1, block_k), segk_index),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda b, j, t, _hk=hk: (b // _hk, 0,
+                                                  dkv_col(b, j, t)[1])),
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d), dkv_col),
@@ -639,7 +764,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-    )(k, v, q, do, lse, delta, seg_q, seg_k)
+    )(k, v, q, do, lse, delta, seg_q, seg_k, seed, bias)
     return dq, dk, dv
 
 
@@ -647,18 +772,18 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, num_heads,
 # custom_vjp wrapper, [B, S, H, D] public layout
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash_bhsd(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
-                num_heads):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
+def _flash_bhsd(q, k, v, seg_q, seg_k, seed, bias, scale, causal, block_q,
+                block_k, num_heads, dropout):
     o, _ = _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
-                seg_q, seg_k)
+                seg_q, seg_k, dropout=dropout, seed=seed, bias=bias)
     return o
 
 
-def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
-                    num_heads):
+def _flash_fwd_rule(q, k, v, seg_q, seg_k, seed, bias, scale, causal,
+                    block_q, block_k, num_heads, dropout):
     o, lse = _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
-                  seg_q, seg_k)
+                  seg_q, seg_k, dropout=dropout, seed=seed, bias=bias)
     # Residuals carry checkpoint names so a remat policy can elect to SAVE
     # them: without this, jax.checkpoint re-runs the forward kernel inside
     # the backward (~0.96 ms/layer at the 1.3B shape) just to regenerate
@@ -666,14 +791,17 @@ def _flash_fwd_rule(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
     from jax.ad_checkpoint import checkpoint_name
     o = checkpoint_name(o, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
-    return o, (q, k, v, o, lse, seg_q, seg_k)
+    return o, (q, k, v, o, lse, seg_q, seg_k, seed, bias)
 
 
-def _flash_bwd_rule(scale, causal, block_q, block_k, num_heads, res, do):
-    q, k, v, o, lse, seg_q, seg_k = res
+def _flash_bwd_rule(scale, causal, block_q, block_k, num_heads, dropout,
+                    res, do):
+    q, k, v, o, lse, seg_q, seg_k, seed, bias = res
     dq, dk, dv = _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k,
-                      num_heads, seg_q, seg_k)
-    return dq, dk, dv, None, None
+                      num_heads, seg_q, seg_k, dropout=dropout, seed=seed,
+                      bias=bias)
+    # the additive key bias is a mask, not a trained parameter: no cotangent
+    return dq, dk, dv, None, None, None, None
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -684,7 +812,7 @@ def _flash_bhsd_lse(q, k, v, seg_q, seg_k, scale, causal, block_q, block_k,
                     num_heads):
     """Like _flash_bhsd but returns (o, lse [BH, 1, Sq] fp32) and is
     differentiable in BOTH outputs — the lse cotangent feeds ring-attention
-    merges (distributed/context_parallel.py)."""
+    merges (distributed/context_parallel.py). No dropout (CP forbids it)."""
     return _fwd(q, k, v, scale, causal, block_q, block_k, num_heads,
                 seg_q, seg_k)
 
@@ -760,7 +888,9 @@ def flash_attention_pallas(query, key, value, causal: bool = False,
                            scale: Optional[float] = None,
                            block_q: Optional[int] = None,
                            block_k: Optional[int] = None,
-                           segment_ids=None, segment_ids_k=None):
+                           segment_ids=None, segment_ids_k=None,
+                           dropout: float = 0.0, dropout_seed=None,
+                           key_bias=None):
     """[B, S, H, D] flash attention via Pallas. Differentiable.
 
     Block sizes default to the autotuned table in ``_pick_blocks``; pass
@@ -810,6 +940,17 @@ def flash_attention_pallas(query, key, value, causal: bool = False,
         seg_k = seg_q if segment_ids_k is None and sq == sk else \
             per_head(segment_ids_k if segment_ids_k is not None
                      else segment_ids, sk, "segment_ids_k")
-    o = _flash_bhsd(q, k, v, seg_q, seg_k, float(scale), bool(causal),
-                    block_q, block_k, h)
+    if dropout > 0.0:
+        if dropout_seed is None:
+            from ...core.random import next_key
+            dropout_seed = jax.random.randint(
+                next_key(), (1,), 0, 2 ** 31 - 1, dtype=jnp.int32)
+        seed = jnp.asarray(dropout_seed, jnp.int32).reshape(1)
+    else:
+        seed = jnp.zeros((1,), jnp.int32)
+    bias = None
+    if key_bias is not None:
+        bias = jnp.asarray(key_bias, jnp.float32).reshape(b, 1, sk)
+    o = _flash_bhsd(q, k, v, seg_q, seg_k, seed, bias, float(scale),
+                    bool(causal), block_q, block_k, h, float(dropout))
     return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)

@@ -72,18 +72,60 @@ def _use_pallas(q, k) -> bool:
     return supported_shapes(q, k)
 
 
+def _dense_prob_dropout_attention(q, k, v, causal, scale, seed,
+                                  rate: float):
+    """Dense mirror of the kernel's attention-prob dropout: the SAME
+    position-hashed mask (``dropout_keep_dense``), applied to the softmax
+    probabilities (NOT the output — ref flash_attn_kernel.cu:44), so
+    pallas and fallback paths agree bitwise under a fixed seed."""
+    from ._pallas.flash_attention import dropout_keep_dense
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if k.shape[2] != h:
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), sk - sq)
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.where(jnp.isfinite(scores),
+                  jnp.exp(scores - jnp.where(jnp.isfinite(m), m, 0.0)), 0.0)
+    probs = e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+    keep = dropout_keep_dense(b * h, sq, sk, seed, rate)  # [BH, Sq, Sk]
+    probs = (probs * keep.reshape(b, h, sq, sk)).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 def flash_attention(query, key, value, dropout: float = 0.0,
                     causal: bool = False, return_softmax: bool = False,
-                    *, scale: Optional[float] = None, training: bool = True):
-    """paddle.nn.functional.flash_attention parity ([B,S,H,D])."""
+                    *, scale: Optional[float] = None, training: bool = True,
+                    fixed_seed_offset=None):
+    """paddle.nn.functional.flash_attention parity ([B,S,H,D]).
+
+    ``dropout`` is attention-PROB dropout inside the kernel (ref
+    flash_attn_kernel.cu:44): the mask is regenerated in backward from
+    (position, seed) — the TPU-native form of the reference's saved-RNG-
+    state recompute (:76). ``fixed_seed_offset`` pins the seed."""
     if return_softmax:
         raise NotImplementedError("return_softmax is a debug-only GPU feature")
     if dropout > 0.0 and training:
-        # Attention-prob dropout breaks the flash recomputation trick cheaply
-        # on TPU; paddle models we target use dropout=0 in attention core.
-        out = reference_attention(query, key, value, causal, scale)
-        from ..nn.functional import dropout as F_dropout
-        return F_dropout(out, dropout, training=True)
+        if fixed_seed_offset is not None:
+            seed = jnp.asarray(fixed_seed_offset, jnp.int32).reshape(1)
+        else:
+            from ..core.random import next_key
+            seed = jax.random.randint(next_key(), (1,), 0, 2 ** 31 - 1,
+                                      dtype=jnp.int32)
+        if _use_pallas(query, key):
+            from ._pallas.flash_attention import flash_attention_pallas
+            return flash_attention_pallas(query, key, value, causal=causal,
+                                          scale=scale, dropout=dropout,
+                                          dropout_seed=seed)
+        return _dense_prob_dropout_attention(query, key, value, causal,
+                                             scale, seed, dropout)
     if _use_pallas(query, key):
         from ._pallas.flash_attention import flash_attention_pallas
         return flash_attention_pallas(query, key, value, causal=causal,

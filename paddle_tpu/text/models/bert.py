@@ -88,13 +88,18 @@ class Bert(nn.Layer):
         self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size,
                                 weight_attr=_attr(cfg))
 
-    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                packed_segment_ids=None):
+        """``packed_segment_ids`` [B, S] int32 activates PACKED attention:
+        multiple sequences share a row, attention stays within segments
+        (flash_attn_unpadded's varlen semantics on static shapes)."""
         x = self.embeddings(input_ids, token_type_ids)
         mask = None
         if attention_mask is not None:
-            # [B, S] 1/0 -> additive [B, 1, 1, S]
+            # [B, S] 1/0 -> additive [B, 1, 1, S]; key-only masks ride the
+            # flash kernel's additive key-bias block (nn.functional SDPA)
             mask = (1.0 - attention_mask[:, None, None, :].astype(x.dtype)) * -1e9
-        x = self.encoder(x, src_mask=mask)
+        x = self.encoder(x, src_mask=mask, segment_ids=packed_segment_ids)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 
@@ -115,8 +120,10 @@ class BertForPretraining(nn.Layer):
         self.nsp_head = nn.Linear(cfg.hidden_size, 2, weight_attr=_attr(cfg))
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
-                masked_lm_labels=None, next_sentence_labels=None):
-        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+                masked_lm_labels=None, next_sentence_labels=None,
+                packed_segment_ids=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask,
+                                packed_segment_ids=packed_segment_ids)
         h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
         logits = jnp.matmul(h, self.bert.embeddings.word_embeddings.weight.T) \
             + self.mlm_bias

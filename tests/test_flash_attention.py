@@ -299,3 +299,93 @@ class TestPairedCausalEnumeration:
         o_r = reference_attention(q, k, v, True, None)
         np.testing.assert_allclose(np.asarray(o4), np.asarray(o_r),
                                    atol=2e-5)
+
+
+# ---- r4: in-kernel attention-prob dropout + additive key bias ----------
+
+class TestDropoutAndBias:
+    """VERDICT r3 missing #2 / ask #4: in-kernel attention-prob dropout
+    (mask regenerated in backward from position+seed — the TPU-native form
+    of flash_attn_kernel.cu:76's saved-RNG recompute) and the additive
+    key-bias block keeping masked models on the flash path."""
+
+    def test_dropout_kernel_matches_dense_mirror(self):
+        q, k, v = _rand_qkv(b=2, s=256, h=2, d=64)
+        seed = jnp.asarray([1234], jnp.int32)
+        with interpreted_pallas() as fa:
+            o_kernel = fa.flash_attention_pallas(
+                q, k, v, causal=True, dropout=0.1, dropout_seed=seed)
+        from paddle_tpu.ops.flash_attention import \
+            _dense_prob_dropout_attention
+        o_dense = _dense_prob_dropout_attention(q, k, v, True, None, seed,
+                                                0.1)
+        np.testing.assert_allclose(np.asarray(o_kernel),
+                                   np.asarray(o_dense), atol=2e-5)
+
+    def test_dropout_grads_match_dense_mirror(self):
+        q, k, v = _rand_qkv(b=1, s=256, h=2, d=64)
+        seed = jnp.asarray([7], jnp.int32)
+        from paddle_tpu.ops.flash_attention import \
+            _dense_prob_dropout_attention
+        with interpreted_pallas() as fa:
+            g = jax.grad(lambda q_: (fa.flash_attention_pallas(
+                q_, k, v, causal=True, dropout=0.2, dropout_seed=seed) ** 2)
+                .sum())(q)
+        gd = jax.grad(lambda q_: (_dense_prob_dropout_attention(
+            q_, k, v, True, None, seed, 0.2) ** 2).sum())(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(gd), atol=3e-4)
+
+    def test_dropout_rate_statistics(self):
+        from paddle_tpu.ops._pallas.flash_attention import dropout_keep_dense
+        keep = dropout_keep_dense(4, 256, 256, jnp.asarray([3], jnp.int32),
+                                  0.25)
+        frac = float((np.asarray(keep) == 0).mean())
+        assert abs(frac - 0.25) < 0.01
+        # kept entries carry the unbiased 1/keep scale
+        kept = np.asarray(keep)[np.asarray(keep) > 0]
+        np.testing.assert_allclose(kept, 1.0 / 0.75, rtol=1e-6)
+
+    def test_additive_key_bias_matches_reference(self):
+        from paddle_tpu.ops.flash_attention import reference_attention
+        b, s = 2, 256
+        q, k, v = _rand_qkv(b=b, s=s, h=2, d=64)
+        rng = np.random.default_rng(5)
+        bias_k = jnp.asarray(
+            np.where(rng.uniform(size=(b, s)) < 0.3, -1e9, 0.0), jnp.float32)
+        with interpreted_pallas() as fa:
+            o_kern = fa.flash_attention_pallas(q, k, v, key_bias=bias_k)
+        o_ref = reference_attention(q, k, v,
+                                    bias=bias_k[:, None, None, :])
+        np.testing.assert_allclose(np.asarray(o_kern), np.asarray(o_ref),
+                                   atol=2e-5)
+
+    def test_sdpa_key_mask_forms(self):
+        from paddle_tpu.nn.functional import _as_key_mask
+        b, sq, sk = 3, 8, 8
+        m = jnp.ones((b, sk), bool)
+        assert _as_key_mask(m, b, sq, sk).shape == (b, sk)
+        assert _as_key_mask(jnp.ones((b, 1, 1, sk)), b, sq, sk).shape \
+            == (b, sk)
+        assert _as_key_mask(jnp.ones((1, 1, 1, sk)), b, sq, sk).shape \
+            == (b, sk)
+        # per-query masks are NOT key-only
+        assert _as_key_mask(jnp.ones((b, 1, sq, sk)), b, sq, sk) is None
+
+    def test_packed_segment_ids_through_bert(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.text.models.bert import bert_tiny, BertForPretraining
+        paddle.seed(0)
+        cfg = bert_tiny()
+        model = BertForPretraining(cfg)
+        model.eval()
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 64)),
+                          jnp.int32)
+        seg = jnp.asarray(
+            np.concatenate([np.full((2, 32), 1), np.full((2, 32), 2)],
+                           axis=1), jnp.int32)
+        logits, _ = model(ids, packed_segment_ids=seg)
+        # packed segments == running the halves separately
+        l1, _ = model(ids[:, :32])
+        np.testing.assert_allclose(np.asarray(logits[:, :32]),
+                                   np.asarray(l1), atol=2e-3)
