@@ -19,3 +19,4 @@ push per-row gradients back, applied server-side with SGD/AdaGrad rules
 from .table import DenseTable, SparseTable, SSDSparseTable  # noqa: F401
 from .server import ParameterServer, run_server  # noqa: F401
 from .client import PSClient, PSEmbedding  # noqa: F401
+from .communicator import AsyncCommunicator  # noqa: F401

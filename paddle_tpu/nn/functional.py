@@ -13,6 +13,7 @@ data_format="NHWC"; on TPU, XLA canonicalizes layouts internally.
 
 from __future__ import annotations
 
+import functools
 import math
 from typing import Optional, Sequence, Tuple, Union
 
@@ -327,6 +328,73 @@ def adaptive_avg_pool2d(x, output_size, data_format: str = "NCHW"):
 # Normalization
 # ---------------------------------------------------------------------------
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train_core(x, weight, bias, axis, epsilon):
+    """Training-mode BN with the CLOSED-FORM backward (ref phi
+    batch_norm_grad kernel). Autodiff of the mean/var computation re-reads
+    the activation through the d(mean)/dx and d(var)/dx chains — measured
+    as ~5 operand-sized reads per BN-stat fusion in the ResNet-50 step
+    (2.99 ms vs the 1.10 ms two-read ideal). The classic closed form
+    needs exactly (dy, x) in backward:
+
+        dbeta = sum(dy);  dgamma = sum(dy * xhat)
+        dx = gamma*r * (dy - (xhat*dgamma + dbeta)/M)
+
+    Returns (y, mean_f32, var_f32); mean/var feed running-stat buffer
+    updates and are treated as non-differentiable (zero cotangent)."""
+    y, mean, var, _ = _bn_train_fwd_impl(x, weight, bias, axis, epsilon)
+    return y, mean, var
+
+
+def _bn_train_fwd_impl(x, weight, bias, axis, epsilon):
+    reduce_axes = tuple(i for i in range(x.ndim) if i != (axis % x.ndim))
+    shape = [1] * x.ndim
+    shape[axis % x.ndim] = x.shape[axis % x.ndim]
+    xf = x.astype(jnp.float32)
+    # single-pass stats (cuDNN-style sum/sumsq): jnp.var computes the mean
+    # first and re-reads the activation; one fused pass does both
+    n = x.size // x.shape[axis % x.ndim]
+    s1 = jnp.sum(xf, axis=reduce_axes)
+    s2 = jnp.sum(xf * xf, axis=reduce_axes)
+    mean = s1 / n
+    var = jnp.maximum(s2 / n - mean * mean, 0.0)
+    r = lax.rsqrt(var + epsilon)
+    scale = r * weight.astype(jnp.float32)
+    shift = bias.astype(jnp.float32) - mean * scale
+    y = x * scale.reshape(shape).astype(x.dtype) + \
+        shift.reshape(shape).astype(x.dtype)
+    return y, mean, var, r
+
+
+def _bn_train_fwd_rule(x, weight, bias, axis, epsilon):
+    y, mean, var, r = _bn_train_fwd_impl(x, weight, bias, axis, epsilon)
+    return (y, mean, var), (x, mean, r, weight)
+
+
+def _bn_train_bwd_rule(axis, epsilon, res, cts):
+    dy, _dmean, _dvar = cts  # running-stat outputs: no gradient path
+    x, mean, r, weight = res
+    ax = axis % x.ndim
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ax)
+    shape = [1] * x.ndim
+    shape[ax] = x.shape[ax]
+    m = x.size // x.shape[ax]
+    # one fused two-read pass: both channel reductions from (dy, x)
+    dyf = dy.astype(jnp.float32)
+    xhat_f = (x.astype(jnp.float32)
+              - mean.reshape(shape)) * r.reshape(shape)
+    dbeta = jnp.sum(dyf, axis=reduce_axes)
+    dgamma = jnp.sum(dyf * xhat_f, axis=reduce_axes)
+    # dx pass (reads dy, x again; per-channel f32 coefficients)
+    g_r = (weight.astype(jnp.float32) * r).reshape(shape)
+    dx = (g_r * (dyf - (xhat_f * dgamma.reshape(shape)
+                        + dbeta.reshape(shape)) / m)).astype(x.dtype)
+    return dx, dgamma.astype(weight.dtype), dbeta.astype(weight.dtype)
+
+
+_bn_train_core.defvjp(_bn_train_fwd_rule, _bn_train_bwd_rule)
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training: bool = False, momentum: float = 0.9, epsilon: float = 1e-5,
                data_format: str = "NCHW"):
@@ -340,6 +408,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     shape[axis % x.ndim] = x.shape[axis % x.ndim]
 
     if training:
+        if weight is not None and bias is not None:
+            out, mean, var = _bn_train_core(x, weight, bias, axis, epsilon)
+            n = x.size // x.shape[axis % x.ndim]
+            unbiased = var * n / max(n - 1, 1)
+            new_mean = momentum * running_mean + (1 - momentum) * mean
+            new_var = momentum * running_var + (1 - momentum) * unbiased
+            return out, new_mean, new_var
         xf = x.astype(jnp.float32)
         mean = xf.mean(axis=reduce_axes)
         var = xf.var(axis=reduce_axes)

@@ -34,6 +34,7 @@ __all__ = [
     # binary / multiary
     "add", "subtract", "multiply", "divide", "matmul", "masked_matmul", "mv",
     "addmm",
+    "slice", "pca_lowrank", "nn",
 ]
 
 

@@ -1,6 +1,7 @@
-"""Sparse 3-D convolution (ref paddle/phi/kernels/sparse/conv_kernel.h:1 —
-Conv3dCooKernel / submanifold variant; python surface
-paddle.sparse.nn.functional.conv3d / subm_conv3d).
+"""Sparse N-D convolution + pooling (ref paddle/phi/kernels/sparse/
+conv_kernel.h:1 — Conv3dCooKernel / submanifold variant; python surface
+paddle.sparse.nn.functional.{conv3d, subm_conv3d, conv2d, subm_conv2d,
+max_pool3d}).
 
 TPU-native design: the reference builds a gather-GEMM-scatter "rulebook"
 (per kernel offset: which input nnz hits which output position) in CUDA.
@@ -11,139 +12,200 @@ values:
 
     out[j] += sum_off  match_off[j, i] * (vals[i] @ W[off])
 
-- **subm_conv3d** (submanifold): output positions == input positions —
+- **subm_conv*d** (submanifold): output positions == input positions —
   fully jit/grad-compatible (the hot path for point-cloud backbones).
-- **conv3d** (standard): output positions are data-dependent (union of
-  shifted inputs), so the output index set is computed host-side eagerly
-  (like the reference's rulebook build on the stream) and the value
-  compute stays traceable.
+- **conv*d / max_pool3d** (standard): output positions are data-dependent
+  (union of shifted inputs), so the output index set is computed host-side
+  eagerly (like the reference's rulebook build on the stream) and the
+  value computation stays traceable.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+import itertools
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["subm_conv3d", "conv3d"]
+__all__ = ["subm_conv3d", "conv3d", "subm_conv2d", "conv2d", "max_pool3d"]
 
 
-def _triple(v):
+def _tuple_n(v, n: int):
     if isinstance(v, (tuple, list)):
         return tuple(int(x) for x in v)
-    return (int(v),) * 3
+    return (int(v),) * n
 
 
 def _offsets(ks):
-    kd, kh, kw = ks
-    return [(d - kd // 2, h - kh // 2, w - kw // 2)
-            for d in range(kd) for h in range(kh) for w in range(kw)]
+    """Kernel offsets relative to the centre, any spatial rank."""
+    return [tuple(i - k // 2 for i, k in zip(idx, ks))
+            for idx in itertools.product(*(range(k) for k in ks))]
 
 
 def _gather_gemm_scatter(in_idx, out_idx, values, weight, ks, strides):
-    """Σ_off match(out, in+off) (vals @ W[off]); idx [nnz, 4] = (n,d,h,w)."""
-    kd, kh, kw = ks
-    w_flat = weight.reshape(kd * kh * kw, weight.shape[3], weight.shape[4])
-    sd, sh, sw = strides
-    out = jnp.zeros((out_idx.shape[0], weight.shape[4]), values.dtype)
-    for o, (od, oh, ow) in enumerate(_offsets(ks)):
+    """Σ_off match(out, in+off) (vals @ W[off]); idx [nnz, 1+rank] =
+    (n, *spatial); weight [*ks, Cin, Cout] — any spatial rank."""
+    rank = len(ks)
+    w_flat = weight.reshape(int(np.prod(ks)), weight.shape[-2],
+                            weight.shape[-1])
+    out = jnp.zeros((out_idx.shape[0], weight.shape[-1]), values.dtype)
+    for o, off in enumerate(_offsets(ks)):
         # input point i contributes to output j when
         # out_pos * stride + offset == in_pos (VALID-style centre align)
-        tgt_d = out_idx[:, 1] * sd + od
-        tgt_h = out_idx[:, 2] * sh + oh
-        tgt_w = out_idx[:, 3] * sw + ow
-        match = ((out_idx[:, 0][:, None] == in_idx[:, 0][None, :]) &
-                 (tgt_d[:, None] == in_idx[:, 1][None, :]) &
-                 (tgt_h[:, None] == in_idx[:, 2][None, :]) &
-                 (tgt_w[:, None] == in_idx[:, 3][None, :]))
+        match = out_idx[:, 0][:, None] == in_idx[:, 0][None, :]
+        for a in range(rank):
+            tgt = out_idx[:, 1 + a] * strides[a] + off[a]
+            match = match & (tgt[:, None] == in_idx[:, 1 + a][None, :])
         contrib = values @ w_flat[o].astype(values.dtype)
         out = out + match.astype(values.dtype) @ contrib
     return out
 
 
-def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
-                groups: int = 1, data_format: str = "NDHWC", key=None):
-    """Submanifold sparse conv: output sparsity pattern == input pattern
-    (ref conv_kernel.h subm=true). x: SparseCooTensor [N, D, H, W] sparse
-    dims with dense channel values [nnz, C]; weight [kd, kh, kw, C, M]."""
-    from . import SparseCooTensor, _unwrap, sparse_coo_tensor
-
-    if _triple(stride) != (1, 1, 1):
-        raise ValueError("subm_conv3d requires stride 1 (pattern-preserving)")
+def _validate(name, rank, stride, dilation, groups, data_format, subm):
+    expect_fmt = {2: "NHWC", 3: "NDHWC"}[rank]
     if groups != 1:
         raise NotImplementedError("sparse conv groups > 1")
-    if _triple(dilation) != (1, 1, 1):
+    if _tuple_n(dilation, rank) != (1,) * rank:
         raise NotImplementedError("sparse conv dilation != 1")
-    if data_format != "NDHWC":
-        raise NotImplementedError("sparse conv supports NDHWC only")
+    if data_format != expect_fmt:
+        raise NotImplementedError(f"sparse conv supports {expect_fmt} only")
+    if subm and _tuple_n(stride, rank) != (1,) * rank:
+        raise ValueError(f"{name} requires stride 1 (pattern-preserving)")
+
+
+def _subm_conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                  data_format, rank, name):
+    from . import _unwrap, sparse_coo_tensor
+    _validate(name, rank, stride, dilation, groups, data_format, subm=True)
     t = _unwrap(x)
-    idx = t.indices  # [nnz, 4] (n, d, h, w)
-    vals = t.data
-    ks = tuple(int(s) for s in weight.shape[:3])
-    out_vals = _gather_gemm_scatter(idx, idx, vals, jnp.asarray(weight),
-                                    ks, (1, 1, 1))
+    idx = t.indices  # [nnz, 1+rank]
+    ks = tuple(int(s) for s in weight.shape[:rank])
+    out_vals = _gather_gemm_scatter(idx, idx, t.data, jnp.asarray(weight),
+                                    ks, (1,) * rank)
     if bias is not None:
         out_vals = out_vals + jnp.asarray(bias, out_vals.dtype)
-    shape = t.shape[:-1] + (int(weight.shape[4]),)
+    shape = t.shape[:-1] + (int(weight.shape[-1]),)
     return sparse_coo_tensor(idx.T, out_vals, shape)
+
+
+def _out_sites(idx, spatial, ks, strides, pads, rank):
+    """Host-side rulebook: the stride-aligned output sites whose receptive
+    field covers any input nnz (data-dependent output pattern)."""
+    cand = set()
+    for off in _offsets(ks):
+        for row in idx:
+            z = []
+            ok = True
+            for a in range(rank):
+                za = row[1 + a] + pads[a] - (off[a] + ks[a] // 2)
+                if za % strides[a]:
+                    ok = False
+                    break
+                za //= strides[a]
+                if not (0 <= za < spatial[a]):
+                    ok = False
+                    break
+                z.append(int(za))
+            if ok:
+                cand.add((int(row[0]), *z))
+    return np.asarray(sorted(cand), np.int32).reshape(-1, 1 + rank)
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+             data_format, rank, name):
+    from . import _unwrap, sparse_coo_tensor
+    _validate(name, rank, stride, dilation, groups, data_format, subm=False)
+    strides = _tuple_n(stride, rank)
+    pads = _tuple_n(padding, rank)
+    t = _unwrap(x)
+    idx = np.asarray(jax.device_get(t.indices))  # host rulebook build
+    ks = tuple(int(s) for s in weight.shape[:rank])
+    spatial_in = t.shape[1:-1]
+    out_sp = tuple((dim + 2 * p - k) // s + 1
+                   for dim, p, k, s in zip(spatial_in, pads, ks, strides))
+    out_idx = _out_sites(idx, out_sp, ks, strides, pads, rank)
+
+    # shift output coords back to the input frame for matching: offset o
+    # hits input position out*stride - pad + (o + k//2)
+    shifted = jnp.asarray(out_idx, jnp.int32)
+    for a in range(rank):
+        shifted = shifted.at[:, 1 + a].set(
+            out_idx[:, 1 + a] * strides[a] - pads[a] + ks[a] // 2)
+    out_vals = _gather_gemm_scatter(
+        t.indices, shifted, t.data, jnp.asarray(weight), ks, (1,) * rank)
+    if bias is not None:
+        out_vals = out_vals + jnp.asarray(bias, out_vals.dtype)
+    shape = (t.shape[0],) + out_sp + (int(weight.shape[-1]),)
+    return sparse_coo_tensor(jnp.asarray(out_idx.T), out_vals, shape)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups: int = 1, data_format: str = "NDHWC", key=None):
+    """Submanifold sparse conv3d (ref conv_kernel.h subm=true). x:
+    SparseCooTensor [N, D, H, W, C]; weight [kd, kh, kw, C, M]."""
+    return _subm_conv_nd(x, weight, bias, stride, padding, dilation,
+                         groups, data_format, 3, "subm_conv3d")
 
 
 def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
            groups: int = 1, data_format: str = "NDHWC", key=None):
-    """Standard sparse conv3d (ref Conv3dCooKernel, subm=false): output
-    positions are every stride-aligned site reached by the kernel support.
-    The output index set is built host-side (data-dependent shape); the
-    value computation is jit-traceable given those indices."""
-    from . import sparse_coo_tensor, _unwrap
+    """Standard sparse conv3d (ref Conv3dCooKernel, subm=false)."""
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 3, "conv3d")
 
-    if groups != 1:
-        raise NotImplementedError("sparse conv groups > 1")
-    if _triple(dilation) != (1, 1, 1):
-        raise NotImplementedError("sparse conv dilation != 1")
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups: int = 1, data_format: str = "NHWC", key=None):
+    """Submanifold sparse conv2d (ref sparse/nn/functional/conv.py
+    subm_conv2d). x: SparseCooTensor [N, H, W, C]; weight [kh, kw, C, M]."""
+    return _subm_conv_nd(x, weight, bias, stride, padding, dilation,
+                         groups, data_format, 2, "subm_conv2d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+           groups: int = 1, data_format: str = "NHWC", key=None):
+    """Standard sparse conv2d (ref Conv2dCooKernel)."""
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups,
+                    data_format, 2, "conv2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0,
+               data_format: str = "NDHWC", name=None):
+    """Sparse max pooling (ref phi/kernels/sparse/pool_kernel.h
+    MaxPoolCooKernel): output sites from the same rulebook as conv3d;
+    each output channel takes the max over the covering input nnz."""
+    from . import _unwrap, sparse_coo_tensor
     if data_format != "NDHWC":
-        raise NotImplementedError("sparse conv supports NDHWC only")
-    strides = _triple(stride)
-    pads = _triple(padding)
+        raise NotImplementedError("sparse max_pool3d supports NDHWC only")
+    rank = 3
+    ks = _tuple_n(kernel_size, rank)
+    strides = _tuple_n(stride if stride is not None else kernel_size, rank)
+    pads = _tuple_n(padding, rank)
     t = _unwrap(x)
-    idx = np.asarray(jax.device_get(t.indices))  # host rulebook build
+    idx = np.asarray(jax.device_get(t.indices))
+    spatial_in = t.shape[1:-1]
+    out_sp = tuple((dim + 2 * p - k) // s + 1
+                   for dim, p, k, s in zip(spatial_in, pads, ks, strides))
+    out_idx = _out_sites(idx, out_sp, ks, strides, pads, rank)
+    # exact (out, in) pair lists built host-side (out_idx already is), then
+    # one segment_max — no [n_out, nnz, C] temporary
+    coord_to_i = {tuple(int(v) for v in row): i for i, row in enumerate(idx)}
+    pair_in, pair_out = [], []
+    for j, orow in enumerate(out_idx):
+        base = [int(orow[1 + a]) * strides[a] - pads[a] + ks[a] // 2
+                for a in range(rank)]
+        for off in _offsets(ks):
+            key = (int(orow[0]),
+                   *(base[a] + off[a] for a in range(rank)))
+            i = coord_to_i.get(key)
+            if i is not None:
+                pair_out.append(j)
+                pair_in.append(i)
     vals = t.data
-    ks = tuple(int(s) for s in weight.shape[:3])
-    n, d, h, w, _ = t.shape
-    out_sp = tuple(
-        (dim + 2 * p - k) // s + 1
-        for dim, p, k, s in zip((d, h, w), pads, ks, strides))
-
-    # candidate outputs: for each input nnz and kernel offset, the output
-    # site whose receptive field covers it
-    cand = set()
-    for od, oh, ow in _offsets(ks):
-        for row in idx:
-            zd = row[1] + pads[0] - (od + ks[0] // 2)
-            zh = row[2] + pads[1] - (oh + ks[1] // 2)
-            zw = row[3] + pads[2] - (ow + ks[2] // 2)
-            if zd % strides[0] or zh % strides[1] or zw % strides[2]:
-                continue
-            zd //= strides[0]; zh //= strides[1]; zw //= strides[2]
-            if 0 <= zd < out_sp[0] and 0 <= zh < out_sp[1] \
-                    and 0 <= zw < out_sp[2]:
-                cand.add((int(row[0]), int(zd), int(zh), int(zw)))
-    out_idx = np.asarray(sorted(cand), np.int32).reshape(-1, 4)
-
-    # shift output coords back to input frame for matching: the offset o
-    # hits input position out*stride - pad + (o + k//2)
-    shifted = jnp.asarray(out_idx, jnp.int32)
-    shifted = shifted.at[:, 1].set(out_idx[:, 1] * strides[0] - pads[0]
-                                   + ks[0] // 2)
-    shifted = shifted.at[:, 2].set(out_idx[:, 2] * strides[1] - pads[1]
-                                   + ks[1] // 2)
-    shifted = shifted.at[:, 3].set(out_idx[:, 3] * strides[2] - pads[2]
-                                   + ks[2] // 2)
-    shifted = shifted.at[:, 0].set(out_idx[:, 0])
-    out_vals = _gather_gemm_scatter(
-        t.indices, shifted, vals, jnp.asarray(weight), ks, (1, 1, 1))
-    if bias is not None:
-        out_vals = out_vals + jnp.asarray(bias, out_vals.dtype)
-    shape = (n,) + out_sp + (int(weight.shape[4]),)
-    return sparse_coo_tensor(jnp.asarray(out_idx.T), out_vals, shape)
+    out = jax.ops.segment_max(
+        vals[jnp.asarray(pair_in, jnp.int32)],
+        jnp.asarray(pair_out, jnp.int32),
+        num_segments=out_idx.shape[0])
+    shape = (t.shape[0],) + out_sp + (vals.shape[-1],)
+    return sparse_coo_tensor(jnp.asarray(out_idx.T), out, shape)

@@ -1,8 +1,79 @@
 """paddle.sparse.nn.functional parity (ref python/paddle/sparse/nn/
-functional/): sparse conv + value-wise activations."""
+functional/): sparse conv + pooling + value-wise activations + attention."""
 
 from __future__ import annotations
 
-from .conv import conv3d, subm_conv3d  # noqa: F401
+import math
 
-__all__ = ["conv3d", "subm_conv3d"]
+import jax
+import jax.numpy as jnp
+
+from .conv import (conv3d, subm_conv3d, conv2d, subm_conv2d,  # noqa: F401
+                   max_pool3d)
+
+__all__ = ["conv3d", "subm_conv3d", "conv2d", "subm_conv2d", "max_pool3d",
+           "relu", "relu6", "leaky_relu", "softmax", "attention"]
+
+
+def _value_act(x, fn):
+    from . import _map_values
+    return _map_values(x, fn)
+
+
+def relu(x, name=None):
+    """ref sparse/nn/functional/activation.py relu — zero-preserving, so
+    it maps the stored values only."""
+    return _value_act(x, lambda v: jnp.maximum(v, 0))
+
+
+def relu6(x, name=None):
+    return _value_act(x, lambda v: jnp.clip(v, 0, 6))
+
+
+def leaky_relu(x, negative_slope: float = 0.01, name=None):
+    return _value_act(x, lambda v: jnp.where(v >= 0, v,
+                                             negative_slope * v))
+
+
+def softmax(x, axis: int = -1, name=None):
+    """Row-wise softmax over the STORED values of each row (ref sparse
+    softmax kernel: zeros are excluded from the distribution)."""
+    from .nn import Softmax
+    return Softmax(axis)(x)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention (ref sparse/nn/functional/transformer.py:26):
+    softmax(QK^T / sqrt(d) restricted to sparse_mask's pattern) @ V.
+
+    query/key/value: dense [B, H, S, D]; sparse_mask: SparseCsrTensor
+    [B*H, S, S] whose STORED positions define which (q, k) pairs
+    participate; key_padding_mask [B, S] and attn_mask [S, S] are additive
+    f32 masks. Returns dense [B, H, S, D]. The pattern restriction is the
+    semantic contract; compute is dense-masked (XLA fuses the masking into
+    the softmax — the reference's CSR kernel exists to SKIP compute, which
+    on the MXU only pays off at extreme sparsity)."""
+    from . import _unwrap
+    b, h, s, d = query.shape
+    scores = jnp.einsum("bhqd,bhkd->bhqk", query, key,
+                        preferred_element_type=jnp.float32) \
+        / math.sqrt(d)
+    t = _unwrap(sparse_mask)
+    from jax.experimental import sparse as jsparse
+    if isinstance(t, jsparse.BCSR):
+        t = t.to_bcoo()
+    pattern = jnp.zeros((b * h, s, s), bool)
+    rows = t.indices
+    pattern = pattern.at[rows[:, 0], rows[:, 1], rows[:, 2]].set(True)
+    scores = jnp.where(pattern.reshape(b, h, s, s), scores, -jnp.inf)
+    if key_padding_mask is not None:
+        scores = scores + jnp.asarray(
+            key_padding_mask, jnp.float32)[:, None, None, :]
+    if attn_mask is not None:
+        scores = scores + jnp.asarray(attn_mask, jnp.float32)[None, None]
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.where(jnp.isfinite(scores),
+                  jnp.exp(scores - jnp.where(jnp.isfinite(m), m, 0.0)), 0.0)
+    probs = e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True), 1e-30)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs.astype(query.dtype), value)

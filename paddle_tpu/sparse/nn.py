@@ -41,12 +41,19 @@ class Softmax:
         from . import SparseCooTensor, _unwrap
         from jax.experimental import sparse as jsparse
         import jax
+        import numpy as np
 
         t = _unwrap(x)
         if isinstance(t, jsparse.BCSR):
             t = t.to_bcoo()
-        rows = t.indices[:, 0]
-        n_rows = t.shape[0]
+        # a "row" is the full leading-index tuple (batch dims included):
+        # grouping by indices[:, 0] alone would softmax a whole [B, S, S]
+        # slab per batch element instead of per row
+        lead_shape = t.shape[:-1]
+        strides = np.cumprod((1,) + lead_shape[::-1][:-1])[::-1]
+        rows = (t.indices[:, :-1]
+                * jnp.asarray(strides.copy(), t.indices.dtype)).sum(axis=1)
+        n_rows = int(np.prod(lead_shape))
         vals = t.data
         row_max = jax.ops.segment_max(vals, rows, num_segments=n_rows)
         e = jnp.exp(vals - row_max[rows])
@@ -103,20 +110,26 @@ class BatchNorm(_BatchNormBase):
 
 
 class _SparseConvBase(Layer):
+    _RANK = 3
+    _DEFAULT_FMT = "NDHWC"
+
     def __init__(self, in_channels, out_channels, kernel_size, stride=1,
                  padding=0, dilation=1, groups=1, subm=False,
-                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+                 weight_attr=None, bias_attr=None, data_format=None):
         from ..nn import initializer as I
         super().__init__()
+        rank = self._RANK
         ks = kernel_size if isinstance(kernel_size, (tuple, list)) \
-            else (kernel_size,) * 3
+            else (kernel_size,) * rank
         self.stride = stride
         self.padding = padding
         self.dilation = dilation
         self.groups = groups
-        self.data_format = data_format
+        self.data_format = data_format or self._DEFAULT_FMT
         self.subm = subm
-        fan_in = in_channels * ks[0] * ks[1] * ks[2]
+        fan_in = in_channels
+        for k in ks:
+            fan_in *= k
         self.weight = self.create_parameter(
             tuple(ks) + (in_channels, out_channels), attr=weight_attr,
             default_initializer=I.Uniform(-(fan_in ** -0.5), fan_in ** -0.5))
@@ -127,8 +140,9 @@ class _SparseConvBase(Layer):
             self.bias = None
 
     def forward(self, x):
-        from .conv import conv3d, subm_conv3d
-        fn = subm_conv3d if self.subm else conv3d
+        from . import conv as C
+        fn = getattr(C, ("subm_conv" if self.subm else "conv")
+                     + f"{self._RANK}d")
         return fn(x, self.weight, self.bias, stride=self.stride,
                   padding=self.padding, dilation=self.dilation,
                   groups=self.groups, data_format=self.data_format)
@@ -148,6 +162,69 @@ class SubmConv3D(_SparseConvBase):
     def __init__(self, in_channels, out_channels, kernel_size, **kw):
         super().__init__(in_channels, out_channels, kernel_size,
                          subm=True, **kw)
+
+
+class Conv2D(_SparseConvBase):
+    """ref paddle.sparse.nn.Conv2D (Conv2dCooKernel)."""
+
+    _RANK = 2
+    _DEFAULT_FMT = "NHWC"
+
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size,
+                         subm=False, **kw)
+
+
+class SubmConv2D(_SparseConvBase):
+    """ref paddle.sparse.nn.SubmConv2D — submanifold 2-D."""
+
+    _RANK = 2
+    _DEFAULT_FMT = "NHWC"
+
+    def __init__(self, in_channels, out_channels, kernel_size, **kw):
+        super().__init__(in_channels, out_channels, kernel_size,
+                         subm=True, **kw)
+
+
+class MaxPool3D(Layer):
+    """ref paddle.sparse.nn.MaxPool3D (MaxPoolCooKernel)."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format: str = "NDHWC", name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.data_format = data_format
+
+    def forward(self, x):
+        from .conv import max_pool3d
+        return max_pool3d(x, self.kernel_size, self.stride, self.padding,
+                          self.data_format)
+
+
+class ReLU6(_ValueActivation):
+    def __init__(self):
+        super().__init__(lambda v: jnp.clip(v, 0, 6))
+
+
+class SyncBatchNorm(BatchNorm):
+    """ref paddle.sparse.nn.SyncBatchNorm: BatchNorm whose batch stats are
+    computed over the GLOBAL batch. Under GSPMD there is no separate sync
+    path — when the nnz/value tensors are sharded over a mesh, the stat
+    reductions already produce globally-reduced results (XLA inserts the
+    cross-replica psum), which is exactly what the reference's NCCL
+    sync_batch_norm kernel hand-writes."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """ref SyncBatchNorm.convert_sync_batchnorm: swap BatchNorm
+        sublayers for SyncBatchNorm in place and return the layer."""
+        for holder in layer.sublayers(include_self=True):
+            for name, child in list(holder._sub_layers.items()):
+                if type(child) is BatchNorm:
+                    child.__class__ = cls
+        return layer
 
 
 from . import functional  # noqa: F401,E402

@@ -1,0 +1,87 @@
+"""Trainer for the elastic end-to-end drill (VERDICT r3 ask #9).
+
+Trains a small regression model with periodic checkpoints; on its FIRST
+incarnation it SIGKILLs itself mid-train (simulating a dead worker). The
+relaunched process auto-resumes from the latest checkpoint and finishes.
+Loss continuity is verifiable because each step's batch derives from the
+step index: resumed-after-crash training is bitwise the same trajectory
+as an uninterrupted run.
+
+Ref: fleet/elastic/manager.py watch loop + dygraph_dist_save_load-style
+resume tests.
+"""
+
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.framework import io as fio
+from paddle_tpu.framework.functional import functional_call, get_params
+from paddle_tpu.optimizer import Momentum
+
+WORK = os.environ["ELASTIC_WORK_DIR"]
+TOTAL_STEPS = int(os.environ.get("ELASTIC_TOTAL_STEPS", "20"))
+KILL_AT = int(os.environ.get("ELASTIC_KILL_AT", "9"))
+CKPT_EVERY = int(os.environ.get("ELASTIC_CKPT_EVERY", "4"))
+CKPT = os.path.join(WORK, "ckpt.pdparams")
+KILL_MARKER = os.path.join(WORK, "killed_once")
+LOG = os.path.join(WORK, "train_log.jsonl")
+
+
+def batch_for(step: int):
+    rng = np.random.default_rng(1000 + step)
+    x = rng.standard_normal((16, 8)).astype("float32")
+    y = (x @ np.arange(8).astype("float32") / 8.0)[:, None]
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def main():
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 32), nn.Tanh(), nn.Linear(32, 1))
+    opt = Momentum(learning_rate=0.05, momentum=0.9)
+    params = get_params(model)
+    state = opt.init(params)
+    start = 0
+    if os.path.exists(CKPT):
+        saved = fio.load(CKPT)
+        params = saved["params"]
+        state = saved["opt_state"]
+        start = int(saved["step"])
+        with open(LOG, "a") as f:
+            f.write(json.dumps({"event": "resumed", "step": start}) + "\n")
+
+    def loss_fn(p, x, y):
+        return jnp.mean((functional_call(model, p, x) - y) ** 2)
+
+    step_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+    for step in range(start, TOTAL_STEPS):
+        x, y = batch_for(step)
+        loss, grads = step_fn(params, x, y)
+        params, state = opt.apply_gradients(params, grads, state)
+        with open(LOG, "a") as f:
+            f.write(json.dumps({"step": step, "loss": float(loss)}) + "\n")
+        if (step + 1) % CKPT_EVERY == 0:
+            fio.save({"params": params, "opt_state": state,
+                      "step": step + 1}, CKPT)
+        if step + 1 == KILL_AT and not os.path.exists(KILL_MARKER):
+            open(KILL_MARKER, "w").close()
+            os.kill(os.getpid(), signal.SIGKILL)  # die WITHOUT cleanup
+
+    fio.save({"params": params, "opt_state": state, "step": TOTAL_STEPS},
+             CKPT)
+    with open(LOG, "a") as f:
+        f.write(json.dumps({"event": "done"}) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -389,3 +389,134 @@ class TestFlashAttentionNamespace:
         q = jnp.ones((1, 8, 2, 16), jnp.float32)
         out = F.flash_attention(q, q, q, causal=True)
         assert out.shape == q.shape
+
+
+class TestSparseTierR4:
+    """VERDICT r3 missing #4/#10: sparse 2-D convs, pooling, functional
+    activations, attention, SyncBatchNorm (ref phi/kernels/sparse/)."""
+
+    def test_subm_conv2d_matches_dense_on_pattern(self):
+        import paddle_tpu as paddle
+        from jax import lax
+        sp = paddle.sparse
+        F = sp.nn.functional
+        rng = np.random.default_rng(0)
+        idx = np.array([[0, 0, 0], [0, 1, 2], [0, 2, 1], [0, 3, 3]]).T
+        vals = rng.standard_normal((4, 3)).astype("float32")
+        x = sp.sparse_coo_tensor(idx, vals, (1, 4, 4, 3))
+        w = rng.standard_normal((3, 3, 3, 5)).astype("float32")
+        out = F.subm_conv2d(x, jnp.asarray(w))
+        dense = np.zeros((1, 4, 4, 3), np.float32)
+        for (n, h, ww), v in zip(idx.T, vals):
+            dense[n, h, ww] = v
+        ref = lax.conv_general_dilated(
+            jnp.asarray(dense), jnp.asarray(w), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        od = np.asarray(out.to_dense())
+        for (n, h, ww) in idx.T:
+            np.testing.assert_allclose(od[n, h, ww],
+                                       np.asarray(ref)[n, h, ww], rtol=1e-4)
+
+    def test_conv2d_strided_output_shape(self):
+        import paddle_tpu as paddle
+        sp = paddle.sparse
+        rng = np.random.default_rng(0)
+        idx = np.array([[0, 0, 0], [0, 3, 3]]).T
+        x = sp.sparse_coo_tensor(
+            idx, rng.standard_normal((2, 3)).astype("float32"), (1, 4, 4, 3))
+        w = jnp.asarray(rng.standard_normal((3, 3, 3, 5)), jnp.float32)
+        out = sp.nn.functional.conv2d(x, w, stride=2, padding=1)
+        assert out.shape == (1, 2, 2, 5)
+
+    def test_max_pool3d_stored_only_semantics(self):
+        import paddle_tpu as paddle
+        sp = paddle.sparse
+        rng = np.random.default_rng(0)
+        idx3 = np.array([[0, 0, 0, 0], [0, 1, 1, 1], [0, 0, 1, 0],
+                         [0, 3, 3, 3]]).T
+        vals3 = rng.standard_normal((4, 2)).astype("float32")
+        x3 = sp.sparse_coo_tensor(idx3, vals3, (1, 4, 4, 4, 2))
+        p3 = sp.nn.functional.max_pool3d(x3, 2, stride=2)
+        win = {}
+        for (n, d, h, w), v in zip(idx3.T, vals3):
+            key = (n, d // 2, h // 2, w // 2)
+            win[key] = np.maximum(win[key], v) if key in win else v
+        pi = np.asarray(p3.indices()).T
+        pv = np.asarray(p3.values())
+        assert len(win) == pi.shape[0]
+        for row, v in zip(pi, pv):
+            np.testing.assert_allclose(v, win[tuple(row)], rtol=1e-5)
+
+    def test_sparse_attention_matches_masked_dense(self):
+        import paddle_tpu as paddle
+        from jax.experimental import sparse as jsparse
+        sp = paddle.sparse
+        rng = np.random.default_rng(1)
+        q = jnp.asarray(rng.standard_normal((1, 2, 4, 8)), jnp.float32)
+        mask_dense = np.tril(np.ones((4, 4), np.float32))
+        md = np.broadcast_to(mask_dense, (2, 4, 4)).copy()
+        bcoo = jsparse.BCOO.fromdense(jnp.asarray(md))
+        wrap = sp.sparse_coo_tensor(np.asarray(bcoo.indices).T,
+                                    np.asarray(bcoo.data), (2, 4, 4))
+        att = sp.nn.functional.attention(q, q, q, wrap)
+        sc = np.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(8)
+        sc = np.where(mask_dense[None, None] > 0, sc, -np.inf)
+        pr = np.exp(sc - sc.max(-1, keepdims=True))
+        pr /= pr.sum(-1, keepdims=True)
+        refa = np.einsum("bhqk,bhkd->bhqd", pr, np.asarray(q))
+        np.testing.assert_allclose(np.asarray(att), refa, rtol=1e-4)
+
+    def test_sparse_functional_activations(self):
+        import paddle_tpu as paddle
+        sp = paddle.sparse
+        x = sp.sparse_coo_tensor(np.array([[0, 1]]),
+                                 np.array([[-2.0, 8.0]]).T.astype("float32"),
+                                 (3, 1))
+        np.testing.assert_allclose(
+            np.asarray(sp.nn.functional.relu6(x).values()).ravel(),
+            [0.0, 6.0])
+        np.testing.assert_allclose(
+            np.asarray(sp.nn.functional.leaky_relu(x, 0.1).values()).ravel(),
+            [-0.2, 8.0])
+
+    def test_sync_batchnorm_convert(self):
+        import paddle_tpu.nn as nn
+        from paddle_tpu.sparse.nn import BatchNorm, SyncBatchNorm
+
+        class M(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.bn = BatchNorm(3)
+
+        m = SyncBatchNorm.convert_sync_batchnorm(M())
+        assert type(m.bn) is SyncBatchNorm
+
+    def test_sparse_surface_vs_reference_names(self):
+        """Every public name of the reference sparse package exists."""
+        import paddle_tpu.sparse as ps
+        ours = set(dir(ps)) | set(dir(ps.nn)) | set(dir(ps.nn.functional))
+        expected = {
+            "sin", "tan", "asin", "atan", "sinh", "tanh", "square", "sqrt",
+            "log1p", "abs", "pow", "cast", "neg", "coalesce", "rad2deg",
+            "deg2rad", "expm1", "transpose", "sum", "reshape", "isnan",
+            "slice", "pca_lowrank", "add", "subtract", "multiply", "divide",
+            "matmul", "masked_matmul", "mv", "addmm", "is_same_shape",
+            "sparse_coo_tensor", "sparse_csr_tensor",
+            "ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+            "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+            "MaxPool3D",
+            "conv2d", "conv3d", "subm_conv2d", "subm_conv3d", "max_pool3d",
+            "relu", "relu6", "leaky_relu", "softmax", "attention",
+        }
+        missing = sorted(expected - ours)
+        assert not missing, missing
+
+    def test_sparse_softmax_3d_per_row(self):
+        import paddle_tpu as paddle
+        sp = paddle.sparse
+        idx = np.array([[0, 0, 0, 0], [0, 0, 1, 1], [0, 1, 0, 1]])
+        vals = np.array([1., 2., 3., 4.], np.float32)
+        x = sp.sparse_coo_tensor(idx, vals, (1, 2, 2))
+        out = np.asarray(sp.nn.functional.softmax(x).values())
+        np.testing.assert_allclose(
+            out, [0.268941, 0.731059, 0.268941, 0.731059], rtol=1e-5)

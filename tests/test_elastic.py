@@ -65,3 +65,52 @@ def test_restart_budget_exhausted(tmp_path):
     assert rc == 7
     assert calls["n"] == 3  # initial + 2 restarts
     assert len(mgr.history) == 3
+
+
+def test_kill_mid_train_resumes_from_checkpoint_with_loss_continuity(
+        tmp_path):
+    """VERDICT r3 ask #9, end to end: a worker is SIGKILLed mid-train; the
+    ElasticManager relaunches it; the relaunch auto-resumes from the latest
+    checkpoint; and because batches derive from the step index, the
+    resumed trajectory must be IDENTICAL to an uninterrupted run."""
+    import json
+
+    def run_job(workdir, kill_at):
+        os.makedirs(workdir, exist_ok=True)
+        env = dict(os.environ, ELASTIC_WORK_DIR=str(workdir),
+                   ELASTIC_TOTAL_STEPS="20", ELASTIC_KILL_AT=str(kill_at),
+                   ELASTIC_CKPT_EVERY="4", JAX_PLATFORMS="cpu")
+        cfg = LaunchConfig(nproc_per_node=1,
+                           log_dir=str(workdir) + "/logs", envs=env)
+        script = os.path.join(REPO, "tests", "elastic_trainer_script.py")
+        return launch(cfg, script, max_restarts=2,
+                      elastic_dir=str(workdir) + "/hb")
+
+    crashed = tmp_path / "crashed"
+    rc = run_job(crashed, kill_at=9)
+    assert rc == 0
+
+    ref = tmp_path / "reference"
+    rc = run_job(ref, kill_at=999)  # never killed
+    assert rc == 0
+
+    def read_log(d):
+        events, losses = [], {}
+        for line in open(os.path.join(d, "train_log.jsonl")):
+            rec = json.loads(line)
+            if "step" in rec and "loss" in rec:
+                losses[rec["step"]] = rec["loss"]  # re-run overwrites
+            elif "event" in rec:
+                events.append(rec)
+        return events, losses
+
+    ev_c, loss_c = read_log(crashed)
+    _, loss_r = read_log(ref)
+    # the relaunch resumed from the step-8 checkpoint, not from scratch
+    resumed = [e for e in ev_c if e.get("event") == "resumed"]
+    assert resumed and resumed[0]["step"] == 8
+    assert any(e.get("event") == "done" for e in ev_c)
+    # loss continuity: identical trajectory to the uninterrupted run
+    assert set(loss_c) == set(loss_r) == set(range(20))
+    for s in range(20):
+        assert abs(loss_c[s] - loss_r[s]) < 1e-7, (s, loss_c[s], loss_r[s])

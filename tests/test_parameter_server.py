@@ -237,3 +237,47 @@ class TestSSDSparseTable:
                             cache_rows=4, rule="sgd", lr=0.5, seed=3)
         t2.load_state_dict(sd)
         np.testing.assert_array_equal(t.pull([5]), t2.pull([5]))
+
+
+class TestAsyncCommunicator:
+    """VERDICT r3 missing #5: async grad push/pull (ref
+    ps/service/communicator/ AsyncCommunicator merge-then-send)."""
+
+    def test_async_push_merges_and_applies(self, cluster):
+        from paddle_tpu.distributed.ps import AsyncCommunicator
+        _, client = cluster
+        client.create_sparse_table("emb_async", dim=2, rule="sgd", lr=1.0,
+                                   init="zeros")
+        comm = AsyncCommunicator(client, send_interval=0.01, max_merge=8)
+        comm.start()
+        # many small async pushes, overlapping ids — must merge by SUM
+        for i in range(10):
+            comm.push_sparse_async("emb_async", [1, 2],
+                                   np.ones((2, 2), np.float32))
+        comm.flush()
+        comm.stop()
+        out = client.pull_sparse("emb_async", [1, 2])
+        # sgd lr=1.0 from zeros: w = -sum(grads) = -10
+        np.testing.assert_allclose(out, -10 * np.ones((2, 2)), rtol=1e-6)
+        assert comm.pushed_batches >= 1
+        assert comm.merged_items == 10
+
+    def test_async_dense_and_stop_flushes(self, cluster):
+        from paddle_tpu.distributed.ps import AsyncCommunicator
+        _, client = cluster
+        client.create_dense_table("w_async", shape=(3,), rule="sgd", lr=0.5,
+                                  init="zeros")
+        comm = AsyncCommunicator(client, send_interval=0.01)
+        comm.start()
+        for _ in range(4):
+            comm.push_dense_async("w_async", np.ones(3, np.float32))
+        comm.stop()  # implies flush
+        np.testing.assert_allclose(client.pull_dense("w_async"),
+                                   -2.0 * np.ones(3), rtol=1e-6)
+
+    def test_push_before_start_raises(self, cluster):
+        from paddle_tpu.distributed.ps import AsyncCommunicator
+        _, client = cluster
+        comm = AsyncCommunicator(client)
+        with pytest.raises(RuntimeError):
+            comm.push_dense_async("x", np.ones(2))
