@@ -44,10 +44,14 @@ class RecomputePolicy:
             return None
         import jax.ad_checkpoint as adc
         if name == RecomputePolicy.DOTS_AND_FLASH:
+            # norm_xhat/norm_stat are the closed-form LN backward's
+            # residuals (saving them skips the whole LN recompute; the LN
+            # OUTPUT rebuilds from xhat with one elementwise FMA)
             return adc.checkpoint_policies.save_from_both_policies(
                 adc.checkpoint_policies.dots_saveable,
                 adc.checkpoint_policies.save_only_these_names(
-                    "flash_out", "flash_lse", "norm_out"))
+                    "flash_out", "flash_lse", "norm_xhat", "norm_stat",
+                    "norm_out"))
         return getattr(adc.checkpoint_policies, name)
 
 

@@ -368,12 +368,14 @@ def _bn_train_fwd_impl(x, weight, bias, axis, epsilon):
 
 def _bn_train_fwd_rule(x, weight, bias, axis, epsilon):
     y, mean, var, r = _bn_train_fwd_impl(x, weight, bias, axis, epsilon)
-    return (y, mean, var), (x, mean, r, weight)
+    return (y, mean, var), (x, mean, r, weight,
+                            jnp.zeros((0,), bias.dtype))
 
 
 def _bn_train_bwd_rule(axis, epsilon, res, cts):
     dy, _dmean, _dvar = cts  # running-stat outputs: no gradient path
-    x, mean, r, weight = res
+    x, mean, r, weight, bias_proto = res
+    bias_dtype = bias_proto.dtype
     ax = axis % x.ndim
     reduce_axes = tuple(i for i in range(x.ndim) if i != ax)
     shape = [1] * x.ndim
@@ -389,7 +391,7 @@ def _bn_train_bwd_rule(axis, epsilon, res, cts):
     g_r = (weight.astype(jnp.float32) * r).reshape(shape)
     dx = (g_r * (dyf - (xhat_f * dgamma.reshape(shape)
                         + dbeta.reshape(shape)) / m)).astype(x.dtype)
-    return dx, dgamma.astype(weight.dtype), dbeta.astype(weight.dtype)
+    return dx, dgamma.astype(weight.dtype), dbeta.astype(bias_dtype)
 
 
 _bn_train_core.defvjp(_bn_train_fwd_rule, _bn_train_bwd_rule)
@@ -408,7 +410,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     shape[axis % x.ndim] = x.shape[axis % x.ndim]
 
     if training:
-        if weight is not None and bias is not None:
+        if weight is not None and bias is not None \
+                and _closed_form_norm_grad():
             out, mean, var = _bn_train_core(x, weight, bias, axis, epsilon)
             n = x.size // x.shape[axis % x.ndim]
             unbiased = var * n / max(n - 1, 1)
@@ -441,10 +444,83 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     return out, new_mean, new_var
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln_core(x, weight, bias, n_norm_axes, epsilon):
+    """LayerNorm with the CLOSED-FORM backward (same reasoning as
+    _bn_train_core: autodiff of the mean/var computation adds extra
+    activation-wide terms; the classic formula needs only (dy, xhat)):
+
+        dgamma = sum_rows(dy * xhat);  dbeta = sum_rows(dy)
+        g = dy * gamma
+        dx = r * (g - mean_f(g) - xhat * mean_f(g * xhat))
+    """
+    y, _, _ = _ln_fwd_impl(x, weight, bias, n_norm_axes, epsilon)
+    return y
+
+
+def _ln_fwd_impl(x, weight, bias, n_norm_axes, epsilon):
+    axes = tuple(range(x.ndim - n_norm_axes, x.ndim))
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=axes, keepdims=True)
+    var = xf.var(axis=axes, keepdims=True)
+    r = lax.rsqrt(var + epsilon)
+    xhat = (xf - mean) * r
+    out = xhat * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return out.astype(x.dtype), xhat.astype(x.dtype), r
+
+
+def _ln_fwd_rule(x, weight, bias, n_norm_axes, epsilon):
+    y, xhat, r = _ln_fwd_impl(x, weight, bias, n_norm_axes, epsilon)
+    from jax.ad_checkpoint import checkpoint_name
+    xhat = checkpoint_name(xhat, "norm_xhat")
+    r = checkpoint_name(r, "norm_stat")
+    return y, (xhat, r, weight, jnp.zeros((0,), bias.dtype))
+
+
+def _ln_bwd_rule(n_norm_axes, epsilon, res, dy):
+    xhat, r, weight, bias_proto = res
+    bias_dtype = bias_proto.dtype
+    ndim = dy.ndim
+    feat_axes = tuple(range(ndim - n_norm_axes, ndim))
+    row_axes = tuple(range(ndim - n_norm_axes))
+    dyf = dy.astype(jnp.float32)
+    xhat_f = xhat.astype(jnp.float32)
+    dgamma = jnp.sum(dyf * xhat_f, axis=row_axes)
+    dbeta = jnp.sum(dyf, axis=row_axes)
+    g = dyf * weight.astype(jnp.float32)
+    m1 = jnp.mean(g, axis=feat_axes, keepdims=True)
+    m2 = jnp.mean(g * xhat_f, axis=feat_axes, keepdims=True)
+    dx = (r * (g - m1 - xhat_f * m2)).astype(dy.dtype)
+    return dx, dgamma.astype(weight.dtype), dbeta.astype(bias_dtype)
+
+
+_ln_core.defvjp(_ln_fwd_rule, _ln_bwd_rule)
+
+
+def _closed_form_norm_grad() -> bool:
+    """custom_vjp norms are faster but do NOT support forward-mode AD
+    (jax.jvp / paddle.autograd.jvp / hessian). Users needing jvp through
+    norm layers set FLAGS_closed_form_norm_grad=0."""
+    from ..core import flags as _flags
+    if "closed_form_norm_grad" not in _flags.get_flags():
+        _flags.define_flag(
+            "closed_form_norm_grad", 1,
+            "use custom_vjp closed-form norm backward (faster; disables "
+            "forward-mode AD through layer_norm/batch_norm)")
+    return bool(_flags.flag("closed_form_norm_grad"))
+
+
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-5):
     if isinstance(normalized_shape, int):
         normalized_shape = (normalized_shape,)
-    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    n_axes = len(normalized_shape)
+    from jax.ad_checkpoint import checkpoint_name
+    if weight is not None and bias is not None and _closed_form_norm_grad():
+        # named so a remat policy may elect to SAVE normalized activations
+        # (the closed-form backward reads xhat, not x)
+        return checkpoint_name(
+            _ln_core(x, weight, bias, n_axes, epsilon), "norm_out")
+    axes = tuple(range(x.ndim - n_axes, x.ndim))
     xf = x.astype(jnp.float32)
     mean = xf.mean(axis=axes, keepdims=True)
     var = xf.var(axis=axes, keepdims=True)
@@ -453,12 +529,7 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon: float = 1e-
         out = out * weight.astype(jnp.float32)
     if bias is not None:
         out = out + bias.astype(jnp.float32)
-    out = out.astype(x.dtype)
-    # named so a remat policy may elect to SAVE normalized activations:
-    # recomputing LN inside backward costs ~1.6 ms/layer at GPT-1.3B shape
-    # (the f32 minor-axis reductions + the transposed copy feeding wgrad)
-    from jax.ad_checkpoint import checkpoint_name
-    return checkpoint_name(out, "norm_out")
+    return checkpoint_name(out.astype(x.dtype), "norm_out")
 
 
 def rms_norm(x, weight=None, epsilon: float = 1e-6, axis: int = -1):
