@@ -25,8 +25,17 @@ activations fit v5e HBM at this batch, and blanket block remat costs ~25%
 step time (see PERF.md). Set BENCH_REMAT=1 for the memory-constrained
 configuration.
 
+Self-defense (VERDICT r4 #1): every config is timed over >=3 independent
+windows guarded by a roofline floor computed from the compiled step's
+FLOPs/bytes; windows slower than BENCH_ANOMALY_FACTOR (4x) the floor are
+discarded and retried, and a config that never produces a clean window is
+emitted with "anomaly": true plus the discard log. Modeled on the
+reference's CI outlier gate (tools/check_op_benchmark_result.py). The pure
+selection logic is fault-injection-tested in tests/test_bench_guard.py.
+
 Env: BENCH_SMALL=1 (CPU smoke), BENCH_CONFIGS=gpt|all (default all),
-BENCH_LAYERS/HIDDEN/HEADS/SEQ/BATCH/STEPS/REMAT/PEAK_TFLOPS.
+BENCH_LAYERS/HIDDEN/HEADS/SEQ/BATCH/STEPS/REMAT/PEAK_TFLOPS,
+BENCH_WINDOWS/ANOMALY_FACTOR/RETRY_WINDOWS (guard knobs).
 """
 
 from __future__ import annotations
@@ -53,33 +62,188 @@ def _peak_flops(dev) -> float:
     return 197e12
 
 
-def _timed_steps(step, state, args, steps):
-    """Run `steps` chained iterations of step(state, *args) -> (loss, state);
-    returns (loss, dt_per_step). Syncs via a device->host transfer (see
-    PERF.md: block_until_ready is unreliable through the axon tunnel).
-    One warm call beyond compile; delegates to the same wall window as
-    _wall_and_device so the sync discipline lives in one place."""
-    loss, state = step(state, *args)  # extra warm step (parity with r3)
-    lv, dt, _, _ = _wall_and_device(step, state, args, steps,
-                                    with_device=False)
-    return lv, dt
+# ---------------------------------------------------------------------------
+# Self-defending measurement (VERDICT r4 missing #3 / next-round #1).
+#
+# The round-4 driver capture recorded BERT at 0.048x — a 25x collapse from a
+# transient tunnel/TPU pathology that the bench accepted as truth. Defense,
+# modeled on the reference's CI outlier gate (tools/
+# check_op_benchmark_result.py — rejects runs outside a tolerance band):
+#   1. >=3 independent timing windows per config; the reported number is the
+#      min over windows that pass the sanity check.
+#   2. A roofline floor computed from the compiled step's FLOPs and bytes
+#      (XLA cost analysis): no valid window can beat max(flops/peak,
+#      bytes/bw), and a window slower than ANOMALY_FACTOR x that floor is
+#      physically implausible for these >=0.3-MFU configs — it is discarded
+#      and the window retried.
+#   3. If every window is anomalous after retries, the result is still
+#      emitted but carries "anomaly": true and the discard log, so the
+#      record can never silently present a stalled-tunnel number as a clean
+#      measurement.
+# The pure window-selection logic (guarded_min) is fault-injection-tested in
+# tests/test_bench_guard.py.
+# ---------------------------------------------------------------------------
+
+N_WINDOWS = int(os.environ.get("BENCH_WINDOWS", "3"))
+ANOMALY_FACTOR = float(os.environ.get("BENCH_ANOMALY_FACTOR", "4.0"))
+MAX_EXTRA_WINDOWS = int(os.environ.get("BENCH_RETRY_WINDOWS", "3"))
 
 
-def _wall_and_device(step, state, args, steps, with_device=True):
-    """Chain-safe timing for donated-state steps: wall window + device
-    trace, threading the live state through. Returns
-    (loss, dt_wall, dt_device_or_None, state)."""
-    loss, state = step(state, *args)  # compile + warm
+def _peak_hbm_bw(dev) -> float:
+    """Peak HBM bandwidth (bytes/s) for the chip (v5e default)."""
+    env = os.environ.get("BENCH_PEAK_HBM_GBS")
+    if env:
+        return float(env) * 1e9
+    kind = getattr(dev, "device_kind", "").lower()
+    table = {"v5 lite": 819e9, "v5e": 819e9, "v4": 1228e9,
+             "v5p": 2765e9, "v6e": 1640e9, "v6 lite": 1640e9}
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 819e9
+
+
+def roofline_step_seconds(flops, bytes_accessed, peak_flops, peak_bw):
+    """Lower-bound step time from compiled cost: max of the compute and
+    memory rooflines. 0.0 when neither quantity is known (guard disabled)."""
+    t = 0.0
+    if flops and peak_flops:
+        t = max(t, flops / peak_flops)
+    if bytes_accessed and peak_bw:
+        t = max(t, bytes_accessed / peak_bw)
+    return t
+
+
+def _roofline_for(dev, flops, nbytes):
+    """Roofline floor for the guard — only on TPU, where the peak tables
+    apply (a CPU smoke run would flag every window against a v5e peak)."""
+    if getattr(dev, "platform", "") != "tpu":
+        return 0.0
+    return roofline_step_seconds(flops, nbytes, _peak_flops(dev),
+                                 _peak_hbm_bw(dev))
+
+
+def guarded_min(window_fn, n_windows, roofline_s, factor=None,
+                max_extra=None):
+    """Collect `n_windows` valid timing windows and return their min.
+
+    window_fn() -> per-step seconds, or None when the window failed to
+    measure (e.g. trace did not parse). A window slower than
+    factor * roofline_s is an anomaly: it is recorded, discarded, and an
+    extra window is attempted (up to n_windows + max_extra total attempts).
+
+    Returns (best_seconds_or_None, anomaly, valid_times, discarded_times):
+    anomaly=True means NO clean window was obtained and best is the min of
+    the discarded (i.e. untrustworthy) times, or None if nothing measured.
+    """
+    factor = ANOMALY_FACTOR if factor is None else factor
+    max_extra = MAX_EXTRA_WINDOWS if max_extra is None else max_extra
+    # Sub-millisecond rooflines (tiny smoke shapes) are dominated by fixed
+    # per-step overheads the FLOPs/bytes model can't see — the guard only
+    # has meaning for the real >=100 ms configs.
+    limit = factor * roofline_s if roofline_s and roofline_s >= 1e-3 \
+        else None
+    valid, discarded = [], []
+    attempts = 0
+    while len(valid) < n_windows and attempts < n_windows + max_extra:
+        attempts += 1
+        t = window_fn()
+        if t is None:
+            continue
+        if limit is not None and t > limit:
+            discarded.append(t)
+            continue
+        valid.append(t)
+    if valid:
+        return min(valid), False, valid, discarded
+    if discarded:
+        return min(discarded), True, valid, discarded
+    return None, True, valid, discarded
+
+
+def _measure_guarded(step, state, args, steps, roofline_s,
+                     n_windows=None):
+    """Guarded wall + device timing for a donated-state step fn.
+
+    Pre-warm: one compile call + one warm call run before any timed window
+    (this is also where Pallas block selection consults the pre-loaded
+    autotune cache — never inside a window). Then `n_windows` wall windows
+    and `n_windows` device-trace windows, each guarded against the roofline
+    floor. Device time is the preferred basis (PERF.md r4: the axon tunnel
+    adds ~10-15 ms/dispatch of host latency no real deployment pays).
+
+    Returns dict(loss, wall_s, device_s, used_s, timing, anomaly,
+    windows, discarded, state).
+    """
+    n_windows = N_WINDOWS if n_windows is None else n_windows
+    loss, state = step(state, *args)  # compile
+    loss, state = step(state, *args)  # warm (autotune cache consulted above)
     float(loss)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, state = step(state, *args)
-    lv = float(loss)
-    dt = (time.perf_counter() - t0) / steps
-    dt_dev = None
-    if with_device:
-        dt_dev, state = _device_step_time(step, state, args, steps)
-    return lv, dt, dt_dev, state
+    box = {"state": state, "loss": None}
+
+    def wall_window():
+        t0 = time.perf_counter()
+        st = box["state"]
+        for _ in range(steps):
+            loss, st = step(st, *args)
+        box["loss"] = float(loss)
+        box["state"] = st
+        return (time.perf_counter() - t0) / steps
+
+    # Wall windows: the guard still applies (a tunnel stall shows up here
+    # first), but wall legitimately carries dispatch latency — it is only
+    # the fallback basis when no trace parses.
+    wall_s, wall_anom, wall_ok, wall_disc = guarded_min(
+        wall_window, n_windows, roofline_s)
+
+    def device_window():
+        dt, st = _device_step_time(step, box["state"], args, steps)
+        box["state"] = st
+        return dt
+
+    dev_s, dev_anom, dev_ok, dev_disc = guarded_min(
+        device_window, n_windows, roofline_s)
+
+    if dev_s is not None and not dev_anom:
+        used, timing, anomaly = dev_s, "device", False
+    elif wall_s is not None and not wall_anom:
+        used, timing, anomaly = wall_s, "wall", False
+    else:
+        cands = [t for t in (dev_s, wall_s) if t is not None]
+        used = min(cands) if cands else None
+        timing = "device" if used == dev_s and dev_s is not None else "wall"
+        anomaly = True
+    return {
+        "loss": box["loss"], "wall_s": wall_s, "device_s": dev_s,
+        "used_s": used, "timing": timing, "anomaly": anomaly,
+        "windows": {"device_ms": [round(t * 1e3, 2) for t in dev_ok],
+                    "wall_ms": [round(t * 1e3, 2) for t in wall_ok]},
+        "discarded": {"device_ms": [round(t * 1e3, 2) for t in dev_disc],
+                      "wall_ms": [round(t * 1e3, 2) for t in wall_disc]},
+        "roofline_ms": round(roofline_s * 1e3, 2) if roofline_s else None,
+        "state": box["state"],
+    }
+
+
+def _guard_extra(m):
+    """The guard fields every emitted config carries."""
+    return {
+        "anomaly": m["anomaly"], "timing": m["timing"],
+        "windows": m["windows"], "discarded": m["discarded"],
+        "roofline_ms": m["roofline_ms"],
+        "wall_step_ms": round(m["wall_s"] * 1e3, 2) if m["wall_s"] else None,
+    }
+
+
+def _prewarm_autotune():
+    """Load the persistent kernel-autotune cache before any timing so
+    _pick_blocks-style selectors hit it at trace time (VERDICT r4 #1:
+    'pre-warm the autotune cache inside bench before timing')."""
+    try:
+        from paddle_tpu.ops._pallas.autotune import get_cache
+        get_cache().load()
+    except Exception:
+        pass
 
 
 def _device_step_time(step, state, args, steps):
@@ -129,14 +293,17 @@ def _emit(name, value, unit, mfu, extra):
     }), flush=True)
 
 
-def _compiled_flops(jitted, *args) -> float:
+def _compiled_cost(jitted, *args):
+    """(flops, bytes_accessed) from XLA's compiled cost analysis — the
+    inputs to the roofline floor the anomaly guard checks against."""
     try:
         cost = jitted.lower(*args).compile().cost_analysis()
         if isinstance(cost, list):
             cost = cost[0]
-        return float(cost.get("flops", 0.0))
+        return (float(cost.get("flops", 0.0)),
+                float(cost.get("bytes accessed", 0.0)))
     except Exception:
-        return 0.0
+        return 0.0, 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -192,19 +359,17 @@ def bench_resnet(small: bool):
     y = jnp.asarray(rng.integers(0, 10 if small else 1000, (batch,)),
                     jnp.int32)
     state = (params, buffers, opt_state)
-    flops = _compiled_flops(step, state, x, y)
-    loss, dt, dt_dev, state = _wall_and_device(step, state, (x, y), steps)
-    dt_used = dt_dev or dt
+    dev = jax.devices()[0]
+    flops, nbytes = _compiled_cost(step, state, x, y)
+    roof = _roofline_for(dev, flops, nbytes)
+    m = _measure_guarded(step, state, (x, y), steps, roof)
+    dt_used = m["used_s"]
     imgs_s = batch / dt_used
-    mfu = flops / dt_used / _peak_flops(jax.devices()[0]) if flops else 0.0
+    mfu = flops / dt_used / _peak_flops(dev) if flops else 0.0
     _emit("resnet50_dp_imgs_per_sec_per_chip", imgs_s, "imgs/sec/chip", mfu,
-          {"loss": loss, "batch": batch, "img": img,
+          {"loss": m["loss"], "batch": batch, "img": img,
            "step_ms": round(dt_used * 1e3, 2),
-           "wall_step_ms": round(dt * 1e3, 2),
-           "timing": "device" if dt_dev else "wall",
-           "bound": "HBM-bandwidth (PERF.md r4: ideal fully-fused traffic "
-                    "34 GB/step; closing the rest needs a cuDNN-class "
-                    "fused-conv kernel library)",
+           **_guard_extra(m),
            "baseline_config": 2})
 
 
@@ -254,17 +419,18 @@ def bench_bert(small: bool):
                          jnp.int32)
     sop = jnp.asarray(rng.integers(0, 2, (batch, 1)), jnp.int32)
     state = (params, opt_state)
-    flops = _compiled_flops(step, state, ids, labels, sop)
-    loss, dt, dt_dev, state = _wall_and_device(step, state,
-                                               (ids, labels, sop), steps)
-    dt_used = dt_dev or dt
+    dev = jax.devices()[0]
+    flops, nbytes = _compiled_cost(step, state, ids, labels, sop)
+    roof = _roofline_for(dev, flops, nbytes)
+    m = _measure_guarded(step, state, (ids, labels, sop), steps, roof)
+    state = m["state"]
+    dt_used = m["used_s"]
     tok_s = batch * seq / dt_used
-    mfu = flops / dt_used / _peak_flops(jax.devices()[0]) if flops else 0.0
+    mfu = flops / dt_used / _peak_flops(dev) if flops else 0.0
 
-    extra = {"loss": loss, "batch": batch, "seq": seq,
+    extra = {"loss": m["loss"], "batch": batch, "seq": seq,
              "step_ms": round(dt_used * 1e3, 2),
-             "wall_step_ms": round(dt * 1e3, 2),
-             "timing": "device" if dt_dev else "wall",
+             **_guard_extra(m),
              "baseline_config": 3}
 
     if not small:
@@ -290,9 +456,9 @@ def bench_bert(small: bool):
                                                           labels)
             return loss, (*opt.apply_gradients(p, grads, st, 1e-4),)
 
-        _, dtp, dtp_dev, state = _wall_and_device(
-            step_padded, state, (ids, att_j, pl_labels), steps)
-        dtp_used = dtp_dev or dtp
+        mp = _measure_guarded(step_padded, state, (ids, att_j, pl_labels),
+                              steps, roof)
+        state, dtp_used = mp["state"], mp["used_s"]
 
         # pack the SAME real tokens into fewer rows (greedy first-fit)
         rows, row, used = [], [], 0
@@ -333,10 +499,14 @@ def bench_bert(small: bool):
 
         pk_args = (jnp.asarray(pk_ids), jnp.asarray(pk_seg),
                    jnp.asarray(pk_lab))
-        _, dtk, dtk_dev, state = _wall_and_device(step_packed, state,
-                                                  pk_args, steps)
-        dtk_used = dtk_dev or dtk
+        # packed rows < batch → fewer FLOPs; reuse the main roofline only
+        # as a permissive floor scaled by row count
+        mk = _measure_guarded(step_packed, state, pk_args, steps,
+                              roof * n_rows / batch)
+        state, dtk_used = mk["state"], mk["used_s"]
         extra.update({
+            "padded_anomaly": mp["anomaly"],
+            "packed_anomaly": mk["anomaly"],
             "padding_ratio": round(1 - real / (batch * seq), 3),
             "padded_real_tokens_per_sec": round(real / dtp_used, 1),
             "packed_real_tokens_per_sec": round(real / dtk_used, 1),
@@ -407,20 +577,24 @@ def bench_ernie(small: bool):
         p, st, loss = pstep(p, st, ids, labels, jnp.float32(1e-4))
         return loss, (p, st)
 
-    loss, dt, dt_dev, _ = _wall_and_device(step, (params, opt_state),
-                                           (ids, labels), steps)
-    dt_used = dt_dev or dt
+    dev = jax.devices()[0]
+    # The pipeline step jits internally, so XLA cost analysis is out of
+    # reach here — the roofline floor is the analytic 6N FLOPs/token.
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+    roof = (6 * n_params * batch * seq / _peak_flops(dev)
+            if getattr(dev, "platform", "") == "tpu" else 0.0)
+    m = _measure_guarded(step, (params, opt_state), (ids, labels), steps,
+                         roof)
+    dt_used = m["used_s"]
     tok_s = batch * seq / dt_used
     # Analytic MFU: 6N per token (encoder matmuls + untied MLM head).
-    n_params = sum(int(np.prod(p.shape)) for p in params.values())
-    mfu = tok_s * 6 * n_params / _peak_flops(jax.devices()[0])
+    mfu = tok_s * 6 * n_params / _peak_flops(dev)
     set_hybrid_mesh(None)
     _emit("ernie_pipeline_tokens_per_sec_per_chip", tok_s, "tokens/sec/chip",
           mfu,
-          {"loss": loss, "batch": batch, "seq": seq, "n_micro": n_micro,
+          {"loss": m["loss"], "batch": batch, "seq": seq, "n_micro": n_micro,
            "n_params": n_params, "step_ms": round(dt_used * 1e3, 2),
-           "wall_step_ms": round(dt * 1e3, 2),
-           "timing": "device" if dt_dev else "wall",
+           **_guard_extra(m),
            "baseline_config": 5, "pp_degree": 1,
            "note": "single-chip: pp machinery runs with num_stages=1 "
                    "(microbatched); real pp=4 validated functionally in "
@@ -433,11 +607,10 @@ def bench_ernie(small: bool):
 # ---------------------------------------------------------------------------
 
 def _gpt_measure(layers, hidden, heads, seq, batch, steps, remat, vocab):
-    """Build + time one GPT train-step config.
+    """Build + time one GPT train-step config under the anomaly guard.
 
-    Returns (dt_wall_s, dt_device_s_or_None, n_params, loss): wall is
-    min-of-3 chained windows; device comes from an xprof trace when the
-    parser is available."""
+    Returns (measurement_dict, n_params): guarded min-of-N wall + device
+    windows against the compiled-cost roofline floor (_measure_guarded)."""
     import jax
     import jax.numpy as jnp
     import paddle_tpu as paddle
@@ -478,19 +651,12 @@ def _gpt_measure(layers, hidden, heads, seq, batch, steps, remat, vocab):
     ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
     labels = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1), jnp.int32)
     state = (params, opt_state)
-    loss, state = step(state, ids, labels)  # compile
-    loss, state = step(state, ids, labels)
-    float(loss)
-    best = lv = None
-    for _ in range(3):  # min-of-3 windows: tunnel jitter is one-sided
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            loss, state = step(state, ids, labels)
-        lv = float(loss)
-        dt = (time.perf_counter() - t0) / steps
-        best = dt if best is None else min(best, dt)
-    dt_dev, state = _device_step_time(step, state, (ids, labels), steps)
-    return best, dt_dev, n_params, lv
+    dev = jax.devices()[0]
+    flops, nbytes = _compiled_cost(step, state, ids, labels)
+    roof = _roofline_for(dev, flops, nbytes)
+    m = _measure_guarded(step, state, (ids, labels), steps, roof)
+    m.pop("state")
+    return m, n_params
 
 
 def _gpt_flops_per_token(n_params, layers, seq, hidden):
@@ -519,17 +685,22 @@ def bench_gpt_13b_extrapolated():
     seq, batch, heads, hidden, vocab = 2048, 4, 16, 2048, 50304
     pts = []
     for L in (6, 12):
-        dt_wall, dt_dev, n_params, loss = _gpt_measure(
+        m, n_params = _gpt_measure(
             L, hidden, heads, seq, batch, steps=8, remat=True, vocab=vocab)
-        pts.append([L, dt_dev, n_params, loss, dt_wall])
+        pts.append((L, m, n_params))
     # headline on DEVICE time when a trace was parsed for BOTH depths (the
     # axon tunnel's ~10-15 ms/dispatch host latency is a harness artifact,
     # not chip throughput); otherwise wall time for both — never mixed
-    timing_basis = "device" if all(p[1] for p in pts) else "wall"
-    for p in pts:
-        if timing_basis == "wall":
-            p[1] = p[4]
-    (l1, t1, _, loss1, w1), (l2, t2, _, _, w2) = pts
+    ms = [p[1] for p in pts]
+    # "device" only when BOTH depths produced CLEAN device windows —
+    # m["timing"] is set to "device" only in that case (an all-anomalous
+    # device trace must never become the headline basis).
+    timing_basis = ("device" if all(m["timing"] == "device" for m in ms)
+                    else "wall")
+    times = [m["device_s" if timing_basis == "device" else "wall_s"]
+             for m in ms]
+    anomaly = any(m["anomaly"] for m in ms)
+    (l1, l2), (t1, t2) = (pts[0][0], pts[1][0]), times
     per_layer = (t2 - t1) / (l2 - l1)
     fixed = t1 - l1 * per_layer
     t24 = fixed + 24 * per_layer
@@ -543,7 +714,8 @@ def bench_gpt_13b_extrapolated():
     mfu = tokens_per_sec * flops_per_token / _peak_flops(jax.devices()[0])
     _emit("gpt3_1p3b_train_tokens_per_sec_per_chip", tokens_per_sec,
           "tokens/sec/chip", mfu,
-          {"n_params": n24, "loss_at_l6": loss1,
+          {"n_params": n24, "loss_at_l6": ms[0]["loss"],
+           "anomaly": anomaly,
            "config": {"layers": 24, "hidden": hidden, "heads": heads,
                       "seq": seq, "batch": batch, "remat": True,
                       "amp": "O2 (bf16 + f32 master)"},
@@ -551,8 +723,12 @@ def bench_gpt_13b_extrapolated():
                      "> 15.75 GB HBM single-chip; BASELINE runs it mp=4)",
            "measured_points": [
                {"layers": l, "step_ms": round(t * 1e3, 2),
-                "wall_step_ms": round(w * 1e3, 2)}
-               for l, t, _, _, w in pts],
+                "wall_step_ms": round(m["wall_s"] * 1e3, 2)
+                if m["wall_s"] else None,
+                "anomaly": m["anomaly"],
+                "windows": m["windows"], "discarded": m["discarded"],
+                "roofline_ms": m["roofline_ms"]}
+               for (l, m, _), t in zip(pts, times)],
            "timing": ("device (xprof hlo_stats; wall incl. ~10-15 ms/step "
                       "axon-tunnel dispatch latency reported alongside)"
                       if timing_basis == "device" else "wall"),
@@ -614,23 +790,30 @@ def bench_gpt(small: bool):
     ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
     labels = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1), jnp.int32)
 
-    loss, dt = _timed_steps(step, (params, opt_state), (ids, labels), steps)
+    dev = jax.devices()[0]
+    flops, nbytes = _compiled_cost(step, (params, opt_state), ids, labels)
+    roof = _roofline_for(dev, flops, nbytes)
+    m = _measure_guarded(step, (params, opt_state), (ids, labels), steps,
+                         roof)
+    dt = m["used_s"]
     tokens_per_sec = batch * seq / dt
     # Model FLOPs per token: 6N (fwd+bwd matmuls) + causal attention
     # 12*L*seq*hidden/2 (QK^T + PV, fwd+bwd, halved by causal masking).
     flops_per_token = 6 * n_params + 6 * layers * seq * hidden
-    mfu = tokens_per_sec * flops_per_token / _peak_flops(jax.devices()[0])
+    mfu = tokens_per_sec * flops_per_token / _peak_flops(dev)
     _emit(f"gpt_{n_params/1e6:.0f}M_train_tokens_per_sec_per_chip",
           tokens_per_sec, "tokens/sec/chip", mfu,
-          {"loss": loss, "n_params": n_params,
+          {"loss": m["loss"], "n_params": n_params,
            "config": {"layers": layers, "hidden": hidden, "heads": heads,
                       "seq": seq, "batch": batch, "steps": steps,
                       "remat": remat},
+           **_guard_extra(m),
            "step_ms": round(dt * 1e3, 2), "baseline_config": 4})
 
 
 def main():
     small = os.environ.get("BENCH_SMALL") == "1"
+    _prewarm_autotune()
     which = os.environ.get("BENCH_CONFIGS", "all")
     selected = {w.strip() for w in which.split(",")}
     by_name = {"resnet": bench_resnet, "bert": bench_bert,
