@@ -330,8 +330,13 @@ def bench_resnet(small: bool):
     # matmuls (see nn/functional.conv2d fast path) which XLA fuses with
     # the surrounding BN/ReLU elementwise work. Profiled r3 on v5e.
     fmt = os.environ.get("BENCH_RN_FORMAT", "NHWC")
+    # MLPerf space-to-depth stem (exact 7x7/s2 rewrite as 4x4/s1 over 2x2
+    # s2d input): fills the MXU's input-channel lanes (12 vs 3)
+    stem = os.environ.get("BENCH_RN_STEM", "space_to_depth"
+                          if fmt == "NHWC" else "conv")
     model = resnet18(num_classes=10, data_format=fmt) if small \
-        else resnet50(data_format=fmt)
+        else resnet50(data_format=fmt, stem_mode=stem)  # small: 18 has no
+    # 7x7 stem benefit worth modeling; BENCH_RN_STEM applies to the full run
     model.train()
     model.astype(paddle.bfloat16)
     opt = Momentum(learning_rate=0.1, momentum=0.9, multi_precision=True)

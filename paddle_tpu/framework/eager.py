@@ -45,6 +45,44 @@ _counter = itertools.count()
 _suppress = []
 
 
+class TensorHookRemoveHelper:
+    """Handle returned by ``Tensor.register_hook`` (ref
+    ``python/paddle/fluid/dygraph/tensor_patch_methods.py`` —
+    TensorHookRemoveHelper.remove())."""
+
+    def __init__(self, tensor: "Tensor", hook_id: int):
+        import weakref
+        self._tensor_ref = weakref.ref(tensor)
+        self._hook_id = hook_id
+
+    def remove(self) -> bool:
+        t = self._tensor_ref()
+        if t is not None and t._hooks and self._hook_id in t._hooks:
+            del t._hooks[self._hook_id]
+            return True
+        return False
+
+
+def _apply_hooks(t: "Tensor", g: jax.Array) -> jax.Array:
+    """Run t's grad hooks in registration order on the FULLY-ACCUMULATED
+    gradient (ref fluid/eager/hooks.h TensorHook: hooks fire when the
+    engine finishes the grad for that tensor; a non-None return replaces
+    it and flows to upstream nodes)."""
+    hooks = t._hooks
+    if not hooks:
+        return g
+    gt = Tensor(g)
+    for fn in list(hooks.values()):
+        r = fn(gt)
+        if r is not None:
+            gt = r if isinstance(r, Tensor) else Tensor(to_tensor_value(r))
+    if gt._value.shape != g.shape:
+        raise ValueError(
+            f"register_hook callback changed the gradient shape: "
+            f"{g.shape} -> {gt._value.shape}")
+    return gt._value.astype(g.dtype)
+
+
 def _suppress_param_grads() -> bool:
     return bool(_suppress)
 
@@ -112,7 +150,9 @@ class _Node:
                   if _is_float_array(l)]
         return leaves
 
-    def run_backward(self, acc: Dict[int, jax.Array], needed: Dict[int, "_Node"]):
+    def run_backward(self, acc: Dict[int, jax.Array],
+                     needed: Dict[int, "_Node"],
+                     leaf_sink: Optional[Dict[int, Tuple]] = None):
         if self.released:
             raise RuntimeError(
                 "Trying to backward through the graph a second time: the "
@@ -123,13 +163,21 @@ class _Node:
                               for n in self.frozen_trainable_names})
         diff_vals += [self.leaf_vals[i] for i in self.diff_pos]
         _, pull = jax.vjp(lambda *dv: self._replay(dv), *diff_vals)
-        cts = [acc.get(id(t), None) for t in self.out_tensors]
-        cts = [jnp.zeros_like(t._value) if c is None else c
-               for c, t in zip(cts, self.out_tensors)]
+        # Reverse-creation-order walk: by the time a node consumes its
+        # outputs' cotangents every consumer has contributed, so this is
+        # the fully-accumulated grad — the hook point.
+        cts = []
+        for t in self.out_tensors:
+            c = acc.get(id(t), None)
+            c = jnp.zeros_like(t._value) if c is None else c
+            if t._hooks:
+                c = _apply_hooks(t, c)
+                acc[id(t)] = c  # non-leaf paddle.grad inputs read acc later
+            cts.append(c)
         grads = pull(cts)
         gi = 0
         if self.layer is not None:
-            self._write_param_grads(grads[0])
+            self._write_param_grads(grads[0], leaf_sink)
             gi = 1
         for parent, g in zip(self.parents, grads[gi:]):
             pnode = parent._node
@@ -139,7 +187,13 @@ class _Node:
             elif not parent.stop_gradient:
                 if _suppress and id(parent) not in _suppress[-1]:
                     continue  # paddle.grad: grads only for requested inputs
-                parent._accumulate_grad(g)
+                if leaf_sink is not None:
+                    # stage: leaf hooks fire ONCE on the summed grad
+                    ent = leaf_sink.get(id(parent))
+                    leaf_sink[id(parent)] = \
+                        (parent, g if ent is None else ent[1] + g)
+                else:
+                    parent._accumulate_grad(g)
 
     # layer-node plumbing: trainable params are re-read at backward time so
     # repeated backward() calls after opt.step() see fresh values is NOT
@@ -151,13 +205,23 @@ class _Node:
     def _param_value(self, name):
         return self._trainable_snapshot[name]
 
-    def _write_param_grads(self, gdict: Dict[str, jax.Array]):
+    def _write_param_grads(self, gdict: Dict[str, jax.Array],
+                           leaf_sink: Optional[Dict[int, Tuple]] = None):
         if _suppress_param_grads():
             return
         refs = dict(self.layer.named_parameters())
         for name, g in gdict.items():
             ref = refs[name]
-            ref.grad = g if ref.grad is None else ref.grad + g
+            if leaf_sink is not None and getattr(ref, "_hooks", None):
+                # key by (layer, attr): ParamRef handles are recreated per
+                # named_parameters() call, so id(ref) would split one
+                # parameter's contributions across sink entries and fire
+                # the hook per node instead of once on the sum
+                key = (id(ref.layer), ref.attr_name)
+                ent = leaf_sink.get(key)
+                leaf_sink[key] = (ref, g if ent is None else ent[1] + g)
+            else:
+                ref.grad = g if ref.grad is None else ref.grad + g
 
     def release(self):
         self.released = True
@@ -185,7 +249,7 @@ class Tensor:
     """
 
     __slots__ = ("_value", "stop_gradient", "_node", "_grad", "name",
-                 "persistable", "__weakref__")
+                 "persistable", "_hooks", "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, node=None,
                  name: Optional[str] = None):
@@ -195,6 +259,7 @@ class Tensor:
         self._node = node
         self._grad = None
         self.persistable = False
+        self._hooks: Optional[Dict[int, Any]] = None
         self.name = name or f"eager_tmp_{next(_counter)}"
 
     # -- interop protocols --------------------------------------------------
@@ -304,7 +369,7 @@ class Tensor:
                 # scalar leaf accumulates ones)
                 seed = jnp.ones_like(self._value) if grad_tensor is None \
                     else to_tensor_value(grad_tensor)
-                self._accumulate_grad(seed)
+                self._accumulate_grad(_apply_hooks(self, seed))
             return
         backward_multi([self], [grad_tensor], retain_graph=retain_graph)
 
@@ -324,8 +389,23 @@ class Tensor:
     def clone(self) -> "Tensor":
         return record_call(lambda v: v + 0, (self,), {})
 
-    def register_hook(self, hook):  # grad-hook stub (functional AD)
-        return hook
+    def register_hook(self, hook):
+        """Register ``hook(grad) -> new_grad | None`` to run when this
+        tensor's gradient is computed during backward (ref
+        ``paddle/fluid/eager/hooks.h`` TensorHook via
+        ``tensor_patch_methods.register_hook``). A non-None return replaces
+        the gradient, affecting both ``.grad`` and upstream flow. Returns a
+        helper whose ``remove()`` unregisters the hook."""
+        if self.stop_gradient:
+            # ref tensor_patch_methods.register_hook: "Cannot register hook
+            # on a tensor that stop gradient"
+            raise RuntimeError(
+                "Cannot register hook on a tensor with stop_gradient=True")
+        if self._hooks is None:
+            self._hooks = {}
+        hid = next(_counter)
+        self._hooks[hid] = hook
+        return TensorHookRemoveHelper(self, hid)
 
     def retain_grads(self):
         self.stop_gradient = False
@@ -622,16 +702,27 @@ def backward_multi(tensors, seeds=None, retain_graph: bool = False):
         seed = jnp.ones_like(t._value) if s is None else to_tensor_value(s)
         if t._node is None:
             if not t.stop_gradient:
-                t._accumulate_grad(seed)
+                t._accumulate_grad(_apply_hooks(t, seed))
             continue
         nodes.update(_collect_nodes(t._node))
         prev = acc.get(id(t))
         acc[id(t)] = seed if prev is None else prev + seed
+    leaf_sink: Dict[int, Tuple] = {}
     for node in sorted(nodes.values(), key=lambda n: -n.counter):
-        node.run_backward(acc, nodes)
+        node.run_backward(acc, nodes, leaf_sink)
+    _finalize_leaf_sink(leaf_sink)
     if not retain_graph:
         for node in nodes.values():
             node.release()
+
+
+def _finalize_leaf_sink(leaf_sink: Dict[int, Tuple]):
+    """Leaf/parameter grads staged during the walk land here once fully
+    summed — the hook fires a single time on the total, then accumulates
+    into ``.grad`` (matching the engine's GradNodeAccumulation hook point,
+    ref fluid/eager/accumulation/accumulation_node.cc)."""
+    for t, total in leaf_sink.values():
+        t._accumulate_grad(_apply_hooks(t, total))
 
 
 def _collect_nodes(root: _Node) -> Dict[int, _Node]:
@@ -676,8 +767,10 @@ def tape_grad(outputs, inputs, grad_outputs=None, retain_graph=False,
     # paddle.grad must not touch param.grad or unrelated leaves' .grad
     _suppress.append({id(t) for t in ins})
     try:
+        leaf_sink: Dict[int, Tuple] = {}
         for node in sorted(nodes.values(), key=lambda n: -n.counter):
-            node.run_backward(acc, nodes)
+            node.run_backward(acc, nodes, leaf_sink)
+        _finalize_leaf_sink(leaf_sink)
         for t in ins:
             g = t._grad
             # non-leaf input: grad is its accumulated cotangent

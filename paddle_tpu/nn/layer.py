@@ -137,9 +137,57 @@ class ParamRef:
     def clear_grad(self) -> None:
         self.grad = None
 
+    # -- grad hooks (ref fluid/eager/hooks.h; fired by the eager tape) ----
+    # Hooks live on the owning Layer (ParamRef handles are recreated per
+    # named_parameters() call) keyed by attr name, so registration survives
+    # handle churn and the tape fires them once per backward.
+
+    @property
+    def _hooks(self):
+        return getattr(self.layer, "_param_hooks", {}).get(self.attr_name)
+
+    def register_hook(self, hook):
+        """hook(grad) -> new_grad | None, fired when this parameter's
+        gradient lands during ``loss.backward()``. Returns a handle with
+        ``remove()``."""
+        store = getattr(self.layer, "_param_hooks", None)
+        if store is None:
+            store = {}
+            object.__setattr__(self.layer, "_param_hooks", store)
+        hooks = store.setdefault(self.attr_name, {})
+        hid = next(_param_hook_ids)
+        hooks[hid] = hook
+        return _ParamHookRemoveHelper(self.layer, self.attr_name, hid)
+
+    def _accumulate_grad(self, g) -> None:
+        self.grad = g if self.grad is None else self.grad + g
+
     def __repr__(self):
         return (f"ParamRef(name={self.name!r}, shape={self.shape}, "
                 f"dtype={self.dtype}, trainable={self.trainable})")
+
+
+import itertools as _itertools  # noqa: E402
+
+_param_hook_ids = _itertools.count()
+
+
+class _ParamHookRemoveHelper:
+    def __init__(self, layer, attr_name: str, hook_id: int):
+        import weakref
+        self._layer_ref = weakref.ref(layer)
+        self._attr = attr_name
+        self._hook_id = hook_id
+
+    def remove(self) -> bool:
+        layer = self._layer_ref()
+        if layer is None:
+            return False
+        hooks = getattr(layer, "_param_hooks", {}).get(self._attr)
+        if hooks and self._hook_id in hooks:
+            del hooks[self._hook_id]
+            return True
+        return False
 
 
 class Layer:

@@ -15,6 +15,47 @@ __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2"]
 
 
+def _fusable(block, x) -> bool:
+    """The deferred-BN fused path (nn.fused_conv_bn) applies when training
+    in NHWC with plain affine BatchNorm everywhere — the conditions under
+    which the reference would dispatch cuDNN fused conv-BN-activation."""
+    from ...nn import fused_conv_bn as FCB
+    from ...nn.layers import _BatchNormBase
+    if x.ndim != 4 or getattr(block, "_data_format", None) != "NHWC":
+        return False
+    if not block.training or not FCB.fused_conv_bn_enabled():
+        return False
+    bns = [block.bn1, block.bn2] + \
+        ([block.bn3] if hasattr(block, "bn3") else [])
+    if block.downsample is not None:
+        if len(getattr(block.downsample, "_sub_layers", {})) != 2:
+            return False
+        bns.append(block.downsample[1])
+    for bn in bns:
+        if not isinstance(bn, _BatchNormBase) or bn.use_global_stats \
+                or bn.weight is None or bn.bias is None:
+            return False
+    return True
+
+
+def _fused_identity(block, x):
+    """Downsample branch under the fused path: 1x1 strided conv with stats
+    epilogue, BN applied from its own sums (no activation)."""
+    from ...nn import fused_conv_bn as FCB
+    if block.downsample is None:
+        return x
+    dconv, dbn = block.downsample[0], block.downsample[1]
+    s = _pair(dconv.stride)
+    od, sd, ssd = FCB.conv_stats(x, dconv.weight, s, _pair(dconv.padding),
+                                 _pair(dconv.dilation), dconv.groups)
+    FCB.update_bn_buffers(dbn, sd, ssd, od.size // od.shape[-1])
+    return FCB.bn_act_from_stats(od, dbn.weight, dbn.bias, sd, ssd,
+                                 dbn.epsilon, "none")
+
+
+from ...nn.functional import _pair  # noqa: E402
+
+
 def _norm(norm_layer, num_features, data_format):
     """Construct a norm layer, passing data_format only to callables that
     accept it (custom norm_layer callables may not)."""
@@ -47,14 +88,30 @@ class BasicBlock(nn.Layer):
         self.bn2 = _norm(norm_layer, planes, df)
         self.downsample = downsample if downsample is not None else None
         self.stride = stride
+        self._data_format = data_format
 
     def forward(self, x):
+        if _fusable(self, x):
+            return self._forward_fused(x)
         identity = x
         out = self.relu(self.bn1(self.conv1(x)))
         out = self.bn2(self.conv2(out))
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
+
+    def _forward_fused(self, x):
+        from ...nn import fused_conv_bn as FCB
+        o1, s1, ss1 = FCB.conv_stats(
+            x, self.conv1.weight, _pair(self.conv1.stride), (1, 1))
+        FCB.update_bn_buffers(self.bn1, s1, ss1, o1.size // o1.shape[-1])
+        o2, s2, ss2 = FCB.conv_bn_act(
+            o1, self.bn1.weight, self.bn1.bias, s1, ss1, self.conv2.weight,
+            self.bn1.epsilon, "relu", (1, 1), (1, 1))
+        FCB.update_bn_buffers(self.bn2, s2, ss2, o2.size // o2.shape[-1])
+        identity = _fused_identity(self, x)
+        return FCB.bn_add_act(o2, self.bn2.weight, self.bn2.bias, s2, ss2,
+                              identity, self.bn2.epsilon)
 
 
 class BottleneckBlock(nn.Layer):
@@ -79,8 +136,11 @@ class BottleneckBlock(nn.Layer):
         self.bn3 = _norm(norm_layer, planes * self.expansion, df)
         self.relu = nn.ReLU()
         self.downsample = downsample if downsample is not None else None
+        self._data_format = data_format
 
     def forward(self, x):
+        if _fusable(self, x):
+            return self._forward_fused(x)
         identity = x
         out = self.relu(self.bn1(self.conv1(x)))
         out = self.relu(self.bn2(self.conv2(out)))
@@ -88,6 +148,28 @@ class BottleneckBlock(nn.Layer):
         if self.downsample is not None:
             identity = self.downsample(x)
         return self.relu(out + identity)
+
+    def _forward_fused(self, x):
+        """Deferred-BN bottleneck: each conv consumes the previous conv's
+        raw output with BN+ReLU as an in-fusion prologue and emits channel
+        sums as an epilogue (nn.fused_conv_bn docstring has the full
+        traffic story). Semantically identical to the plain forward."""
+        from ...nn import fused_conv_bn as FCB
+        c2 = self.conv2
+        o1, s1, ss1 = FCB.conv_stats(x, self.conv1.weight)
+        FCB.update_bn_buffers(self.bn1, s1, ss1, o1.size // o1.shape[-1])
+        o2, s2, ss2 = FCB.conv_bn_act(
+            o1, self.bn1.weight, self.bn1.bias, s1, ss1, c2.weight,
+            self.bn1.epsilon, "relu", _pair(c2.stride), _pair(c2.padding),
+            _pair(c2.dilation), c2.groups)
+        FCB.update_bn_buffers(self.bn2, s2, ss2, o2.size // o2.shape[-1])
+        o3, s3, ss3 = FCB.conv_bn_act(
+            o2, self.bn2.weight, self.bn2.bias, s2, ss2, self.conv3.weight,
+            self.bn2.epsilon, "relu")
+        FCB.update_bn_buffers(self.bn3, s3, ss3, o3.size // o3.shape[-1])
+        identity = _fused_identity(self, x)
+        return FCB.bn_add_act(o3, self.bn3.weight, self.bn3.bias, s3, ss3,
+                              identity, self.bn3.epsilon)
 
 
 def _space_to_depth(x):
@@ -172,18 +254,46 @@ class ResNet(nn.Layer):
                                 base_width=self.base_width, data_format=df))
         return nn.Sequential(*layers)
 
+    def _stem_fusable(self, x) -> bool:
+        from ...nn import fused_conv_bn as FCB
+        from ...nn.layers import _BatchNormBase
+        return (x.ndim == 4 and self.data_format == "NHWC" and self.training
+                and FCB.fused_conv_bn_enabled()
+                and isinstance(self.bn1, _BatchNormBase)
+                and not self.bn1.use_global_stats
+                and self.bn1.weight is not None
+                and self.bn1.bias is not None)
+
     def forward(self, x):
+        fused = self._stem_fusable(x)
         if self.stem_mode == "space_to_depth":
             import jax.numpy as jnp
             from ...nn import functional as F
             xs = _space_to_depth(x)
             xs = jnp.pad(xs, ((0, 0), (2, 1), (2, 1), (0, 0)))
             w2 = _fold_stem_weight(self.conv1.weight)
-            x = F.conv2d(xs, w2.astype(xs.dtype), stride=1, padding=0,
-                         data_format="NHWC")
+            if fused:
+                x, stem_pad = xs, (0, 0)
+                stem_w, stem_stride = w2, (1, 1)
+            else:
+                x = F.conv2d(xs, w2.astype(xs.dtype), stride=1, padding=0,
+                             data_format="NHWC")
+        elif fused:
+            stem_w = self.conv1.weight
+            stem_stride, stem_pad = _pair(self.conv1.stride), \
+                _pair(self.conv1.padding)
         else:
             x = self.conv1(x)
-        x = self.maxpool(self.relu(self.bn1(x)))
+        if fused:
+            from ...nn import fused_conv_bn as FCB
+            o0, s0, ss0 = FCB.conv_stats(x, stem_w, stem_stride, stem_pad)
+            FCB.update_bn_buffers(self.bn1, s0, ss0,
+                                  o0.size // o0.shape[-1])
+            x = FCB.bn_act_from_stats(o0, self.bn1.weight, self.bn1.bias,
+                                      s0, ss0, self.bn1.epsilon, "relu")
+            x = self.maxpool(x)
+        else:
+            x = self.maxpool(self.relu(self.bn1(x)))
         x = self.layer4(self.layer3(self.layer2(self.layer1(x))))
         if self.with_pool:
             x = self.avgpool(x)

@@ -212,3 +212,128 @@ def test_multi_root_backward_shared_graph():
     b = (y * 5).sum()
     paddle.autograd.backward([a, b])
     np.testing.assert_allclose(x.grad.numpy(), [16.0, 16.0])
+
+
+# ---------------------------------------------------------------------------
+# Tensor.register_hook (VERDICT r4 weak #5; ref fluid/eager/hooks.h +
+# tensor_patch_methods.register_hook semantics)
+# ---------------------------------------------------------------------------
+
+def test_register_hook_modifies_leaf_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    x.register_hook(lambda g: g * 2)
+    loss = paddle.sum(x * 3.0)
+    loss.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_register_hook_observe_only_returns_none():
+    seen = []
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    x.register_hook(lambda g: seen.append(g.numpy()))
+    paddle.sum(x * 3.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0, 3.0])
+
+
+def test_register_hook_remove_stops_firing():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    h = x.register_hook(lambda g: g * 10)
+    paddle.sum(x * 1.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [10.0])
+    assert h.remove() is True
+    assert h.remove() is False  # second remove is a no-op
+    x.clear_grad()
+    paddle.sum(x * 1.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0])
+
+
+def test_register_hook_fires_once_on_accumulated_grad():
+    # x feeds TWO consumers: the hook must see the SUMMED gradient once
+    # (engine fires tensor hooks on the finished accumulation, not per
+    # contribution)
+    calls = []
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+
+    def hook(g):
+        calls.append(np.asarray(g.numpy()))
+        return g * 2
+
+    x.register_hook(hook)
+    loss = paddle.sum(x * 2.0) + paddle.sum(x * 5.0)
+    loss.backward()
+    assert len(calls) == 1
+    np.testing.assert_allclose(calls[0], [7.0, 7.0])
+    np.testing.assert_allclose(x.grad.numpy(), [14.0, 14.0])
+
+
+def test_register_hook_intermediate_affects_upstream():
+    # hook on an INTERMEDIATE tensor rescales the grad flowing to leaves
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * 3.0
+    y.register_hook(lambda g: g * 5)
+    paddle.sum(y * 1.0).backward()
+    np.testing.assert_allclose(x.grad.numpy(), [15.0])  # 1 * 5 * 3
+
+
+def test_register_hook_on_parameter():
+    lin = nn.Linear(2, 2)
+    refs = dict(lin.named_parameters())
+    h = refs["weight"].register_hook(lambda g: g * 0.0)
+    x = paddle.to_tensor([[1.0, 2.0]])
+    paddle.sum(lin(x)).backward()
+    # hook registration survives ParamRef handle churn (stored on the Layer)
+    refs2 = dict(lin.named_parameters())
+    np.testing.assert_allclose(np.asarray(refs2["weight"].grad),
+                               np.zeros((2, 2)))
+    # bias had no hook: untouched ones
+    np.testing.assert_allclose(np.asarray(refs2["bias"].grad), [1.0, 1.0])
+    # remove via the original handle, grads flow again
+    assert h.remove() is True
+    refs2["weight"].clear_grad()
+    refs2["bias"].clear_grad()
+    paddle.sum(lin(x)).backward()
+    assert np.abs(np.asarray(dict(lin.named_parameters())["weight"].grad)
+                  ).sum() > 0
+
+
+def test_register_hook_fires_in_paddle_grad():
+    x = paddle.to_tensor([4.0], stop_gradient=False)
+    y = x * x
+    y.register_hook(lambda g: g * 3)
+    (g,) = paddle.grad([paddle.sum(y * 1.0)], [x])
+    np.testing.assert_allclose(g.numpy(), [24.0])  # 2x * 3
+
+
+def test_register_hook_stop_gradient_raises():
+    x = paddle.to_tensor([1.0])  # stop_gradient=True
+    with pytest.raises(RuntimeError):
+        x.register_hook(lambda g: g)
+
+
+def test_register_hook_shape_change_rejected():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    x.register_hook(lambda g: paddle.to_tensor([1.0]))
+    with pytest.raises(ValueError):
+        paddle.sum(x).backward()
+
+
+def test_param_hook_fires_once_across_multiple_layer_calls():
+    # the same layer called twice: the param hook must see the SUMMED grad
+    # once (sink keyed by (layer, attr), not by the per-call ParamRef id)
+    calls = []
+    lin = nn.Linear(2, 2)
+    refs = dict(lin.named_parameters())
+
+    def hook(g):
+        calls.append(np.asarray(g.numpy()))
+        return g * 0.5
+
+    refs["weight"].register_hook(hook)
+    x = paddle.to_tensor([[1.0, 2.0]])
+    loss = paddle.sum(lin(x)) + paddle.sum(lin(x))
+    loss.backward()
+    assert len(calls) == 1
+    got = np.asarray(dict(lin.named_parameters())["weight"].grad)
+    np.testing.assert_allclose(got, calls[0] * 0.5, rtol=1e-6)

@@ -1,0 +1,225 @@
+"""Parity tests for the deferred-BN fused conv units (VERDICT r4 #2).
+
+Every unit in nn/fused_conv_bn.py must be numerically identical (f32, CPU)
+to the unfused composition it replaces — values AND gradients, with the
+closed-form BN backward checked against plain autodiff through the
+mean/var chains. Then the block-level fast path in vision/models/resnet.py
+is checked against the plain forward: same outputs, same param grads, same
+running-stat updates."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.nn import fused_conv_bn as FCB
+
+
+def ref_bn_relu(u, gamma, beta, eps, act="relu"):
+    """Plain-autodiff BN(train) + activation — the unfused reference."""
+    ax = tuple(range(u.ndim - 1))
+    mean = u.mean(axis=ax)
+    var = u.var(axis=ax)
+    xhat = (u - mean) / jnp.sqrt(var + eps)
+    a = xhat * gamma + beta
+    return jnp.maximum(a, 0) if act == "relu" else a
+
+
+def ref_conv(a, w, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
+             groups=1):
+    dn = jax.lax.conv_dimension_numbers(a.shape, w.shape,
+                                        ("NHWC", "OIHW", "NHWC"))
+    return jax.lax.conv_general_dilated(
+        a, w, stride, [(padding[0], padding[0]), (padding[1], padding[1])],
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+
+
+def rand(*shape, key):
+    return jnp.asarray(np.random.default_rng(key).standard_normal(shape),
+                       jnp.float32)
+
+
+class TestUnits:
+    def test_conv_stats_values_and_grads(self):
+        x, w = rand(2, 8, 8, 6, key=0), rand(10, 6, 3, 3, key=1)
+        o, s, ss = FCB.conv_stats(x, w, (1, 1), (1, 1))
+        o_ref = ref_conv(x, w, (1, 1), (1, 1))
+        np.testing.assert_allclose(o, o_ref, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(s, o_ref.sum((0, 1, 2)), rtol=1e-4)
+        np.testing.assert_allclose(ss, (o_ref ** 2).sum((0, 1, 2)),
+                                   rtol=1e-4)
+        cot = rand(*o.shape, key=2)
+        g = jax.grad(lambda x, w: jnp.sum(
+            FCB.conv_stats(x, w, (1, 1), (1, 1))[0] * cot), argnums=(0, 1))
+        gr = jax.grad(lambda x, w: jnp.sum(
+            ref_conv(x, w, (1, 1), (1, 1)) * cot), argnums=(0, 1))
+        for a, b in zip(g(x, w), gr(x, w)):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_conv_stats_1x1_stride2_matches_general(self):
+        # the 1x1 fast path (slice + matmul) vs lax.conv with stride
+        x, w = rand(2, 8, 8, 6, key=3), rand(10, 6, 1, 1, key=4)
+        o, _, _ = FCB.conv_stats(x, w, (2, 2), (0, 0))
+        np.testing.assert_allclose(o, ref_conv(x, w, (2, 2)), rtol=1e-5,
+                                   atol=1e-5)
+        cot = rand(*o.shape, key=5)
+        g = jax.grad(lambda x, w: jnp.sum(
+            FCB.conv_stats(x, w, (2, 2), (0, 0))[0] * cot), argnums=(0, 1))
+        gr = jax.grad(lambda x, w: jnp.sum(
+            ref_conv(x, w, (2, 2)) * cot), argnums=(0, 1))
+        for a, b in zip(g(x, w), gr(x, w)):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("act", ["relu", "none"])
+    @pytest.mark.parametrize("conv_cfg", [
+        dict(k=1, stride=(1, 1), padding=(0, 0), groups=1),
+        dict(k=3, stride=(2, 2), padding=(1, 1), groups=1),
+        dict(k=3, stride=(1, 1), padding=(1, 1), groups=2),
+    ])
+    def test_conv_bn_act_matches_unfused(self, act, conv_cfg):
+        """The workhorse: closed-form BN grads through the prologue must
+        equal plain autodiff through mean/var (the defining property of
+        the phi batch_norm_grad closed form)."""
+        k, stride, padding, groups = (conv_cfg["k"], conv_cfg["stride"],
+                                      conv_cfg["padding"],
+                                      conv_cfg["groups"])
+        cin, cout, eps = 6, 8, 1e-5
+        u = rand(2, 8, 8, cin, key=6)
+        gamma, beta = rand(cin, key=7) * 0.2 + 1.0, rand(cin, key=8) * 0.2
+        w = rand(cout, cin // groups, k, k, key=9)
+        s, ss = FCB.channel_stats(u)
+
+        def fused(u, gamma, beta, w):
+            o, _, _ = FCB.conv_bn_act(u, gamma, beta, s, ss, w, eps, act,
+                                      stride, padding, (1, 1), groups)
+            return o
+
+        def unfused(u, gamma, beta, w):
+            return ref_conv(ref_bn_relu(u, gamma, beta, eps, act), w,
+                            stride, padding, (1, 1), groups)
+
+        o_f, o_r = fused(u, gamma, beta, w), unfused(u, gamma, beta, w)
+        np.testing.assert_allclose(o_f, o_r, rtol=1e-4, atol=1e-5)
+        cot = rand(*o_f.shape, key=10)
+        g = jax.grad(lambda *a: jnp.sum(fused(*a) * cot), argnums=(0, 1, 2, 3))
+        gr = jax.grad(lambda *a: jnp.sum(unfused(*a) * cot),
+                      argnums=(0, 1, 2, 3))
+        for a, b in zip(g(u, gamma, beta, w), gr(u, gamma, beta, w)):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-4)
+
+    def test_bn_act_from_stats_grads(self):
+        u = rand(2, 4, 4, 6, key=11)
+        gamma, beta = rand(6, key=12) * 0.3 + 1.0, rand(6, key=13)
+        s, ss = FCB.channel_stats(u)
+        cot = rand(*u.shape[:-1], 6, key=14)
+        g = jax.grad(lambda u, g_, b: jnp.sum(FCB.bn_act_from_stats(
+            u, g_, b, s, ss, 1e-5, "relu") * cot), argnums=(0, 1, 2))
+        gr = jax.grad(lambda u, g_, b: jnp.sum(
+            ref_bn_relu(u, g_, b, 1e-5) * cot), argnums=(0, 1, 2))
+        for a, b in zip(g(u, gamma, beta), gr(u, gamma, beta)):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_bn_add_act_grads(self):
+        u = rand(2, 4, 4, 6, key=15)
+        res = rand(2, 4, 4, 6, key=16)
+        gamma, beta = rand(6, key=17) * 0.3 + 1.0, rand(6, key=18)
+        s, ss = FCB.channel_stats(u)
+        cot = rand(*u.shape, key=19)
+
+        def fused(u, g_, b, r):
+            return jnp.sum(FCB.bn_add_act(u, g_, b, s, ss, r, 1e-5) * cot)
+
+        def unfused(u, g_, b, r):
+            return jnp.sum(jnp.maximum(
+                ref_bn_relu(u, g_, b, 1e-5, act="none") + r, 0) * cot)
+
+        np.testing.assert_allclose(fused(u, gamma, beta, res),
+                                   unfused(u, gamma, beta, res), rtol=1e-4)
+        g = jax.grad(fused, argnums=(0, 1, 2, 3))(u, gamma, beta, res)
+        gr = jax.grad(unfused, argnums=(0, 1, 2, 3))(u, gamma, beta, res)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+class TestBlockParity:
+    """Flag on vs off over the real model blocks: identical training
+    semantics (outputs, parameter grads, running-stat buffer updates)."""
+
+    def _run_block(self, model, x, fused: bool):
+        from paddle_tpu.framework.functional import (functional_call,
+                                                     get_buffers, get_params)
+        prev = _flags.flag("fused_conv_bn")
+        _flags.set_flags({"fused_conv_bn": 1 if fused else 0})
+        try:
+            params = get_params(model)
+            buffers = get_buffers(model)
+
+            def loss_fn(p, x):
+                out, new_buf = functional_call(model, p, x, buffers=buffers,
+                                               mutable=True, training=True)
+                return jnp.sum(out * out), (out, new_buf)
+
+            (loss, (out, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, x)
+            return out, grads, new_buf
+        finally:
+            _flags.set_flags({"fused_conv_bn": prev})
+
+    @pytest.mark.parametrize("depth,stride", [(18, 1), (50, 1), (50, 2)])
+    def test_block_fused_vs_plain(self, depth, stride):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models.resnet import (BasicBlock,
+                                                     BottleneckBlock)
+        paddle.seed(0)
+        cls = BasicBlock if depth == 18 else BottleneckBlock
+        planes = 4
+        inplanes = planes * cls.expansion
+        downsample = None
+        if stride != 1:
+            from paddle_tpu import nn
+            downsample = nn.Sequential(
+                nn.Conv2D(inplanes, planes * cls.expansion, 1, stride=stride,
+                          bias_attr=False, data_format="NHWC"),
+                nn.BatchNorm2D(planes * cls.expansion, data_format="NHWC"),
+            )
+        block = cls(inplanes, planes, stride=stride, downsample=downsample,
+                    data_format="NHWC")
+        block.train()
+        x = rand(2, 8, 8, inplanes, key=20)
+        out_f, g_f, buf_f = self._run_block(block, x, fused=True)
+        out_p, g_p, buf_p = self._run_block(block, x, fused=False)
+        np.testing.assert_allclose(out_f, out_p, rtol=1e-4, atol=1e-4)
+        for k in g_p:
+            np.testing.assert_allclose(g_f[k], g_p[k], rtol=2e-3,
+                                       atol=1e-3, err_msg=k)
+        for k in buf_p:
+            np.testing.assert_allclose(buf_f[k], buf_p[k], rtol=1e-4,
+                                       atol=1e-5, err_msg=k)
+
+    def test_resnet18_model_fused_vs_plain(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models import resnet18
+        paddle.seed(0)
+        model = resnet18(num_classes=7, data_format="NHWC")
+        model.train()
+        x = rand(2, 32, 32, 3, key=21)
+        out_f, g_f, buf_f = TestBlockParity._run_block(self, model, x, True)
+        out_p, g_p, buf_p = TestBlockParity._run_block(self, model, x, False)
+        np.testing.assert_allclose(out_f, out_p, rtol=2e-3, atol=2e-3)
+        for k in buf_p:
+            np.testing.assert_allclose(buf_f[k], buf_p[k], rtol=1e-3,
+                                       atol=1e-4, err_msg=k)
+
+    def test_eval_mode_uses_plain_path(self):
+        """Fused path is training-only; eval must route through running
+        stats exactly as before."""
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models.resnet import BottleneckBlock
+        paddle.seed(0)
+        block = BottleneckBlock(16, 4, data_format="NHWC")
+        block.eval()
+        x = rand(2, 8, 8, 16, key=22)
+        out = block(x)
+        assert out.shape == (2, 8, 8, 16)

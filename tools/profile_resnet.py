@@ -1,0 +1,85 @@
+"""Profile the ResNet-50 train step by HLO category, fused vs plain path.
+
+Usage: python tools/profile_resnet.py [fused|plain] [top_n]
+"""
+import functools
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import paddle_tpu as paddle
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.framework.functional import (functional_call, get_buffers,
+                                             get_params)
+from paddle_tpu.nn import functional as F
+from paddle_tpu.nn import fused_conv_bn  # ensure flag defined
+from paddle_tpu.optimizer import Momentum
+from paddle_tpu.vision.models import resnet50
+from paddle_tpu.profiler.statistic import device_statistics
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "fused"
+top_n = int(sys.argv[2]) if len(sys.argv) > 2 else 18
+_flags.set_flags({"fused_conv_bn": 1 if mode == "fused" else 0})
+
+batch, img, steps = 256, 224, 6
+paddle.seed(0)
+model = resnet50(data_format="NHWC")
+model.train()
+model.astype(paddle.bfloat16)
+opt = Momentum(learning_rate=0.1, momentum=0.9, multi_precision=True)
+params = get_params(model)
+buffers = get_buffers(model)
+opt_state = opt.init(params)
+
+
+def loss_of(p, buf, x, y):
+    out, new_buf = functional_call(model, p, x, buffers=buf, mutable=True,
+                                   training=True)
+    return F.cross_entropy(out.astype(jnp.float32), y,
+                           reduction="mean"), new_buf
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def step(state, x, y):
+    p, buf, st = state
+    (loss, new_buf), grads = jax.value_and_grad(
+        loss_of, has_aux=True)(p, buf, x, y)
+    new_p, new_st = opt.apply_gradients(p, grads, st, 0.1)
+    return loss, (new_p, new_buf, new_st)
+
+
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.standard_normal((batch, img, img, 3)), jnp.bfloat16)
+y = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+state = (params, buffers, opt_state)
+losses = []
+loss, state = step(state, x, y)
+losses.append(float(loss))
+for _ in range(3):
+    loss, state = step(state, x, y)
+    losses.append(float(loss))
+
+tracedir = tempfile.mkdtemp(prefix="rn_profile_")
+with jax.profiler.trace(tracedir):
+    for _ in range(steps):
+        loss, state = step(state, x, y)
+    float(loss)
+st = device_statistics(tracedir, top=top_n)
+shutil.rmtree(tracedir, ignore_errors=True)
+by_cat, top = st
+total = sum(by_cat.values())
+print(f"mode={mode}  device total {total/steps:.2f} ms/step   "
+      f"losses={['%.4f' % l for l in losses]}")
+for cat, ms in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+    print(f"  {cat:28s} {ms/steps:8.3f} ms/step")
+print("top ops:")
+for o in top:
+    print(f"  {o['ms']/steps:8.3f} ms  x{o['occurrences']}  "
+          f"[{o['category']}] {o['bound_by']:8s} {o['op'][:95]}")
