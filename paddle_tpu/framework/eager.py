@@ -698,16 +698,23 @@ def backward_multi(tensors, seeds=None, retain_graph: bool = False):
     seeds = seeds or [None] * len(tensors)
     nodes: Dict[int, _Node] = {}
     acc: Dict[int, jax.Array] = {}
+    leaf_sink: Dict[int, Tuple] = {}
     for t, s in zip(tensors, seeds):
         seed = jnp.ones_like(t._value) if s is None else to_tensor_value(s)
         if t._node is None:
+            # a node-less root may STILL feed the graph (leaf passed as a
+            # root alongside a loss that consumes it): stage the seed so
+            # the hook fires once on seed + consumer contributions, not
+            # once per source (ref: GradNodeAccumulation fires a single
+            # hook on the summed grad).
             if not t.stop_gradient:
-                t._accumulate_grad(_apply_hooks(t, seed))
+                ent = leaf_sink.get(id(t))
+                leaf_sink[id(t)] = \
+                    (t, seed if ent is None else ent[1] + seed)
             continue
         nodes.update(_collect_nodes(t._node))
         prev = acc.get(id(t))
         acc[id(t)] = seed if prev is None else prev + seed
-    leaf_sink: Dict[int, Tuple] = {}
     for node in sorted(nodes.values(), key=lambda n: -n.counter):
         node.run_backward(acc, nodes, leaf_sink)
     _finalize_leaf_sink(leaf_sink)

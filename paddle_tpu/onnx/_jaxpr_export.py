@@ -1,0 +1,705 @@
+"""jaxpr -> ONNX converter: the real-protobuf export path.
+
+The reference's ``python/paddle/onnx/export.py`` hands an inference program
+to paddle2onnx, which pattern-matches framework ops into ONNX nodes. The
+TPU-native pipeline has a better IR to start from: any inference callable
+traces to a jaxpr of ~40 first-order lax primitives, each of which has a
+direct ONNX opset-13 mapping — so one generic converter covers every
+Linear/Conv/BN/pool/activation/attention/reshape model in the library
+without per-layer export rules.
+
+Two passes:
+  1. constant folding — every eqn whose inputs are all input-independent
+     (params, iotas, causal masks, position tables...) is evaluated
+     eagerly and becomes a single initializer;
+  2. primitive mapping — the remaining input-dependent eqns emit ONNX
+     nodes (higher-order prims pjit/custom_vjp/remat are inlined first).
+
+bfloat16 is widened to float32 by default (numerics preserved; most ONNX
+runtimes reject BFLOAT16 tensors).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["JaxprToOnnx", "UnsupportedOnnxExport"]
+
+
+class UnsupportedOnnxExport(NotImplementedError):
+    pass
+
+
+def _pb():
+    from . import onnx_subset_pb2 as P
+    return P
+
+
+# jax dtype name -> ONNX TensorProto.DataType
+_DTYPES = {
+    "float32": 1, "uint8": 2, "int8": 3, "uint16": 4, "int16": 5,
+    "int32": 6, "int64": 7, "bool": 9, "float16": 10, "float64": 11,
+    "uint32": 12, "uint64": 13, "bfloat16": 16,
+}
+
+# prim -> ONNX op for trivial 1:1 elementwise cases
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow", "rem": "Mod",
+    "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
+    "sqrt": "Sqrt", "erf": "Erf", "logistic": "Sigmoid", "abs": "Abs",
+    "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "round": "Round",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "asin": "Asin",
+    "acos": "Acos", "atan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "not": "Not", "and": "And", "or": "Or", "xor": "Xor",
+    "eq": "Equal", "lt": "Less", "le": "LessOrEqual", "gt": "Greater",
+    "ge": "GreaterOrEqual",
+    "stop_gradient": "Identity", "copy": "Identity",
+}
+
+_INLINE_PRIMS = {"pjit", "jit", "closed_call", "custom_jvp_call",
+                 "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                 "checkpoint", "remat2", "custom_jvp_call_jaxpr"}
+
+# folding never materializes an initializer bigger than this many elements
+_FOLD_LIMIT = 1 << 24
+
+
+class JaxprToOnnx:
+    """Converts one ClosedJaxpr to a ModelProto."""
+
+    def __init__(self, closed_jaxpr, *, graph_name="paddle_tpu",
+                 widen_bf16=True, opset=13):
+        self.jaxpr = closed_jaxpr.jaxpr
+        self.consts = closed_jaxpr.consts
+        self.widen_bf16 = widen_bf16
+        self.opset = opset
+        self.graph_name = graph_name
+        P = _pb()
+        self.graph = P.GraphProto(name=graph_name)
+        self.names = {}          # jax Var id -> onnx name
+        self.known = {}          # jax Var id -> np.ndarray (foldable value)
+        self.emitted_init = set()
+        self.counter = 0
+
+    # -- naming ------------------------------------------------------------
+    def fresh(self, hint="t"):
+        self.counter += 1
+        return f"{hint}_{self.counter}"
+
+    def name_of(self, var):
+        from jax._src.core import Literal
+        if isinstance(var, Literal):
+            return self.add_initializer(np.asarray(var.val),
+                                        self.fresh("lit"))
+        vid = id(var)
+        if vid in self.names:
+            return self.names[vid]
+        if vid in self.known:
+            n = self.add_initializer(self.known[vid], self.fresh("const"))
+            self.names[vid] = n
+            return n
+        raise KeyError(f"untracked var {var}")
+
+    # -- proto builders ----------------------------------------------------
+    def onnx_dtype(self, dt) -> int:
+        name = np.dtype(dt).name if not str(dt) == "bfloat16" else "bfloat16"
+        name = str(dt) if str(dt) in _DTYPES else name
+        if name == "bfloat16" and self.widen_bf16:
+            name = "float32"
+        if name not in _DTYPES:
+            raise UnsupportedOnnxExport(f"dtype {dt} has no ONNX mapping")
+        return _DTYPES[name]
+
+    def _np_for_export(self, arr) -> np.ndarray:
+        if str(arr.dtype) == "bfloat16":
+            if not self.widen_bf16:
+                raise UnsupportedOnnxExport(
+                    "bfloat16 initializers need widen_bf16=True")
+            arr = np.asarray(arr, np.float32)
+        return np.ascontiguousarray(np.asarray(arr))
+
+    def add_initializer(self, arr, name=None) -> str:
+        arr = self._np_for_export(np.asarray(arr))
+        name = name or self.fresh("init")
+        t = self.graph.initializer.add()
+        t.name = name
+        t.dims.extend(arr.shape)
+        t.data_type = _DTYPES[arr.dtype.name]
+        t.raw_data = arr.tobytes()
+        return name
+
+    def _i64(self, values, hint) -> str:
+        return self.add_initializer(np.asarray(values, np.int64),
+                                    self.fresh(hint))
+
+    def node(self, op, inputs, n_out=1, name=None, **attrs):
+        P = _pb()
+        nd = self.graph.node.add()
+        nd.op_type = op
+        nd.name = name or self.fresh(op.lower())
+        nd.input.extend(inputs)
+        outs = [self.fresh(op.lower() + "_out") for _ in range(n_out)]
+        nd.output.extend(outs)
+        for aname, aval in attrs.items():
+            a = nd.attribute.add()
+            a.name = aname
+            if isinstance(aval, float):
+                a.f = aval
+                a.type = P.AttributeProto.FLOAT
+            elif isinstance(aval, bool) or isinstance(aval, int):
+                a.i = int(aval)
+                a.type = P.AttributeProto.INT
+            elif isinstance(aval, (bytes, str)):
+                a.s = aval.encode() if isinstance(aval, str) else aval
+                a.type = P.AttributeProto.STRING
+            elif isinstance(aval, (list, tuple)) and all(
+                    isinstance(v, (int, np.integer)) for v in aval):
+                a.ints.extend(int(v) for v in aval)
+                a.type = P.AttributeProto.INTS
+            elif isinstance(aval, (list, tuple)):
+                a.floats.extend(float(v) for v in aval)
+                a.type = P.AttributeProto.FLOATS
+            else:
+                raise TypeError(f"attr {aname}={aval!r}")
+        return outs if n_out != 1 else outs[0]
+
+    def value_info(self, coll, name, aval):
+        vi = coll.add()
+        vi.name = name
+        tt = vi.type.tensor_type
+        tt.elem_type = self.onnx_dtype(aval.dtype)
+        for d in aval.shape:
+            tt.shape.dim.add().dim_value = int(d)
+
+    # -- driver ------------------------------------------------------------
+    def convert(self, input_names=None, output_names=None):
+        P = _pb()
+        for var, val in zip(self.jaxpr.constvars, self.consts):
+            self.known[id(var)] = val
+        input_names = input_names or [
+            f"input_{i}" for i in range(len(self.jaxpr.invars))]
+        for var, nm in zip(self.jaxpr.invars, input_names):
+            self.names[id(var)] = nm
+            self.value_info(self.graph.input, nm, var.aval)
+        self._convert_eqns(self.jaxpr.eqns)
+        output_names = output_names or [
+            f"output_{i}" for i in range(len(self.jaxpr.outvars))]
+        for var, nm in zip(self.jaxpr.outvars, output_names):
+            src = self.name_of(var)
+            # outputs must be node outputs with the declared name
+            self.node("Identity", [src], name=self.fresh("out_id"))
+            self.graph.node[-1].output[0] = nm
+            self.value_info(self.graph.output, nm, var.aval)
+        model = P.ModelProto()
+        model.ir_version = 8
+        model.producer_name = "paddle_tpu"
+        model.producer_version = "0"
+        model.graph.CopyFrom(self.graph)
+        ops = model.opset_import.add()
+        ops.domain = ""
+        ops.version = self.opset
+        return model
+
+    def _convert_eqns(self, eqns):
+        for eqn in eqns:
+            prim = eqn.primitive.name
+            if prim in _INLINE_PRIMS:
+                self._inline(eqn)
+                continue
+            if self._try_fold(eqn):
+                continue
+            handler = getattr(self, f"_op_{prim}", None)
+            if handler is None and prim in _SIMPLE:
+                handler = self._op_simple
+            if handler is None:
+                raise UnsupportedOnnxExport(
+                    f"primitive '{prim}' has no ONNX mapping (inference "
+                    f"subset exporter); eqn: {eqn}")
+            handler(eqn)
+
+    def _inline(self, eqn):
+        import jax
+        params = eqn.params
+        inner = params.get("jaxpr") or params.get("call_jaxpr") \
+            or params.get("fun_jaxpr")
+        if inner is None:
+            raise UnsupportedOnnxExport(
+                f"can't inline {eqn.primitive.name}: no inner jaxpr")
+        if isinstance(inner, jax._src.core.Jaxpr):
+            inner = jax._src.core.ClosedJaxpr(inner, ())
+        sub_jaxpr = inner.jaxpr
+        # bind consts + outer names into the inner vars
+        for var, val in zip(sub_jaxpr.constvars, inner.consts):
+            self.known[id(var)] = val
+        for var, outer in zip(sub_jaxpr.invars, eqn.invars):
+            self._alias(var, outer)
+        self._convert_eqns(sub_jaxpr.eqns)
+        for outer, inner_v in zip(eqn.outvars, sub_jaxpr.outvars):
+            self._alias_back(outer, inner_v)
+
+    def _alias(self, inner_var, outer_atom):
+        from jax._src.core import Literal
+        if isinstance(outer_atom, Literal):
+            self.known[id(inner_var)] = np.asarray(outer_atom.val)
+            return
+        oid = id(outer_atom)
+        if oid in self.known:
+            self.known[id(inner_var)] = self.known[oid]
+        else:
+            self.names[id(inner_var)] = self.name_of(outer_atom)
+
+    def _alias_back(self, outer_var, inner_atom):
+        from jax._src.core import Literal
+        if isinstance(inner_atom, Literal):
+            self.known[id(outer_var)] = np.asarray(inner_atom.val)
+            return
+        iid = id(inner_atom)
+        if iid in self.known:
+            self.known[id(outer_var)] = self.known[iid]
+        else:
+            self.names[id(outer_var)] = self.name_of(inner_atom)
+
+    def _try_fold(self, eqn) -> bool:
+        from jax._src.core import Literal
+        vals = []
+        for v in eqn.invars:
+            if isinstance(v, Literal):
+                vals.append(v.val)
+            elif id(v) in self.known:
+                vals.append(self.known[id(v)])
+            else:
+                return False
+        if any(int(np.prod(ov.aval.shape)) > _FOLD_LIMIT
+               for ov in eqn.outvars):
+            return False
+        import jax
+        with jax.default_device(jax.devices("cpu")[0]):
+            out = eqn.primitive.bind(
+                *[np.asarray(v) if not hasattr(v, "dtype") else v
+                  for v in vals], **eqn.params)
+        outs = out if eqn.primitive.multiple_results else [out]
+        for var, val in zip(eqn.outvars, outs):
+            self.known[id(var)] = np.asarray(val)
+        return True
+
+    # -- handlers ----------------------------------------------------------
+    def _set(self, var, name):
+        self.names[id(var)] = name
+
+    def _ins(self, eqn):
+        return [self.name_of(v) for v in eqn.invars]
+
+    def _op_simple(self, eqn):
+        op = _SIMPLE[eqn.primitive.name]
+        self._set(eqn.outvars[0], self.node(op, self._ins(eqn)))
+
+    def _op_ne(self, eqn):
+        e = self.node("Equal", self._ins(eqn))
+        self._set(eqn.outvars[0], self.node("Not", [e]))
+
+    def _op_name(self, eqn):
+        # jax.ad_checkpoint.checkpoint_name — remat metadata, a no-op here
+        self._alias(eqn.outvars[0], eqn.invars[0])
+
+    def _op_erfc(self, eqn):
+        one = self.add_initializer(
+            np.asarray(1, eqn.invars[0].aval.dtype))
+        e = self.node("Erf", self._ins(eqn))
+        self._set(eqn.outvars[0], self.node("Sub", [one, e]))
+
+    def _op_rsqrt(self, eqn):
+        s = self.node("Sqrt", self._ins(eqn))
+        self._set(eqn.outvars[0], self.node("Reciprocal", [s]))
+
+    def _op_log1p(self, eqn):
+        one = self.add_initializer(
+            np.asarray(1, eqn.invars[0].aval.dtype))
+        a = self.node("Add", [self._ins(eqn)[0], one])
+        self._set(eqn.outvars[0], self.node("Log", [a]))
+
+    def _op_expm1(self, eqn):
+        one = self.add_initializer(
+            np.asarray(1, eqn.invars[0].aval.dtype))
+        e = self.node("Exp", self._ins(eqn))
+        self._set(eqn.outvars[0], self.node("Sub", [e, one]))
+
+    def _op_integer_pow(self, eqn):
+        y = eqn.params["y"]
+        x = self._ins(eqn)[0]
+        if y == 2:
+            self._set(eqn.outvars[0], self.node("Mul", [x, x]))
+            return
+        p = self.add_initializer(
+            np.asarray(y, eqn.invars[0].aval.dtype))
+        self._set(eqn.outvars[0], self.node("Pow", [x, p]))
+
+    def _op_exp2(self, eqn):
+        two = self.add_initializer(
+            np.asarray(2, eqn.invars[0].aval.dtype))
+        self._set(eqn.outvars[0], self.node("Pow",
+                                            [two, self._ins(eqn)[0]]))
+
+    def _op_select_n(self, eqn):
+        pred, *cases = eqn.invars
+        if len(cases) != 2 or str(pred.aval.dtype) != "bool":
+            raise UnsupportedOnnxExport("select_n beyond bool 2-case")
+        self._set(eqn.outvars[0], self.node(
+            "Where", [self.name_of(pred), self.name_of(cases[1]),
+                      self.name_of(cases[0])]))
+
+    def _op_convert_element_type(self, eqn):
+        to = self.onnx_dtype(eqn.params["new_dtype"])
+        self._set(eqn.outvars[0],
+                  self.node("Cast", self._ins(eqn), to=to))
+
+    def _op_reshape(self, eqn):
+        if eqn.params.get("dimensions") is not None:
+            perm = list(eqn.params["dimensions"])
+            t = self.node("Transpose", self._ins(eqn), perm=perm)
+        else:
+            t = self._ins(eqn)[0]
+        shape = self._i64(eqn.outvars[0].aval.shape, "shape")
+        self._set(eqn.outvars[0], self.node("Reshape", [t, shape]))
+
+    def _op_transpose(self, eqn):
+        self._set(eqn.outvars[0], self.node(
+            "Transpose", self._ins(eqn),
+            perm=list(eqn.params["permutation"])))
+
+    def _op_broadcast_in_dim(self, eqn):
+        shape = eqn.params["shape"]
+        bdims = eqn.params["broadcast_dimensions"]
+        in_aval = eqn.invars[0].aval
+        x = self._ins(eqn)[0]
+        # step 1: reshape so rank matches (size-1 slots elsewhere)
+        mid = [1] * len(shape)
+        for src, dst in enumerate(bdims):
+            mid[dst] = in_aval.shape[src]
+        if tuple(mid) != tuple(in_aval.shape):
+            x = self.node("Reshape", [x, self._i64(mid, "shape")])
+        if tuple(mid) != tuple(shape):
+            x = self.node("Expand", [x, self._i64(shape, "shape")])
+        self._set(eqn.outvars[0], x)
+
+    def _op_squeeze(self, eqn):
+        shape = self._i64(eqn.outvars[0].aval.shape, "shape")
+        self._set(eqn.outvars[0], self.node(
+            "Reshape", [self._ins(eqn)[0], shape]))
+
+    def _op_expand_dims(self, eqn):
+        shape = self._i64(eqn.outvars[0].aval.shape, "shape")
+        self._set(eqn.outvars[0], self.node(
+            "Reshape", [self._ins(eqn)[0], shape]))
+
+    def _op_concatenate(self, eqn):
+        self._set(eqn.outvars[0], self.node(
+            "Concat", self._ins(eqn), axis=eqn.params["dimension"]))
+
+    def _op_slice(self, eqn):
+        starts = list(eqn.params["start_indices"])
+        ends = list(eqn.params["limit_indices"])
+        strides = eqn.params.get("strides")
+        steps = list(strides) if strides else [1] * len(starts)
+        axes = list(range(len(starts)))
+        self._set(eqn.outvars[0], self.node(
+            "Slice", [self._ins(eqn)[0], self._i64(starts, "starts"),
+                      self._i64(ends, "ends"), self._i64(axes, "axes"),
+                      self._i64(steps, "steps")]))
+
+    def _op_rev(self, eqn):
+        dims = list(eqn.params["dimensions"])
+        shape = eqn.invars[0].aval.shape
+        starts = [shape[d] - 1 for d in dims]
+        ends = [-(shape[d] + 1) for d in dims]
+        steps = [-1] * len(dims)
+        self._set(eqn.outvars[0], self.node(
+            "Slice", [self._ins(eqn)[0], self._i64(starts, "starts"),
+                      self._i64(ends, "ends"), self._i64(dims, "axes"),
+                      self._i64(steps, "steps")]))
+
+    def _op_pad(self, eqn):
+        cfg = eqn.params["padding_config"]
+        if any(i != 0 for _, _, i in cfg):
+            raise UnsupportedOnnxExport("interior (dilated) pad")
+        x, pval = self._ins(eqn)
+        los = [lo for lo, _, _ in cfg]
+        his = [hi for _, hi, _ in cfg]
+        if any(v < 0 for v in los + his):
+            raise UnsupportedOnnxExport("negative pad (crop)")
+        pads = self._i64(los + his, "pads")
+        self._set(eqn.outvars[0], self.node("Pad", [x, pads, pval]))
+
+    def _op_reduce_sum(self, eqn):
+        axes = self._i64(eqn.params["axes"], "axes")
+        self._set(eqn.outvars[0], self.node(
+            "ReduceSum", [self._ins(eqn)[0], axes], keepdims=0))
+
+    def _reduce_attr(self, eqn, op):
+        self._set(eqn.outvars[0], self.node(
+            op, self._ins(eqn), axes=list(eqn.params["axes"]), keepdims=0))
+
+    def _op_reduce_max(self, eqn):
+        self._reduce_attr(eqn, "ReduceMax")
+
+    def _op_reduce_min(self, eqn):
+        self._reduce_attr(eqn, "ReduceMin")
+
+    def _op_reduce_prod(self, eqn):
+        self._reduce_attr(eqn, "ReduceProd")
+
+    def _op_reduce_and(self, eqn):
+        c = self.node("Cast", self._ins(eqn), to=6)
+        r = self.node("ReduceMin", [c], axes=list(eqn.params["axes"]),
+                      keepdims=0)
+        self._set(eqn.outvars[0], self.node("Cast", [r], to=9))
+
+    def _op_reduce_or(self, eqn):
+        c = self.node("Cast", self._ins(eqn), to=6)
+        r = self.node("ReduceMax", [c], axes=list(eqn.params["axes"]),
+                      keepdims=0)
+        self._set(eqn.outvars[0], self.node("Cast", [r], to=9))
+
+    def _op_argmax(self, eqn):
+        self._arg(eqn, "ArgMax")
+
+    def _op_argmin(self, eqn):
+        self._arg(eqn, "ArgMin")
+
+    def _arg(self, eqn, op):
+        axes = eqn.params["axes"]
+        if len(axes) != 1:
+            raise UnsupportedOnnxExport(f"{op} over multiple axes")
+        r = self.node(op, self._ins(eqn), axis=int(axes[0]), keepdims=0)
+        want = self.onnx_dtype(eqn.outvars[0].aval.dtype)
+        self._set(eqn.outvars[0],
+                  self.node("Cast", [r], to=want) if want != 7 else r)
+
+    def _op_clamp(self, eqn):
+        lo, x, hi = self._ins(eqn)
+        self._set(eqn.outvars[0], self.node("Clip", [x, lo, hi]))
+
+    def _op_cumsum(self, eqn):
+        ax = self.add_initializer(
+            np.asarray(eqn.params["axis"], np.int64))
+        self._set(eqn.outvars[0], self.node(
+            "CumSum", [self._ins(eqn)[0], ax],
+            reverse=int(bool(eqn.params.get("reverse")))))
+
+    def _op_iota(self, eqn):  # pragma: no cover - normally folded
+        dt = eqn.params["dtype"]
+        dim = eqn.params["dimension"]
+        shape = eqn.params["shape"]
+        rng = np.arange(shape[dim], dtype=dt)
+        full = np.broadcast_to(
+            rng.reshape([-1 if i == dim else 1
+                         for i in range(len(shape))]), shape)
+        self._set(eqn.outvars[0], self.add_initializer(full))
+
+    def _op_dot_general(self, eqn):
+        ((lc, rc), (lb, rb)) = eqn.params["dimension_numbers"]
+        la = eqn.invars[0].aval
+        ra = eqn.invars[1].aval
+        lhs, rhs = self._ins(eqn)
+        # plain batched matmul? [..B.., m, k] @ [..B.., k, n]
+        lrank, rrank = len(la.shape), len(ra.shape)
+        plain = (list(lb) == list(range(lrank - 2))
+                 and list(rb) == list(range(rrank - 2))
+                 and lrank == rrank
+                 and list(lc) == [lrank - 1] and list(rc) == [rrank - 2])
+        if plain:
+            self._set(eqn.outvars[0], self.node("MatMul", [lhs, rhs]))
+            return
+        # general contraction via Einsum
+        letters = "abcdefghijklmnopqrstuvwxyz"
+        next_l = iter(letters)
+        lhs_l = [None] * lrank
+        rhs_l = [None] * rrank
+        for i, j in zip(lb, rb):
+            c = next(next_l)
+            lhs_l[i] = c
+            rhs_l[j] = c
+        for i, j in zip(lc, rc):
+            c = next(next_l)
+            lhs_l[i] = c
+            rhs_l[j] = c
+        for i in range(lrank):
+            if lhs_l[i] is None:
+                lhs_l[i] = next(next_l)
+        for j in range(rrank):
+            if rhs_l[j] is None:
+                rhs_l[j] = next(next_l)
+        out_l = [lhs_l[i] for i in lb] \
+            + [lhs_l[i] for i in range(lrank) if i not in lb + lc] \
+            + [rhs_l[j] for j in range(rrank) if j not in rb + rc]
+        eqn_s = f"{''.join(lhs_l)},{''.join(rhs_l)}->{''.join(out_l)}"
+        self._set(eqn.outvars[0],
+                  self.node("Einsum", [lhs, rhs], equation=eqn_s))
+
+    def _op_conv_general_dilated(self, eqn):
+        p = eqn.params
+        if p["batch_group_count"] != 1:
+            raise UnsupportedOnnxExport("batch_group_count != 1")
+        if any(d != 1 for d in p["lhs_dilation"]):
+            raise UnsupportedOnnxExport("transposed conv (lhs_dilation)")
+        dn = p["dimension_numbers"]
+        # jax specs hold dimension POSITIONS: lhs_spec = (batch_pos,
+        # feature_pos, *spatial_pos) — so the spec itself IS the transpose
+        # permutation into canonical NCHW/OIHW order.
+        lhs_spec, rhs_spec, out_spec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+        lhs, rhs = self._ins(eqn)
+        nsp = len(lhs_spec) - 2
+        lperm = list(lhs_spec)
+        if lperm != list(range(len(lhs_spec))):
+            lhs = self.node("Transpose", [lhs], perm=lperm)
+        rperm = list(rhs_spec)
+        if rperm != list(range(len(rhs_spec))):
+            rhs = self.node("Transpose", [rhs], perm=rperm)
+        pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
+        out = self.node(
+            "Conv", [lhs, rhs], group=p["feature_group_count"],
+            strides=list(p["window_strides"]),
+            dilations=list(p["rhs_dilation"]), pads=pads)
+        # Conv emits canonical (N, O, *sp); out_spec[k] says where
+        # canonical dim k lives in the result: perm[out_spec[k]] = k.
+        inv = [0] * len(out_spec)
+        for k, pos in enumerate(list(out_spec)):
+            inv[pos] = k
+        if inv != list(range(len(out_spec))):
+            out = self.node("Transpose", [out], perm=inv)
+        self._set(eqn.outvars[0], out)
+
+    def _pool_layout(self, eqn):
+        """(perm to NCHW, spatial positions) for a reduce_window where
+        non-window dims have window 1."""
+        win = eqn.params["window_dimensions"]
+        spatial = [i for i, w in enumerate(win) if w != 1]
+        ones = [i for i, w in enumerate(win) if w == 1]
+        strides = eqn.params["window_strides"]
+        # dims with window 1 AND stride 1 are batch/channel
+        batchish = [i for i in ones if strides[i] == 1]
+        if len(batchish) < len(win) - len(spatial):
+            raise UnsupportedOnnxExport("pooling over strided 1-windows")
+        if not spatial:
+            # all-ones window: Identity
+            return None, None
+        if len(batchish) != 2:
+            raise UnsupportedOnnxExport(
+                f"pooling needs 2 non-window dims, got {len(batchish)}")
+        perm = batchish + spatial
+        return perm, spatial
+
+    def _pool_common(self, eqn, op, extra_attrs):
+        perm, spatial = self._pool_layout(eqn)
+        x = self._ins(eqn)[0]
+        if perm is None:
+            self._set(eqn.outvars[0], self.node("Identity", [x]))
+            return
+        win = eqn.params["window_dimensions"]
+        strides = eqn.params["window_strides"]
+        padding = eqn.params["padding"]
+        if any(d != 1 for d in eqn.params.get(
+                "window_dilation", (1,) * len(win))):
+            raise UnsupportedOnnxExport("window_dilation pooling")
+        if any(d != 1 for d in eqn.params.get(
+                "base_dilation", (1,) * len(win))):
+            raise UnsupportedOnnxExport("base_dilation pooling")
+        if perm != list(range(len(win))):
+            x = self.node("Transpose", [x], perm=perm)
+        kshape = [win[i] for i in spatial]
+        pads = [padding[i][0] for i in spatial] + \
+            [padding[i][1] for i in spatial]
+        out = self.node(op, [x], kernel_shape=kshape,
+                        strides=[strides[i] for i in spatial], pads=pads,
+                        **extra_attrs)
+        inv = [0] * len(perm)
+        for pos, src in enumerate(perm):
+            inv[src] = pos
+        if inv != list(range(len(perm))):
+            out = self.node("Transpose", [out], perm=inv)
+        return out
+
+    def _op_reduce_window_max(self, eqn):
+        out = self._pool_common(eqn, "MaxPool", {})
+        if out is not None:
+            self._set(eqn.outvars[0], out)
+
+    def _op_reduce_window_sum(self, eqn):
+        win = eqn.params["window_dimensions"]
+        out = self._pool_common(eqn, "AveragePool",
+                                {"count_include_pad": 1})
+        if out is None:
+            return
+        size = float(int(np.prod([w for w in win if w != 1])))
+        c = self.add_initializer(
+            np.asarray(size, eqn.outvars[0].aval.dtype))
+        self._set(eqn.outvars[0], self.node("Mul", [out, c]))
+
+    def _op_gather(self, eqn):
+        """Embedding-style gathers only: rows of a [V, ...] table selected
+        by integer indices (jnp.take(axis=0) / Embedding lookup)."""
+        p = eqn.params
+        dn = p["dimension_numbers"]
+        operand, indices = eqn.invars
+        oshape = operand.aval.shape
+        islice = p["slice_sizes"]
+        if (tuple(dn.start_index_map) == (0,)
+                and tuple(dn.collapsed_slice_dims) == (0,)
+                and islice[0] == 1
+                and tuple(islice[1:]) == tuple(oshape[1:])
+                and indices.aval.shape[-1] == 1):
+            idx = self.name_of(indices)
+            ishape = indices.aval.shape[:-1]
+            idx = self.node("Reshape",
+                            [idx, self._i64(ishape or (1,), "shape")])
+            out = self.node("Gather", [self.name_of(operand), idx], axis=0)
+            if not ishape:
+                out = self.node(
+                    "Reshape",
+                    [out, self._i64(eqn.outvars[0].aval.shape, "shape")])
+            self._set(eqn.outvars[0], out)
+            return
+        raise UnsupportedOnnxExport(
+            "general gather (only embedding-style axis-0 row gathers "
+            "export)")
+
+    def _op_dynamic_slice(self, eqn):
+        x = eqn.invars[0]
+        sizes = eqn.params["slice_sizes"]
+        starts = eqn.invars[1:]
+        parts = []
+        for s in starts:
+            n = self.name_of(s)
+            n = self.node("Cast", [n], to=7)
+            parts.append(self.node(
+                "Reshape", [n, self._i64([1], "shape")]))
+        st = self.node("Concat", parts, axis=0)
+        en = self.node("Add", [st, self._i64(list(sizes), "sizes")])
+        axes = self._i64(list(range(len(sizes))), "axes")
+        self._set(eqn.outvars[0], self.node(
+            "Slice", [self.name_of(x), st, en, axes]))
+
+    def _op_sort(self, eqn):
+        raise UnsupportedOnnxExport("sort (use top_k for inference)")
+
+    def _op_top_k(self, eqn):
+        k = eqn.params["k"]
+        kk = self._i64([k], "k")
+        vals, idx = self.node("TopK", [self._ins(eqn)[0], kk], n_out=2,
+                              axis=-1, largest=1, sorted=1)
+        self._set(eqn.outvars[0], vals)
+        want = self.onnx_dtype(eqn.outvars[1].aval.dtype)
+        self._set(eqn.outvars[1],
+                  self.node("Cast", [idx], to=want) if want != 7 else idx)
+
+    def _op_device_put(self, eqn):
+        self._set(eqn.outvars[0],
+                  self.node("Identity", self._ins(eqn)))
+
+    def _op_sharding_constraint(self, eqn):
+        self._set(eqn.outvars[0],
+                  self.node("Identity", self._ins(eqn)))
+
+    def _op_square(self, eqn):
+        x = self._ins(eqn)[0]
+        self._set(eqn.outvars[0], self.node("Mul", [x, x]))

@@ -1,0 +1,257 @@
+"""Numpy reference evaluator for the exported ONNX subset.
+
+The environment has no onnxruntime, so round-trip tests execute the
+serialized graph here: initializers are decoded from raw_data, nodes run
+in topological (emission) order with numpy semantics matching ONNX
+opset 13 for exactly the ops the exporter emits. This is a test oracle,
+not a deployment runtime — clarity over speed.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+_NP_DTYPES = {
+    1: np.float32, 2: np.uint8, 3: np.int8, 4: np.uint16, 5: np.int16,
+    6: np.int32, 7: np.int64, 9: np.bool_, 10: np.float16, 11: np.float64,
+    12: np.uint32, 13: np.uint64,
+}
+
+_erf = np.vectorize(math.erf, otypes=[np.float64])
+
+
+def _decode_tensor(t):
+    if t.data_type not in _NP_DTYPES:
+        raise NotImplementedError(f"tensor dtype {t.data_type}")
+    dt = _NP_DTYPES[t.data_type]
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=dt)
+    elif t.float_data:
+        arr = np.asarray(list(t.float_data), dtype=dt)
+    elif t.int64_data:
+        arr = np.asarray(list(t.int64_data), dtype=dt)
+    elif t.int32_data:
+        arr = np.asarray(list(t.int32_data), dtype=dt)
+    else:
+        arr = np.zeros(0, dt)
+    return arr.reshape(tuple(t.dims))
+
+
+def _attrs(node):
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:
+            out[a.name] = a.f
+        elif a.type == 2:
+            out[a.name] = a.i
+        elif a.type == 3:
+            out[a.name] = a.s.decode()
+        elif a.type == 6:
+            out[a.name] = list(a.floats)
+        elif a.type == 7:
+            out[a.name] = list(a.ints)
+        else:
+            raise NotImplementedError(f"attr type {a.type}")
+    return out
+
+
+def _conv(x, w, group, strides, pads, dils):
+    from numpy.lib.stride_tricks import sliding_window_view
+    nsp = x.ndim - 2
+    lo, hi = pads[:nsp], pads[nsp:]
+    x = np.pad(x, [(0, 0), (0, 0)] + list(zip(lo, hi)))
+    ks = list(w.shape[2:])
+    eff = [(k - 1) * d + 1 for k, d in zip(ks, dils)]
+    v = sliding_window_view(x, eff, axis=tuple(range(2, 2 + nsp)))
+    # v: [N, C, *out_sp, *eff]; subsample out spatial by stride, window by
+    # dilation
+    v = v[(slice(None), slice(None))
+          + tuple(slice(None, None, s) for s in strides)]
+    v = v[(Ellipsis,) + tuple(slice(None, None, d) for d in dils)]
+    n = v.shape[0]
+    out_sp = v.shape[2:2 + nsp]
+    g = group
+    o, cg = w.shape[0], w.shape[1]
+    v = v.reshape((n, g, cg) + out_sp + tuple(ks))
+    wg = w.reshape((g, o // g, cg) + tuple(ks))
+    sp = "xyz"[:nsp]
+    eq = f"ngc{''.join('abc'[i] for i in range(nsp))}{sp}," \
+         f"goc{sp}->ngo{''.join('abc'[i] for i in range(nsp))}"
+    out = np.einsum(eq, v.astype(np.float64), wg.astype(np.float64))
+    return out.reshape((n, o) + out_sp).astype(x.dtype)
+
+
+def _pool(x, kshape, strides, pads, mode):
+    from numpy.lib.stride_tricks import sliding_window_view
+    nsp = x.ndim - 2
+    lo, hi = pads[:nsp], pads[nsp:]
+    fill = -np.inf if mode == "max" else 0.0
+    xp = np.pad(x.astype(np.float64),
+                [(0, 0), (0, 0)] + list(zip(lo, hi)),
+                constant_values=fill)
+    v = sliding_window_view(xp, kshape, axis=tuple(range(2, 2 + nsp)))
+    v = v[(slice(None), slice(None))
+          + tuple(slice(None, None, s) for s in strides)]
+    axes = tuple(range(2 + nsp, 2 + 2 * nsp))
+    out = v.max(axis=axes) if mode == "max" else v.mean(axis=axes)
+    return out.astype(x.dtype)
+
+
+def evaluate(model, inputs):
+    g = model.graph
+    env = {}
+    for t in g.initializer:
+        env[t.name] = _decode_tensor(t)
+    graph_ins = [i for i in g.input if i.name not in env]
+    if len(graph_ins) != len(inputs):
+        raise ValueError(
+            f"model takes {len(graph_ins)} inputs, got {len(inputs)}")
+    for vi, val in zip(graph_ins, inputs):
+        env[vi.name] = np.asarray(val)
+    for node in g.node:
+        ins = [env[i] for i in node.input if i]
+        outs = _run_node(node, ins)
+        for name, val in zip(node.output, outs):
+            env[name] = val
+    return [env[o.name] for o in g.output]
+
+
+def _run_node(node, ins):
+    op = node.op_type
+    at = _attrs(node)
+    x = ins[0] if ins else None
+    if op == "Identity":
+        return [x]
+    unary = {
+        "Neg": np.negative, "Exp": np.exp, "Log": np.log, "Tanh": np.tanh,
+        "Sqrt": np.sqrt, "Abs": np.abs, "Sign": np.sign,
+        "Floor": np.floor, "Ceil": np.ceil,
+        "Round": lambda v: np.round(v),
+        "Sin": np.sin, "Cos": np.cos, "Tan": np.tan, "Asin": np.arcsin,
+        "Acos": np.arccos, "Atan": np.arctan, "Sinh": np.sinh,
+        "Cosh": np.cosh, "Not": np.logical_not,
+        "Reciprocal": lambda v: (1.0 / v).astype(v.dtype),
+        "Erf": lambda v: _erf(v).astype(v.dtype),
+        "Sigmoid": lambda v: (1.0 / (1.0 + np.exp(-v.astype(np.float64)))
+                              ).astype(v.dtype),
+    }
+    if op in unary:
+        r = unary[op](x)
+        return [r.astype(x.dtype) if op not in ("Not",) else r]
+    binary = {
+        "Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+        "Div": lambda a, b: (a / b if np.issubdtype(a.dtype, np.floating)
+                             else a // b),
+        "Pow": np.power, "Mod": np.fmod, "Max": np.maximum,
+        "Min": np.minimum, "And": np.logical_and, "Or": np.logical_or,
+        "Xor": np.logical_xor,
+    }
+    if op in binary:
+        r = binary[op](ins[0], ins[1])
+        if op in ("And", "Or", "Xor"):
+            return [r]
+        return [np.asarray(r, ins[0].dtype)]
+    compare = {"Equal": np.equal, "Less": np.less,
+               "LessOrEqual": np.less_equal, "Greater": np.greater,
+               "GreaterOrEqual": np.greater_equal}
+    if op in compare:
+        return [compare[op](ins[0], ins[1])]
+    if op == "Where":
+        return [np.where(ins[0], ins[1], ins[2]).astype(ins[1].dtype)]
+    if op == "Cast":
+        return [x.astype(_NP_DTYPES[at["to"]])]
+    if op == "Reshape":
+        return [x.reshape(tuple(int(v) for v in ins[1]))]
+    if op == "Transpose":
+        return [np.transpose(x, at["perm"])]
+    if op == "Expand":
+        return [np.broadcast_to(
+            x, tuple(int(v) for v in ins[1])).copy()]
+    if op == "Concat":
+        return [np.concatenate(ins, axis=at["axis"])]
+    if op == "Slice":
+        starts = [int(v) for v in ins[1]]
+        ends = [int(v) for v in ins[2]]
+        axes = [int(v) for v in ins[3]] if len(ins) > 3 else \
+            list(range(len(starts)))
+        steps = [int(v) for v in ins[4]] if len(ins) > 4 else \
+            [1] * len(starts)
+        sl = [slice(None)] * x.ndim
+        for a, st, en, sp in zip(axes, starts, ends, steps):
+            en = None if (sp < 0 and en < -x.shape[a]) else en
+            sl[a] = slice(st, en, sp)
+        return [x[tuple(sl)]]
+    if op == "Pad":
+        pads = [int(v) for v in ins[1]]
+        n = len(pads) // 2
+        cval = float(ins[2]) if len(ins) > 2 else 0.0
+        return [np.pad(x, list(zip(pads[:n], pads[n:])),
+                       constant_values=cval).astype(x.dtype)]
+    if op == "ReduceSum":
+        axes = tuple(int(v) for v in ins[1]) if len(ins) > 1 else None
+        return [x.sum(axis=axes, keepdims=bool(at.get("keepdims", 1)))
+                .astype(x.dtype)]
+    if op in ("ReduceMax", "ReduceMin", "ReduceProd", "ReduceMean"):
+        fn = {"ReduceMax": np.max, "ReduceMin": np.min,
+              "ReduceProd": np.prod, "ReduceMean": np.mean}[op]
+        axes = tuple(at["axes"]) if "axes" in at else None
+        return [fn(x, axis=axes, keepdims=bool(at.get("keepdims", 1)))
+                .astype(x.dtype)]
+    if op in ("ArgMax", "ArgMin"):
+        fn = np.argmax if op == "ArgMax" else np.argmin
+        ax = at.get("axis", 0)
+        r = fn(x, axis=ax)
+        if at.get("keepdims", 1):
+            r = np.expand_dims(r, ax)
+        return [r.astype(np.int64)]
+    if op == "Clip":
+        lo = ins[1] if len(ins) > 1 else None
+        hi = ins[2] if len(ins) > 2 else None
+        return [np.clip(x, lo, hi).astype(x.dtype)]
+    if op == "CumSum":
+        ax = int(ins[1])
+        if at.get("reverse"):
+            r = np.flip(np.cumsum(np.flip(x, ax), axis=ax), ax)
+        else:
+            r = np.cumsum(x, axis=ax)
+        return [r.astype(x.dtype)]
+    if op == "MatMul":
+        return [np.matmul(ins[0].astype(np.float64),
+                          ins[1].astype(np.float64)).astype(ins[0].dtype)]
+    if op == "Einsum":
+        return [np.einsum(at["equation"],
+                          *[i.astype(np.float64) for i in ins])
+                .astype(ins[0].dtype)]
+    if op == "Conv":
+        nsp = x.ndim - 2
+        return [_conv(x, ins[1] if len(ins) > 1 else None,
+                      at.get("group", 1),
+                      at.get("strides", [1] * nsp),
+                      at.get("pads", [0] * 2 * nsp),
+                      at.get("dilations", [1] * nsp))]
+    if op == "MaxPool":
+        nsp = x.ndim - 2
+        return [_pool(x, at["kernel_shape"],
+                      at.get("strides", [1] * nsp),
+                      at.get("pads", [0] * 2 * nsp), "max")]
+    if op == "AveragePool":
+        nsp = x.ndim - 2
+        return [_pool(x, at["kernel_shape"],
+                      at.get("strides", [1] * nsp),
+                      at.get("pads", [0] * 2 * nsp), "avg")]
+    if op == "Gather":
+        return [np.take(ins[0], ins[1].astype(np.int64),
+                        axis=at.get("axis", 0))]
+    if op == "TopK":
+        k = int(ins[1])
+        ax = at.get("axis", -1)
+        idx = np.argsort(-x, axis=ax, kind="stable")
+        idx = np.take(idx, np.arange(k), axis=ax)
+        vals = np.take_along_axis(x, idx, axis=ax)
+        return [vals, idx.astype(np.int64)]
+    raise NotImplementedError(f"numpy runtime op {op}")
+
+
+__all__ = ["evaluate"]

@@ -337,3 +337,23 @@ def test_param_hook_fires_once_across_multiple_layer_calls():
     assert len(calls) == 1
     got = np.asarray(dict(lin.named_parameters())["weight"].grad)
     np.testing.assert_allclose(got, calls[0] * 0.5, rtol=1e-6)
+
+
+def test_register_hook_root_and_interior_leaf_fires_once():
+    # x passed as a backward ROOT while also feeding loss: the hook sees
+    # ONE call on seed + consumer contribution (GradNodeAccumulation fires
+    # on the final sum, not per source)
+    calls = []
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+
+    def hook(g):
+        calls.append(np.asarray(g.numpy()))
+        return g * 10
+
+    x.register_hook(hook)
+    loss = paddle.sum(x * 3.0)
+    paddle.autograd.backward([x, loss])
+    assert len(calls) == 1
+    # seed ones + d(loss)/dx = 3 -> hook sees 4, grad = 40
+    np.testing.assert_allclose(calls[0], [4.0, 4.0])
+    np.testing.assert_allclose(x.grad.numpy(), [40.0, 40.0])
