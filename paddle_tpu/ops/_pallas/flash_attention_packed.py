@@ -66,15 +66,25 @@ def _pick_blocks_packed(sq: int, sk: int, dp: int, bwd: bool = False):
             return min(tq, sq), min(tk, sk)
     except Exception:
         pass
-    cap = (256 if bwd else 512) if dp <= 768 else (128 if bwd else 256)
+    # on-chip sweep at B64 S512 H12, fwd+bwd, device time, only configs
+    # that pass the numeric guard: bwd 256x512 5.20 ms vs 256x256 5.91 /
+    # 512x256 6.05; 512x512 overflows the 16MB scoped-vmem stack (the
+    # G-way unrolled head loop keeps ~5 [bq,bk] f32 temporaries live).
+    if bwd:
+        cq, ck = (256, 512) if dp <= 768 else (128, 256)
+    else:
+        # 512-square q tiles overflow the stack in the G=12 direct form
+        # (in-graph, with the segment/bias dummies); 256x512 fits and
+        # keeps block_k == seq for the scratch-free single-k-block path.
+        cq, ck = (256, 512) if dp <= 768 else (256, 256)
 
-    def fit(s):
+    def fit(cap, s):
         b = min(cap, s)
         while b > 128 and s % b:
             b -= 128
         return b
 
-    return fit(sq), fit(sk)
+    return fit(cq, sq), fit(ck, sk)
 
 
 def _seg_mask_b(s, segq_ref, segk_ref):
@@ -152,6 +162,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, segq_ref, segk_ref, seed_ref, bias_ref,
         lse_ref[0] = m_scr[...] + jnp.log(l)        # [bq, G]
 
 
+def _fwd_kernel_direct(q_ref, k_ref, v_ref, segq_ref, segk_ref, seed_ref,
+                       bias_ref, o_ref, lse_ref,
+                       *, scale, causal, segmented, block_q, block_k,
+                       seq_q, seq_k, g_pack, hg, num_heads, dropout=0.0,
+                       biased=False):
+    """Single-k-block specialization (block_k >= seq_k): plain per-head
+    softmax, no online-max scratch, no narrow-lane m/l read-modify-write —
+    measured 2.2x faster than the streamed form at B64 S512 G12 (the
+    common encoder shape puts the WHOLE key sequence in one tile)."""
+    bg = pl.program_id(0)
+    qi = pl.program_id(1)
+    offset = seq_k - seq_q
+    qp = q_ref[0]
+    kp = k_ref[0]
+    vp = v_ref[0]
+    for h in range(g_pack):
+        sl = slice(h * HEAD_D, (h + 1) * HEAD_D)
+        s = _dot(qp[:, sl], kp[:, sl], ((1,), (1,))) * scale
+        if causal:
+            s = _causal_mask(s, qi, 0, block_q, block_k, offset)
+        if segmented:
+            s = _seg_mask_b(s, segq_ref, segk_ref)
+        if biased:
+            s = s + bias_ref[0]
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m) * (s > NEG_INF / 2)
+        l = jnp.maximum(jnp.sum(p, axis=1, keepdims=True), 1e-30)
+        pv = p
+        if dropout > 0.0:
+            pv = p * _dropout_keepf(
+                p.shape, _flat_head(bg, hg, g_pack, h, num_heads), qi, 0,
+                block_q, block_k, seq_q, seq_k, seed_ref[0], dropout)
+        o = _dot(pv.astype(vp.dtype), vp[:, sl], ((1,), (0,)))
+        o_ref[0, :, sl] = (o / l).astype(o_ref.dtype)
+        lse_ref[0, :, h:h + 1] = m + jnp.log(l)
+
+
 def _fwd(q, k, v, scale, causal, block_q, block_k, g_pack, num_heads,
          seg_q=None, seg_k=None, dropout=0.0, seed=None, bias=None):
     """q/k/v: [B*HG, S, G*64] packed; seg_q/k: [B, 1, S] int32 or None;
@@ -172,6 +219,46 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, g_pack, num_heads,
     if not biased:
         bias = jnp.zeros((b, 1, sk), jnp.float32)
     nq, nk = sq // block_q, sk // block_k
+    cost = pl.CostEstimate(
+        flops=4 * bhg * g_pack * sq * sk * HEAD_D
+        // (2 if causal else 1),
+        bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
+        transcendentals=bhg * g_pack * sq * sk,
+    )
+    out_shape = [
+        jax.ShapeDtypeStruct((bhg, sq, dp), q.dtype),
+        jax.ShapeDtypeStruct((bhg, sq, g_pack), jnp.float32),
+    ]
+    if nk == 1:
+        kern = functools.partial(
+            _fwd_kernel_direct, scale=scale, causal=causal,
+            segmented=segmented, block_q=block_q, block_k=block_k,
+            seq_q=sq, seq_k=sk, g_pack=g_pack, hg=hg,
+            num_heads=num_heads, dropout=dropout, biased=biased)
+        o, lse = pl.pallas_call(
+            kern,
+            grid=(bhg, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, dp), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, block_k, dp), lambda b_, i: (b_, 0, 0)),
+                pl.BlockSpec((1, block_k, dp), lambda b_, i: (b_, 0, 0)),
+                pl.BlockSpec((1, 1, block_q),
+                             lambda b_, i, _hg=hg: (b_ // _hg, 0, i)),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda b_, i, _hg=hg: (b_ // _hg, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda b_, i, _hg=hg: (b_ // _hg, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, dp), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, block_q, g_pack),
+                             lambda b_, i: (b_, i, 0)),
+            ],
+            out_shape=out_shape,
+            cost_estimate=cost,
+        )(q, k, v, seg_q, seg_k, seed, bias)
+        return o, lse
     kern = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, segmented=segmented,
         block_q=block_q, block_k=block_k, seq_q=sq, seq_k=sk,
@@ -196,21 +283,13 @@ def _fwd(q, k, v, scale, causal, block_q, block_k, g_pack, num_heads,
             pl.BlockSpec((1, block_q, dp), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_q, g_pack), lambda b_, i, j: (b_, i, 0)),
         ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bhg, sq, dp), q.dtype),
-            jax.ShapeDtypeStruct((bhg, sq, g_pack), jnp.float32),
-        ],
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, g_pack), jnp.float32),
             pltpu.VMEM((block_q, g_pack), jnp.float32),
             pltpu.VMEM((block_q, dp), jnp.float32),
         ],
-        cost_estimate=pl.CostEstimate(
-            flops=4 * bhg * g_pack * sq * sk * HEAD_D
-            // (2 if causal else 1),
-            bytes_accessed=(q.size + k.size + v.size) * q.dtype.itemsize,
-            transcendentals=bhg * g_pack * sq * sk,
-        ),
+        cost_estimate=cost,
     )(q, k, v, seg_q, seg_k, seed, bias)
     return o, lse
 
@@ -325,6 +404,111 @@ def _bwd_dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
+def _bwd_dkv_kernel_direct(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                           segq_ref, segk_ref, seed_ref, bias_ref, dk_ref,
+                           dv_ref,
+                           *, scale, causal, segmented, block_q, block_k,
+                           seq_q, seq_k, g_pack, hg, num_heads,
+                           dropout=0.0, biased=False):
+    """Single-q-block dk/dv: the whole query sequence sits in one tile."""
+    bg = pl.program_id(0)
+    kj = pl.program_id(1)
+    offset = seq_k - seq_q
+    kp = k_ref[0]
+    vp = v_ref[0]
+    qp = q_ref[0]
+    dop = do_ref[0]
+    for h in range(g_pack):
+        sl = slice(h * HEAD_D, (h + 1) * HEAD_D)
+        lse = lse_ref[0][:, h:h + 1]
+        delta = delta_ref[0][:, h:h + 1]
+        s = _dot(qp[:, sl], kp[:, sl], ((1,), (1,))) * scale
+        if causal:
+            s = _causal_mask(s, 0, kj, block_q, block_k, offset)
+        if segmented:
+            s = _seg_mask_b(s, segq_ref, segk_ref)
+        if biased:
+            s = s + bias_ref[0]
+        p = jnp.exp(s - lse) * (s > NEG_INF / 2)
+        pv = p
+        dp = _dot(dop[:, sl], vp[:, sl], ((1,), (1,)))
+        if dropout > 0.0:
+            keepf = _dropout_keepf(
+                p.shape, _flat_head(bg, hg, g_pack, h, num_heads), 0, kj,
+                block_q, block_k, seq_q, seq_k, seed_ref[0], dropout)
+            pv = p * keepf
+            dp = dp * keepf
+        dv_ref[0, :, sl] = _dot(pv.astype(dop.dtype), dop[:, sl],
+                                ((0,), (0,))).astype(dv_ref.dtype)
+        ds = (p * (dp - delta) * scale).astype(qp.dtype)
+        dk_ref[0, :, sl] = _dot(ds, qp[:, sl],
+                                ((0,), (0,))).astype(dk_ref.dtype)
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      segq_ref, segk_ref, seed_ref, bias_ref,
+                      dq_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                      *, scale, causal, segmented, block_q, block_k,
+                      seq_q, seq_k, g_pack, hg, num_heads, dropout=0.0,
+                      biased=False):
+    """Fused dq+dkv for the single-k-block regime (block_k >= seq_k).
+
+    The r4 fused-backward attempt was rejected because dq and dk/dv have
+    conflicting reduction axes — accumulating one of them meant HBM
+    read-modify-write across grid steps, unsound under Mosaic's async
+    output windows. With the WHOLE key sequence in the tile that conflict
+    disappears: dq is complete within one program (its k-reduction is the
+    in-tile dot), and dk/dv accumulate across the streamed q-blocks in
+    VMEM scratch — the one (s, p) recompute serves all three gradients
+    (5 dot-sets per head vs 3+4 in the split kernels, exp once vs twice,
+    q/do DMA'd once vs twice)."""
+    bg = pl.program_id(0)
+    qi = pl.program_id(1)
+    nq = pl.num_programs(1)
+    offset = seq_k - seq_q
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    qp = q_ref[0]
+    kp = k_ref[0]
+    vp = v_ref[0]
+    dop = do_ref[0]
+    for h in range(g_pack):
+        sl = slice(h * HEAD_D, (h + 1) * HEAD_D)
+        lse = lse_ref[0][:, h:h + 1]
+        delta = delta_ref[0][:, h:h + 1]
+        s = _dot(qp[:, sl], kp[:, sl], ((1,), (1,))) * scale
+        if causal:
+            s = _causal_mask(s, qi, 0, block_q, block_k, offset)
+        if segmented:
+            s = _seg_mask_b(s, segq_ref, segk_ref)
+        if biased:
+            s = s + bias_ref[0]
+        p = jnp.exp(s - lse) * (s > NEG_INF / 2)
+        pv = p
+        dp = _dot(dop[:, sl], vp[:, sl], ((1,), (1,)))
+        if dropout > 0.0:
+            keepf = _dropout_keepf(
+                p.shape, _flat_head(bg, hg, g_pack, h, num_heads), qi, 0,
+                block_q, block_k, seq_q, seq_k, seed_ref[0], dropout)
+            pv = p * keepf
+            dp = dp * keepf
+        ds = (p * (dp - delta) * scale).astype(kp.dtype)
+        dq_ref[0, :, sl] = _dot(ds, kp[:, sl],
+                                ((1,), (0,))).astype(dq_ref.dtype)
+        dv_scr[:, sl] = dv_scr[:, sl] + _dot(
+            pv.astype(dop.dtype), dop[:, sl], ((0,), (0,)))
+        dk_scr[:, sl] = dk_scr[:, sl] + _dot(ds, qp[:, sl], ((0,), (0,)))
+
+    @pl.when(qi == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
 def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, g_pack,
          num_heads, seg_q=None, seg_k=None, dropout=0.0, seed=None,
          bias=None):
@@ -351,69 +535,149 @@ def _bwd(q, k, v, o, lse, do, scale, causal, block_q, block_k, g_pack,
     def batch_of(b_, i, j, _hg=hg):
         return b_ // _hg
 
-    dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, segmented=segmented,
-            block_q=block_q, block_k=block_k, seq_q=sq, seq_k=sk,
-            g_pack=g_pack, hg=hg, num_heads=num_heads, dropout=dropout,
-            biased=biased),
-        grid=(bhg, nq, nk),
-        in_specs=[
-            pl.BlockSpec((1, block_q, dp), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda b_, i, j: (b_, j, 0)),
-            pl.BlockSpec((1, block_q, dp), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, g_pack), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, block_q, g_pack), lambda b_, i, j: (b_, i, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b_, i, j: (batch_of(b_, i, j), 0, i)),
-            pl.BlockSpec((1, 1, block_k),
-                         lambda b_, i, j: (batch_of(b_, i, j), 0, j)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, block_k),
-                         lambda b_, i, j: (batch_of(b_, i, j), 0, j)),
-        ],
-        out_specs=pl.BlockSpec((1, block_q, dp),
-                               lambda b_, i, j: (b_, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bhg, sq, dp), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
-    )(q, k, v, do, lse, delta, seg_q, seg_k, seed, bias)
+    kw = dict(scale=scale, causal=causal, segmented=segmented,
+              seq_q=sq, seq_k=sk, g_pack=g_pack, hg=hg,
+              num_heads=num_heads, dropout=dropout, biased=biased)
 
-    dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal,
-            segmented=segmented, block_q=block_q, block_k=block_k,
-            seq_q=sq, seq_k=sk, g_pack=g_pack, hg=hg, num_heads=num_heads,
-            dropout=dropout, biased=biased),
-        grid=(bhg, nk, nq),
-        in_specs=[
-            pl.BlockSpec((1, block_k, dp), lambda b_, j, t: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda b_, j, t: (b_, j, 0)),
-            pl.BlockSpec((1, block_q, dp), lambda b_, j, t: (b_, t, 0)),
-            pl.BlockSpec((1, block_q, dp), lambda b_, j, t: (b_, t, 0)),
-            pl.BlockSpec((1, block_q, g_pack), lambda b_, j, t: (b_, t, 0)),
-            pl.BlockSpec((1, block_q, g_pack), lambda b_, j, t: (b_, t, 0)),
-            pl.BlockSpec((1, 1, block_q),
-                         lambda b_, j, t: (batch_of(b_, j, t), 0, t)),
-            pl.BlockSpec((1, 1, block_k),
-                         lambda b_, j, t: (batch_of(b_, j, t), 0, j)),
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, 1, block_k),
-                         lambda b_, j, t: (batch_of(b_, j, t), 0, j)),
-        ],
-        out_specs=[
-            pl.BlockSpec((1, block_k, dp), lambda b_, j, t: (b_, j, 0)),
-            pl.BlockSpec((1, block_k, dp), lambda b_, j, t: (b_, j, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bhg, sk, dp), k.dtype),
-            jax.ShapeDtypeStruct((bhg, sk, dp), v.dtype),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_k, dp), jnp.float32),
-            pltpu.VMEM((block_k, dp), jnp.float32),
-        ],
-    )(k, v, q, do, lse, delta, seg_q, seg_k, seed, bias)
+    if nk == 1:
+        # fused dq+dkv: one (s, p) recompute serves all three grads
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(_bwd_fused_kernel, block_q=block_q,
+                              block_k=block_k, **kw),
+            grid=(bhg, nq),
+            in_specs=[
+                pl.BlockSpec((1, block_q, dp), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, block_k, dp), lambda b_, i: (b_, 0, 0)),
+                pl.BlockSpec((1, block_k, dp), lambda b_, i: (b_, 0, 0)),
+                pl.BlockSpec((1, block_q, dp), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, block_q, g_pack),
+                             lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, block_q, g_pack),
+                             lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, 1, block_q),
+                             lambda b_, i, _hg=hg: (b_ // _hg, 0, i)),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda b_, i, _hg=hg: (b_ // _hg, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda b_, i, _hg=hg: (b_ // _hg, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, block_q, dp), lambda b_, i: (b_, i, 0)),
+                pl.BlockSpec((1, block_k, dp), lambda b_, i: (b_, 0, 0)),
+                pl.BlockSpec((1, block_k, dp), lambda b_, i: (b_, 0, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bhg, sq, dp), q.dtype),
+                jax.ShapeDtypeStruct((bhg, sk, dp), k.dtype),
+                jax.ShapeDtypeStruct((bhg, sk, dp), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, dp), jnp.float32),
+                pltpu.VMEM((block_k, dp), jnp.float32),
+            ],
+        )(q, k, v, do, lse, delta, seg_q, seg_k, seed, bias)
+        return dq, dk, dv
+    if nk > 1:  # streamed dq over key blocks
+        dq = pl.pallas_call(
+            functools.partial(_bwd_dq_kernel, block_q=block_q,
+                              block_k=block_k, **kw),
+            grid=(bhg, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, block_q, dp),
+                             lambda b_, i, j: (b_, i, 0)),
+                pl.BlockSpec((1, block_k, dp),
+                             lambda b_, i, j: (b_, j, 0)),
+                pl.BlockSpec((1, block_k, dp),
+                             lambda b_, i, j: (b_, j, 0)),
+                pl.BlockSpec((1, block_q, dp),
+                             lambda b_, i, j: (b_, i, 0)),
+                pl.BlockSpec((1, block_q, g_pack),
+                             lambda b_, i, j: (b_, i, 0)),
+                pl.BlockSpec((1, block_q, g_pack),
+                             lambda b_, i, j: (b_, i, 0)),
+                pl.BlockSpec((1, 1, block_q),
+                             lambda b_, i, j: (batch_of(b_, i, j), 0, i)),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda b_, i, j: (batch_of(b_, i, j), 0, j)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, block_k),
+                             lambda b_, i, j: (batch_of(b_, i, j), 0, j)),
+            ],
+            out_specs=pl.BlockSpec((1, block_q, dp),
+                                   lambda b_, i, j: (b_, i, 0)),
+            out_shape=jax.ShapeDtypeStruct((bhg, sq, dp), q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, dp), jnp.float32)],
+        )(q, k, v, do, lse, delta, seg_q, seg_k, seed, bias)
+
+    # dkv mirrors the dq tiling: its streamed axis is q, so it gets the
+    # SMALL tile on q and the large one on k (block_k x block_q swapped);
+    # unmirrored when sq != sk makes the swap non-dividing.
+    kq, kk = block_k, block_q
+    if sq % min(kq, sq) or sk % min(kk, sk):
+        kq, kk = block_q, block_k
+    nkv_q, nkv_k = sq // min(kq, sq), sk // min(kk, sk)
+    kq, kk = min(kq, sq), min(kk, sk)
+    dkv_out = [
+        jax.ShapeDtypeStruct((bhg, sk, dp), k.dtype),
+        jax.ShapeDtypeStruct((bhg, sk, dp), v.dtype),
+    ]
+    if nkv_q == 1:
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel_direct, block_q=kq,
+                              block_k=kk, **kw),
+            grid=(bhg, nkv_k),
+            in_specs=[
+                pl.BlockSpec((1, kk, dp), lambda b_, j: (b_, j, 0)),
+                pl.BlockSpec((1, kk, dp), lambda b_, j: (b_, j, 0)),
+                pl.BlockSpec((1, kq, dp), lambda b_, j: (b_, 0, 0)),
+                pl.BlockSpec((1, kq, dp), lambda b_, j: (b_, 0, 0)),
+                pl.BlockSpec((1, kq, g_pack), lambda b_, j: (b_, 0, 0)),
+                pl.BlockSpec((1, kq, g_pack), lambda b_, j: (b_, 0, 0)),
+                pl.BlockSpec((1, 1, kq),
+                             lambda b_, j, _hg=hg: (b_ // _hg, 0, 0)),
+                pl.BlockSpec((1, 1, kk),
+                             lambda b_, j, _hg=hg: (b_ // _hg, 0, j)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, kk),
+                             lambda b_, j, _hg=hg: (b_ // _hg, 0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, kk, dp), lambda b_, j: (b_, j, 0)),
+                pl.BlockSpec((1, kk, dp), lambda b_, j: (b_, j, 0)),
+            ],
+            out_shape=dkv_out,
+        )(k, v, q, do, lse, delta, seg_q, seg_k, seed, bias)
+    else:
+        dk, dv = pl.pallas_call(
+            functools.partial(_bwd_dkv_kernel, block_q=kq, block_k=kk,
+                              **kw),
+            grid=(bhg, nkv_k, nkv_q),
+            in_specs=[
+                pl.BlockSpec((1, kk, dp), lambda b_, j, t: (b_, j, 0)),
+                pl.BlockSpec((1, kk, dp), lambda b_, j, t: (b_, j, 0)),
+                pl.BlockSpec((1, kq, dp), lambda b_, j, t: (b_, t, 0)),
+                pl.BlockSpec((1, kq, dp), lambda b_, j, t: (b_, t, 0)),
+                pl.BlockSpec((1, kq, g_pack), lambda b_, j, t: (b_, t, 0)),
+                pl.BlockSpec((1, kq, g_pack), lambda b_, j, t: (b_, t, 0)),
+                pl.BlockSpec((1, 1, kq),
+                             lambda b_, j, t: (batch_of(b_, j, t), 0, t)),
+                pl.BlockSpec((1, 1, kk),
+                             lambda b_, j, t: (batch_of(b_, j, t), 0, j)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+                pl.BlockSpec((1, 1, kk),
+                             lambda b_, j, t: (batch_of(b_, j, t), 0, j)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, kk, dp), lambda b_, j, t: (b_, j, 0)),
+                pl.BlockSpec((1, kk, dp), lambda b_, j, t: (b_, j, 0)),
+            ],
+            out_shape=dkv_out,
+            scratch_shapes=[
+                pltpu.VMEM((kk, dp), jnp.float32),
+                pltpu.VMEM((kk, dp), jnp.float32),
+            ],
+        )(k, v, q, do, lse, delta, seg_q, seg_k, seed, bias)
     return dq, dk, dv
 
 
