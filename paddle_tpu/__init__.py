@@ -58,6 +58,7 @@ from . import utils  # noqa: F401
 from .utils import flops  # noqa: F401
 from . import device  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import analysis  # noqa: F401
 from . import hub  # noqa: F401
 from . import onnx  # noqa: F401
 from . import callbacks  # noqa: F401

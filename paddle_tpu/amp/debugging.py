@@ -26,7 +26,8 @@ import numpy as np
 
 from ..core import flags
 
-__all__ = ["check_numerics", "check_numerics_tree", "enabled"]
+__all__ = ["check_numerics", "check_numerics_tree", "check_optimizer_state",
+           "enabled"]
 
 _FP16_MAX = 65504.0
 
@@ -42,12 +43,20 @@ def _host_check(name: str, where: str, level: int, x) -> None:
     n_nan = int(np.isnan(a).sum())
     n_inf = int(np.isinf(a).sum())
     if n_nan or n_inf:
-        msg = (f"[check_nan_inf] {where}: tensor {name!r} contains "
-               f"{n_nan} NaN / {n_inf} Inf (shape {tuple(a.shape)}, "
-               f"dtype {a.dtype})")
+        # report through the analysis Diagnostic channel — the runtime
+        # NaN scan and the static linter share one record format
+        from ..analysis.jaxpr_lint import Diagnostic, ERROR, WARNING
+        diag = Diagnostic(
+            rule="N001", name="nan-inf",
+            severity=ERROR if level == 0 else WARNING,
+            message=(f"[check_nan_inf] {where}: tensor {name!r} contains "
+                     f"{n_nan} NaN / {n_inf} Inf (shape {tuple(a.shape)}, "
+                     f"dtype {a.dtype})"),
+            where=where,
+            hint="FLAGS_check_nan_inf_level>=1 logs instead of raising")
         if level == 0:
-            raise FloatingPointError(msg)
-        print(msg, file=sys.stderr)
+            raise FloatingPointError(diag.message)
+        print(diag.format(), file=sys.stderr)
         return
     finite = a[np.isfinite(a)]
     if level >= 2 and finite.size and \
@@ -71,6 +80,15 @@ def check_numerics(x, name: str = "tensor", where: str = "step",
     level = int(flags.flag("check_nan_inf_level"))
     jax.debug.callback(functools.partial(_host_check, name, where, level), x)
     return x
+
+
+def check_optimizer_state(opt_state: Any, where: str = "optimizer",
+                          force: bool = False) -> Any:
+    """Scan an optimizer-state pytree (Adam moments, loss-scale, ...) —
+    moment corruption outlives the grad step that caused it, so the
+    train-step scans cover state as well as grads. Returns the tree."""
+    return check_numerics_tree(opt_state, where=where + "/opt_state",
+                               force=force)
 
 
 def check_numerics_tree(tree: Any, where: str = "step",

@@ -1,0 +1,38 @@
+"""paddle_tpu.analysis — static analysis over traced programs and source.
+
+Three layers, one :class:`Diagnostic` currency (see ``RULES.md`` for the
+rule catalog):
+
+- :mod:`.jaxpr_lint` — walks ``jax.make_jaxpr`` output of any jitted
+  function through a pluggable rule registry (f64 promotion, host syncs in
+  loop bodies, PRNG key reuse, dead subgraphs, donation aliasing, ...).
+- :mod:`.pallas_check` — arithmetic checks of Pallas kernel block
+  configurations against TPU constraints (16MB scoped VMEM, (8,128)
+  native tiles, grid divisibility) without needing a TPU.
+- :mod:`.repo_lint` — AST lint with project source rules (host clocks in
+  kernel modules, constant PRNG seeds, flag-registry bypass).
+
+Wiring: ``FLAGS_static_analysis`` (off | warn | error) runs the jaxpr
+linter inside ``jit.to_static`` / ``framework.sharded.TrainStep`` /
+``framework.eager`` layer tracing, and the kernel hooks in
+``ops/_pallas``; ``tools/lint_graph.py`` is the CLI; the repo lint gates
+CI via ``tests/test_repo_lint.py``.
+"""
+
+from .jaxpr_lint import (Diagnostic, GraphLintError, lint_jaxpr,  # noqa: F401
+                         lint_fn, register_rule, all_rules, emit,
+                         analysis_mode, ERROR, WARNING, INFO)
+from .pallas_check import (KernelSpec, BlockUse, check_kernel_spec,  # noqa: F401
+                           spec_for_flash_packed, spec_for_flash,
+                           check_jaxpr_pallas, VMEM_BUDGET)
+from . import repo_lint  # noqa: F401
+from . import _jaxpr_utils as jaxpr_utils  # noqa: F401
+
+__all__ = [
+    "Diagnostic", "GraphLintError", "lint_jaxpr", "lint_fn",
+    "register_rule", "all_rules", "emit", "analysis_mode",
+    "ERROR", "WARNING", "INFO",
+    "KernelSpec", "BlockUse", "check_kernel_spec",
+    "spec_for_flash_packed", "spec_for_flash", "check_jaxpr_pallas",
+    "VMEM_BUDGET", "repo_lint", "jaxpr_utils",
+]

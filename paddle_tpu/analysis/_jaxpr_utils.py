@@ -1,0 +1,108 @@
+"""Shared jaxpr-introspection helpers for the analysis subsystem.
+
+One home for the idioms that were growing ad hoc in the ONNX exporter and
+the Pallas modules: extracting inner jaxprs from higher-order equations,
+pretty-printing shapes/avals for human-readable messages, and summarizing
+an equation's user-source location. ``onnx/_jaxpr_export.py`` (inlining +
+error messages) and ``ops/_pallas`` (shape errors) reuse these; the linter
+in :mod:`.jaxpr_lint` is built on them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterator, List, Tuple
+
+__all__ = ["INLINE_PRIMS", "LOOP_PRIMS", "CALLBACK_PRIMS", "inner_jaxprs",
+           "fmt_shape", "fmt_dtype", "fmt_aval", "eqn_source"]
+
+# Higher-order call primitives that are pure inlining boundaries: the inner
+# jaxpr is the whole semantics (no control flow). Shared by the ONNX
+# exporter's _inline pass and the linter's same-level descent.
+INLINE_PRIMS = frozenset({
+    "pjit", "jit", "closed_call", "custom_jvp_call", "custom_vjp_call",
+    "custom_vjp_call_jaxpr", "remat", "checkpoint", "remat2",
+    "custom_jvp_call_jaxpr",
+})
+
+# Primitives whose body jaxprs execute per iteration — a host callback or
+# an expensive op inside one runs N times, not once.
+LOOP_PRIMS = frozenset({"scan", "while", "fori"})
+
+# Host-callback primitives: each forces a device->host sync when it runs.
+CALLBACK_PRIMS = frozenset({
+    "debug_callback", "pure_callback", "io_callback", "callback",
+    "python_callback", "outside_call", "host_callback_call",
+})
+
+# jax dtype name -> terse jaxpr-style spelling
+_DTYPE_SHORT = {
+    "float32": "f32", "float64": "f64", "float16": "f16",
+    "bfloat16": "bf16", "int64": "i64", "int32": "i32", "int16": "i16",
+    "int8": "i8", "uint64": "u64", "uint32": "u32", "uint16": "u16",
+    "uint8": "u8", "bool": "bool", "complex64": "c64", "complex128": "c128",
+}
+
+
+def _is_jaxpr(x) -> bool:
+    return hasattr(x, "eqns") and hasattr(x, "invars")
+
+
+def _as_closed(x):
+    """Wrap a raw Jaxpr as a (const-free) ClosedJaxpr; pass through closed."""
+    if hasattr(x, "jaxpr") and hasattr(x, "consts"):
+        return x
+    if _is_jaxpr(x):
+        from jax._src.core import ClosedJaxpr
+        return ClosedJaxpr(x, ())
+    return None
+
+
+def inner_jaxprs(eqn) -> List[Tuple[str, Any]]:
+    """Every inner ClosedJaxpr carried by this equation's params.
+
+    Returns ``[(param_name, ClosedJaxpr), ...]`` covering pjit/remat
+    (``jaxpr``/``call_jaxpr``/``fun_jaxpr``), scan (``jaxpr``), while
+    (``cond_jaxpr``/``body_jaxpr``), cond (``branches`` tuple), and any
+    future param that quacks like a jaxpr — so walkers don't hard-code the
+    param-name zoo per primitive.
+    """
+    found: List[Tuple[str, Any]] = []
+    for pname, pval in eqn.params.items():
+        closed = _as_closed(pval)
+        if closed is not None:
+            found.append((pname, closed))
+            continue
+        if isinstance(pval, (list, tuple)):
+            for i, item in enumerate(pval):
+                closed = _as_closed(item)
+                if closed is not None:
+                    found.append((f"{pname}[{i}]", closed))
+    return found
+
+
+def fmt_shape(shape) -> str:
+    """``(8, 128)`` -> ``"8x128"`` (``""`` for scalars)."""
+    return "x".join(str(int(d)) for d in shape)
+
+
+def fmt_dtype(dtype) -> str:
+    name = getattr(dtype, "name", None) or str(dtype)
+    return _DTYPE_SHORT.get(name, name)
+
+
+def fmt_aval(aval) -> str:
+    """jaxpr-style ``f32[8,128]`` for anything with shape/dtype."""
+    if not hasattr(aval, "dtype"):
+        return repr(aval)
+    dims = ",".join(str(int(d)) for d in getattr(aval, "shape", ()))
+    return f"{fmt_dtype(aval.dtype)}[{dims}]"
+
+
+def eqn_source(eqn) -> str:
+    """``"file.py:123 (fn_name)"`` for an equation, best effort ``""``."""
+    try:
+        from jax._src import source_info_util
+        s = source_info_util.summarize(eqn.source_info)
+        return "" if s == "<unknown>" else s
+    except Exception:
+        return ""

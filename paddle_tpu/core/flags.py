@@ -21,6 +21,7 @@ __all__ = [
     "get_flags",
     "set_flags",
     "flag",
+    "unknown_env_flags",
 ]
 
 
@@ -31,6 +32,7 @@ class _FlagSpec:
     type: type
     help: str
     on_change: Optional[Callable[[Any], None]] = None
+    choices: Optional[tuple] = None
 
 
 _registry: Dict[str, _FlagSpec] = {}
@@ -40,16 +42,33 @@ _lock = threading.RLock()
 
 def _coerce(spec: _FlagSpec, value: Any) -> Any:
     if spec.type is bool and isinstance(value, str):
-        return value.lower() in ("1", "true", "yes", "on")
-    return spec.type(value)
+        value = value.lower() in ("1", "true", "yes", "on")
+    value = spec.type(value)
+    if spec.choices is not None and value not in spec.choices:
+        raise ValueError(
+            f"FLAGS_{spec.name}={value!r} is not a valid value; "
+            f"choices: {list(spec.choices)}")
+    return value
+
+
+def _unknown_flag_error(name: str) -> KeyError:
+    """KeyError naming the typo'd flag, the closest match, and the full
+    valid-name list — a typo must never silently no-op."""
+    import difflib
+    close = difflib.get_close_matches(name, _registry, n=1)
+    suggest = f" (did you mean {close[0]!r}?)" if close else ""
+    return KeyError(
+        f"Unknown flag {name!r}{suggest}; valid flags: {sorted(_registry)}")
 
 
 def define_flag(name: str, default: Any, help: str = "",
-                on_change: Optional[Callable[[Any], None]] = None) -> None:
+                on_change: Optional[Callable[[Any], None]] = None,
+                choices: Optional[Iterable[Any]] = None) -> None:
     """Register a flag. Environment variable ``FLAGS_<name>`` overrides default."""
     with _lock:
         spec = _FlagSpec(name=name, default=default, type=type(default),
-                         help=help, on_change=on_change)
+                         help=help, on_change=on_change,
+                         choices=tuple(choices) if choices else None)
         _registry[name] = spec
         env = os.environ.get("FLAGS_" + name)
         _values[name] = _coerce(spec, env) if env is not None else default
@@ -60,7 +79,7 @@ def flag(name: str) -> Any:
     try:
         return _values[name]
     except KeyError:
-        raise KeyError(f"Unknown flag {name!r}; known: {sorted(_registry)}")
+        raise _unknown_flag_error(name) from None
 
 
 def get_flags(names: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
@@ -80,7 +99,7 @@ def set_flags(flags_map: Dict[str, Any]) -> None:
             if name.startswith("FLAGS_"):
                 name = name[len("FLAGS_"):]
             if name not in _registry:
-                raise KeyError(f"Unknown flag {name!r}")
+                raise _unknown_flag_error(name)
             spec = _registry[name]
             _values[name] = _coerce(spec, value)
             if spec.on_change is not None:
@@ -90,6 +109,17 @@ def set_flags(flags_map: Dict[str, Any]) -> None:
 def list_flags() -> List[_FlagSpec]:
     with _lock:
         return list(_registry.values())
+
+
+def unknown_env_flags() -> List[str]:
+    """``FLAGS_*`` environment variables that match no registered flag —
+    the set-time typo check extended to the env surface. Subsystems that
+    define flags lazily (e.g. framework.determinism) should be imported
+    before calling; the `tools/lint_graph.py` CLI reports these."""
+    with _lock:
+        return sorted(k for k in os.environ
+                      if k.startswith("FLAGS_")
+                      and k[len("FLAGS_"):] not in _registry)
 
 
 # ---------------------------------------------------------------------------
@@ -115,3 +145,8 @@ define_flag("flash_attn_version", 2, "Pallas flash-attention kernel version.")
 define_flag("use_pallas_kernels", True,
             "Use Pallas TPU kernels where available (else jnp reference).")
 define_flag("amp_dtype", "bfloat16", "Preferred mixed-precision compute dtype.")
+define_flag("static_analysis", "off",
+            "Graph/kernel static analysis mode (paddle_tpu.analysis): "
+            "'off' skips, 'warn' prints diagnostics to stderr, 'error' "
+            "raises GraphLintError on error-severity findings.",
+            choices=("off", "warn", "error"))

@@ -107,8 +107,11 @@ def make_deterministic_dp_step(loss_fn: Callable, optimizer, groups: int,
         def _step(params, opt_state, batch, step_idx, lr):
             with jax.default_matmul_precision("highest"):
                 def body(_, g):
+                    # fixed base key IS the contract here: bitwise-equal
+                    # streams across layouts, varied via fold_in
                     key = jax.random.fold_in(
-                        jax.random.PRNGKey(0), step_idx * groups + g)
+                        jax.random.PRNGKey(0),  # repo-lint: allow R002
+                        step_idx * groups + g)
                     bg = jax.tree_util.tree_map(
                         lambda a: a.reshape((groups, -1) + a.shape[1:])[g],
                         batch)
@@ -137,7 +140,8 @@ def make_deterministic_dp_step(loss_fn: Callable, optimizer, groups: int,
             def per_shard(params, opt_state, batch, step_idx, lr):
                 g = lax.axis_index(dp_axis)
                 key = jax.random.fold_in(
-                    jax.random.PRNGKey(0), step_idx * groups + g)
+                    jax.random.PRNGKey(0),  # repo-lint: allow R002
+                    step_idx * groups + g)
                 loss_g, grads_g = _group_step(loss_fn, params, batch, key)
                 # gather-then-sum: every shard sees the SAME [G, ...] stack
                 # and performs the same single-kernel reduction.

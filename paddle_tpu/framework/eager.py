@@ -654,11 +654,40 @@ def record_call(fn, args: tuple, kwargs: dict):
     return _wrap_outputs(out, node, requires)
 
 
+_LINTED_LAYER_TYPES = set()
+
+
+def _maybe_lint_layer(layer, args, kwargs) -> None:
+    """FLAGS_static_analysis hook for the eager/dygraph path: lint each
+    Layer class's functional view once (the same program jit would
+    compile), so graph-level findings surface even in op-by-op mode."""
+    from ..analysis import jaxpr_lint
+    if jaxpr_lint.analysis_mode() == "off":
+        return
+    key = type(layer)
+    if key in _LINTED_LAYER_TYPES:
+        return
+    _LINTED_LAYER_TYPES.add(key)
+    from .functional import functional_call, get_params
+    vals = jax.tree_util.tree_map(
+        to_tensor_value, (args, kwargs),
+        is_leaf=lambda x: isinstance(x, Tensor))
+    try:
+        diags = jaxpr_lint.lint_fn(
+            lambda p, a, k: functional_call(layer, p, *a, **k),
+            get_params(layer), vals[0], vals[1],
+            where=f"eager:{key.__name__}")
+    except Exception:
+        return  # exotic layers may not trace functionally; jit will tell
+    jaxpr_lint.emit(diags, where=f"eager:{key.__name__}")
+
+
 def eager_layer_call(layer, args: tuple, kwargs: dict):
     """Record one tape node for a whole Layer call (see module docstring)."""
     from ..core.random import get_rng_state, set_rng_state
     from .functional import get_params, get_buffers
 
+    _maybe_lint_layer(layer, args, kwargs)
     leaves, treedef = _Node._flatten_call(args, kwargs)
     vals = [to_tensor_value(l) for l in leaves]
     diff_pos = [i for i, l in enumerate(leaves)

@@ -204,6 +204,11 @@ class TrainStep:
                 _dbg.check_numerics_tree(grads, where="train_step/grads")
             new_params, new_state = optimizer.apply_gradients(
                 params, grads, opt_state, lr)
+            if _dbg.enabled():
+                # moment/variance corruption hides in optimizer state long
+                # after the offending grad step — scan it too
+                _dbg.check_numerics_tree(new_state,
+                                         where="train_step/opt_state")
             return loss, new_params, new_state, new_buffers
 
         self._compiled = jax.jit(
@@ -214,8 +219,28 @@ class TrainStep:
             # the Layer tree's arrays; donating would delete them under the
             # model.
             donate_argnums=(0, 1) if donate else ())
+        self._step_fn = step
+        self._donate = donate
+        self._linted = False
         self._step_count = 0
         self._base_key = jax.random.key(0)
+
+    def _maybe_lint(self, batch, lr, key) -> None:
+        """FLAGS_static_analysis: lint the whole train step (fwd + grads +
+        update) once at the first batch shape, donation-aware."""
+        from ..analysis import jaxpr_lint
+        if self._linted or jaxpr_lint.analysis_mode() == "off":
+            return
+        self._linted = True
+        try:
+            diags = jaxpr_lint.lint_fn(
+                self._step_fn, self.params, self.opt_state, self.buffers,
+                batch, lr, key,
+                donate_argnums=(0, 1) if self._donate else (),
+                where="sharded.TrainStep")
+        except Exception:
+            return
+        jaxpr_lint.emit(diags, where="sharded.TrainStep")
 
     def step(self, batch) -> jax.Array:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
@@ -240,6 +265,7 @@ class TrainStep:
         prev_mesh = get_hybrid_mesh()
         set_hybrid_mesh(self.mesh)
         try:
+            self._maybe_lint(batch, lr, key)
             loss, self.params, self.opt_state, self.buffers = self._compiled(
                 self.params, self.opt_state, self.buffers, batch, lr, key)
         finally:

@@ -175,6 +175,11 @@ class Model:
                 new_opt_state = jax.tree_util.tree_map(
                     lambda new, old: jnp.where(found_inf, old, new),
                     new_opt_state, opt_state)
+            if _dbg.enabled():
+                # also scan the optimizer state pytree (moments can go
+                # NaN a step after the grads did and survive the skip)
+                _dbg.check_numerics_tree(new_opt_state,
+                                         where="Model.train_batch/opt_state")
             new_params = {**new_trainable, **frozen}
             return (new_params, new_buf, new_opt_state, new_scaler_state,
                     total, out)

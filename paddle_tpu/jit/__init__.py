@@ -36,6 +36,21 @@ def _abstractify(tree):
         if hasattr(a, "shape") or isinstance(a, (int, float)) else a, tree)
 
 
+def _maybe_lint(fn, args, kwargs, where: str) -> None:
+    """FLAGS_static_analysis hook: lint the traced program for this input
+    signature before compiling (warn prints, error raises GraphLintError).
+    Trace failures here are ignored — jit itself will produce the real
+    error with full context."""
+    from ..analysis import jaxpr_lint
+    if jaxpr_lint.analysis_mode() == "off":
+        return
+    try:
+        diags = jaxpr_lint.lint_fn(fn, *args, where=where, **kwargs)
+    except Exception:
+        return
+    jaxpr_lint.emit(diags, where=where)
+
+
 class StaticFunction:
     """Compiled-function cache front (ref StaticFunction/partial_program)."""
 
@@ -45,6 +60,8 @@ class StaticFunction:
         self._input_spec = input_spec
         self._is_layer = isinstance(fn_or_layer, Layer)
         self._cache: Dict[Any, Callable] = {}
+        self._raw: Dict[Any, Callable] = {}
+        self._linted: set = set()
 
     @property
     def code_cache_size(self) -> int:
@@ -64,37 +81,53 @@ class StaticFunction:
                     return out, new_buf
 
                 fn = jax.jit(pure)
+                self._raw[key] = pure
             else:
                 # dy2static: AST-convert data-dependent Python control flow
                 # into lax.cond/while_loop (ref dy2static transformers) so
                 # tracing doesn't choke on `if tensor:`.
                 from .dy2static import convert_to_static
-                fn = jax.jit(convert_to_static(self._target))
+                converted = convert_to_static(self._target)
+                fn = jax.jit(converted)
+                self._raw[key] = converted
             self._cache[key] = fn
-        return fn
+        return key, fn
+
+    def _lint_signature(self, key, args, kwargs):
+        """FLAGS_static_analysis: lint each input signature once (flag-off
+        calls don't consume the once, so enabling the flag later works)."""
+        from ..analysis import jaxpr_lint
+        if key in self._linted or jaxpr_lint.analysis_mode() == "off":
+            return
+        self._linted.add(key)
+        name = getattr(self._target, "__name__",
+                       type(self._target).__name__)
+        _maybe_lint(self._raw[key], args, kwargs, where=f"to_static:{name}")
 
     def __call__(self, *args, **kwargs):
-        fn = self._compiled_for(args, kwargs)
+        key, fn = self._compiled_for(args, kwargs)
         if self._is_layer:
             layer = self._target
             params = get_params(layer)
             buffers = get_buffers(layer)
+            self._lint_signature(key, (params, buffers) + args, kwargs)
             out, new_buf = fn(params, buffers, *args, **kwargs)
             from ..framework.functional import set_buffers
             if new_buf:
                 set_buffers(layer, new_buf)
             return out
+        self._lint_signature(key, args, kwargs)
         return fn(*args, **kwargs)
 
     # paddle parity: concrete_program etc. are not meaningful; expose the
     # lowered StableHLO for inspection instead.
     def lowered(self, *args, **kwargs):
+        _, fn = self._compiled_for(args, kwargs)
         if self._is_layer:
             params = get_params(self._target)
             buffers = get_buffers(self._target)
-            return self._compiled_for(args, kwargs).lower(params, buffers,
-                                                          *args, **kwargs)
-        return self._compiled_for(args, kwargs).lower(*args, **kwargs)
+            return fn.lower(params, buffers, *args, **kwargs)
+        return fn.lower(*args, **kwargs)
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,

@@ -937,6 +937,25 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 # Entry point
 # ---------------------------------------------------------------------------
 
+def _report_unconverted(fn, reason: str) -> None:
+    """Under FLAGS_static_analysis, a silent conversion fallback becomes a
+    visible diagnostic: the function will trace as-is, so a tensor `if`
+    inside it fails with a raw tracer error instead of lax.cond."""
+    from ..analysis import jaxpr_lint
+    if jaxpr_lint.analysis_mode() == "off":
+        return
+    name = getattr(fn, "__qualname__", repr(fn))
+    jaxpr_lint.emit([jaxpr_lint.Diagnostic(
+        rule="D001", name="dy2static-unconverted",
+        severity=jaxpr_lint.WARNING,
+        message=f"dy2static could not convert {name}: {reason}; "
+                "data-dependent Python control flow inside it will not "
+                "lower to lax.cond/while_loop",
+        hint="define the function in a plain module/def so its source is "
+             "importable, or restructure with jnp.where")],
+        where="dy2static")
+
+
 def convert_to_static(fn: Callable) -> Callable:
     """AST-convert a Python function's control flow for tracing (ref
     program_translator.py:313 StaticFunction conversion step).
@@ -950,10 +969,12 @@ def convert_to_static(fn: Callable) -> Callable:
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)
-    except (OSError, TypeError, IndentationError, SyntaxError):
+    except (OSError, TypeError, IndentationError, SyntaxError) as e:
+        _report_unconverted(fn, f"source unavailable ({type(e).__name__})")
         return fn
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        _report_unconverted(fn, "not a plain function definition")
         return fn
     fdef.decorator_list = []  # run undecorated; to_static re-wraps
     # pass order matters: early returns become flags first, then

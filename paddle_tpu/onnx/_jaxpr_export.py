@@ -23,6 +23,9 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..analysis._jaxpr_utils import (INLINE_PRIMS, eqn_source, fmt_aval,
+                                     inner_jaxprs)
+
 __all__ = ["JaxprToOnnx", "UnsupportedOnnxExport"]
 
 
@@ -57,9 +60,9 @@ _SIMPLE = {
     "stop_gradient": "Identity", "copy": "Identity",
 }
 
-_INLINE_PRIMS = {"pjit", "jit", "closed_call", "custom_jvp_call",
-                 "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
-                 "checkpoint", "remat2", "custom_jvp_call_jaxpr"}
+# higher-order call prims that are pure inlining boundaries — shared with
+# the jaxpr linter (analysis/_jaxpr_utils.py)
+_INLINE_PRIMS = INLINE_PRIMS
 
 # folding never materializes an initializer bigger than this many elements
 _FOLD_LIMIT = 1 << 24
@@ -213,21 +216,22 @@ class JaxprToOnnx:
             if handler is None and prim in _SIMPLE:
                 handler = self._op_simple
             if handler is None:
+                src = eqn_source(eqn)
+                outs = ", ".join(fmt_aval(v.aval) for v in eqn.outvars
+                                 if hasattr(v, "aval"))
                 raise UnsupportedOnnxExport(
-                    f"primitive '{prim}' has no ONNX mapping (inference "
-                    f"subset exporter); eqn: {eqn}")
+                    f"primitive '{prim}' -> ({outs}) has no ONNX mapping "
+                    f"(inference subset exporter)"
+                    + (f"; traced at {src}" if src else "")
+                    + f"; eqn: {eqn}")
             handler(eqn)
 
     def _inline(self, eqn):
-        import jax
-        params = eqn.params
-        inner = params.get("jaxpr") or params.get("call_jaxpr") \
-            or params.get("fun_jaxpr")
-        if inner is None:
+        inners = inner_jaxprs(eqn)
+        if not inners:
             raise UnsupportedOnnxExport(
                 f"can't inline {eqn.primitive.name}: no inner jaxpr")
-        if isinstance(inner, jax._src.core.Jaxpr):
-            inner = jax._src.core.ClosedJaxpr(inner, ())
+        inner = inners[0][1]
         sub_jaxpr = inner.jaxpr
         # bind consts + outer names into the inner vars
         for var, val in zip(sub_jaxpr.constvars, inner.consts):

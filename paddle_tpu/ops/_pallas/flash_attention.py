@@ -935,6 +935,13 @@ def flash_attention_pallas(query, key, value, causal: bool = False,
         raise ValueError(
             f"query heads {h} must be a multiple of kv heads {hk} "
             f"(grouped-query)")
+    if _flags.flag("static_analysis") != "off":
+        # TPU-constraint pre-check of the chosen block config (P0xx rules)
+        from ...analysis import pallas_check as _pc
+        for _bwd in (False, True):
+            _pc.enforce(_pc.spec_for_flash(sq, sk, d, block_q, block_k,
+                                           query.dtype, bwd=_bwd),
+                        where="flash_attention_pallas")
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
     def to_bhsd(x, s, heads):
@@ -948,11 +955,12 @@ def flash_attention_pallas(query, key, value, causal: bool = False,
     seg_q = seg_k = None
     if segment_ids is not None:
         def per_head(seg, s, what):
+            from ...analysis._jaxpr_utils import fmt_shape
             seg = jnp.asarray(seg, jnp.int32)
             if seg.shape != (b, s):
                 raise ValueError(
-                    f"{what} must be [batch, seq] = ({b}, {s}); "
-                    f"got {seg.shape}")
+                    f"{what} must be [batch, seq] = {fmt_shape((b, s))}; "
+                    f"got {fmt_shape(seg.shape)}")
             return jnp.repeat(seg[:, None, :], h,
                               axis=1).reshape(b * h, 1, s)
         seg_q = per_head(segment_ids, sq, "segment_ids")

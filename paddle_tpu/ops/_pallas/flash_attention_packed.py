@@ -748,6 +748,18 @@ def flash_attention_packed(query, key, value, causal=False, scale=None,
         raise ValueError(
             f"packed flash needs seq lengths divisible by blocks; "
             f"sq={sq}, sk={sk}")
+    from ...core import flags as _flags
+    if _flags.flag("static_analysis") != "off":
+        # Enforce the tuning folklore statically (P001/P004: the backward
+        # score-tile VMEM budget that forced the 256-row cap) before
+        # Mosaic hits it at compile time on hardware.
+        from ...analysis import pallas_check as _pc
+        _pc.enforce(_pc.spec_for_flash_packed(
+            sq, sk, g * HEAD_D, block_q, block_k, g, query.dtype),
+            where="flash_attention_packed")
+        _pc.enforce(_pc.spec_for_flash_packed(
+            sq, sk, g * HEAD_D, bwd_bq, bwd_bk, g, query.dtype, bwd=True),
+            where="flash_attention_packed")
     scale = scale if scale is not None else 1.0 / _math.sqrt(d)
 
     def to_packed(x, s):
@@ -763,11 +775,12 @@ def flash_attention_packed(query, key, value, causal=False, scale=None,
     seg_q = seg_k = None
     if segment_ids is not None:
         def as_seg(ids, s_, what):
+            from ...analysis._jaxpr_utils import fmt_shape
             ids = jnp.asarray(ids, jnp.int32)
             if ids.shape != (b, s_):
                 raise ValueError(
-                    f"{what} must be [batch, seq] = ({b}, {s_}); "
-                    f"got {ids.shape}")
+                    f"{what} must be [batch, seq] = {fmt_shape((b, s_))}; "
+                    f"got {fmt_shape(ids.shape)}")
             return ids.reshape(b, 1, s_)
         seg_q = as_seg(segment_ids, sq, "segment_ids")
         sk_ids = segment_ids_k if segment_ids_k is not None else \

@@ -1,0 +1,89 @@
+"""AST repo lint: the paddle_tpu tree must be free of error-severity
+project-rule violations (the fast, no-TPU tier-1 CI gate), and the rules
+themselves detect planted violations."""
+
+import os
+import textwrap
+
+from paddle_tpu.analysis import repo_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_snippet(tmp_path, code, relpath):
+    p = tmp_path / os.path.basename(relpath)
+    p.write_text(textwrap.dedent(code))
+    return repo_lint.lint_file(str(p), relpath)
+
+
+def test_repo_tree_has_no_error_findings():
+    diags = repo_lint.lint_tree(REPO)
+    errors = [d for d in diags if d.severity == "error"]
+    assert errors == [], "\n".join(d.format() for d in errors)
+
+
+def test_r001_host_clock_in_kernel_module(tmp_path):
+    diags = _lint_snippet(tmp_path, """
+        import time
+        def kernel():
+            t0 = time.time()
+            return t0
+        """, "paddle_tpu/ops/_pallas/fake_kernel.py")
+    assert any(d.rule == "R001" and d.severity == "error" for d in diags)
+    # same code outside a kernel module: no finding
+    diags = _lint_snippet(tmp_path, """
+        import time
+        def host():
+            return time.time()
+        """, "paddle_tpu/profiler/fake.py")
+    assert not any(d.rule == "R001" for d in diags)
+
+
+def test_r002_constant_prngkey_outside_tests(tmp_path):
+    diags = _lint_snippet(tmp_path, """
+        import jax
+        def f():
+            return jax.random.PRNGKey(0)
+        """, "paddle_tpu/nn/fake.py")
+    assert any(d.rule == "R002" for d in diags)
+    # in tests/: allowed
+    diags = _lint_snippet(tmp_path, """
+        import jax
+        def f():
+            return jax.random.PRNGKey(0)
+        """, "tests/test_fake.py")
+    assert not any(d.rule == "R002" for d in diags)
+
+
+def test_r003_env_flag_bypass(tmp_path):
+    diags = _lint_snippet(tmp_path, """
+        import os
+        val = os.environ.get("FLAGS_check_nan_inf")
+        other = os.environ["FLAGS_log_level"]
+        """, "paddle_tpu/fake_subsys.py")
+    r3 = [d for d in diags if d.rule == "R003"]
+    assert len(r3) == 2 and all(d.severity == "error" for d in r3)
+    # core/flags.py itself is the registry — exempt
+    diags = _lint_snippet(tmp_path, """
+        import os
+        val = os.environ.get("FLAGS_check_nan_inf")
+        """, "paddle_tpu/core/flags.py")
+    assert not any(d.rule == "R003" for d in diags)
+
+
+def test_allow_marker_suppresses(tmp_path):
+    diags = _lint_snippet(tmp_path, """
+        import jax
+        def f():
+            return jax.random.PRNGKey(0)  # repo-lint: allow R002
+        """, "paddle_tpu/nn/fake.py")
+    assert not any(d.rule == "R002" for d in diags)
+
+
+def test_diagnostics_carry_file_and_line(tmp_path):
+    diags = _lint_snippet(tmp_path, """
+        import jax
+        k = jax.random.PRNGKey(42)
+        """, "paddle_tpu/nn/fake.py")
+    d = next(d for d in diags if d.rule == "R002")
+    assert d.source.endswith("fake.py:3")

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Lint the traced graphs of the repo's example models (and, with --all,
+the Pallas kernel configs and the source tree) with paddle_tpu.analysis.
+
+    python tools/lint_graph.py --model bert          # one model, CPU, fast
+    python tools/lint_graph.py --all                 # models + kernels + AST
+    python tools/lint_graph.py --model gpt --min-severity info
+
+Exits nonzero when any error-severity diagnostic is found — the CI gate
+that needs no TPU. Clean models print their diagnostic count (0) and the
+jaxpr size, so regressions in graph hygiene show up in review.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_layer(layer, args, where):
+    from paddle_tpu.framework.functional import functional_call, get_params
+    from paddle_tpu.analysis import lint_jaxpr
+    layer.eval()  # inference view: dropout off, no host RNG pulls
+    params = get_params(layer)
+    closed = jax.make_jaxpr(
+        lambda p, *a: functional_call(layer, p, *a))(params, *args)
+    diags = lint_jaxpr(closed, where=where)
+    return diags, len(closed.jaxpr.eqns)
+
+
+def lint_bert():
+    from paddle_tpu.text.models.bert import Bert, bert_tiny
+    ids = jnp.zeros((2, 128), jnp.int32)
+    return _lint_layer(Bert(bert_tiny()), (ids,), "bert")
+
+
+def lint_gpt():
+    from paddle_tpu.text.models.gpt import GPT, gpt_tiny
+    ids = jnp.zeros((2, 128), jnp.int32)
+    return _lint_layer(GPT(gpt_tiny()), (ids,), "gpt")
+
+
+def lint_mlp():
+    from paddle_tpu import nn
+    net = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 10))
+    return _lint_layer(net, (jnp.zeros((4, 64), jnp.float32),), "mlp")
+
+
+MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp}
+
+_SEV_RANK = {"info": 0, "warning": 1, "error": 2}
+
+
+def run(models, with_kernels=False, with_repo=False, min_severity="info"):
+    from paddle_tpu.analysis import check_kernel_spec, repo_lint
+    from paddle_tpu.core import flags as core_flags
+    all_diags = []
+    for name in models:
+        diags, n_eqns = MODELS[name]()
+        shown = [d for d in diags
+                 if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]]
+        print(f"== {name}: {n_eqns} eqns, {len(diags)} diagnostic(s)")
+        for d in shown:
+            print("  " + d.format())
+        all_diags += diags
+    if with_kernels:
+        from paddle_tpu.analysis import spec_for_flash_packed, spec_for_flash
+        from paddle_tpu.ops._pallas.flash_attention_packed import (
+            _pick_blocks_packed, pack_group, HEAD_D)
+        print("== pallas kernel configs")
+        for sq, sk, h in ((512, 512, 12), (1024, 1024, 16)):
+            g = pack_group(h) or 2
+            dp = g * HEAD_D
+            for bwd in (False, True):
+                bq, bk = _pick_blocks_packed(sq, sk, dp, bwd=bwd)
+                spec = spec_for_flash_packed(sq, sk, dp, bq, bk, g, bwd=bwd)
+                diags = check_kernel_spec(spec)
+                tag = f"{spec.name} sq{sq} sk{sk} g{g} blocks {bq}x{bk}"
+                print(f"  {tag}: {len(diags)} diagnostic(s)")
+                for d in diags:
+                    print("    " + d.format())
+                all_diags += diags
+    if with_repo:
+        print("== repo AST lint (paddle_tpu/)")
+        diags = repo_lint.lint_tree(REPO)
+        for d in diags:
+            if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]:
+                print("  " + d.format())
+        all_diags += diags
+        unknown = core_flags.unknown_env_flags()
+        if unknown:
+            print(f"  note: unrecognized FLAGS_* env vars: {unknown}")
+    errors = [d for d in all_diags if d.severity == "error"]
+    print(f"total: {len(all_diags)} diagnostic(s), {len(errors)} error(s)")
+    return 1 if errors else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--model", choices=sorted(MODELS), action="append",
+                   help="model graph(s) to lint (repeatable)")
+    p.add_argument("--all", action="store_true",
+                   help="lint every model + pallas kernel configs + repo AST")
+    p.add_argument("--min-severity", choices=["info", "warning", "error"],
+                   default="info", help="only print findings at or above")
+    a = p.parse_args(argv)
+    if a.all:
+        models = sorted(MODELS)
+    else:
+        models = a.model or ["bert"]
+    return run(models, with_kernels=a.all, with_repo=a.all,
+               min_severity=a.min_severity)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
