@@ -35,7 +35,10 @@ selection logic is fault-injection-tested in tests/test_bench_guard.py.
 
 Env: BENCH_SMALL=1 (CPU smoke), BENCH_CONFIGS=gpt|all (default all),
 BENCH_LAYERS/HIDDEN/HEADS/SEQ/BATCH/STEPS/REMAT/PEAK_TFLOPS,
-BENCH_WINDOWS/ANOMALY_FACTOR/RETRY_WINDOWS (guard knobs).
+BENCH_WINDOWS/ANOMALY_FACTOR/RETRY_WINDOWS (guard knobs),
+BENCH_PALLAS_CONV=1 (Pallas-vs-XLA conv A/B: per-shape device-time table
+at the top-3 ResNet byte shapes + the full-graph ResNet step with
+FLAGS_pallas_conv=1 — the table VERDICT r5 asks the next chip round for).
 """
 
 from __future__ import annotations
@@ -371,11 +374,93 @@ def bench_resnet(small: bool):
     dt_used = m["used_s"]
     imgs_s = batch / dt_used
     mfu = flops / dt_used / _peak_flops(dev) if flops else 0.0
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.nn import fused_conv_bn  # noqa: F401  (defines flag)
+    from paddle_tpu.ops._pallas import conv as _pconv  # noqa: F401
     _emit("resnet50_dp_imgs_per_sec_per_chip", imgs_s, "imgs/sec/chip", mfu,
           {"loss": m["loss"], "batch": batch, "img": img,
            "step_ms": round(dt_used * 1e3, 2),
+           "pallas_conv": int(bool(_flags.flag("pallas_conv"))),
+           "fused_conv_bn": int(bool(_flags.flag("fused_conv_bn"))),
            **_guard_extra(m),
            "baseline_config": 2})
+
+
+# ---------------------------------------------------------------------------
+# BENCH_PALLAS_CONV=1: the Pallas-vs-XLA conv A/B VERDICT r5 demands —
+# a per-shape device-time table at the top-3 ResNet byte shapes, then the
+# full-graph ResNet step with the kernels swapped into the fused units
+# ---------------------------------------------------------------------------
+
+def bench_pallas_conv_ab(small: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.nn import fused_conv_bn  # noqa: F401  (defines flag)
+    from paddle_tpu.ops._pallas import conv as pconv
+    from paddle_tpu.ops._pallas.autotune import _measure
+
+    shapes = [(k, 2 if small else n, h, w, ci, co, s)
+              for k, n, h, w, ci, co, s in pconv.RESNET50_TOP3_SHAPES]
+    if not small:
+        # register block configs in the persistent device-time cache so
+        # the full-graph run below traces against tuned blocks
+        try:
+            pconv.tune_conv_shapes()
+        except Exception:
+            pass
+    rng = np.random.default_rng(0)
+    rows = []
+    for kind, n, h, w, cin, cout, s_ in shapes:
+        k = 1 if kind == "conv1x1" else 3
+        pad = (0, 0) if k == 1 else (1, 1)
+        stride = (s_, s_)
+        x = jnp.asarray(rng.standard_normal((n, h, w, cin)), jnp.bfloat16)
+        wgt = jnp.asarray(rng.standard_normal((cout, cin, k, k)) * 0.05,
+                          jnp.bfloat16)
+        scale = jnp.ones((cin,), jnp.float32)
+        shift = jnp.zeros((cin,), jnp.float32)
+
+        pallas_fn = jax.jit(functools.partial(
+            pconv.conv2d_fwd, act="relu", stride=stride, padding=pad))
+
+        dn = lax.conv_dimension_numbers(x.shape, wgt.shape,
+                                        ("NHWC", "OIHW", "NHWC"))
+
+        @jax.jit
+        def xla_fn(x, wgt, scale, shift):
+            a = jnp.maximum(x * scale.astype(x.dtype) +
+                            shift.astype(x.dtype), 0)
+            o = lax.conv_general_dilated(
+                a, wgt, stride, [(pad[0], pad[0]), (pad[1], pad[1])],
+                dimension_numbers=dn)
+            of = o.astype(jnp.float32)
+            return o, jnp.sum(of, (0, 1, 2)), jnp.sum(of * of, (0, 1, 2))
+
+        row = {"shape": f"{kind} n{n} {h}x{w} {cin}->{cout} s{s_}"}
+        for tag, fn in (("pallas_ms", pallas_fn), ("xla_ms", xla_fn)):
+            try:
+                row[tag] = round(_measure(
+                    lambda: fn(x, wgt, scale, shift), 2, 5), 4)
+            except Exception as e:
+                row[tag] = None
+                row[tag + "_error"] = str(e)[:200]
+        if row.get("pallas_ms") and row.get("xla_ms"):
+            row["speedup"] = round(row["xla_ms"] / row["pallas_ms"], 3)
+        rows.append(row)
+    _emit("pallas_conv_shape_ab", len(rows), "shapes", 0.0,
+          {"table": rows, "note": "fused fwd (BN prologue + stats "
+           "epilogue) per shape, device time; full-graph A/B follows as "
+           "resnet50_dp with pallas_conv=1"})
+    # full-graph A/B: the same guarded ResNet measurement with the Pallas
+    # kernels swapped into the fused_conv_bn units end-to-end
+    prev = _flags.get_flags(["fused_conv_bn", "pallas_conv"])
+    _flags.set_flags({"fused_conv_bn": 1, "pallas_conv": 1})
+    try:
+        bench_resnet(small)
+    finally:
+        _flags.set_flags(prev)
 
 
 # ---------------------------------------------------------------------------
@@ -830,6 +915,13 @@ def main():
             except Exception as e:  # secondary configs must not kill the run
                 print(json.dumps({"metric": f"{fn.__name__}_FAILED",
                                   "error": str(e)[:500]}), flush=True)
+    if os.environ.get("BENCH_PALLAS_CONV") == "1" and (
+            "all" in selected or "resnet" in selected):
+        try:
+            bench_pallas_conv_ab(small)
+        except Exception as e:
+            print(json.dumps({"metric": "bench_pallas_conv_ab_FAILED",
+                              "error": str(e)[:500]}), flush=True)
     if "all" in selected or "gpt" in selected:
         bench_gpt(small)  # primary: printed last
 

@@ -24,6 +24,7 @@ from .jaxpr_lint import (Diagnostic, GraphLintError, lint_jaxpr,  # noqa: F401
                          analysis_mode, ERROR, WARNING, INFO)
 from .pallas_check import (KernelSpec, BlockUse, check_kernel_spec,  # noqa: F401
                            spec_for_flash_packed, spec_for_flash,
+                           spec_for_conv_matmul, spec_for_conv3x3,
                            check_jaxpr_pallas, VMEM_BUDGET)
 from . import repo_lint  # noqa: F401
 from . import _jaxpr_utils as jaxpr_utils  # noqa: F401
@@ -33,6 +34,7 @@ __all__ = [
     "register_rule", "all_rules", "emit", "analysis_mode",
     "ERROR", "WARNING", "INFO",
     "KernelSpec", "BlockUse", "check_kernel_spec",
-    "spec_for_flash_packed", "spec_for_flash", "check_jaxpr_pallas",
+    "spec_for_flash_packed", "spec_for_flash", "spec_for_conv_matmul",
+    "spec_for_conv3x3", "check_jaxpr_pallas",
     "VMEM_BUDGET", "repo_lint", "jaxpr_utils",
 ]

@@ -10,14 +10,20 @@ enforced, explainable diagnostics.
 
 Checked per :class:`KernelSpec`:
   P001  estimated VMEM footprint (block tiles + scratch + live score
-        temporaries) vs the ~16MB per-core budget            [error]
+        temporaries + in-kernel im2col tiles) vs the ~16MB
+        per-core budget                                      [error]
   P002  tile alignment: last dim % 128, second-minor % dtype sublane
         (8 f32 / 16 bf16 / 32 int8)                          [warning]
   P003  grid/block divisibility: every blocked dim must divide [error]
   P004  a single score tile consuming over half the budget    [warning]
 
 ``enforce`` is the kernel-side hook: builds the spec, checks, and routes
-through :func:`jaxpr_lint.emit` under ``FLAGS_static_analysis``.
+through :func:`jaxpr_lint.emit` under ``FLAGS_static_analysis``. The
+conv kernel family (``ops/_pallas/conv.py``) declares its im2col working
+set (the nine VMEM-assembled tap tiles plus the f32 accumulator) via
+:attr:`KernelSpec.im2col`, so the budget check covers the one footprint
+a BlockSpec reading misses; its ``supports()`` routability test refuses
+any config these checks reject (fallback to lax, never a Mosaic error).
 """
 
 from __future__ import annotations
@@ -31,8 +37,8 @@ from ._jaxpr_utils import fmt_shape
 from .jaxpr_lint import Diagnostic, ERROR, WARNING, emit
 
 __all__ = ["VMEM_BUDGET", "KernelSpec", "BlockUse", "check_kernel_spec",
-           "spec_for_flash_packed", "spec_for_flash", "enforce",
-           "check_jaxpr_pallas"]
+           "spec_for_flash_packed", "spec_for_flash", "spec_for_conv_matmul",
+           "spec_for_conv3x3", "enforce", "check_jaxpr_pallas"]
 
 # Mosaic's scoped-VMEM stack per core (v4/v5 generations): ~16 MB.
 VMEM_BUDGET = 16 * 1024 * 1024
@@ -68,6 +74,9 @@ class KernelSpec:
     # flash-style kernels: (block_q, block_k, live_f32_temporaries) — the
     # [bq, bk] score/probability tiles Mosaic keeps on the scoped stack
     score_tile: Optional[Tuple[int, int, int]] = None
+    # conv-style kernels: VMEM-assembled im2col tap tiles + accumulators
+    # that never appear in any BlockSpec (live kernel temporaries)
+    im2col: List[BlockUse] = field(default_factory=list)
 
 
 def _vmem_estimate(spec: KernelSpec) -> Tuple[int, str]:
@@ -77,10 +86,13 @@ def _vmem_estimate(spec: KernelSpec) -> Tuple[int, str]:
     if spec.score_tile:
         bq, bk, live = spec.score_tile
         score_b = bq * bk * 4 * live
-    total = tile_b + scratch_b + score_b
+    im2col_b = sum(b.bytes() for b in spec.im2col)
+    total = tile_b + scratch_b + score_b + im2col_b
     detail = (f"{tile_b / 2**20:.1f}MB tiles + "
               f"{scratch_b / 2**20:.1f}MB scratch + "
               f"{score_b / 2**20:.1f}MB live score temporaries")
+    if spec.im2col:
+        detail += f" + {im2col_b / 2**20:.1f}MB im2col tiles"
     return total, detail
 
 
@@ -96,7 +108,7 @@ def check_kernel_spec(spec: KernelSpec) -> List[Diagnostic]:
                      "Mosaic will fail or spill"),
             hint="shrink block_q/block_k (the packed flash backward caps "
                  "score tiles at 256) or stream over a larger grid"))
-    for b in spec.blocks + spec.scratch:
+    for b in spec.blocks + spec.scratch + spec.im2col:
         if len(b.shape) < 2:
             continue
         minor = int(b.shape[-1])
@@ -176,6 +188,75 @@ def spec_for_flash_packed(seq_q: int, seq_k: int, packed_d: int,
         blocks=blocks, scratch=scratch,
         dims=[("seq_q", seq_q, bq), ("seq_k", seq_k, bk)],
         score_tile=(bq, bk, live))
+
+
+def spec_for_conv_matmul(m: int, cin: int, cout: int, block_m: int,
+                         dtype=np.float32, wgrad: bool = False) -> KernelSpec:
+    """Spec for the 1x1-as-matmul conv kernels of ``ops/_pallas/conv.py``
+    (forward/dgrad share a kernel; ``wgrad=True`` models the a^T@dy
+    accumulator, whose f32 [Cin, Cout] scratch is the footprint risk)."""
+    dt = np.dtype(dtype)
+    bm = min(block_m, m)
+    blocks = [BlockUse((bm, cin), dt, "x"),
+              BlockUse((1, cin), np.float32, "scale"),
+              BlockUse((1, cin), np.float32, "shift")]
+    if wgrad:
+        blocks += [BlockUse((bm, cout), dt, "dy"),
+                   BlockUse((cin, cout), np.float32, "dw")]
+        scratch = [BlockUse((cin, cout), np.float32, "dw_acc")]
+    else:
+        blocks += [BlockUse((cin, cout), dt, "w"),
+                   BlockUse((bm, cout), dt, "y"),
+                   BlockUse((1, cout), np.float32, "s"),
+                   BlockUse((1, cout), np.float32, "ss")]
+        scratch = [BlockUse((1, cout), np.float32, "s_acc"),
+                   BlockUse((1, cout), np.float32, "ss_acc")]
+    # the f32 MXU accumulator tile is live alongside the operand tiles
+    im2col = [BlockUse((bm, cout) if not wgrad else (cin, cout),
+                       np.float32, "acc")]
+    return KernelSpec(
+        name="pallas_conv1x1" + ("_wgrad" if wgrad else ""),
+        grid=(1, max(1, m // bm)),
+        blocks=blocks, scratch=scratch, im2col=im2col,
+        dims=[("m", m, bm)])
+
+
+def spec_for_conv3x3(n: int, h: int, w: int, c: int, cout: int,
+                     block_h: int, stride: int, dtype=np.float32,
+                     pad: int = 1, wgrad: bool = False) -> KernelSpec:
+    """Spec for the NHWC 3x3 conv kernels at one block configuration.
+
+    The padded image rides VMEM whole per batch index; each grid step
+    assembles nine [block_h*Wo, C] im2col tap tiles in VMEM next to the
+    f32 [block_h*Wo, Cout] accumulator — the footprint a BlockSpec
+    reading misses, declared via ``im2col``."""
+    dt = np.dtype(dtype)
+    hp, wp = h + 2 * pad, w + 2 * pad
+    ho = (hp - 3) // stride + 1
+    wo = (wp - 3) // stride + 1
+    bh = min(block_h, ho)
+    blocks = [BlockUse((hp, wp, c), dt, "image"),
+              BlockUse((9, c, cout), dt, "taps"),
+              BlockUse((1, c), np.float32, "scale"),
+              BlockUse((1, c), np.float32, "shift")]
+    if wgrad:
+        blocks += [BlockUse((bh, wo, cout), dt, "dy"),
+                   BlockUse((9, c, cout), np.float32, "dw")]
+        scratch = [BlockUse((9, c, cout), np.float32, "dw_acc")]
+        acc = BlockUse((c, cout), np.float32, "tap_acc")
+    else:
+        blocks += [BlockUse((bh, wo, cout), dt, "y"),
+                   BlockUse((1, cout), np.float32, "s"),
+                   BlockUse((1, cout), np.float32, "ss")]
+        scratch = [BlockUse((1, cout), np.float32, "s_acc"),
+                   BlockUse((1, cout), np.float32, "ss_acc")]
+        acc = BlockUse((bh * wo, cout), np.float32, "acc")
+    im2col = [BlockUse((bh * wo, c), dt, "im2col tap"), acc]
+    return KernelSpec(
+        name="pallas_conv3x3" + ("_wgrad" if wgrad else ""),
+        grid=(n, max(1, ho // bh)),
+        blocks=blocks, scratch=scratch, im2col=im2col,
+        dims=[("h_out", ho, bh)])
 
 
 def spec_for_flash(seq_q: int, seq_k: int, head_d: int, block_q: int,

@@ -29,6 +29,15 @@ All units are shape-polymorphic over NHWC (channels on the 128-lane minor
 dim) and express the conv via lax.conv_general_dilated / a 1x1-as-matmul
 fast path, so the MXU mapping is XLA's own; backward uses
 jax.linear_transpose of the conv (no forward re-execution).
+
+``FLAGS_pallas_conv`` swaps the conv expression inside these units for
+the Pallas kernel family (``ops/_pallas/conv.py``): the BN+ReLU prologue
+and the stat epilogue then run *inside* the kernel (true cuDNN-style
+fusion, not XLA operand fusion), and backward goes through the Pallas
+dgrad/wgrad pair with the prologue recomputed in-kernel. Unsupported
+shapes (groups, dilation, non-1x1/3x3, over-VMEM configs) fall back to
+the lax path inside the same custom_vjp boundaries, so the unit-level
+semantics — what is saved, how BN grads close — are flag-invariant.
 """
 
 from __future__ import annotations
@@ -128,6 +137,43 @@ def _bn_closed_form_dx(da, u, mean, r, gamma):
 
 
 # ---------------------------------------------------------------------------
+# Pallas routing: FLAGS_pallas_conv sends supported (1x1 / NHWC 3x3 s1-s2)
+# convs through ops/_pallas/conv.py with in-kernel prologue + stat epilogue
+# ---------------------------------------------------------------------------
+
+def _pallas_conv():
+    from ..ops._pallas import conv as _pc
+    return _pc
+
+
+def _pallas_route(x, w, stride, padding, dilation, groups) -> bool:
+    try:
+        _pc = _pallas_conv()
+    except Exception:
+        return False
+    if not _pc.pallas_conv_enabled():
+        return False
+    return _pc.supports(x.shape, w.shape, stride, padding, dilation,
+                        groups, x.dtype)
+
+
+def _pallas_grads(do, a_or_u, w, stride, padding, scale=None, shift=None,
+                  act="none", need_da=True, need_dw=True):
+    """dgrad/wgrad through the Pallas pair. When (scale, shift) are given
+    the wgrad kernel recomputes the BN+ReLU prologue from the raw input
+    in-kernel (only the pre-BN tensor was saved)."""
+    _pc = _pallas_conv()
+    da = dw = None
+    if need_da:
+        da = _pc.conv2d_dgrad(do, w, a_or_u.shape, stride,
+                              padding).astype(a_or_u.dtype)
+    if need_dw:
+        dw = _pc.conv2d_wgrad(a_or_u, do, w.shape, scale, shift, act,
+                              stride, padding).astype(w.dtype)
+    return da, dw
+
+
+# ---------------------------------------------------------------------------
 # Conv expression + its operand transposes (stride/pad/dilation/groups all
 # flow through lax; 1x1 stride-1 lowers to a plain matmul)
 # ---------------------------------------------------------------------------
@@ -183,6 +229,10 @@ def conv_stats(x, w, stride=(1, 1), padding=(0, 0), dilation=(1, 1),
     Returns (o [N,H',W',Cout], s [Cout] f32, ss [Cout] f32); s/ss are
     non-differentiable (their information re-enters through the consuming
     unit's closed-form BN backward)."""
+    if _pallas_route(x, w, stride, padding, dilation, groups):
+        o, s, ss = _pallas_conv().conv2d_fwd(x, w, stride=stride,
+                                             padding=padding)
+        return o, lax.stop_gradient(s), lax.stop_gradient(ss)
     o = _conv_expr(x, w, stride, padding, dilation, groups)
     s, ss = channel_stats(o)
     return o, s, ss
@@ -196,6 +246,8 @@ def _conv_stats_fwd(x, w, stride, padding, dilation, groups):
 def _conv_stats_bwd(stride, padding, dilation, groups, res, cts):
     x, w = res
     do, _ds, _dss = cts  # stats: no gradient path (closed form downstream)
+    if _pallas_route(x, w, stride, padding, dilation, groups):
+        return _pallas_grads(do, x, w, stride, padding)
     dx, dw = _conv_grads(do, x, w, stride, padding, dilation, groups)
     return dx, dw
 
@@ -216,6 +268,15 @@ def conv_bn_act(u, gamma, beta, s, ss, w, epsilon=1e-5, act="relu",
     (exact, from the producing unit — non-diff); gamma/beta: the BN params.
     The normalized activation exists only inside XLA's conv fusion, never
     in HBM. Returns (o, s_o, ss_o)."""
+    if _pallas_route(u, w, stride, padding, dilation, groups):
+        # BN+ReLU as an in-kernel prologue: fold (gamma, beta, stats) to a
+        # per-channel FMA and let the kernel apply it tile by tile
+        m = u.size // u.shape[-1]
+        mean, _, r = stats_to_moments(s, ss, m, epsilon)
+        scale, shift = _scale_shift(gamma, beta, mean, r)
+        o, s_o, ss_o = _pallas_conv().conv2d_fwd(
+            u, w, scale, shift, act=act, stride=stride, padding=padding)
+        return o, lax.stop_gradient(s_o), lax.stop_gradient(ss_o)
     a, _, _ = _apply_bn_act(u, gamma, beta, s, ss, epsilon, act)
     o = _conv_expr(a, w, stride, padding, dilation, groups)
     s_o, ss_o = channel_stats(o)
@@ -236,6 +297,16 @@ def _conv_bn_act_bwd(epsilon, act, stride, padding, dilation, groups,
     # Recompute the prologue (reads u; XLA sinks it into the wgrad conv
     # operand — the in-graph analogue of the flash-attention backward).
     a, mean, r = _apply_bn_act(u, gamma, beta, s, ss, epsilon, act)
+    if _pallas_route(u, w, stride, padding, dilation, groups):
+        # wgrad recomputes the prologue in-kernel from u (the saved raw
+        # tensor); dgrad runs the transposed Pallas conv
+        scale, shift = _scale_shift(gamma, beta, mean, r)
+        da, dw = _pallas_grads(do, u, w, stride, padding, scale, shift, act)
+        if act == "relu":
+            da = da * (a > 0)
+        du, dgamma, dbeta = _bn_closed_form_dx(da, u, mean, r, gamma)
+        return (du, dgamma, dbeta.astype(beta.dtype), jnp.zeros_like(s),
+                jnp.zeros_like(ss), dw)
     da, dw = _conv_grads(do, a, w, stride, padding, dilation, groups)
     if act == "relu":
         da = da * (a > 0)

@@ -30,6 +30,12 @@ def export(layer, path: str, input_spec=None, opset_version: int = 13,
 
     import jax.numpy as jnp
 
+    if opset_version < 13:
+        # the converter only emits opset-13 forms (Mod/fmod, Squeeze with
+        # axes-as-input, ...); stamping a lower opset would mislabel them
+        raise ValueError(
+            f"opset_version must be >= 13, got {opset_version} (the "
+            "converter emits opset-13 operator forms only)")
     if input_spec is None:
         raise ValueError("onnx.export requires input_spec")
     example = []

@@ -48,7 +48,7 @@ _DTYPES = {
 # prim -> ONNX op for trivial 1:1 elementwise cases
 _SIMPLE = {
     "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
-    "max": "Max", "min": "Min", "pow": "Pow", "rem": "Mod",
+    "max": "Max", "min": "Min", "pow": "Pow",
     "neg": "Neg", "exp": "Exp", "log": "Log", "tanh": "Tanh",
     "sqrt": "Sqrt", "erf": "Erf", "logistic": "Sigmoid", "abs": "Abs",
     "sign": "Sign", "floor": "Floor", "ceil": "Ceil", "round": "Round",
@@ -301,6 +301,13 @@ class JaxprToOnnx:
     def _op_ne(self, eqn):
         e = self.node("Equal", self._ins(eqn))
         self._set(eqn.outvars[0], self.node("Not", [e]))
+
+    def _op_rem(self, eqn):
+        # lax.rem is C-style truncated remainder (sign of the dividend)
+        # for ints AND floats; ONNX Mod defaults to fmod=0 (Python
+        # flooring semantics, sign of the divisor) and the spec forbids
+        # fmod=0 on float tensors — emit fmod=1 explicitly.
+        self._set(eqn.outvars[0], self.node("Mod", self._ins(eqn), fmod=1))
 
     def _op_name(self, eqn):
         # jax.ad_checkpoint.checkpoint_name — remat metadata, a no-op here
@@ -678,6 +685,13 @@ class JaxprToOnnx:
             parts.append(self.node(
                 "Reshape", [n, self._i64([1], "shape")]))
         st = self.node("Concat", parts, axis=0)
+        # jax clamps out-of-range starts to max(0, min(start, dim - size));
+        # ONNX Slice clamps ENDS but a start past the dim yields an empty
+        # (wrong-shaped) slice — reproduce the jax clamp explicitly
+        dims = [int(d) for d in x.aval.shape]
+        st = self.node("Min", [st, self._i64(
+            [d - s for d, s in zip(dims, sizes)], "maxstart")])
+        st = self.node("Max", [st, self._i64([0] * len(sizes), "zeros")])
         en = self.node("Add", [st, self._i64(list(sizes), "sizes")])
         axes = self._i64(list(range(len(sizes))), "axes")
         self._set(eqn.outvars[0], self.node(

@@ -140,11 +140,18 @@ def _run_node(node, ins):
     if op in unary:
         r = unary[op](x)
         return [r.astype(x.dtype) if op not in ("Not",) else r]
+    if op == "Mod":
+        # fmod=1 -> C fmod (truncated, sign of dividend; what lax.rem
+        # exports); fmod=0 -> Python flooring mod (ints only per spec)
+        fn = np.fmod if at.get("fmod", 0) else np.mod
+        return [np.asarray(fn(ins[0], ins[1]), ins[0].dtype)]
     binary = {
         "Add": np.add, "Sub": np.subtract, "Mul": np.multiply,
+        # ONNX Div on ints truncates toward zero (C semantics), NOT
+        # numpy's floor division — (-7)//2 = -4 but Div(-7, 2) = -3
         "Div": lambda a, b: (a / b if np.issubdtype(a.dtype, np.floating)
-                             else a // b),
-        "Pow": np.power, "Mod": np.fmod, "Max": np.maximum,
+                             else np.trunc(np.true_divide(a, b))),
+        "Pow": np.power, "Max": np.maximum,
         "Min": np.minimum, "And": np.logical_and, "Or": np.logical_or,
         "Xor": np.logical_xor,
     }

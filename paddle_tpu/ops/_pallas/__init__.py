@@ -4,4 +4,9 @@ The reference vendors CUTLASS flash-attention and hand-fused CUDA kernels
 (``paddle/phi/kernels/gpu/flash_attn_kernel.cu``, ``fluid/operators/fused/``).
 Here the equivalents are Pallas kernels tiled for the MXU; everything else is
 left to XLA fusion.
+
+Modules: ``flash_attention`` / ``flash_attention_packed`` (attention),
+``fused_matmul_bn`` (isolated 1x1+BN prototype), ``conv`` (the conv kernel
+family with in-kernel BN epilogues — fwd/dgrad/wgrad, FLAGS_pallas_conv),
+``autotune`` (persistent device-time block-config cache).
 """

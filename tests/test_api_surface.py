@@ -364,16 +364,21 @@ class TestHubAndMisc:
         assert paddle.callbacks.EarlyStopping is not None
 
     def test_onnx_export_roundtrip(self):
+        # r5 made onnx.export emit a real .onnx protobuf (no jit.save
+        # bundle); assert the round-trip through the in-repo loader,
+        # structural checker, and numpy reference evaluator
         net = paddle.nn.Linear(4, 2)
         net.eval()
         x = jnp.ones((1, 4), jnp.float32)
         ref = net(x)
         with tempfile.TemporaryDirectory() as d:
-            prefix = paddle.onnx.export(net, os.path.join(d, "m.onnx"),
-                                        input_spec=[x])
-            loaded = paddle.jit.load(prefix)
-            np.testing.assert_allclose(np.asarray(loaded(x)),
-                                       np.asarray(ref), atol=1e-6)
+            path = paddle.onnx.export(net, os.path.join(d, "m.onnx"),
+                                      input_spec=[x])
+            assert path.endswith(".onnx")
+            model = paddle.onnx.load_model(path)
+            paddle.onnx.check_model(model)
+            got = paddle.onnx.run_model(model, np.asarray(x))[0]
+            np.testing.assert_allclose(got, np.asarray(ref), atol=1e-6)
 
 
 def test_full_reference_top_level_all_covered():

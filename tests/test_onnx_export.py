@@ -191,3 +191,90 @@ def test_unsupported_primitive_raises(tmp_path):
     x = np.random.default_rng(6).standard_normal((8,)).astype(np.float32)
     with pytest.raises(NotImplementedError):
         ponnx.export(fn, str(tmp_path / "bad.onnx"), input_spec=[x])
+
+
+# ---------------------------------------------------------------------------
+# opset / numeric-semantics oracle tests (VERDICT weak-spot fixes): the
+# exporter and the numpy runtime must agree with JAX on the signed cases
+# where ONNX defaults diverge (Mod fmod, integer Div, dynamic-slice clamp)
+# ---------------------------------------------------------------------------
+
+def test_opset_below_13_rejected(tmp_path):
+    m = nn.Linear(2, 2)
+    with pytest.raises(ValueError, match="opset_version"):
+        ponnx.export(m, str(tmp_path / "old.onnx"),
+                     input_spec=[((1, 2), "float32")], opset_version=11)
+
+
+def _export_fn(fn, specs, tmp_path, name):
+    path = ponnx.export(fn, str(tmp_path / name), input_spec=specs)
+    model = ponnx.load_model(path)
+    ponnx.check_model(model)
+    return model
+
+
+def test_rem_exports_mod_fmod1_float_negative_operands(tmp_path):
+    import jax
+
+    def fn(a, b):
+        return jax.lax.rem(a, b)
+
+    model = _export_fn(fn, [((4,), "float32"), ((4,), "float32")],
+                       tmp_path, "remf.onnx")
+    mods = [n for n in model.graph.node if n.op_type == "Mod"]
+    assert mods, "lax.rem must export as Mod"
+    at = {a.name: a.i for a in mods[0].attribute}
+    assert at.get("fmod") == 1, "float Mod with fmod=0 is spec-invalid"
+    a = np.array([-7.5, 7.5, -7.5, 7.5], np.float32)
+    b = np.array([2.0, -2.0, 3.0, -3.0], np.float32)
+    got = ponnx.run_model(model, a, b)[0]
+    np.testing.assert_allclose(got, np.asarray(jax.lax.rem(a, b)),
+                               atol=1e-6)
+
+
+def test_rem_int_truncated_semantics(tmp_path):
+    import jax
+
+    def fn(a, b):
+        return jax.lax.rem(a, b)
+
+    model = _export_fn(fn, [((4,), "int32"), ((4,), "int32")],
+                       tmp_path, "remi.onnx")
+    a = np.array([-7, 7, -7, 7], np.int32)
+    b = np.array([2, -2, 3, -3], np.int32)
+    got = ponnx.run_model(model, a, b)[0]
+    # lax.rem: sign of the DIVIDEND (C semantics): [-1, 1, -1, 1]
+    np.testing.assert_array_equal(got, np.asarray(jax.lax.rem(a, b)))
+
+
+def test_div_int_truncates_toward_zero(tmp_path):
+    import jax
+
+    def fn(a, b):
+        return jax.lax.div(a, b)
+
+    model = _export_fn(fn, [((4,), "int32"), ((4,), "int32")],
+                       tmp_path, "divi.onnx")
+    a = np.array([-7, 7, -7, 7], np.int32)
+    b = np.array([2, -2, 3, -3], np.int32)
+    got = ponnx.run_model(model, a, b)[0]
+    # lax.div on ints truncates toward zero: [-3, -3, -2, -2]; numpy's
+    # floor division would give [-4, -4, -3, -3]
+    np.testing.assert_array_equal(got, np.asarray(jax.lax.div(a, b)))
+    assert got.tolist() == [-3, -3, -2, -2]
+
+
+def test_dynamic_slice_start_clamped_like_jax(tmp_path):
+    import jax
+
+    def fn(x, i):
+        return jax.lax.dynamic_slice(x, (i,), (3,))
+
+    model = _export_fn(fn, [((5,), "float32"), ((), "int32")],
+                       tmp_path, "dslice.onnx")
+    x = np.arange(5, dtype=np.float32)
+    for start in (0, 1, 4, 7):  # 4 and 7 exceed dim - size = 2
+        i = np.asarray(start, np.int32)
+        got = ponnx.run_model(model, x, i)[0]
+        want = np.asarray(jax.lax.dynamic_slice(x, (i,), (3,)))
+        np.testing.assert_allclose(got, want, err_msg=f"start={start}")

@@ -1,0 +1,302 @@
+"""Parity + gradient tests for the Pallas conv kernel family
+(``ops/_pallas/conv.py`` — VERDICT r5 missing #2).
+
+Kernels run in Pallas interpret mode on CPU (the module resolves
+``interpret`` from the backend, so no monkeypatching is needed): values,
+dgrad/wgrad, and the BN prologue/stat-epilogue must match
+``lax.conv_general_dilated`` autodiff at the top-3 byte-dominant
+ResNet-50 shape classes (``RESNET50_TOP3_SHAPES``, batch scaled to 2 for
+CPU runtime), stride 1 and 2, f32 tight and bf16 loose. The end-to-end
+block tests prove ``FLAGS_pallas_conv=1`` swaps the kernels into the
+``nn/fused_conv_bn.py`` units: ResNet block forward AND backward run
+through the Pallas pair with unchanged unit semantics.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+
+from paddle_tpu.core import flags as _flags
+from paddle_tpu.nn import fused_conv_bn  # noqa: F401  (defines the flag)
+from paddle_tpu.ops._pallas import conv as pconv
+from paddle_tpu.ops._pallas.conv import RESNET50_TOP3_SHAPES
+
+
+def ref_conv(a, w, stride=(1, 1), padding=(0, 0)):
+    dn = lax.conv_dimension_numbers(a.shape, w.shape,
+                                    ("NHWC", "OIHW", "NHWC"))
+    return lax.conv_general_dilated(
+        a, w.astype(a.dtype), stride,
+        [(padding[0], padding[0]), (padding[1], padding[1])],
+        dimension_numbers=dn)
+
+
+def rand(*shape, key, dtype=jnp.float32, scale=1.0):
+    return jnp.asarray(
+        np.random.default_rng(key).standard_normal(shape) * scale, dtype)
+
+
+# the top-3 shape classes with batch scaled down for CPU interpret speed
+TOP3_SMALL = [(kind, 2, h, w, cin, cout)
+              for kind, _, h, w, cin, cout, _ in RESNET50_TOP3_SHAPES]
+
+
+def _case(kind, cin, cout, stride, h=8, w=8, dtype=jnp.float32):
+    k = 1 if kind == "conv1x1" else 3
+    pad = (0, 0) if k == 1 else (1, 1)
+    x = rand(2, h, w, cin, key=1, dtype=dtype)
+    wgt = rand(cout, cin, k, k, key=2, dtype=dtype, scale=0.1)
+    return x, wgt, (stride, stride), pad
+
+
+class TestTop3ShapeParity:
+    """Acceptance gate: fwd/bwd parity vs lax autodiff at the top-3
+    ``tools/resnet_bytes.py`` shape classes, stride 1 and 2."""
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    @pytest.mark.parametrize("kind,n,h,w,cin,cout", TOP3_SMALL)
+    def test_values_grads_and_stats(self, kind, n, h, w, cin, cout, stride):
+        k = 1 if kind == "conv1x1" else 3
+        pad = (0, 0) if k == 1 else (1, 1)
+        st = (stride, stride)
+        x = rand(n, h, w, cin, key=3)
+        wgt = rand(cout, cin, k, k, key=4, scale=0.1)
+        y, s, ss = pconv.conv2d_fwd(x, wgt, stride=st, padding=pad)
+        yr = ref_conv(x, wgt, st, pad)
+        np.testing.assert_allclose(y, yr, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(s, yr.sum((0, 1, 2)), rtol=1e-4,
+                                   atol=1e-3)
+        np.testing.assert_allclose(ss, (yr.astype(jnp.float32) ** 2
+                                        ).sum((0, 1, 2)), rtol=1e-4,
+                                   atol=1e-3)
+        cot = rand(*y.shape, key=5)
+        g = jax.grad(lambda x, w: jnp.sum(
+            pconv.conv2d(x, w, st, pad) * cot), argnums=(0, 1))(x, wgt)
+        gr = jax.grad(lambda x, w: jnp.sum(
+            ref_conv(x, w, st, pad) * cot), argnums=(0, 1))(x, wgt)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("kind,n,h,w,cin,cout", TOP3_SMALL)
+    def test_bf16_tolerance(self, kind, n, h, w, cin, cout):
+        k = 1 if kind == "conv1x1" else 3
+        pad = (0, 0) if k == 1 else (1, 1)
+        x = rand(n, h, w, cin, key=6, dtype=jnp.bfloat16)
+        wgt = rand(cout, cin, k, k, key=7, dtype=jnp.bfloat16, scale=0.1)
+        y, _, _ = pconv.conv2d_fwd(x, wgt, stride=(1, 1), padding=pad)
+        yr = ref_conv(x, wgt, (1, 1), pad)
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32), np.asarray(yr, np.float32),
+            rtol=2e-2, atol=2e-1)
+
+
+class TestPrologueEpilogue:
+    """With/without the in-kernel BN-apply(+ReLU) prologue and the
+    (sum, sumsq) epilogue, every kernel entry."""
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    @pytest.mark.parametrize("kind", ["conv1x1", "conv3x3"])
+    @pytest.mark.parametrize("act", ["none", "relu"])
+    def test_fwd_prologue(self, kind, act, stride):
+        x, wgt, st, pad = _case(kind, 8, 16, stride)
+        scale, shift = rand(8, key=8), rand(8, key=9)
+        y, s, ss = pconv.conv2d_fwd(x, wgt, scale, shift, act=act,
+                                    stride=st, padding=pad)
+        a = x * scale + shift
+        if act == "relu":
+            a = jnp.maximum(a, 0)
+        yr = ref_conv(a, wgt, st, pad)
+        np.testing.assert_allclose(y, yr, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(s, yr.sum((0, 1, 2)), rtol=1e-4,
+                                   atol=1e-4)
+        np.testing.assert_allclose(ss, (yr ** 2).sum((0, 1, 2)),
+                                   rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    @pytest.mark.parametrize("kind", ["conv1x1", "conv3x3"])
+    def test_wgrad_prologue_remat(self, kind, stride):
+        """wgrad recomputing act(x*scale+shift) in-kernel must equal
+        autodiff through the materialized activation."""
+        x, wgt, st, pad = _case(kind, 8, 16, stride)
+        scale, shift = rand(8, key=10), rand(8, key=11)
+        ho = 8 // stride
+        dy = rand(2, ho, ho, 16, key=12)
+        dw = pconv.conv2d_wgrad(x, dy, wgt.shape, scale, shift, "relu",
+                                st, pad)
+        dwr = jax.grad(lambda w: jnp.sum(ref_conv(
+            jnp.maximum(x * scale + shift, 0), w, st, pad) * dy))(wgt)
+        np.testing.assert_allclose(dw, dwr, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    @pytest.mark.parametrize("kind", ["conv1x1", "conv3x3"])
+    def test_dgrad_kernel(self, kind, stride):
+        x, wgt, st, pad = _case(kind, 8, 16, stride)
+        ho = 8 // stride
+        dy = rand(2, ho, ho, 16, key=13)
+        dx = pconv.conv2d_dgrad(dy, wgt, x.shape, st, pad)
+        dxr = jax.grad(lambda x: jnp.sum(
+            ref_conv(x, wgt, st, pad) * dy))(x)
+        np.testing.assert_allclose(dx, dxr, rtol=1e-4, atol=1e-4)
+
+    def test_stats_off_returns_zeros(self):
+        x, wgt, st, pad = _case("conv1x1", 8, 16, 1)
+        _, s, ss = pconv.conv2d_fwd(x, wgt, stride=st, padding=pad,
+                                    stats=False)
+        assert float(jnp.max(jnp.abs(s))) == 0.0
+        assert float(jnp.max(jnp.abs(ss))) == 0.0
+
+
+class TestFiniteDifference:
+    """Directional finite-difference check of the custom_vjp pair — the
+    oracle that does not share code with either implementation."""
+
+    @pytest.mark.parametrize("kind,stride", [("conv1x1", 1), ("conv1x1", 2),
+                                             ("conv3x3", 1), ("conv3x3", 2)])
+    def test_fd_directional(self, kind, stride):
+        x, wgt, st, pad = _case(kind, 8, 8, stride, h=4, w=4)
+        cot_shape = pconv.conv2d(x, wgt, st, pad).shape
+        cot = rand(*cot_shape, key=14)
+
+        def f(x, w):
+            return jnp.sum(pconv.conv2d(x, w, st, pad) * cot)
+
+        gx, gw = jax.grad(f, argnums=(0, 1))(x, wgt)
+        dx = rand(*x.shape, key=15, scale=1.0)
+        dw = rand(*wgt.shape, key=16, scale=1.0)
+        eps = 1e-3
+        fd = (f(x + eps * dx, wgt + eps * dw) -
+              f(x - eps * dx, wgt - eps * dw)) / (2 * eps)
+        analytic = jnp.sum(gx * dx) + jnp.sum(gw * dw)
+        np.testing.assert_allclose(float(fd), float(analytic), rtol=2e-3,
+                                   atol=2e-3)
+
+
+class TestRoutability:
+    def test_supports_matrix(self):
+        ok = functools.partial(pconv.supports, (2, 8, 8, 16))
+        assert ok((32, 16, 1, 1))
+        assert ok((32, 16, 3, 3), padding=(1, 1))
+        assert ok((32, 16, 3, 3), stride=(2, 2), padding=(1, 1))
+        assert not ok((32, 16, 3, 3))                    # pad 0 on 3x3
+        assert not ok((32, 16, 1, 1), padding=(1, 1))    # pad on 1x1
+        assert not ok((32, 16, 5, 5), padding=(2, 2))    # kernel size
+        assert not ok((32, 8, 3, 3), padding=(1, 1), groups=2)
+        assert not ok((32, 16, 3, 3), padding=(1, 1), dilation=(2, 2))
+        assert not ok((32, 16, 3, 3), stride=(3, 3), padding=(1, 1))
+
+    def test_supports_rejects_over_vmem(self):
+        # a 112x112x512 f32 image alone (~26 MB) can never fit the 16MB
+        # scoped-VMEM budget whatever the block config — must fall back
+        assert not pconv.supports((256, 112, 112, 512), (512, 512, 3, 3),
+                                  padding=(1, 1), dtype=jnp.float32)
+
+    def test_enforce_rejects_bad_block_under_error_mode(self):
+        from paddle_tpu.analysis import GraphLintError
+        prev = _flags.flag("static_analysis")
+        _flags.set_flags({"static_analysis": "error"})
+        try:
+            x = rand(2, 56, 56, 512, key=17)
+            wgt = rand(512, 512, 3, 3, key=18, scale=0.1)
+            with pytest.raises(GraphLintError) as ei:
+                pconv.conv2d_fwd(x, wgt, stride=(1, 1), padding=(1, 1),
+                                 block_h=56)
+            assert "P001" in str(ei.value)
+        finally:
+            _flags.set_flags({"static_analysis": prev})
+
+
+class TestFusedUnitIntegration:
+    """FLAGS_pallas_conv=1 swaps the kernels into the fused_conv_bn units
+    end-to-end: ResNet block forward+backward through the Pallas pair must
+    match the plain (both-flags-off) path — outputs, parameter grads,
+    running-stat buffer updates."""
+
+    def _run_block(self, model, x, pallas: bool):
+        from paddle_tpu.framework.functional import (functional_call,
+                                                     get_buffers, get_params)
+        prev = _flags.get_flags(["fused_conv_bn", "pallas_conv"])
+        _flags.set_flags({"fused_conv_bn": 1 if pallas else 0,
+                          "pallas_conv": 1 if pallas else 0})
+        try:
+            params = get_params(model)
+            buffers = get_buffers(model)
+
+            def loss_fn(p, x):
+                out, new_buf = functional_call(model, p, x, buffers=buffers,
+                                               mutable=True, training=True)
+                return jnp.sum(out * out), (out, new_buf)
+
+            (loss, (out, new_buf)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, x)
+            return out, grads, new_buf
+        finally:
+            _flags.set_flags(prev)
+
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_bottleneck_block_pallas_vs_plain(self, stride, monkeypatch):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.vision.models.resnet import BottleneckBlock
+        paddle.seed(0)
+        # count kernel entries so a silent supports() fallback can't fake
+        # a pass: fwd AND both backward kernels must actually run
+        calls = {"fwd": 0, "dgrad": 0, "wgrad": 0}
+        for name, fn in (("fwd", pconv.conv2d_fwd),
+                         ("dgrad", pconv.conv2d_dgrad),
+                         ("wgrad", pconv.conv2d_wgrad)):
+            def counted(*a, _name=name, _fn=fn, **kw):
+                calls[_name] += 1
+                return _fn(*a, **kw)
+            monkeypatch.setattr(pconv, f"conv2d_{name}", counted)
+        planes = 4
+        inplanes = planes * BottleneckBlock.expansion
+        downsample = None
+        if stride != 1:
+            downsample = nn.Sequential(
+                nn.Conv2D(inplanes, planes * BottleneckBlock.expansion, 1,
+                          stride=stride, bias_attr=False,
+                          data_format="NHWC"),
+                nn.BatchNorm2D(planes * BottleneckBlock.expansion,
+                               data_format="NHWC"),
+            )
+        block = BottleneckBlock(inplanes, planes, stride=stride,
+                                downsample=downsample, data_format="NHWC")
+        block.train()
+        x = rand(2, 8, 8, inplanes, key=19)
+        out_p, g_p, buf_p = self._run_block(block, x, pallas=True)
+        assert calls["fwd"] >= 3 and calls["dgrad"] >= 2 \
+            and calls["wgrad"] >= 3, calls
+        out_r, g_r, buf_r = self._run_block(block, x, pallas=False)
+        np.testing.assert_allclose(out_p, out_r, rtol=1e-4, atol=1e-4)
+        for k in g_r:
+            np.testing.assert_allclose(g_p[k], g_r[k], rtol=2e-3,
+                                       atol=1e-3, err_msg=k)
+        for k in buf_r:
+            np.testing.assert_allclose(buf_p[k], buf_r[k], rtol=1e-4,
+                                       atol=1e-5, err_msg=k)
+
+    def test_flag_defaults_off(self):
+        assert not pconv.pallas_conv_enabled()
+
+
+class TestAutotuneCacheHook:
+    def test_selector_consults_persistent_cache(self, tmp_path):
+        """A tuned block config planted in the autotune cache must be
+        picked up by the selector (the device-round registration path)."""
+        from paddle_tpu.ops._pallas.autotune import AutotuneCache, CACHE_SCHEMA
+        import paddle_tpu.ops._pallas.autotune as autotune_mod
+        cache = AutotuneCache(path=str(tmp_path / "autotune.json"))
+        key = pconv._mm_key(128, 8, 16, jnp.float32)
+        cache.put("pallas_conv1x1", key, 32, 0.123)
+        prev = autotune_mod._cache
+        autotune_mod._cache = cache
+        try:
+            assert pconv._pick_block_m(128, 8, 16, jnp.float32) == 32
+        finally:
+            autotune_mod._cache = prev
+        # and without the planted entry the divisor table answers
+        assert pconv._pick_block_m(128, 8, 16, jnp.float32) == 128

@@ -264,6 +264,57 @@ def test_p003_grid_divisibility():
     assert "P003" not in rules_of(check_kernel_spec(ok))
 
 
+def test_conv3x3_spec_vmem_includes_im2col_tiles():
+    from paddle_tpu.analysis import spec_for_conv3x3
+    # 512-channel 56x56 f32: image (6.9MB) + taps (9.4MB) alone overflow
+    # the budget — and the im2col tap/acc tiles must appear in the message
+    bad = check_kernel_spec(spec_for_conv3x3(2, 56, 56, 512, 512,
+                                             block_h=56, stride=1))
+    hits = [d for d in bad if d.rule == "P001"]
+    assert hits and hits[0].severity == "error"
+    assert "im2col" in hits[0].message
+    # the shipped default (block_h=8, ResNet stage-1 bf16) fits
+    good = check_kernel_spec(spec_for_conv3x3(256, 56, 56, 64, 64,
+                                              block_h=8, stride=1,
+                                              dtype=np.dtype("bfloat16")))
+    assert not [d for d in good if d.severity == "error"]
+
+
+def test_conv3x3_wgrad_spec_defaults_fit():
+    from paddle_tpu.analysis import spec_for_conv3x3
+    good = check_kernel_spec(spec_for_conv3x3(256, 56, 56, 64, 64,
+                                              block_h=8, stride=1,
+                                              dtype=np.dtype("bfloat16"),
+                                              wgrad=True))
+    assert not [d for d in good if d.severity == "error"]
+
+
+def test_conv_matmul_spec_rules():
+    from paddle_tpu.analysis import spec_for_conv_matmul
+    # non-dividing row block -> P003
+    ragged = check_kernel_spec(spec_for_conv_matmul(1000, 64, 256,
+                                                    block_m=512))
+    assert any(d.rule == "P003" and d.severity == "error" for d in ragged)
+    # misaligned minor dim -> P002 warning (not an error)
+    mis = check_kernel_spec(spec_for_conv_matmul(512, 64, 192, block_m=256))
+    assert "P002" in rules_of(mis)
+    # the shipped stage-1 1x1 default config is clean
+    ok = check_kernel_spec(spec_for_conv_matmul(256 * 56 * 56, 256, 64,
+                                                block_m=512,
+                                                dtype=np.dtype("bfloat16")))
+    assert not [d for d in ok if d.severity == "error"]
+
+
+def test_conv_supports_refuses_what_checks_reject():
+    """ops/_pallas/conv.py routability must agree with the checker: an
+    over-VMEM shape falls back to lax instead of reaching Mosaic."""
+    from paddle_tpu.ops._pallas import conv as pconv
+    assert not pconv.supports((256, 112, 112, 512), (512, 512, 3, 3),
+                              padding=(1, 1), dtype=np.float32)
+    assert pconv.supports((2, 56, 56, 64), (64, 64, 3, 3), padding=(1, 1),
+                          dtype=np.float32)
+
+
 def test_packed_flash_entry_enforces_under_error_mode(analysis_error_mode):
     q = jnp.zeros((1, 512, 12, 64), jnp.float32)
     with pytest.raises(GraphLintError) as ei:

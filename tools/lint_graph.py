@@ -87,6 +87,37 @@ def run(models, with_kernels=False, with_repo=False, min_severity="info"):
                 for d in diags:
                     print("    " + d.format())
                 all_diags += diags
+        # the conv family at its default blocks for the byte-dominant
+        # ResNet shapes (fwd + wgrad; dgrad reuses the fwd kernel spec)
+        import numpy as np
+        from paddle_tpu.analysis import (spec_for_conv_matmul,
+                                         spec_for_conv3x3)
+        from paddle_tpu.ops._pallas import conv as pconv
+        print("== pallas conv configs (RESNET50_TOP3_SHAPES, bf16)")
+        bf16 = np.dtype("bfloat16")
+        for kind, n, h, w, cin, cout, s_ in pconv.RESNET50_TOP3_SHAPES:
+            if kind == "conv1x1":
+                m = n * ((h + s_ - 1) // s_) * ((w + s_ - 1) // s_)
+                bm = pconv._pick_block_m(m, cin, cout, jnp.bfloat16)
+                specs = [spec_for_conv_matmul(m, cin, cout, bm, dtype=bf16),
+                         spec_for_conv_matmul(m, cin, cout, bm, dtype=bf16,
+                                              wgrad=True)]
+                cfg = f"m{m} ci{cin} co{cout} block_m {bm}"
+            else:
+                ho = (h + 2 - 3) // s_ + 1
+                bh = pconv._pick_block_h(ho, n, h, w, cin, cout, s_,
+                                         jnp.bfloat16)
+                specs = [spec_for_conv3x3(n, h, w, cin, cout, bh, s_,
+                                          dtype=bf16),
+                         spec_for_conv3x3(n, h, w, cin, cout, bh, s_,
+                                          dtype=bf16, wgrad=True)]
+                cfg = f"n{n} {h}x{w} ci{cin} co{cout} s{s_} block_h {bh}"
+            for spec in specs:
+                diags = check_kernel_spec(spec)
+                print(f"  {spec.name} {cfg}: {len(diags)} diagnostic(s)")
+                for d in diags:
+                    print("    " + d.format())
+                all_diags += diags
     if with_repo:
         print("== repo AST lint (paddle_tpu/)")
         diags = repo_lint.lint_tree(REPO)

@@ -1,7 +1,13 @@
 """Where do the ResNet step's HBM bytes go? Aggregates hlo_stats rows
 (bytes ~= measured bw x self-time) by op-name bucket.
 
-Usage: python tools/resnet_bytes.py [fused|plain]
+Usage: python tools/resnet_bytes.py [fused|pallas|plain]
+
+``pallas`` additionally routes the fused units through the Pallas conv
+kernel family (FLAGS_pallas_conv — ops/_pallas/conv.py). The top-3
+byte-dominant conv shape classes this profile identified (r5, batch 256)
+are recorded as ``RESNET50_TOP3_SHAPES`` in that module; the per-shape
+kernel A/B against them runs via ``BENCH_PALLAS_CONV=1 python bench.py``.
 """
 import functools
 import glob
@@ -28,7 +34,10 @@ from paddle_tpu.optimizer import Momentum
 from paddle_tpu.vision.models import resnet50
 
 mode = sys.argv[1] if len(sys.argv) > 1 else "plain"
-_flags.set_flags({"fused_conv_bn": 1 if mode == "fused" else 0})
+_flags.set_flags({"fused_conv_bn": 1 if mode in ("fused", "pallas") else 0})
+if mode == "pallas":
+    from paddle_tpu.ops._pallas import conv as _pconv  # noqa: F401
+    _flags.set_flags({"pallas_conv": 1})
 
 batch, img, steps = 256, 224, 6
 paddle.seed(0)
