@@ -165,7 +165,7 @@ def guarded_min(window_fn, n_windows, roofline_s, factor=None,
 
 
 def _measure_guarded(step, state, args, steps, roofline_s,
-                     n_windows=None):
+                     n_windows=None, args_seq=None):
     """Guarded wall + device timing for a donated-state step fn.
 
     Pre-warm: one compile call + one warm call run before any timed window
@@ -175,20 +175,35 @@ def _measure_guarded(step, state, args, steps, roofline_s,
     floor. Device time is the preferred basis (PERF.md r4: the axon tunnel
     adds ~10-15 ms/dispatch of host latency no real deployment pays).
 
+    args_seq: optional list of per-step arg tuples, cycled across ALL
+    steps (warmup included) — a fresh batch per step, so reported losses
+    reflect optimization rather than single-batch memorization (VERDICT
+    r5 weak #3). Default: `args` every step.
+
     Returns dict(loss, wall_s, device_s, used_s, timing, anomaly,
     windows, discarded, state).
     """
     n_windows = N_WINDOWS if n_windows is None else n_windows
-    loss, state = step(state, *args)  # compile
-    loss, state = step(state, *args)  # warm (autotune cache consulted above)
+    seq = list(args_seq) if args_seq else None
+    box = {"state": state, "loss": None, "i": 0}
+
+    def next_args():
+        if seq is None:
+            return args
+        a = seq[box["i"] % len(seq)]
+        box["i"] += 1
+        return a
+
+    loss, state = step(state, *next_args())  # compile
+    box["state"] = state
+    loss, box["state"] = step(box["state"], *next_args())  # warm
     float(loss)
-    box = {"state": state, "loss": None}
 
     def wall_window():
         t0 = time.perf_counter()
         st = box["state"]
         for _ in range(steps):
-            loss, st = step(st, *args)
+            loss, st = step(st, *next_args())
         box["loss"] = float(loss)
         box["state"] = st
         return (time.perf_counter() - t0) / steps
@@ -200,8 +215,11 @@ def _measure_guarded(step, state, args, steps, roofline_s,
         wall_window, n_windows, roofline_s)
 
     def device_window():
-        dt, st = _device_step_time(step, box["state"], args, steps)
+        dt, st, loss = _device_step_time(step, box["state"], next_args,
+                                         steps)
         box["state"] = st
+        if loss is not None:
+            box["loss"] = loss
         return dt
 
     dev_s, dev_anom, dev_ok, dev_disc = guarded_min(
@@ -249,13 +267,15 @@ def _prewarm_autotune():
         pass
 
 
-def _device_step_time(step, state, args, steps):
+def _device_step_time(step, state, args_fn, steps):
     """DEVICE time per step from a profiler trace (hlo_stats total).
 
     Through the axon tunnel every dispatch costs ~10-15 ms of host latency
     that no real deployment pays (host-local dispatch pipelines ahead of a
     >100 ms device step), so wall-clock under-reports chip throughput.
-    Returns (device_dt, state) or (None, state) when xprof is unavailable.
+    args_fn() supplies each step's args (fresh-batch cycling).
+    Returns (device_dt, state, loss) — device_dt None when xprof is
+    unavailable.
     """
     import shutil
     import tempfile
@@ -263,23 +283,24 @@ def _device_step_time(step, state, args, steps):
     import jax
 
     tracedir = tempfile.mkdtemp(prefix="bench_trace_")
+    floss = None
     try:
         loss = None
         with jax.profiler.trace(tracedir):
             for _ in range(steps):
-                loss, state = step(state, *args)
-            float(loss)  # sync inside the trace window
+                loss, state = step(state, *args_fn())
+            floss = float(loss)  # sync inside the trace window
         from paddle_tpu.profiler.statistic import device_statistics
         st = device_statistics(tracedir, top=1)
         if not st:
-            return None, state
+            return None, state, floss
         by_cat, _ = st
         total_ms = sum(by_cat.values())
         if not total_ms:
-            return None, state
-        return total_ms / steps / 1e3, state
+            return None, state, floss
+        return total_ms / steps / 1e3, state, floss
     except Exception:
-        return None, state
+        return None, state, floss
     finally:
         shutil.rmtree(tracedir, ignore_errors=True)
 
@@ -613,6 +634,64 @@ def bench_bert(small: bool):
 # Config 5: ERNIE through the pipeline train step (tokens/sec/chip)
 # ---------------------------------------------------------------------------
 
+def _ernie_pp_probe(pl, params, ids, labels, dev, n_stages, n_micro,
+                    steps):
+    """Measure the pp schedule MACHINERY on one chip (VERDICT r5 ask #3,
+    third carry-over): run the real n_stages-stage 1F1B tick schedule with
+    all stages serially resident (pipeline_schedule.spmd_pipeline_serial —
+    identical tick/ring/bubble structure, ppermute serialized) against the
+    plain microbatch loop over the same stages. The ideal time ratio is
+    the bubble, (n_micro + S - 1) / n_micro; anything beyond it is
+    schedule machinery (tick scan, ring shifts, output masking), reported
+    as pp{S}_machinery_overhead_pct. Rooflines come from each probe
+    step's COMPILED executable cost, not the analytic 6N."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.distributed.pipeline_schedule import build_serial_probe
+    from paddle_tpu.optimizer import AdamW
+
+    probe = build_serial_probe(pl, n_stages, n_micro, remat=True)
+    if probe is None:
+        return {"error": f"trunk not homogeneous over {n_stages} stages"}
+    loss_sched, loss_plain, _ = probe
+    opt = AdamW(learning_rate=1e-4, multi_precision=True)
+
+    def make_step(loss_of):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def stp(state, ids, labels):
+            p, st = state
+            loss, grads = jax.value_and_grad(loss_of)(p, ids, labels)
+            return loss, opt.apply_gradients(p, grads, st, 1e-4)
+        return stp
+
+    out, times = {}, {}
+    for tag, lf in (("plain", loss_plain), ("sched", loss_sched)):
+        stp = make_step(lf)
+        # fresh param copies per tag: the probe steps donate their state,
+        # and the PipelineLayer's own arrays must survive for the main
+        # measurement that follows
+        p0 = {k: jnp.copy(v) for k, v in params.items()}
+        state = (p0, opt.init(p0))
+        flops, nbytes = _compiled_cost(stp, state, ids, labels)
+        roof = _roofline_for(dev, flops, nbytes)
+        m = _measure_guarded(stp, state, (ids, labels), steps, roof,
+                             n_windows=2)
+        m.pop("state")
+        times[tag] = m["used_s"]
+        out[tag] = {"step_ms": round(m["used_s"] * 1e3, 2),
+                    "timing": m["timing"], "anomaly": m["anomaly"],
+                    "roofline_ms": m["roofline_ms"],
+                    "compiled_gflops": round(flops / 1e9, 2),
+                    "compiled_gb": round(nbytes / 2**30, 3)}
+    ratio = (n_micro + n_stages - 1) / n_micro
+    overhead = times["sched"] / (times["plain"] * ratio) - 1.0
+    out["n_stages"] = n_stages
+    out["n_micro"] = n_micro
+    out["ideal_bubble_ratio"] = round(ratio, 4)
+    out["machinery_overhead_pct"] = round(100.0 * overhead, 2)
+    return out
+
+
 def bench_ernie(small: bool):
     import jax
     import jax.numpy as jnp
@@ -668,11 +747,33 @@ def bench_ernie(small: bool):
         return loss, (p, st)
 
     dev = jax.devices()[0]
-    # The pipeline step jits internally, so XLA cost analysis is out of
-    # reach here — the roofline floor is the analytic 6N FLOPs/token.
     n_params = sum(int(np.prod(p.shape)) for p in params.values())
-    roof = (6 * n_params * batch * seq / _peak_flops(dev)
-            if getattr(dev, "platform", "") == "tpu" else 0.0)
+    # pp machinery probe FIRST — it copies params; the main measurement
+    # below donates the PipelineLayer's own arrays through pstep.
+    pp_stages = 4 if not small else 2
+    try:
+        pp_probe = _ernie_pp_probe(pl, params, ids, labels, dev,
+                                   n_stages=pp_stages, n_micro=n_micro,
+                                   steps=max(2, steps // 2))
+    except Exception as e:
+        pp_probe = {"error": f"{type(e).__name__}: {e}"[:300]}
+    # Roofline from the step's COMPILED executable cost (VERDICT r5
+    # weak #4: the strongest number had the weakest guard) — the pp=1
+    # path of make_pipeline_train_step returns the jitted step itself,
+    # so its compiled cost IS reachable; analytic 6N/token is the
+    # fallback for the non-lowerable (het-dispatch) variant.
+    if hasattr(pstep, "lower"):
+        flops, nbytes = _compiled_cost(pstep, params, opt_state, ids,
+                                       labels, jnp.float32(1e-4))
+    else:
+        flops, nbytes = 0.0, 0.0
+    if flops:
+        roof = _roofline_for(dev, flops, nbytes)
+        roof_basis = "compiled"
+    else:
+        roof = (6 * n_params * batch * seq / _peak_flops(dev)
+                if getattr(dev, "platform", "") == "tpu" else 0.0)
+        roof_basis = "analytic_6N"
     m = _measure_guarded(step, (params, opt_state), (ids, labels), steps,
                          roof)
     dt_used = m["used_s"]
@@ -685,11 +786,15 @@ def bench_ernie(small: bool):
           {"loss": m["loss"], "batch": batch, "seq": seq, "n_micro": n_micro,
            "n_params": n_params, "step_ms": round(dt_used * 1e3, 2),
            **_guard_extra(m),
+           "roofline_basis": roof_basis,
+           "pp4_machinery_overhead_pct":
+               pp_probe.get("machinery_overhead_pct"),
+           "pp4_probe": pp_probe,
            "baseline_config": 5, "pp_degree": 1,
-           "note": "single-chip: pp machinery runs with num_stages=1 "
-                   "(microbatched); real pp=4 validated functionally in "
-                   "dryrun_multichip[2]/[7] — one chip cannot host 4 "
-                   "stages"})
+           "note": "single-chip: the pp=4 1F1B tick schedule is measured "
+                   "with stages serially resident (pp4_probe); the "
+                   "throughput metric runs num_stages=1 (microbatched) — "
+                   "one chip cannot host 4 parallel stages"})
 
 
 # ---------------------------------------------------------------------------
@@ -737,16 +842,30 @@ def _gpt_measure(layers, hidden, heads, seq, batch, steps, remat, vocab):
     # multi-GB carry at L=12, costing far more than it saves)
     step = functools.partial(jax.jit, donate_argnums=(0,))(one_step)
 
-    rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
-    labels = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1), jnp.int32)
+    batches = _gpt_batches(batch, seq, vocab)
     state = (params, opt_state)
     dev = jax.devices()[0]
-    flops, nbytes = _compiled_cost(step, state, ids, labels)
+    flops, nbytes = _compiled_cost(step, state, *batches[0])
     roof = _roofline_for(dev, flops, nbytes)
-    m = _measure_guarded(step, state, (ids, labels), steps, roof)
+    m = _measure_guarded(step, state, batches[0], steps, roof,
+                         args_seq=batches)
     m.pop("state")
     return m, n_params
+
+
+def _gpt_batches(batch, seq, vocab, pool=16):
+    """A pool of DISTINCT synthetic (ids, labels) batches, cycled one per
+    step by the guarded measurement — the reported loss then reflects real
+    optimization across batches, not memorization of a single batch
+    (VERDICT r5 weak #3: loss_at_l6 = 0.027 after 10 same-batch steps)."""
+    import jax.numpy as jnp
+    rng = np.random.default_rng(0)
+    out = []
+    for _ in range(pool):
+        ids = rng.integers(0, vocab, (batch, seq))
+        out.append((jnp.asarray(ids, jnp.int32),
+                    jnp.asarray(np.roll(ids, -1, axis=1), jnp.int32)))
+    return out
 
 
 def _gpt_flops_per_token(n_params, layers, seq, hidden):
@@ -755,28 +874,126 @@ def _gpt_flops_per_token(n_params, layers, seq, hidden):
     return 6 * n_params + 6 * layers * seq * hidden
 
 
-def bench_gpt_13b_extrapolated():
+def _gpt_13b_measured_path(mode, layers, hidden, heads, seq, vocab,
+                           steps=3, budget_gb=None):
+    """One REAL full-depth fwd+bwd+update GPT step (ISSUE r6 tentpole).
+
+    mode "sgd_no_moment": SGD(multi_precision) — no moments, everything
+    resident (~6 B/param): the zero-transfer baseline that fits HBM.
+    mode "adam_offload_moments": the BASELINE-faithful AdamW, its 8 B/param
+    of moments parked in pinned host memory and streamed through HBM per
+    block by framework/offload.StreamingUpdate — full-depth Adam on one
+    chip, which 14 B/param resident cannot do.
+
+    Batch is the largest of (4, 2, 1) whose tools/hbm_budget plan fits;
+    the plan rides along in the result. Returns (measurement, n_params,
+    batch, plan).
+    """
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import offload
+    from paddle_tpu.framework.functional import functional_call, get_params
+    from paddle_tpu.optimizer import SGD, AdamW
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+    from tools import hbm_budget
+
+    resident = mode == "sgd_no_moment"
+    kwargs = dict(layers=layers, hidden=hidden, heads=heads, seq=seq,
+                  vocab=vocab, optimizer="sgd" if resident else "adamw",
+                  offload="off" if resident else "moments", remat=True)
+    if budget_gb is not None:
+        kwargs["budget_gb"] = budget_gb
+    batch, plan = hbm_budget.choose_batch(**kwargs)
+    if batch is None:
+        raise RuntimeError(f"no batch in (4,2,1) fits HBM: {plan}")
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+                    num_heads=heads, max_position_embeddings=seq,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    recompute=True)
+    model = GPTForCausalLM(cfg)
+    model.train()
+    model.astype(paddle.bfloat16)
+    params = get_params(model)
+    n_params = sum(int(np.prod(p.shape)) for p in params.values())
+
+    def loss_fn(p, ids, labels):
+        return functional_call(model, p, ids, labels, training=True)
+
+    dev = jax.devices()[0]
+    if resident:
+        opt = SGD(learning_rate=1e-4, multi_precision=True)
+        state = (params, opt.init(params))
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(st, ids, labels):
+            p, s = st
+            loss, grads = jax.value_and_grad(loss_fn)(p, ids, labels)
+            return loss, opt.apply_gradients(p, grads, s, 1e-4)
+
+        batches = _gpt_batches(batch, seq, vocab, pool=8)
+        flops, nbytes = _compiled_cost(step, state, *batches[0])
+    else:
+        opt = AdamW(learning_rate=1e-4, weight_decay=0.01,
+                    multi_precision=True)
+        stream = offload.StreamingUpdate(opt)
+        # moments are born host-side param-by-param — the full 10.5 GB
+        # moment set never exists in HBM (offload.init_state)
+        state = (params, stream.init_state(params))
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+        def step(st, ids, labels):
+            p, s = st
+            loss, grads = grad_fn(p, ids, labels)
+            return loss, stream.update(p, grads, s, 1e-4)
+
+        batches = _gpt_batches(batch, seq, vocab, pool=8)
+        # roofline floor from the grad program only — a valid lower bound
+        # (the streamed update adds compute + host-link time on top)
+        flops, nbytes = _compiled_cost(grad_fn, params, *batches[0])
+    roof = _roofline_for(dev, flops, nbytes)
+    m = _measure_guarded(step, state, batches[0], steps, roof,
+                         args_seq=batches)
+    m.pop("state")
+    return m, n_params, batch, plan
+
+
+def bench_gpt_13b():
     """BASELINE config 4, the PRIMARY metric: GPT-3 1.3B tokens/sec/chip.
 
-    Memory arithmetic (documented per VERDICT r2 item 2): the full 1.3B
-    with AMP-O2 AdamW needs 14 B/param on-chip (bf16 params 2 + f32 master
-    4 + f32 m 4 + f32 v 4) = 18.4 GB for 1.32e9 params — over the 15.75 GB
-    v5e HBM budget before a single activation, so the exact BASELINE shape
-    cannot run single-chip (the BASELINE config itself is mp=4 dp=8 over
-    32 chips). Instead: measure the EXACT 1.3B layer shape (d=2048, 16
-    heads x 128, seq 2048, bf16, remat, batch 4) at two depths that fit
-    (L=6: 5.7 GB of state; L=12: 10.0 GB), fit step time = a + b*L — the
-    per-layer cost b and the fixed embedding/head/CE/update cost a — and
-    report t(24). Layer cost is linear in L by construction (identical
-    blocks, remat per block); measured fit residual is printed alongside.
+    Two components, emitted as ONE record:
+
+    - the r3-r5 per-layer extrapolation (measure the exact 1.3B layer
+      shape at L=6 and L=12, fit t = a + b*L, report t(24)) — kept for
+      continuity and as the cross-check target;
+    - ``measured_full_depth`` (NEW, VERDICT r5 missing #1): one real
+      24-layer fwd+bwd+update step, device-timed and anomaly-guarded,
+      under both the SGD-no-moment resident path and the AdamW
+      host-offloaded-moments path (framework/offload.py). The 18.4 GB
+      > 15.75 GB capacity wall that forced the extrapolation for two
+      rounds is gone — moments live in pinned host memory and stream
+      through HBM per block.
+
+    Headline: the measured AdamW number when it produced a clean window
+    (the reference's methodology gates on measured runs only); the
+    extrapolation is confirmed if within 5%, otherwise marked corrected
+    and the MFU restated from the measurement.
     """
     import jax
 
-    seq, batch, heads, hidden, vocab = 2048, 4, 16, 2048, 50304
+    if os.environ.get("BENCH_13B_SMOKE") == "1":
+        # CPU wiring smoke: tiny dims, same code path end to end
+        seq, batch, heads, hidden, vocab = 32, 2, 2, 64, 128
+        depths, full_depth, fit_steps, meas_steps = (1, 2), 4, 2, 2
+    else:
+        seq, batch, heads, hidden, vocab = 2048, 4, 16, 2048, 50304
+        depths, full_depth, fit_steps, meas_steps = (6, 12), 24, 8, 3
     pts = []
-    for L in (6, 12):
+    for L in depths:
         m, n_params = _gpt_measure(
-            L, hidden, heads, seq, batch, steps=8, remat=True, vocab=vocab)
+            L, hidden, heads, seq, batch, steps=fit_steps, remat=True,
+            vocab=vocab)
         pts.append((L, m, n_params))
     # headline on DEVICE time when a trace was parsed for BOTH depths (the
     # axon tunnel's ~10-15 ms/dispatch host latency is a harness artifact,
@@ -793,24 +1010,82 @@ def bench_gpt_13b_extrapolated():
     (l1, l2), (t1, t2) = (pts[0][0], pts[1][0]), times
     per_layer = (t2 - t1) / (l2 - l1)
     fixed = t1 - l1 * per_layer
-    t24 = fixed + 24 * per_layer
+    t24 = fixed + full_depth * per_layer
     # param count of the true 24-layer model (trunk scales linearly; embed
     # + position table are the fixed part)
     n6 = pts[0][2]
     per_layer_params = (pts[1][2] - n6) / (l2 - l1)
-    n24 = int(n6 + (24 - l1) * per_layer_params)
-    tokens_per_sec = batch * seq / t24
-    flops_per_token = _gpt_flops_per_token(n24, 24, seq, hidden)
-    mfu = tokens_per_sec * flops_per_token / _peak_flops(jax.devices()[0])
-    _emit("gpt3_1p3b_train_tokens_per_sec_per_chip", tokens_per_sec,
-          "tokens/sec/chip", mfu,
+    n24 = int(n6 + (full_depth - l1) * per_layer_params)
+    extrap_tok_s = batch * seq / t24
+    flops_per_token = _gpt_flops_per_token(n24, full_depth, seq, hidden)
+    peak = _peak_flops(jax.devices()[0])
+    extrap_mfu = extrap_tok_s * flops_per_token / peak
+
+    # --- measured full depth, both paths -----------------------------------
+    budget_gb = None if os.environ.get("BENCH_13B_SMOKE") != "1" else 1e9
+    measured = {}
+    for mode in ("sgd_no_moment", "adam_offload_moments"):
+        try:
+            m, n_meas, mbatch, plan = _gpt_13b_measured_path(
+                mode, full_depth, hidden, heads, seq, vocab,
+                steps=meas_steps, budget_gb=budget_gb)
+            tok_s = mbatch * seq / m["used_s"]
+            measured[mode] = {
+                "tokens_per_sec": round(tok_s, 1),
+                "mfu": round(tok_s * flops_per_token / peak, 4),
+                "step_ms": round(m["used_s"] * 1e3, 2),
+                "batch": mbatch, "loss": m["loss"],
+                "n_params": n_meas,
+                "hbm_plan": {"device_gb": plan["device_gb"],
+                             "host_gb": plan["host_gb"],
+                             "fits": plan["fits"],
+                             "rows_gb": plan["rows_gb"]},
+                **_guard_extra(m),
+            }
+        except Exception as e:  # OOM/compile failure must not kill primary
+            measured[mode] = {"error": f"{type(e).__name__}: {e}"[:400]}
+
+    adam = measured.get("adam_offload_moments", {})
+    adam_ok = "tokens_per_sec" in adam and not adam.get("anomaly")
+    if adam_ok:
+        agree_pct = 100.0 * (adam["tokens_per_sec"] / extrap_tok_s - 1.0)
+        confirmed = abs(agree_pct) <= 5.0
+        headline_tok_s, headline_mfu = adam["tokens_per_sec"], adam["mfu"]
+        method = ("measured_full_depth: real %d-layer fwd+bwd+update, "
+                  "AdamW moments in pinned host memory streamed per block "
+                  "(FLAGS_offload_optimizer=moments); extrapolation %s "
+                  "(%.1f%% apart)" % (
+                      full_depth,
+                      "confirmed within 5%" if confirmed
+                      else "CORRECTED — headline restated from measurement",
+                      agree_pct))
+    else:
+        agree_pct, confirmed = None, None
+        headline_tok_s, headline_mfu = extrap_tok_s, extrap_mfu
+        method = ("per-layer extrapolation (measured full-depth run "
+                  "unavailable this round — see measured_full_depth for "
+                  "the failure record)")
+
+    _emit("gpt3_1p3b_train_tokens_per_sec_per_chip", headline_tok_s,
+          "tokens/sec/chip", headline_mfu,
           {"n_params": n24, "loss_at_l6": ms[0]["loss"],
-           "anomaly": anomaly,
-           "config": {"layers": 24, "hidden": hidden, "heads": heads,
-                      "seq": seq, "batch": batch, "remat": True,
-                      "amp": "O2 (bf16 + f32 master)"},
-           "method": "per-layer extrapolation (1.3B opt state = 18.4 GB "
-                     "> 15.75 GB HBM single-chip; BASELINE runs it mp=4)",
+           "anomaly": anomaly if not adam_ok else bool(adam.get("anomaly")),
+           "config": {"layers": full_depth, "hidden": hidden,
+                      "heads": heads, "seq": seq, "batch": batch,
+                      "remat": True, "amp": "O2 (bf16 + f32 master)"},
+           "method": method,
+           "measured_full_depth": measured,
+           "extrapolation": {
+               "tokens_per_sec": round(extrap_tok_s, 1),
+               "mfu": round(extrap_mfu, 4),
+               "step_ms": round(t24 * 1e3, 2),
+               "per_layer_ms": round(per_layer * 1e3, 2),
+               "fixed_ms": round(fixed * 1e3, 2),
+               "agreement_pct": (round(agree_pct, 2)
+                                 if agree_pct is not None else None),
+               "confirmed_within_5pct": confirmed,
+               "anomaly": anomaly,
+           },
            "measured_points": [
                {"layers": l, "step_ms": round(t * 1e3, 2),
                 "wall_step_ms": round(m["wall_s"] * 1e3, 2)
@@ -822,9 +1097,9 @@ def bench_gpt_13b_extrapolated():
            "timing": ("device (xprof hlo_stats; wall incl. ~10-15 ms/step "
                       "axon-tunnel dispatch latency reported alongside)"
                       if timing_basis == "device" else "wall"),
-           "per_layer_ms": round(per_layer * 1e3, 2),
-           "fixed_ms": round(fixed * 1e3, 2),
-           "step_ms": round(t24 * 1e3, 2), "baseline_config": 4})
+           "step_ms": (adam["step_ms"] if adam_ok
+                       else round(t24 * 1e3, 2)),
+           "baseline_config": 4})
 
 
 def bench_gpt(small: bool):
@@ -836,8 +1111,9 @@ def bench_gpt(small: bool):
     from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
 
     if not small and not os.environ.get("BENCH_LAYERS"):
-        # Default full run reports the BASELINE-faithful 1.3B metric.
-        return bench_gpt_13b_extrapolated()
+        # Default full run reports the BASELINE-faithful 1.3B metric:
+        # extrapolation + measured full depth (r6 tentpole).
+        return bench_gpt_13b()
 
     # head_dim 128 (not 64) matches the BASELINE GPT-3 1.3B shape
     # (16 heads x 128 at d_model 2048) and fills the 128-lane MXU; batch 16
@@ -876,15 +1152,12 @@ def bench_gpt(small: bool):
         new_p, new_st = opt.apply_gradients(p, grads, st, 1e-4)
         return loss, (new_p, new_st)
 
-    rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, vocab, (batch, seq)), jnp.int32)
-    labels = jnp.asarray(np.roll(np.asarray(ids), -1, axis=1), jnp.int32)
-
+    batches = _gpt_batches(batch, seq, vocab)
     dev = jax.devices()[0]
-    flops, nbytes = _compiled_cost(step, (params, opt_state), ids, labels)
+    flops, nbytes = _compiled_cost(step, (params, opt_state), *batches[0])
     roof = _roofline_for(dev, flops, nbytes)
-    m = _measure_guarded(step, (params, opt_state), (ids, labels), steps,
-                         roof)
+    m = _measure_guarded(step, (params, opt_state), batches[0], steps,
+                         roof, args_seq=batches)
     dt = m["used_s"]
     tokens_per_sec = batch * seq / dt
     # Model FLOPs per token: 6N (fwd+bwd matmuls) + causal attention

@@ -490,6 +490,46 @@ def _rule_nondet_reduction(ctx: LintContext):
                      "segment-sum formulation")
 
 
+def _transfer_kinds(eqn) -> List[str]:
+    """Explicit memory-kind targets of a device_put eqn (Sharding or
+    TransferToMemoryKind destinations with a declared memory_kind)."""
+    kinds = []
+    for d in (eqn.params.get("devices") or ()):
+        k = getattr(d, "memory_kind", None)
+        if k is not None:
+            kinds.append(str(k))
+    return kinds
+
+
+@register_rule("J012", "transfer-in-loop", ERROR,
+               "a host<->device memory-kind transfer (device_put) compiled "
+               "into a scan/while body")
+def _rule_transfer_in_loop(ctx: LintContext):
+    """The offload accident: a host-committed operand (e.g. a pinned-host
+    moment buffer) consumed inside a compiled loop forces a device_put —
+    a synchronous host<->device round trip EVERY iteration, serializing
+    the loop on the host link. Correct offload streams at dispatch level
+    with explicit prefetch (framework/offload.py StreamingUpdate); a
+    memory-kind device_put belongs between compiled programs, not inside
+    their loop bodies."""
+    rule = _RULES["J012"]
+    for info in ctx.eqns:
+        if info.eqn.primitive.name != "device_put" or info.loop_depth == 0:
+            continue
+        kinds = _transfer_kinds(info.eqn)
+        if not kinds:
+            continue  # plain placement device_put, not a tier move
+        yield _diag(
+            rule,
+            f"device_put to memory kind {kinds[0]!r} inside a compiled "
+            f"loop body (depth {info.loop_depth}) — a host<->device "
+            "transfer per iteration serializes the loop on the host link",
+            info.eqn,
+            hint="hoist the transfer out of the loop and stream per block "
+                 "at dispatch level with explicit prefetch "
+                 "(framework/offload.StreamingUpdate)")
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------

@@ -145,6 +145,13 @@ define_flag("flash_attn_version", 2, "Pallas flash-attention kernel version.")
 define_flag("use_pallas_kernels", True,
             "Use Pallas TPU kernels where available (else jnp reference).")
 define_flag("amp_dtype", "bfloat16", "Preferred mixed-precision compute dtype.")
+define_flag("offload_optimizer", "off",
+            "Optimizer-state memory tier (framework/offload.py): 'off' "
+            "keeps all state in HBM (byte-identical to the pre-offload "
+            "path); 'moments' parks first/second moments in pinned host "
+            "memory and streams them through HBM per block during the "
+            "update (ZeRO-Offload-style).",
+            choices=("off", "moments"))
 define_flag("static_analysis", "off",
             "Graph/kernel static analysis mode (paddle_tpu.analysis): "
             "'off' skips, 'warn' prints diagnostics to stderr, 'error' "

@@ -178,6 +178,17 @@ class TrainStep:
             lambda v, s: jax.device_put(v, s), self.opt_state, ssh,
             is_leaf=lambda x: isinstance(x, jax.Array))
         self._state_shardings = ssh
+        # FLAGS_offload_optimizer=moments: moments move to the host tier
+        # (same partitioning, host memory kind) and the update streams them
+        # through HBM per block — the compiled step below then carries
+        # grads, not the optimizer update (framework/offload.py).
+        from . import offload as _offload
+        self._offload = None
+        if (_offload.offload_mode() == "moments"
+                and optimizer.offloadable_state_keys()
+                and _offload.host_memory_kind() is not None):
+            self._offload = _offload.StreamingUpdate(optimizer)
+            self.opt_state = self._offload.place(self.opt_state)
         repl = NamedSharding(mesh, P())
 
         model_obj, lf = model, loss_fn
@@ -211,15 +222,39 @@ class TrainStep:
                                          where="train_step/opt_state")
             return loss, new_params, new_state, new_buffers
 
-        self._compiled = jax.jit(
-            step,
-            in_shardings=(self.pshardings, ssh, None, None, repl, None),
-            out_shardings=(repl, self.pshardings, ssh, None),
-            # Buffers are NOT donated: TrainStep.buffers initially aliases
-            # the Layer tree's arrays; donating would delete them under the
-            # model.
-            donate_argnums=(0, 1) if donate else ())
-        self._step_fn = step
+        def grad_step(params, buffers, batch, key):
+            def loss_of(p):
+                with rng_scope(key):
+                    if self._threads_buffers:
+                        return lf(model_obj, p, buffers, batch)
+                    return lf(model_obj, p, batch), buffers
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            from ..amp import debugging as _dbg
+            if _dbg.enabled():
+                _dbg.check_numerics(loss, "loss", where="train_step")
+                _dbg.check_numerics_tree(grads, where="train_step/grads")
+            return loss, grads, new_buffers
+
+        if self._offload is not None:
+            # Params are NOT donated here — the streaming update consumes
+            # and donates them per block right after.
+            self._compiled = jax.jit(
+                grad_step,
+                in_shardings=(self.pshardings, None, None, None),
+                out_shardings=(repl, self.pshardings, None))
+            self._step_fn = grad_step
+        else:
+            self._compiled = jax.jit(
+                step,
+                in_shardings=(self.pshardings, ssh, None, None, repl, None),
+                out_shardings=(repl, self.pshardings, ssh, None),
+                # Buffers are NOT donated: TrainStep.buffers initially
+                # aliases the Layer tree's arrays; donating would delete
+                # them under the model.
+                donate_argnums=(0, 1) if donate else ())
+            self._step_fn = step
         self._donate = donate
         self._linted = False
         self._step_count = 0
@@ -233,11 +268,16 @@ class TrainStep:
             return
         self._linted = True
         try:
-            diags = jaxpr_lint.lint_fn(
-                self._step_fn, self.params, self.opt_state, self.buffers,
-                batch, lr, key,
-                donate_argnums=(0, 1) if self._donate else (),
-                where="sharded.TrainStep")
+            if self._offload is not None:
+                diags = jaxpr_lint.lint_fn(
+                    self._step_fn, self.params, self.buffers, batch, key,
+                    where="sharded.TrainStep")
+            else:
+                diags = jaxpr_lint.lint_fn(
+                    self._step_fn, self.params, self.opt_state, self.buffers,
+                    batch, lr, key,
+                    donate_argnums=(0, 1) if self._donate else (),
+                    where="sharded.TrainStep")
         except Exception:
             return
         jaxpr_lint.emit(diags, where="sharded.TrainStep")
@@ -266,8 +306,15 @@ class TrainStep:
         set_hybrid_mesh(self.mesh)
         try:
             self._maybe_lint(batch, lr, key)
-            loss, self.params, self.opt_state, self.buffers = self._compiled(
-                self.params, self.opt_state, self.buffers, batch, lr, key)
+            if self._offload is not None:
+                loss, grads, self.buffers = self._compiled(
+                    self.params, self.buffers, batch, key)
+                self.params, self.opt_state = self._offload.update(
+                    self.params, grads, self.opt_state, lr)
+            else:
+                loss, self.params, self.opt_state, self.buffers = \
+                    self._compiled(self.params, self.opt_state, self.buffers,
+                                   batch, lr, key)
         finally:
             set_hybrid_mesh(prev_mesh)
         sched = self.optimizer.lr_scheduler

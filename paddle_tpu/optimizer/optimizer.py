@@ -90,6 +90,13 @@ class Optimizer:
     def _init_param_state(self, p: jax.Array) -> Dict[str, jax.Array]:
         return {}
 
+    def offloadable_state_keys(self) -> tuple:
+        """Per-param state keys that are safe to park in host memory
+        between steps (framework.offload): touched only by the update,
+        elementwise, once per step. Master weights are NOT offloadable —
+        they are the update's output and stay resident by design."""
+        return ()
+
     def _update_param(self, p32: jax.Array, g32: jax.Array,
                       st: Dict[str, jax.Array], lr: jax.Array,
                       step: jax.Array) -> jax.Array:
@@ -118,11 +125,16 @@ class Optimizer:
             state["param_states"][name] = self._init_full_param_state(p)
 
     def apply_gradients(self, params: Params, grads: Grads, state: State,
-                        lr: Optional[jax.Array] = None) -> (Params, State):
+                        lr: Optional[jax.Array] = None,
+                        clip: bool = True) -> (Params, State):
+        """clip=False skips grad_clip — used by the streaming offload
+        update, which clips ONCE over the full gradient tree before
+        splitting it into per-block calls (a per-block global-norm clip
+        would compute the wrong norm)."""
         if lr is None:
             lr = self.get_lr()
         lr = jnp.asarray(lr, jnp.float32)
-        if self.grad_clip is not None:
+        if clip and self.grad_clip is not None:
             grads = self.grad_clip(grads)
         step = state["step"] + 1
         new_params: Params = dict(params)
@@ -232,6 +244,9 @@ class Momentum(Optimizer):
     def _init_param_state(self, p):
         return {"velocity": jnp.zeros(p.shape, jnp.float32)}
 
+    def offloadable_state_keys(self):
+        return ("velocity",)
+
     def _update(self, name, p32, g32, st, lr, step):
         if self.weight_decay:
             g32 = g32 + self.weight_decay * p32
@@ -260,6 +275,9 @@ class Adam(Optimizer):
     def _init_param_state(self, p):
         return {"moment1": jnp.zeros(p.shape, jnp.float32),
                 "moment2": jnp.zeros(p.shape, jnp.float32)}
+
+    def offloadable_state_keys(self):
+        return ("moment1", "moment2")
 
     def _decay(self, p32, g32):
         if self.weight_decay:
@@ -317,6 +335,9 @@ class Adagrad(Optimizer):
         return {"moment": jnp.full(p.shape, self.initial_accumulator_value,
                                    jnp.float32)}
 
+    def offloadable_state_keys(self):
+        return ("moment",)
+
     def _update(self, name, p32, g32, st, lr, step):
         if self.weight_decay:
             g32 = g32 + self.weight_decay * p32
@@ -343,6 +364,9 @@ class RMSProp(Optimizer):
         if self.centered:
             st["mean_grad"] = jnp.zeros(p.shape, jnp.float32)
         return st
+
+    def offloadable_state_keys(self):
+        return ("mean_square", "momentum", "mean_grad")
 
     def _update(self, name, p32, g32, st, lr, step):
         if self.weight_decay:
@@ -377,6 +401,9 @@ class Lamb(Optimizer):
     def _init_param_state(self, p):
         return {"moment1": jnp.zeros(p.shape, jnp.float32),
                 "moment2": jnp.zeros(p.shape, jnp.float32)}
+
+    def offloadable_state_keys(self):
+        return ("moment1", "moment2")
 
     def _update(self, name, p32, g32, st, lr, step):
         m = self.beta1 * st["moment1"] + (1 - self.beta1) * g32
@@ -416,6 +443,9 @@ class Lars(Optimizer):
     def _init_param_state(self, p):
         return {"velocity": jnp.zeros(p.shape, jnp.float32)}
 
+    def offloadable_state_keys(self):
+        return ("velocity",)
+
     def _update(self, name, p32, g32, st, lr, step):
         wd = self.lars_weight_decay
         if any(tag in name for tag in self.exclude_from_weight_decay):
@@ -441,6 +471,9 @@ class Adamax(Adam):
         return {"moment": jnp.zeros(p.shape, jnp.float32),
                 "inf_norm": jnp.zeros(p.shape, jnp.float32)}
 
+    def offloadable_state_keys(self):
+        return ("moment", "inf_norm")
+
     def _update(self, name, p32, g32, st, lr, step):
         g32 = self._decay(p32, g32)
         m = self.beta1 * st["moment"] + (1 - self.beta1) * g32
@@ -464,6 +497,9 @@ class Adadelta(Optimizer):
     def _init_param_state(self, p):
         return {"avg_squared_grad": jnp.zeros(p.shape, jnp.float32),
                 "avg_squared_update": jnp.zeros(p.shape, jnp.float32)}
+
+    def offloadable_state_keys(self):
+        return ("avg_squared_grad", "avg_squared_update")
 
     def _update(self, name, p32, g32, st, lr, step):
         if self.weight_decay:

@@ -136,3 +136,33 @@ class TestEndToEndSmoke:
         assert "windows" in rec["extra"]
         assert "roofline_ms" in rec["extra"]
         assert rec["extra"]["anomaly"] is False
+
+
+class TestFreshBatches:
+    def test_measure_guarded_cycles_args_seq(self):
+        """args_seq: every step (warmup included) consumes the NEXT batch
+        from the pool — the de-memorized GPT probe (VERDICT r5 weak #3)."""
+        import jax.numpy as jnp
+
+        from bench import _measure_guarded
+
+        seen = []
+
+        def step(state, a):
+            seen.append(int(a))
+            return jnp.float32(0.0), state
+
+        seq = [(i,) for i in range(5)]
+        m = _measure_guarded(step, None, seq[0], steps=4, roofline_s=0.0,
+                             n_windows=1, args_seq=seq)
+        assert m["used_s"] is not None
+        assert seen[:5] == [0, 1, 2, 3, 4]
+        assert len(set(seen)) == 5  # the whole pool was visited
+
+    def test_gpt_batches_distinct(self):
+        from bench import _gpt_batches
+
+        pool = _gpt_batches(2, 16, 64, pool=6)
+        assert len(pool) == 6
+        ids = [bytes(memoryview(b[0].tobytes())) for b in pool]
+        assert len(set(ids)) == 6  # no repeated batch in the pool

@@ -220,6 +220,69 @@ def test_j011_negative_flag_off():
 
 
 # ---------------------------------------------------------------------------
+# J012 host<->device transfer inside a compiled loop body
+# ---------------------------------------------------------------------------
+
+def _to_host_kind():
+    from paddle_tpu.framework.offload import host_memory_kind
+    from jax._src.sharding_impls import TransferToMemoryKind
+    return TransferToMemoryKind(host_memory_kind())
+
+
+def test_j012_device_put_in_scan_body():
+    tgt = _to_host_kind()
+
+    def f(xs):
+        def body(c, x):
+            y = jax.device_put(x, tgt)  # tier move per iteration
+            return c + y, y
+        return jax.lax.scan(body, jnp.zeros(()), xs)
+
+    diags = lint_fn(f, jnp.arange(4.0))
+    assert "J012" in rules_of(diags)
+    d = next(d for d in diags if d.rule == "J012")
+    assert d.severity == "error"
+    assert "prefetch" in d.hint
+
+
+def test_j012_negative_top_level_transfer():
+    """The offload streaming idiom — an explicit transfer BETWEEN loop
+    iterations at the top level of the program — is exactly what the rule
+    must not flag."""
+    tgt = _to_host_kind()
+
+    def f(xs):
+        y = jax.device_put(xs, tgt)
+        return jnp.sum(y)
+
+    diags = lint_fn(f, jnp.arange(4.0))
+    assert "J012" not in rules_of(diags)
+
+
+def test_j012_negative_offload_block_update_clean():
+    """framework/offload.StreamingUpdate's compiled block program carries
+    no in-graph transfers (movement is dispatch-level)."""
+    from paddle_tpu import nn as pnn
+    from paddle_tpu.framework import offload
+    from paddle_tpu.framework.functional import get_params
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.seed(0)
+    net = pnn.Sequential(pnn.Linear(8, 8), pnn.Tanh(), pnn.Linear(8, 4))
+    params = get_params(net)
+    su = offload.StreamingUpdate(AdamW(learning_rate=1e-3))
+    state = su.init_state(params)
+    grads = {k: jnp.ones_like(v) for k, v in params.items()}
+    names = offload.group_by_block(list(params))[0][1]
+    st_blk = {n: dict(state["param_states"][n]) for n in names}
+    diags = lint_fn(su._block_fn.__wrapped__,
+                    {n: params[n] for n in names},
+                    {n: grads[n] for n in names},
+                    st_blk, state["step"], jnp.float32(1e-3))
+    assert "J012" not in rules_of(diags)
+
+
+# ---------------------------------------------------------------------------
 # Pallas / TPU-constraint checker
 # ---------------------------------------------------------------------------
 

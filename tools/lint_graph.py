@@ -53,7 +53,36 @@ def lint_mlp():
     return _lint_layer(net, (jnp.zeros((4, 64), jnp.float32),), "mlp")
 
 
-MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp}
+def lint_offload():
+    """The offload streaming-update block program (framework/offload.py):
+    must stay free of in-graph memory-kind transfers (J012) — all
+    host<->device movement happens at dispatch level."""
+    from paddle_tpu import nn
+    from paddle_tpu.analysis import lint_jaxpr
+    from paddle_tpu.framework import offload
+    from paddle_tpu.framework.functional import get_params
+    from paddle_tpu.optimizer import AdamW
+
+    net = nn.Sequential(nn.Linear(32, 64), nn.Tanh(), nn.Linear(64, 8))
+    params = get_params(net)
+    opt = AdamW(learning_rate=1e-3)
+    su = offload.StreamingUpdate(opt)
+    state = su.init_state(params)
+    grads = {k: jnp.ones_like(v) for k, v in params.items()}
+    names = offload.group_by_block(list(params))[0][1]
+    p_blk = {n: params[n] for n in names}
+    g_blk = {n: grads[n] for n in names}
+    st_blk = {n: {k: jax.device_put(v, params[n].sharding)
+                  for k, v in state["param_states"][n].items()}
+              for n in names}
+    closed = jax.make_jaxpr(su._block_fn.__wrapped__)(
+        p_blk, g_blk, st_blk, state["step"], jnp.float32(1e-3))
+    diags = lint_jaxpr(closed, donate_argnums=(0, 1, 2), where="offload")
+    return diags, len(closed.jaxpr.eqns)
+
+
+MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp,
+          "offload": lint_offload}
 
 _SEV_RANK = {"info": 0, "warning": 1, "error": 2}
 
