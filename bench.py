@@ -38,7 +38,10 @@ BENCH_LAYERS/HIDDEN/HEADS/SEQ/BATCH/STEPS/REMAT/PEAK_TFLOPS,
 BENCH_WINDOWS/ANOMALY_FACTOR/RETRY_WINDOWS (guard knobs),
 BENCH_PALLAS_CONV=1 (Pallas-vs-XLA conv A/B: per-shape device-time table
 at the top-3 ResNet byte shapes + the full-graph ResNet step with
-FLAGS_pallas_conv=1 — the table VERDICT r5 asks the next chip round for).
+FLAGS_pallas_conv=1 — the table VERDICT r5 asks the next chip round for),
+BENCH_TELEMETRY=0 (skip the telemetry overhead A/B), BENCH_TRACE_OUT
+(path for the run's step-timeline JSONL, default BENCH_timeline.jsonl —
+render with tools/trace_view.py).
 """
 
 from __future__ import annotations
@@ -798,6 +801,99 @@ def bench_ernie(small: bool):
 
 
 # ---------------------------------------------------------------------------
+# Telemetry overhead A/B (paddle_tpu/observability): the always-on metrics
+# layer must cost <1% step time — measured, not asserted.
+# ---------------------------------------------------------------------------
+
+def bench_telemetry_overhead(small: bool):
+    """A/B the instrumented ``sharded.TrainStep`` with FLAGS_telemetry=off
+    vs =metrics and emit ``telemetry_overhead_pct`` (min-of-windows wall
+    per step, identical model/batch/seed both arms). Also exports this
+    run's recorded step timeline as JSONL (BENCH_TRACE_OUT, default
+    ``BENCH_timeline.jsonl``) — every bench run carries its own timeline,
+    viewable with ``tools/trace_view.py``."""
+    import jax  # noqa: F401
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.observability import metrics as _omx
+    from paddle_tpu.observability import step_monitor
+    from paddle_tpu.optimizer import AdamW
+
+    batch = 32 if small else 64
+    hidden = 512 if small else 2048
+    steps = 20 if small else 30
+    windows = 5 if small else 5
+
+    def loss_fn(model, params, b):
+        x, y = b
+        return F.cross_entropy(functional_call(model, params, x), y).mean()
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, hidden)).astype(np.float32)
+    y = rng.integers(0, 10, (batch,)).astype(np.int64)
+
+    # ONE TrainStep serves both arms (telemetry is host-side only, outputs
+    # are bitwise identical — tested in test_observability.py), so the A/B
+    # compares the same executable on the same buffers and the arms can be
+    # interleaved window-by-window to cancel machine drift.
+    timeline = step_monitor.reset_default()  # this A/B's own timeline
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(hidden, hidden), nn.Tanh(),
+                        nn.Linear(hidden, hidden), nn.Tanh(),
+                        nn.Linear(hidden, 10))
+    ts = make_sharded_train_step(net, AdamW(1e-3), loss_fn)
+    prev = _flags.get_flags(["telemetry"])
+    best = {"off": None, "metrics": None}
+    try:
+        float(ts.step((x, y)))  # compile + warm
+        float(ts.step((x, y)))
+        for _ in range(windows):
+            for mode in ("off", "metrics"):
+                _flags.set_flags({"telemetry": mode})
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    loss = ts.step((x, y))
+                float(loss)  # sync the window
+                dt = (time.perf_counter() - t0) / steps
+                best[mode] = dt if best[mode] is None \
+                    else min(best[mode], dt)
+    finally:
+        _flags.set_flags(prev)
+    t_off, t_on = best["off"], best["metrics"]
+    overhead_pct = 100.0 * (t_on / t_off - 1.0)
+
+    # timeline export: the per-step records from the metrics arm (plus any
+    # earlier instrumented dispatches' series in the metrics snapshot)
+    out_path = os.environ.get("BENCH_TRACE_OUT", "BENCH_timeline.jsonl")
+    n_records = None
+    try:
+        n_records = timeline.export_jsonl(out_path)
+        from paddle_tpu.observability import trace as _otrace
+        n_records += _otrace.export_jsonl(out_path, append=True)
+    except Exception:
+        pass
+    telem_series = {k: v for k, v in _omx.snapshot().items()
+                    if k.startswith("telemetry.")}
+    _emit("telemetry_overhead_pct", overhead_pct, "pct", 0.0, {
+        "overhead_pct": round(overhead_pct, 3),
+        "step_ms_off": round(t_off * 1e3, 3),
+        "step_ms_metrics": round(t_on * 1e3, 3),
+        "steps_per_window": steps, "windows": windows,
+        "batch": batch, "hidden": hidden,
+        "timeline": timeline.summary(),
+        "timeline_jsonl": {"path": out_path, "records": n_records},
+        "telemetry_series": telem_series,
+        "note": "min-of-windows wall per instrumented sharded.TrainStep "
+                "step, FLAGS_telemetry=off vs =metrics, identical "
+                "model/batch/seed; view the JSONL with tools/trace_view.py",
+    })
+
+
+# ---------------------------------------------------------------------------
 # Config 4 (PRIMARY): GPT decoder LM
 # ---------------------------------------------------------------------------
 
@@ -1194,6 +1290,14 @@ def main():
             bench_pallas_conv_ab(small)
         except Exception as e:
             print(json.dumps({"metric": "bench_pallas_conv_ab_FAILED",
+                              "error": str(e)[:500]}), flush=True)
+    # telemetry overhead A/B + this run's timeline export (before the
+    # primary so the driver's final-line headline stays the GPT metric)
+    if os.environ.get("BENCH_TELEMETRY", "1") != "0":
+        try:
+            bench_telemetry_overhead(small)
+        except Exception as e:
+            print(json.dumps({"metric": "bench_telemetry_overhead_FAILED",
                               "error": str(e)[:500]}), flush=True)
     if "all" in selected or "gpt" in selected:
         bench_gpt(small)  # primary: printed last

@@ -42,6 +42,7 @@ from .framework.io import save, load  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .hapi.summary import summary  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from . import static  # noqa: F401
 from . import inference  # noqa: F401
 from . import sparse  # noqa: F401

@@ -530,6 +530,41 @@ def _rule_transfer_in_loop(ctx: LintContext):
                  "(framework/offload.StreamingUpdate)")
 
 
+@register_rule("J013", "telemetry-callback-in-step", WARNING,
+               "a host callback compiled into a step graph while "
+               "FLAGS_telemetry is not 'trace' — telemetry must stay "
+               "host-side")
+def _rule_telemetry_callback(ctx: LintContext):
+    """Telemetry spans/metrics are host-side by design (observability/
+    step_monitor times at dispatch level). A ``pure_callback``/
+    ``io_callback``/``debug.print`` inside a jitted train step is the
+    instrumented-the-wrong-layer accident: it forces a device->host sync
+    per dispatch and under ``FLAGS_telemetry=off`` it still fires —
+    exactly the non-intrusiveness guarantee the flag promises. Only an
+    explicitly requested trace run (``FLAGS_telemetry=trace``) may accept
+    in-graph callbacks as a temporary debugging aid."""
+    from ..core import flags
+    try:
+        if str(flags.flag("telemetry")) == "trace":
+            return
+    except KeyError:
+        pass
+    rule = _RULES["J013"]
+    prims = CALLBACK_PRIMS | {"debug_print"}
+    for info in ctx.eqns:
+        if info.eqn.primitive.name not in prims:
+            continue
+        yield _diag(
+            rule,
+            f"'{info.eqn.primitive.name}' compiled into the step graph "
+            "while FLAGS_telemetry != 'trace' — a host sync per dispatch "
+            "that no flag can turn off",
+            info.eqn,
+            hint="move the measurement to dispatch level "
+                 "(observability.step_monitor phases / metrics), or run "
+                 "under FLAGS_telemetry=trace while debugging")
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------

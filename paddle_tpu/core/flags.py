@@ -152,6 +152,15 @@ define_flag("offload_optimizer", "off",
             "memory and streams them through HBM per block during the "
             "update (ZeRO-Offload-style).",
             choices=("off", "moments"))
+define_flag("telemetry", "metrics",
+            "Runtime telemetry level (paddle_tpu.observability): 'off' "
+            "disables every host-side signal (bitwise non-intrusive on "
+            "step outputs), 'metrics' (default) keeps the always-on "
+            "counters/gauges/histograms + step timeline + recompile "
+            "sentinel + HBM watermarks, 'trace' additionally records "
+            "span trees into the in-memory ring for chrome-trace/JSONL "
+            "export.",
+            choices=("off", "metrics", "trace"))
 define_flag("static_analysis", "off",
             "Graph/kernel static analysis mode (paddle_tpu.analysis): "
             "'off' skips, 'warn' prints diagnostics to stderr, 'error' "

@@ -688,7 +688,14 @@ def make_pipeline_train_step(pl, opt, hcg=None, n_microbatch: int = 1,
             new_params, new_state = opt.apply_gradients(params, grads,
                                                         opt_state, lr)
             return new_params, new_state, loss
-        return _step
+        # Telemetry: dispatches are fingerprinted through the recompile
+        # sentinel and timed as compile/device phases; .lower passes
+        # through, so compiled-cost introspection (bench rooflines) still
+        # reaches the executable.
+        from ..observability.step_monitor import instrument_jitted
+        return instrument_jitted(
+            _step, name=f"pipeline_train_step:{loss_of.__name__}",
+            donate=(0, 1))
 
     if use_pipeline:
         return make_step(loss_pipe)

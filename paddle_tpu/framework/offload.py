@@ -293,6 +293,8 @@ class StreamingUpdate:
         buffer. Global-norm grad clip runs ONCE over the full grad tree
         before any block update (a per-block clip would change the norm).
         """
+        from ..observability import step_monitor
+        tm = step_monitor.current()
         if self._clip_fn is not None:
             grads = self._clip_fn(grads)
         lr = jnp.asarray(lr, jnp.float32)
@@ -303,14 +305,17 @@ class StreamingUpdate:
         groups = [(k, names) for k, names in groups if names]
         new_params = dict(params)
         new_pstates = dict(pstates)
-        inflight = self._prefetch(groups[0][1], params, pstates) \
-            if groups else {}
+        with tm.phase("offload_in"):
+            inflight = self._prefetch(groups[0][1], params, pstates) \
+                if groups else {}
         for i, (_, names) in enumerate(groups):
             dev_moments = inflight
             if i + 1 < len(groups):
                 # issue next block's H2D now — it rides the host link
                 # while this block's update occupies the core
-                inflight = self._prefetch(groups[i + 1][1], params, pstates)
+                with tm.phase("offload_in"):
+                    inflight = self._prefetch(groups[i + 1][1], params,
+                                              pstates)
             p_blk = {n: params[n] for n in names}
             g_blk = {n: grads[n] for n in names}
             st_blk = {}
@@ -319,13 +324,15 @@ class StreamingUpdate:
                 st_blk[n] = {**{k: v for k, v in st.items()
                                 if not self._offloadable(k, v)},
                              **dev_moments.get(n, {})}
-            new_p_blk, new_st_blk = self._block_fn(p_blk, g_blk, st_blk,
-                                                   step, lr)
-            for n in names:
-                new_pstates[n] = {
-                    k: (self._to_host(v, donate=True)
-                        if self._offloadable(k, v) else v)
-                    for k, v in new_st_blk[n].items()}
+            with tm.phase("device"):
+                new_p_blk, new_st_blk = self._block_fn(p_blk, g_blk, st_blk,
+                                                       step, lr)
+            with tm.phase("offload_out"):
+                for n in names:
+                    new_pstates[n] = {
+                        k: (self._to_host(v, donate=True)
+                            if self._offloadable(k, v) else v)
+                        for k, v in new_st_blk[n].items()}
             new_params.update(new_p_blk)
         return new_params, {"step": step + jnp.ones((), jnp.int32),
                             "param_states": new_pstates}

@@ -283,6 +283,12 @@ class TrainStep:
         jaxpr_lint.emit(diags, where="sharded.TrainStep")
 
     def step(self, batch) -> jax.Array:
+        from ..observability import step_monitor
+        tm = step_monitor.current()
+        with tm.step():
+            return self._step_inner(batch, tm)
+
+    def _step_inner(self, batch, tm) -> jax.Array:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         ndim_cache: Dict[int, NamedSharding] = {}
 
@@ -294,7 +300,8 @@ class TrainStep:
                 ndim_cache[x.ndim] = sh
             return jax.device_put(x, sh)
 
-        batch = jax.tree_util.tree_map(place, batch)
+        with tm.phase("h2d"):
+            batch = jax.tree_util.tree_map(place, batch)
         self._step_count += 1
         key = jax.random.fold_in(self._base_key, self._step_count)
         # Trace-time consumers (sharding constraints, CP attention) resolve
@@ -306,15 +313,27 @@ class TrainStep:
         set_hybrid_mesh(self.mesh)
         try:
             self._maybe_lint(batch, lr, key)
+            # Recompile sentinel: params/opt-state signatures are fixed at
+            # construction — churn can only come from the batch (and lr
+            # dtype), so only those are fingerprinted. The dispatch that
+            # first sees a signature is timed as "compile", later ones as
+            # "device".
+            dispatch_phase = "device"
+            if tm.enabled:
+                dispatch_phase = tm.observe_dispatch(
+                    ("sharded.TrainStep", id(self)), (batch, lr),
+                    where="sharded.TrainStep")
             if self._offload is not None:
-                loss, grads, self.buffers = self._compiled(
-                    self.params, self.buffers, batch, key)
+                with tm.phase(dispatch_phase):
+                    loss, grads, self.buffers = self._compiled(
+                        self.params, self.buffers, batch, key)
                 self.params, self.opt_state = self._offload.update(
                     self.params, grads, self.opt_state, lr)
             else:
-                loss, self.params, self.opt_state, self.buffers = \
-                    self._compiled(self.params, self.opt_state, self.buffers,
-                                   batch, lr, key)
+                with tm.phase(dispatch_phase):
+                    loss, self.params, self.opt_state, self.buffers = \
+                        self._compiled(self.params, self.opt_state,
+                                       self.buffers, batch, lr, key)
         finally:
             set_hybrid_mesh(prev_mesh)
         sched = self.optimizer.lr_scheduler

@@ -10,7 +10,8 @@ from typing import Dict, List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRSchedulerCallback",
-           "EarlyStopping", "config_callbacks", "CallbackList"]
+           "EarlyStopping", "StatsLoggerCallback", "config_callbacks",
+           "CallbackList"]
 
 
 class Callback:
@@ -143,6 +144,37 @@ class LRSchedulerCallback(Callback):
             sched.step()
 
 
+class StatsLoggerCallback(Callback):
+    """Per-epoch stat snapshots in the training log + a periodic
+    ``StatsReporter`` for long epochs (ref: the reference's monitor/stat
+    registry feeding the per-rank worker logs). Installed by
+    ``config_callbacks`` whenever ``FLAGS_telemetry`` != ``off``; the old
+    construct-but-never-start gap is closed here — ``fit`` owns the
+    reporter's lifecycle."""
+
+    def __init__(self, interval: float = 60.0, logger=None):
+        from ..profiler.monitor import get_logger
+        self.interval = interval
+        self.logger = logger or get_logger("paddle_tpu.monitor")
+        self._reporter = None
+
+    def on_train_begin(self, logs=None):
+        from ..profiler.monitor import StatsReporter
+        if self._reporter is None:
+            self._reporter = StatsReporter(self.interval, logger=self.logger)
+        self._reporter.start()
+
+    def on_epoch_end(self, epoch, logs=None):
+        from ..observability import metrics
+        snap = metrics.stats_snapshot()
+        if snap:
+            self.logger.info("epoch %d stats %s", epoch, snap)
+
+    def on_train_end(self, logs=None):
+        if self._reporter is not None:
+            self._reporter.stop()
+
+
 class EarlyStopping(Callback):
     def __init__(self, monitor: str = "loss", mode: str = "auto",
                  patience: int = 0, verbose: int = 1, min_delta: float = 0,
@@ -188,6 +220,10 @@ def config_callbacks(callbacks=None, model=None, log_freq: int = 10,
         cbks.append(LRSchedulerCallback())
     if save_dir and not any(isinstance(c, ModelCheckpoint) for c in cbks):
         cbks.append(ModelCheckpoint(save_freq, save_dir))
+    from ..observability.trace import telemetry_mode
+    if telemetry_mode() != "off" and \
+            not any(isinstance(c, StatsLoggerCallback) for c in cbks):
+        cbks.append(StatsLoggerCallback())
     cl = CallbackList(cbks)
     if model is not None:
         cl.set_model(model)

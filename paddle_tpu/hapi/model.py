@@ -271,15 +271,28 @@ class Model:
         lr = jnp.asarray(self._optimizer.get_lr(), jnp.float32)
         key = default_generator().next_key()
 
+        from ..observability import step_monitor
+        tm = step_monitor.current()
+
+        def _dispatch_phase(kind):
+            # Recompile sentinel: churn comes from the (inputs, labels)
+            # signature; the first dispatch of a signature is "compile".
+            if not tm.enabled:
+                return "device"
+            return tm.observe_dispatch(
+                (f"Model.{kind}", id(self)), (inputs, labels),
+                where=f"hapi.Model.{kind}")
+
         accumulating = (not update) or self._accum_grads is not None
         if not accumulating:
             # Fast path: fused grad+apply, donated state.
             if self._train_step_fn is None:
                 self._train_step_fn = self._build_train_step()
-            (new_params, new_buffers, self._opt_state, self._scaler_state,
-             loss, out) = self._train_step_fn(
-                params, buffers, self._opt_state, self._scaler_state,
-                inputs, labels, lr, key)
+            with tm.phase(_dispatch_phase("train_batch")):
+                (new_params, new_buffers, self._opt_state,
+                 self._scaler_state, loss, out) = self._train_step_fn(
+                    params, buffers, self._opt_state, self._scaler_state,
+                    inputs, labels, lr, key)
             set_params(self.network, new_params)
             set_buffers(self.network, new_buffers)
             self._step_count += 1
@@ -288,8 +301,9 @@ class Model:
         # Accumulation path (update=False micro-batches, then update=True).
         if self._grad_step_fn is None:
             self._grad_step_fn = self._build_grad_step()
-        grads, new_buffers, loss, found_inf = self._grad_step_fn(
-            params, buffers, self._scaler_state, inputs, labels, key)
+        with tm.phase(_dispatch_phase("grad_batch")):
+            grads, new_buffers, loss, found_inf = self._grad_step_fn(
+                params, buffers, self._scaler_state, inputs, labels, key)
         set_buffers(self.network, new_buffers)
         if self._accum_grads is None:
             self._accum_grads, self._accum_count = grads, 1
@@ -378,6 +392,8 @@ class Model:
                                 verbose=verbose, save_freq=save_freq,
                                 save_dir=save_dir, metrics=self._metrics)
         self.stop_training = False
+        from ..observability import step_monitor
+        tm = step_monitor.current()
         cbks.on_train_begin()
         iters_done = 0
         for epoch in range(epochs):
@@ -388,13 +404,16 @@ class Model:
             for m in self._metrics:
                 m.reset()
             for step, batch in enumerate(loader):
-                cbks.on_train_batch_begin(step)
-                inputs, labels = self._split_batch(batch)
-                update = (step + 1) % max(1, accumulate_grad_batches) == 0
-                loss = self.train_batch(inputs, labels, update=update)
-                logs["loss"] = loss
-                logs["lr"] = self._optimizer.get_lr()
-                cbks.on_train_batch_end(step, logs)
+                with tm.step():
+                    with tm.phase("callbacks"):
+                        cbks.on_train_batch_begin(step)
+                    inputs, labels = self._split_batch(batch)
+                    update = (step + 1) % max(1, accumulate_grad_batches) == 0
+                    loss = self.train_batch(inputs, labels, update=update)
+                    logs["loss"] = loss
+                    logs["lr"] = self._optimizer.get_lr()
+                    with tm.phase("callbacks"):
+                        cbks.on_train_batch_end(step, logs)
                 iters_done += 1
                 if num_iters is not None and iters_done >= num_iters:
                     self.stop_training = True

@@ -273,7 +273,16 @@ class DataLoader:
 
     @staticmethod
     def _counted(source: Iterator[Any]) -> Iterator[Any]:
-        for batch in source:
+        # Telemetry "data" phase: the wall time the consumer spends WAITING
+        # on the loader (assembly already overlapped by workers doesn't
+        # show up here — only stalls the training loop actually feels).
+        from ..observability import step_monitor
+        tm = step_monitor.current()
+        while True:
+            with tm.phase("data"):
+                batch = next(source, _END)
+            if batch is _END:
+                return
             stat_add("dataloader.batches")
             yield batch
 

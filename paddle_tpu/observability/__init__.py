@@ -1,0 +1,42 @@
+"""paddle_tpu.observability — always-on runtime telemetry.
+
+The XLA-idiomatic successor to the reference's two-tier profiler
+(``paddle/fluid/platform/profiler/``) and ``monitor``/``stat`` registry:
+instead of an attach-a-profiler workflow, the training hot path carries a
+low-overhead measurement layer that is always there (gated by
+``FLAGS_telemetry`` = ``off`` | ``metrics`` (default) | ``trace``):
+
+- :mod:`.metrics` — labeled counters/gauges/log-bucket histograms with
+  Prometheus-text and JSON exposition; absorbs the old
+  ``profiler.monitor`` flat stat registry (which now forwards here).
+- :mod:`.trace` — thread-safe nestable ``span()`` context managers
+  buffering into an in-memory ring, exported as chrome-trace JSON or
+  JSONL (``FLAGS_telemetry=trace`` only).
+- :mod:`.step_monitor` — the :class:`StepTimeline` (per-step phases:
+  data/h2d/compile/device/offload_in/offload_out/callbacks), the
+  recompile sentinel (Diagnostic O001 with the exact shape/dtype diff
+  when a jitted callable churns signatures), and HBM watermarks sampled
+  from ``device.memory_stats()`` and cross-checked against
+  ``tools/hbm_budget.py`` plans (O002).
+
+Wiring: ``framework.sharded.TrainStep``, ``framework.offload``,
+``distributed.pipeline_schedule``, ``io.dataloader`` and ``hapi`` report
+into the process-wide timeline (``step_monitor.current()``); ``bench.py``
+A/Bs the overhead (``telemetry_overhead_pct``) and exports each run's
+timeline; ``tools/trace_view.py`` renders the JSONL. See OBSERVABILITY.md.
+"""
+
+from . import metrics  # noqa: F401
+from . import trace  # noqa: F401
+from . import step_monitor  # noqa: F401
+from .trace import span, telemetry_mode  # noqa: F401
+from .step_monitor import (StepTimeline, RecompileSentinel,  # noqa: F401
+                           current, reset_default, instrument_jitted,
+                           fingerprint, fingerprint_diff)
+
+__all__ = [
+    "metrics", "trace", "step_monitor",
+    "span", "telemetry_mode",
+    "StepTimeline", "RecompileSentinel", "current", "reset_default",
+    "instrument_jitted", "fingerprint", "fingerprint_diff",
+]

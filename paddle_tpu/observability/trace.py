@@ -1,0 +1,155 @@
+"""Span-tree tracer: host-side nested spans with structured export.
+
+The reference merges a C++ HostTracer and a CUPTI CudaTracer into one
+chrome-trace JSON (``paddle/fluid/platform/profiler/``). On TPU the device
+half already exists (``jax.profiler`` XPlane); what was missing is the
+*always-available* host half — a tracer cheap enough to leave compiled
+into every run and structured enough to export without TensorBoard:
+
+- :func:`span` — thread-safe, nestable context manager. Active only under
+  ``FLAGS_telemetry=trace``; when active it also opens a
+  ``jax.profiler.TraceAnnotation`` so the span shows up inside a captured
+  XPlane trace, correlated with device work.
+- completed spans land in a bounded in-memory ring (oldest evicted), so a
+  multi-day trainer can keep tracing without growing;
+- :func:`export_chrome_trace` (``chrome://tracing`` / Perfetto JSON) and
+  :func:`export_jsonl` (one span per line — the format
+  ``tools/trace_view.py`` aggregates).
+
+Spans are host wall-time (``perf_counter_ns``). They never enter traced
+code — a span inside ``jit`` would be a trace-time constant; lint rule
+J013 flags host callbacks smuggled into step graphs instead.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from ..core.flags import flag
+
+__all__ = ["span", "Span", "telemetry_mode", "tracing_active", "spans",
+           "clear", "export_chrome_trace", "export_jsonl", "RING_CAPACITY"]
+
+RING_CAPACITY = 65536
+
+_ring: "deque[Dict[str, Any]]" = deque(maxlen=RING_CAPACITY)
+_ring_mu = threading.Lock()
+_tls = threading.local()
+
+
+def telemetry_mode() -> str:
+    """Current ``FLAGS_telemetry`` value (off | metrics | trace)."""
+    try:
+        return str(flag("telemetry"))
+    except KeyError:  # core.flags not initialized (partial import)
+        return "off"
+
+
+def tracing_active() -> bool:
+    return telemetry_mode() == "trace"
+
+
+def _stack() -> List["Span"]:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+class Span:
+    """One open span; records itself into the ring on exit."""
+
+    __slots__ = ("name", "attrs", "begin_ns", "depth", "_ann", "_active")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+        self.begin_ns = 0
+        self.depth = 0
+        self._ann = None
+        self._active = False
+
+    def __enter__(self) -> "Span":
+        self._active = tracing_active()
+        if not self._active:
+            return self
+        st = _stack()
+        self.depth = len(st)
+        st.append(self)
+        try:  # device-trace correlation (best effort: no-op off-TPU trace)
+            import jax
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+        self.begin_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        if not self._active:
+            return False
+        end_ns = time.perf_counter_ns()
+        if self._ann is not None:
+            self._ann.__exit__(*exc)
+            self._ann = None
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        rec = {
+            "kind": "span",
+            "name": self.name,
+            "ts_us": self.begin_ns / 1e3,
+            "dur_us": (end_ns - self.begin_ns) / 1e3,
+            "tid": threading.get_ident(),
+            "depth": self.depth,
+        }
+        if self.attrs:
+            rec["attrs"] = dict(self.attrs)
+        with _ring_mu:
+            _ring.append(rec)
+        return False
+
+
+def span(name: str, **attrs: Any) -> Span:
+    """``with span("offload/h2d", block=3): ...`` — no-op unless
+    ``FLAGS_telemetry=trace`` (checked at enter, so runtime ``set_flags``
+    changes take effect immediately)."""
+    return Span(name, attrs)
+
+
+def spans() -> List[Dict[str, Any]]:
+    """Snapshot of the ring (oldest first)."""
+    with _ring_mu:
+        return list(_ring)
+
+
+def clear() -> None:
+    with _ring_mu:
+        _ring.clear()
+
+
+def export_chrome_trace(path: str) -> int:
+    """Write the ring as chrome-trace JSON; returns the event count."""
+    events = []
+    for s in spans():
+        ev = {"name": s["name"], "ph": "X", "ts": s["ts_us"],
+              "dur": s["dur_us"], "pid": 0, "tid": s["tid"]}
+        if s.get("attrs"):
+            ev["args"] = s["attrs"]
+        events.append(ev)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return len(events)
+
+
+def export_jsonl(path: str, append: bool = False) -> int:
+    """Write the ring as JSONL (one span per line); returns the count."""
+    recs = spans()
+    with open(path, "a" if append else "w") as f:
+        for r in recs:
+            f.write(json.dumps(r) + "\n")
+    return len(recs)

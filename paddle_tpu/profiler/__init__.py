@@ -7,6 +7,11 @@ states at ``profiler.py:79`` and ``export_chrome_tracing``): on TPU the
 device-side tracer is XLA/XPlane via ``jax.profiler`` (viewable in
 TensorBoard/Perfetto — the chrome-tracing analog), and host spans are
 ``jax.profiler.TraceAnnotation``/``named_scope`` (our RecordEvent).
+
+This module is the *windowed deep-dive* tool; the always-on layer
+(metrics, step timeline, recompile sentinel, HBM watermarks) lives in
+``paddle_tpu.observability`` — the ``monitor`` stat registry below now
+forwards there. See OBSERVABILITY.md for the concept mapping.
 """
 
 from __future__ import annotations

@@ -1,10 +1,14 @@
-"""Monitor counters — process-wide stat registry.
+"""Monitor counters — forwarding shim over ``observability.metrics``.
 
 Ref: ``paddle/fluid/platform/monitor.h`` (``MonitorRegistrar``/``StatValue``
 with the STAT_ADD/STAT_GET macro surface) and the per-rank log convention of
-``distributed/launch``. Counters are cheap thread-safe host-side tallies for
-runtime observability (queue bytes, batches, restarts, step counts); they
-never enter traced code — inside ``jit`` use the profiler, not counters.
+``distributed/launch``. The flat stat registry that used to live here was
+absorbed by :mod:`paddle_tpu.observability.metrics` (labeled metric
+families, Prometheus/JSON exposition); the ``stat_*`` surface below
+forwards there unchanged, so old call sites and the new telemetry series
+share one registry. Counters are cheap thread-safe host-side tallies; they
+never enter traced code — inside ``jit`` use the profiler, not counters
+(lint rule J013).
 """
 
 from __future__ import annotations
@@ -13,87 +17,42 @@ import logging
 import os
 import sys
 import threading
-import time
 from typing import Dict, Union
+
+from ..observability import metrics as _metrics
 
 __all__ = ["stat", "stat_add", "stat_set", "stat_get", "stats_snapshot",
            "stats_reset", "get_logger"]
 
 _Number = Union[int, float]
 
-
-class StatValue:
-    __slots__ = ("name", "_value", "_mu")
-
-    def __init__(self, name: str):
-        self.name = name
-        self._value: _Number = 0
-        self._mu = threading.Lock()
-
-    def add(self, n: _Number = 1) -> None:
-        with self._mu:
-            self._value += n
-
-    def set(self, v: _Number) -> None:
-        with self._mu:
-            self._value = v
-
-    def get(self) -> _Number:
-        with self._mu:
-            return self._value
-
-    def reset(self) -> None:
-        self.set(0)
-
-
-class _Registry:
-    def __init__(self):
-        self._mu = threading.Lock()
-        self._stats: Dict[str, StatValue] = {}
-
-    def get(self, name: str) -> StatValue:
-        with self._mu:
-            s = self._stats.get(name)
-            if s is None:
-                s = self._stats[name] = StatValue(name)
-            return s
-
-    def snapshot(self) -> Dict[str, _Number]:
-        with self._mu:
-            return {k: v.get() for k, v in sorted(self._stats.items())}
-
-    def reset(self) -> None:
-        with self._mu:
-            for v in self._stats.values():
-                v.reset()
-
-
-_registry = _Registry()
+# Old name for the registry's flat-stat series (supports add/set/get/reset).
+StatValue = _metrics.Stat
 
 
 def stat(name: str) -> StatValue:
     """The named counter (created on first use)."""
-    return _registry.get(name)
+    return _metrics.stat(name)
 
 
 def stat_add(name: str, n: _Number = 1) -> None:
-    _registry.get(name).add(n)
+    _metrics.stat_add(name, n)
 
 
 def stat_set(name: str, v: _Number) -> None:
-    _registry.get(name).set(v)
+    _metrics.stat_set(name, v)
 
 
 def stat_get(name: str) -> _Number:
-    return _registry.get(name).get()
+    return _metrics.stat_get(name)
 
 
 def stats_snapshot() -> Dict[str, _Number]:
-    return _registry.snapshot()
+    return _metrics.stats_snapshot()
 
 
 def stats_reset() -> None:
-    _registry.reset()
+    _metrics.stats_reset()
 
 
 # -- rank-aware logging (ref fleet/utils/log_util.py LoggerFactory) ---------

@@ -57,49 +57,99 @@ def host_statistics(events: Optional[Sequence[Tuple[str, int, int]]] = None
     return sorted(stats.values(), key=lambda s: -s.total_ns)
 
 
-def device_statistics(log_dir: str, top: int = 15):
+def _degrade(message: str, severity: Optional[str] = None,
+             diagnostics=None) -> None:
+    """Record a structured note about why device stats are unavailable and
+    route it through the analysis channel (rule O003). Never raises: a
+    missing/broken profile dump must degrade the report, not the run."""
+    try:
+        from ..analysis import jaxpr_lint
+        d = jaxpr_lint.Diagnostic(
+            rule="O003", name="device-stats-unavailable",
+            severity=severity or jaxpr_lint.INFO, message=message,
+            where="profiler.statistic.device_statistics",
+            hint="host-side stats still work; re-capture the trace (or "
+                 "install xprof/tensorboard_plugin_profile) for the "
+                 "KernelView")
+        if diagnostics is not None:
+            diagnostics.append(d)
+        try:
+            jaxpr_lint.emit([d], where=d.where)
+        except jaxpr_lint.GraphLintError:
+            raise
+        except Exception:
+            pass
+    except ImportError:
+        pass
+
+
+def device_statistics(log_dir: str, top: int = 15, diagnostics=None):
     """Parse the newest xplane.pb under log_dir into (by_category,
-    top_ops). Returns None when no trace or no parser is available."""
+    top_ops). Degrades gracefully — returns None (with an O003 Diagnostic
+    through the analysis channel, appended to ``diagnostics`` when a list
+    is given) when no parser is importable, the log dir is missing/empty,
+    or the XPlane payload is unparseable. Never raises."""
     try:
         from xprof.convert import raw_to_tool_data as rtd
-    except ImportError:
+    except Exception:
+        # tensorboard_plugin_profile can fail with AttributeError (its
+        # _pywrap_profiler ABI drifts), not just ImportError — any failure
+        # to produce a parser degrades the same way.
         try:
             from tensorboard_plugin_profile.convert import (  # type: ignore
                 raw_to_tool_data as rtd)
-        except ImportError:
+        except Exception as e:
+            _degrade(f"no usable XPlane parser: {type(e).__name__}: {e}",
+                     diagnostics=diagnostics)
             return None
+    if not os.path.isdir(log_dir):
+        _degrade(f"profiler log dir {log_dir!r} does not exist",
+                 diagnostics=diagnostics)
+        return None
     sessions = sorted(glob.glob(os.path.join(log_dir, "plugins/profile/*")))
     if not sessions:
+        _degrade(f"no profile sessions under {log_dir!r}",
+                 diagnostics=diagnostics)
         return None
     xplane = glob.glob(os.path.join(sessions[-1], "*.xplane.pb"))
     if not xplane:
+        _degrade(f"no *.xplane.pb in session {sessions[-1]!r}",
+                 diagnostics=diagnostics)
         return None
-    import json
-    data, _ = rtd.xspace_to_tool_data(xplane, "hlo_stats", {})
-    d = json.loads(data.decode() if isinstance(data, bytes) else data)
-    cols = [c["id"] for c in d["cols"]]
-    rows = [[c.get("v") for c in r["c"]] for r in d["rows"]]
+    try:
+        import json
+        data, _ = rtd.xspace_to_tool_data(xplane, "hlo_stats", {})
+        d = json.loads(data.decode() if isinstance(data, bytes) else data)
+        cols = [c["id"] for c in d["cols"]]
+        rows = [[c.get("v") for c in r["c"]] for r in d["rows"]]
 
-    def col(name):
-        return cols.index(name) if name in cols else None
+        def col(name):
+            return cols.index(name) if name in cols else None
 
-    i_cat, i_t = col("category"), col("total_self_time")
-    i_expr = col("hlo_op_expression") or col("hlo_op_name")
-    i_bound = col("bound_by")
-    i_occ = col("occurrences")
-    by_cat: Dict[str, float] = {}
-    for r in rows:
-        t = (r[i_t] or 0.0) / 1e3  # us -> ms
-        by_cat[str(r[i_cat])] = by_cat.get(str(r[i_cat]), 0.0) + t
-    rows.sort(key=lambda r: -(r[i_t] or 0.0))
-    top_ops = [{
-        "ms": (r[i_t] or 0.0) / 1e3,
-        "category": str(r[i_cat]),
-        "occurrences": r[i_occ] if i_occ is not None else None,
-        "bound_by": str(r[i_bound]) if i_bound is not None else "",
-        "op": str(r[i_expr])[:120],
-    } for r in rows[:top]]
-    return by_cat, top_ops
+        i_cat, i_t = col("category"), col("total_self_time")
+        i_expr = col("hlo_op_expression") or col("hlo_op_name")
+        i_bound = col("bound_by")
+        i_occ = col("occurrences")
+        by_cat: Dict[str, float] = {}
+        for r in rows:
+            t = (r[i_t] or 0.0) / 1e3  # us -> ms
+            by_cat[str(r[i_cat])] = by_cat.get(str(r[i_cat]), 0.0) + t
+        rows.sort(key=lambda r: -(r[i_t] or 0.0))
+        top_ops = [{
+            "ms": (r[i_t] or 0.0) / 1e3,
+            "category": str(r[i_cat]),
+            "occurrences": r[i_occ] if i_occ is not None else None,
+            "bound_by": str(r[i_bound]) if i_bound is not None else "",
+            "op": str(r[i_expr])[:120],
+        } for r in rows[:top]]
+        return by_cat, top_ops
+    except Exception as e:
+        from ..analysis.jaxpr_lint import WARNING
+        _degrade(
+            f"XPlane trace in {sessions[-1]!r} unparseable: "
+            f"{type(e).__name__}: {e}", severity=WARNING,
+            diagnostics=diagnostics)
+        return None
 
 
 def _fmt_time(ns: float, unit: str) -> str:

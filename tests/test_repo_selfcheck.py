@@ -1,0 +1,75 @@
+"""Repo self-check: the static-analysis gates run over the repo itself, so
+new rules (J013, O0xx) and new subsystems (paddle_tpu/observability/) gate
+each other — a lint rule that the repo's own code trips fails CI here, and
+an observability module with a banned idiom (host clock in a kernel, flag
+registry bypass, constant seed) fails the same way."""
+
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def test_lint_graph_all_exits_zero(capsys):
+    """`tools/lint_graph.py --all` — every example model graph, the Pallas
+    kernel configs, and the AST repo lint — must stay error-free."""
+    from tools import lint_graph
+    rc = lint_graph.run(sorted(lint_graph.MODELS), with_kernels=True,
+                        with_repo=True, min_severity="info")
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 error(s)" in out
+
+
+def test_repo_lint_clean_over_observability():
+    """The new subsystem passes the source rules it sits next to (R001
+    host clocks are fine here — observability is not a kernel module — but
+    R002/R003 apply in full)."""
+    from paddle_tpu.analysis import repo_lint
+    diags = repo_lint.lint_tree(REPO, subdir=os.path.join(
+        "paddle_tpu", "observability"))
+    errors = [d for d in diags if d.severity == "error"]
+    assert errors == [], [d.format() for d in errors]
+
+
+def test_observability_graphs_have_no_callbacks():
+    """J013 self-application: the instrumented train step compiles no host
+    callbacks — telemetry is dispatch-level by construction."""
+    import jax
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.analysis import lint_fn
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+    def loss_fn(model, params, batch):
+        x, y = batch
+        return F.cross_entropy(functional_call(model, params, x), y).mean()
+
+    ts = make_sharded_train_step(net, AdamW(1e-3), loss_fn)
+    import jax.numpy as jnp
+    batch = (jnp.zeros((8, 8), jnp.float32), jnp.zeros((8,), jnp.int32))
+    key = jax.random.key(0)
+    lr = jnp.float32(1e-3)
+    diags = lint_fn(ts._step_fn, ts.params, ts.opt_state, ts.buffers,
+                    batch, lr, key, where="selfcheck")
+    assert "J013" not in {d.rule for d in diags}
+
+
+def test_telemetry_flag_registered():
+    """FLAGS_telemetry goes through the registry (R003 would catch a
+    bypass; this catches a typo'd default)."""
+    from paddle_tpu.core import flags
+    assert flags.flag("telemetry") in ("off", "metrics", "trace")
+    with pytest.raises(ValueError):
+        flags.set_flags({"telemetry": "verbose"})
+    assert "telemetry" not in flags.unknown_env_flags()

@@ -283,6 +283,50 @@ def test_j012_negative_offload_block_update_clean():
 
 
 # ---------------------------------------------------------------------------
+# J013 telemetry callback in step graph
+# ---------------------------------------------------------------------------
+
+def _cb_fn(x):
+    return np.asarray(x)
+
+
+def _with_pure_callback(x):
+    y = jax.pure_callback(_cb_fn, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return y.sum()
+
+
+@pytest.fixture
+def telemetry_mode_restore():
+    prev = flags.get_flags(["telemetry"])
+    yield
+    flags.set_flags(prev)
+
+
+def test_j013_callback_flagged_when_telemetry_not_trace(
+        telemetry_mode_restore):
+    flags.set_flags({"telemetry": "metrics"})
+    diags = lint_fn(_with_pure_callback, jnp.ones((4,)))
+    hits = [d for d in diags if d.rule == "J013"]
+    assert hits and hits[0].severity == "warning"
+    assert "host-side" in hits[0].hint or "dispatch level" in hits[0].hint
+    # off is even stricter a promise — still flagged
+    flags.set_flags({"telemetry": "off"})
+    assert "J013" in rules_of(lint_fn(_with_pure_callback, jnp.ones((4,))))
+
+
+def test_j013_negative_under_trace_mode(telemetry_mode_restore):
+    flags.set_flags({"telemetry": "trace"})
+    diags = lint_fn(_with_pure_callback, jnp.ones((4,)))
+    assert "J013" not in rules_of(diags)
+
+
+def test_j013_negative_no_callback(telemetry_mode_restore):
+    flags.set_flags({"telemetry": "metrics"})
+    diags = lint_fn(lambda x: x.sum(), jnp.ones((4,)))
+    assert "J013" not in rules_of(diags)
+
+
+# ---------------------------------------------------------------------------
 # Pallas / TPU-constraint checker
 # ---------------------------------------------------------------------------
 
