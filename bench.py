@@ -897,6 +897,133 @@ def bench_telemetry_overhead(small: bool):
 # Config 4 (PRIMARY): GPT decoder LM
 # ---------------------------------------------------------------------------
 
+def bench_comm_overlap(small: bool):
+    """A/B the communication-overlap tier (FLAGS_comm_overlap): the
+    Megatron-SP column/row pair as decomposed bidirectional ppermute
+    pipelines vs the GSPMD-scheduled step — same model/seed/batch both
+    arms, loss parity asserted, min-of-windows step time per mode. Needs
+    >= 2 devices on the mp axis; on a single chip the metric still emits
+    the static hop plans (analysis/comm_check) for the next device round.
+    """
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.analysis import comm_check
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.distributed.fleet.utils.sequence_parallel_utils import (
+        ColumnSequenceParallelLinear, RowSequenceParallelLinear,
+        sequence_parallel_constraint)
+    from paddle_tpu.distributed.topology import (create_hybrid_mesh,
+                                                 set_hybrid_mesh)
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.optimizer import AdamW
+
+    # The GPT-1.3B per-layer hop plan (mp=4, bf16) — the A/B shapes the
+    # next device round runs, emitted even when this host cannot.
+    planned = [
+        comm_check.spec_for_allgather_matmul(8, 512, 2048, 2048, 4, 2),
+        comm_check.spec_for_matmul_reduce_scatter(8, 512, 2048, 2048, 4, 2),
+    ]
+    planned_rows = [{
+        "op": s.name, "hops": s.hops,
+        "bytes_per_hop_mb": round(s.bytes_per_hop / 2**20, 3),
+        "diagnostics": [d.rule for d in comm_check.check_comm_spec(s)],
+    } for s in planned]
+
+    mp = 1
+    while mp * 2 <= min(8, jax.device_count()):
+        mp *= 2
+    if mp < 2:
+        print(json.dumps({
+            "metric": "comm_overlap", "value": 0.0, "unit": "ratio",
+            "extra": {"skipped": "needs >=2 devices on the mp axis",
+                      "devices": jax.device_count(),
+                      "planned_specs": planned_rows}}), flush=True)
+        return
+
+    d = 64 if small else 256
+    seq = mp * (16 if small else 64)
+    batch = 4 if small else 8
+    steps = 10 if small else 20
+    windows = 3
+
+    class SPBlock(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = ColumnSequenceParallelLinear(d, 4 * d,
+                                                    gather_output=False)
+            self.fc2 = RowSequenceParallelLinear(4 * d, d,
+                                                 input_is_parallel=True)
+
+        def forward(self, x):
+            x = sequence_parallel_constraint(x)
+            return self.fc2(jax.nn.gelu(self.fc1(x)))
+
+    class Stack(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = nn.LayerList([SPBlock() for _ in range(4)])
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return x
+
+    def loss_fn(model, params, b):
+        x, y = b
+        return jnp.mean((functional_call(model, params, x,
+                                         training=True) - y) ** 2)
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, seq, d)), jnp.float32)
+    y = jnp.asarray(rng.standard_normal(x.shape), jnp.float32)
+    prev = _flags.get_flags(["comm_overlap"])
+    results = {}
+    try:
+        for mode in ("off", "tp"):
+            _flags.set_flags({"comm_overlap": mode})
+            mesh = create_hybrid_mesh(mp=mp)
+            set_hybrid_mesh(mesh)
+            paddle.seed(0)
+            ts = make_sharded_train_step(Stack(), AdamW(1e-3), loss_fn,
+                                         mesh=mesh)
+            loss = float(ts.step((x, y)))  # compile + warm
+            best = None
+            for _ in range(windows):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    out = ts.step((x, y))
+                float(out)
+                dt = (time.perf_counter() - t0) / steps
+                best = dt if best is None else min(best, dt)
+            results[mode] = {"loss": loss,
+                             "step_ms": round(best * 1e3, 3)}
+            set_hybrid_mesh(None)
+    finally:
+        _flags.set_flags(prev)
+        set_hybrid_mesh(None)
+    parity_ok = abs(results["tp"]["loss"] - results["off"]["loss"]) <= \
+        5e-3 * max(1.0, abs(results["off"]["loss"]))
+    speedup = results["off"]["step_ms"] / max(results["tp"]["step_ms"],
+                                              1e-9)
+    print(json.dumps({
+        "metric": "comm_overlap", "value": round(speedup, 4),
+        "unit": "step-time ratio off/tp",
+        "extra": {"modes": results, "parity_ok": bool(parity_ok),
+                  "mesh": {"mp": mp}, "shape": {"batch": batch, "seq": seq,
+                                                "hidden": d, "blocks": 4},
+                  "note": ("CPU-mesh wall times are not ICI-meaningful; "
+                           "the device round reads this A/B on real chips"
+                           if jax.default_backend() != "tpu" else
+                           "device-measured"),
+                  "planned_specs": planned_rows}}), flush=True)
+    assert parity_ok, (
+        f"comm_overlap parity failure: tp loss {results['tp']['loss']} "
+        f"vs off {results['off']['loss']}")
+
+
 def _gpt_measure(layers, hidden, heads, seq, batch, steps, remat, vocab):
     """Build + time one GPT train-step config under the anomaly guard.
 
@@ -1298,6 +1425,15 @@ def main():
             bench_telemetry_overhead(small)
         except Exception as e:
             print(json.dumps({"metric": "bench_telemetry_overhead_FAILED",
+                              "error": str(e)[:500]}), flush=True)
+    # comm-overlap A/B (FLAGS_comm_overlap off vs tp): emits the
+    # comm_overlap metric — measured on >=2-device meshes, static hop
+    # plans only on a single chip (ready for the next device round)
+    if os.environ.get("BENCH_COMM_OVERLAP", "1") != "0":
+        try:
+            bench_comm_overlap(small)
+        except Exception as e:
+            print(json.dumps({"metric": "bench_comm_overlap_FAILED",
                               "error": str(e)[:500]}), flush=True)
     if "all" in selected or "gpt" in selected:
         bench_gpt(small)  # primary: printed last

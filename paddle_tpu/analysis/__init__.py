@@ -26,6 +26,10 @@ from .pallas_check import (KernelSpec, BlockUse, check_kernel_spec,  # noqa: F40
                            spec_for_flash_packed, spec_for_flash,
                            spec_for_conv_matmul, spec_for_conv3x3,
                            check_jaxpr_pallas, VMEM_BUDGET)
+from .comm_check import (CommSpec, check_comm_spec,  # noqa: F401
+                         spec_for_allgather_matmul,
+                         spec_for_matmul_reduce_scatter)
+from . import comm_check  # noqa: F401
 from . import repo_lint  # noqa: F401
 from . import _jaxpr_utils as jaxpr_utils  # noqa: F401
 
@@ -37,4 +41,6 @@ __all__ = [
     "spec_for_flash_packed", "spec_for_flash", "spec_for_conv_matmul",
     "spec_for_conv3x3", "check_jaxpr_pallas",
     "VMEM_BUDGET", "repo_lint", "jaxpr_utils",
+    "CommSpec", "check_comm_spec", "comm_check",
+    "spec_for_allgather_matmul", "spec_for_matmul_reduce_scatter",
 ]

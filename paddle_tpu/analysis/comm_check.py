@@ -1,0 +1,158 @@
+"""Static ICI accounting for decomposed-collective pipelines.
+
+``pallas_check`` turns Mosaic's opaque compile-time kernel limits into
+pure-arithmetic diagnostics; this module does the same for the
+communication-overlap tier (``distributed/overlap.py``): every decomposed
+ppermute loop declares a :class:`CommSpec` (hop count × bytes per hop vs
+the volume of the single collective it replaces, and per-hop transfer
+time vs the compute meant to hide it), checked on any host with no TPU
+attached.
+
+Checked per :class:`CommSpec`:
+  C001  decomposed volume exceeds the one-shot collective's ring volume
+        by more than the tolerance — the rewrite must overlap, never
+        re-send (a mis-scheduled ring re-transfers chunks)      [error]
+  C002  per-hop payload under the latency floor — hop setup time
+        dominates and the pipeline is slower than the fused
+        collective regardless of overlap                        [warning]
+  C003  per-hop ICI transfer time exceeds the hop's matmul compute —
+        the transfer cannot hide under compute at these shapes  [warning]
+
+``enforce`` routes through :func:`jaxpr_lint.emit` under
+``FLAGS_static_analysis``, like the Pallas checker's kernel-entry hook.
+
+Assumed v5e figures (SCALING.md): ~45 GB/s per ICI link direction,
+197 bf16 TFLOP/s per chip.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from .jaxpr_lint import Diagnostic, ERROR, WARNING, emit
+
+__all__ = ["CommSpec", "check_comm_spec", "enforce",
+           "spec_for_allgather_matmul", "spec_for_matmul_reduce_scatter",
+           "ICI_GBPS", "PEAK_TFLOPS", "HOP_LATENCY_FLOOR_BYTES"]
+
+# Per-direction, per-link ICI bandwidth (v5e 2D torus) and bf16 peak.
+ICI_GBPS = 45.0
+PEAK_TFLOPS = 197.0
+
+# Below this per-hop payload the ~1us collective-permute setup latency
+# dominates the wire time (45 GB/s * 1us ≈ 45 KB); decomposing into such
+# hops loses to the fused collective even with perfect overlap.
+HOP_LATENCY_FLOOR_BYTES = 64 * 1024
+
+# Decomposed volume may exceed the ring collective's by at most this
+# factor (slack for the odd-n asymmetric direction split).
+VOLUME_TOLERANCE = 1.25
+
+
+@dataclass
+class CommSpec:
+    """Declared hop plan of one decomposed-collective call site."""
+
+    name: str
+    axis_size: int
+    hops: int              # total chunk transfers across both directions
+    bytes_per_hop: int     # payload of ONE hop on ONE link direction
+    collective_bytes: int  # per-rank volume of the ring collective replaced
+    flops_per_hop: int     # matmul work hiding ONE direction's hop
+    chunks: int = 1        # sub-chunk count per hop matmul
+    directions: int = 2    # concurrent ring directions (bidirectional ICI)
+
+    @property
+    def decomposed_bytes(self) -> int:
+        return self.hops * self.bytes_per_hop
+
+
+def spec_for_allgather_matmul(b: int, s_local: int, k: int, m_local: int,
+                              n: int, itemsize: int,
+                              chunks: int = 1) -> CommSpec:
+    """AG->matmul: n-1 chunk transfers of the [B, s_local, K] activation
+    chunk; each hop hides under one chunk x w_local matmul."""
+    chunk_bytes = b * s_local * k * itemsize
+    return CommSpec(
+        name="allgather_matmul", axis_size=n, hops=max(n - 1, 0),
+        bytes_per_hop=chunk_bytes,
+        collective_bytes=max(n - 1, 0) * chunk_bytes,
+        flops_per_hop=2 * b * s_local * k * m_local,
+        chunks=chunks)
+
+
+def spec_for_matmul_reduce_scatter(b: int, s_chunk: int, k_local: int,
+                                   m: int, n: int, itemsize: int,
+                                   chunks: int = 1) -> CommSpec:
+    """matmul->RS: two accumulators of HALF the [B, s_chunk, M] output
+    chunk travel n-1 hops each; each hop hides under one
+    chunk x w_half partial matmul."""
+    half_bytes = b * s_chunk * max(m // 2, 1) * itemsize
+    hops = 2 * max(n - 1, 0) if m >= 2 else max(n - 1, 0)
+    return CommSpec(
+        name="matmul_reduce_scatter", axis_size=n, hops=hops,
+        bytes_per_hop=half_bytes,
+        collective_bytes=max(n - 1, 0) * b * s_chunk * m * itemsize,
+        flops_per_hop=2 * b * s_chunk * k_local * max(m // 2, 1),
+        chunks=chunks)
+
+
+def check_comm_spec(spec: CommSpec) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    where = f"comm:{spec.name}"
+    if spec.axis_size <= 1 or spec.hops == 0:
+        return diags
+    if spec.collective_bytes and \
+            spec.decomposed_bytes > VOLUME_TOLERANCE * spec.collective_bytes:
+        diags.append(Diagnostic(
+            rule="C001", name="decomposed-volume-blowup", severity=ERROR,
+            message=(f"{spec.hops} hops x {spec.bytes_per_hop / 2**20:.2f}"
+                     f" MiB = {spec.decomposed_bytes / 2**20:.2f} MiB moved"
+                     f" vs {spec.collective_bytes / 2**20:.2f} MiB for the"
+                     " ring collective — the decomposition re-sends chunks"),
+            where=where,
+            hint="the hop schedule must deliver each chunk exactly once "
+                 "per link direction (check the permutation tables)"))
+    if spec.bytes_per_hop < HOP_LATENCY_FLOOR_BYTES:
+        diags.append(Diagnostic(
+            rule="C002", name="hop-below-latency-floor", severity=WARNING,
+            message=(f"per-hop payload {spec.bytes_per_hop / 1024:.1f} KiB"
+                     f" is under the {HOP_LATENCY_FLOOR_BYTES // 1024} KiB"
+                     " latency floor — hop setup dominates and the fused"
+                     " collective wins regardless of overlap"),
+            where=where,
+            hint="decompose only at production shapes, or lower the chunk "
+                 "count; FLAGS_comm_overlap=off for this layer size"))
+    # One pipeline step moves bytes_per_hop on EACH link direction
+    # concurrently while `directions` hop-matmuls execute: the transfer
+    # that must hide is one link's, the compute hiding it is all of it.
+    hop_transfer_s = spec.bytes_per_hop / (ICI_GBPS * 1e9)
+    hop_compute_s = (spec.directions * spec.flops_per_hop /
+                     (PEAK_TFLOPS * 1e12))
+    if hop_compute_s > 0 and hop_transfer_s > hop_compute_s:
+        diags.append(Diagnostic(
+            rule="C003", name="hop-transfer-exceeds-compute",
+            severity=WARNING,
+            message=(f"one hop moves {spec.bytes_per_hop / 2**20:.2f} MiB"
+                     f" (~{hop_transfer_s * 1e6:.1f} us on"
+                     f" {ICI_GBPS:.0f} GB/s ICI) but the concurrent"
+                     f" hop matmuls total only"
+                     f" {spec.directions * spec.flops_per_hop / 1e9:.2f}"
+                     f" GFLOP (~{hop_compute_s * 1e6:.1f} us at"
+                     f" {PEAK_TFLOPS:.0f} TFLOP/s) — the transfer cannot"
+                     " hide under compute"),
+            where=where,
+            hint="the layer is bandwidth-bound at this shape; expect the "
+                 "decomposition to tie, not win — confirm on the device "
+                 "A/B before enabling"))
+    return diags
+
+
+def enforce(spec: CommSpec, where: str = "") -> List[Diagnostic]:
+    """Check + route through the shared diagnostic channel
+    (``FLAGS_static_analysis`` off | warn | error)."""
+    diags = check_comm_spec(spec)
+    if diags:
+        emit(diags, where=where or f"comm:{spec.name}")
+    return diags

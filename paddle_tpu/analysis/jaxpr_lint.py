@@ -82,6 +82,7 @@ class GraphLintError(RuntimeError):
 class EqnInfo:
     eqn: Any
     loop_depth: int           # >0 inside a scan/while body
+    jit_depth: int = 0        # >0 inside a pjit/shard_map compiled region
 
 
 @dataclass
@@ -102,6 +103,13 @@ class LintContext:
 
     def is_used(self, var) -> bool:
         return self.use_count.get(id(var), 0) > 0
+
+
+# Primitives whose inner jaxpr executes as ONE compiled program: an eqn
+# inside them is fused/scheduled by XLA; a collective OUTSIDE all of them
+# (in a traced step that also contains such regions) is a one-off blocking
+# dispatch on the step path (rule J014).
+JIT_REGION_PRIMS = frozenset({"pjit", "jit", "xla_call", "shard_map"})
 
 
 def _is_dropvar(v) -> bool:
@@ -130,25 +138,26 @@ def _build_context(closed_jaxpr, donate_argnums=()) -> LintContext:
     # every inner use count and fabricate "reused key" findings
     seen = set()
 
-    def walk(jaxpr, loop_depth):
-        key = (id(jaxpr), loop_depth > 0)
+    def walk(jaxpr, loop_depth, jit_depth):
+        key = (id(jaxpr), loop_depth > 0, jit_depth > 0)
         if key in seen:
             return
         seen.add(key)
         for eqn in jaxpr.eqns:
-            info = EqnInfo(eqn, loop_depth)
+            info = EqnInfo(eqn, loop_depth, jit_depth)
             ctx.eqns.append(info)
             for v in eqn.invars:
                 note_use(v, info)
             inner = inner_jaxprs(eqn)
             bump = 1 if eqn.primitive.name in LOOP_PRIMS else 0
+            jbump = 1 if eqn.primitive.name in JIT_REGION_PRIMS else 0
             for _, closed in inner:
-                walk(closed.jaxpr, loop_depth + bump)
+                walk(closed.jaxpr, loop_depth + bump, jit_depth + jbump)
         for v in jaxpr.outvars:
             if not _is_literal(v):
                 ctx.use_count[id(v)] = ctx.use_count.get(id(v), 0) + 1
 
-    walk(closed_jaxpr.jaxpr, 0)
+    walk(closed_jaxpr.jaxpr, 0, 0)
     return ctx
 
 
@@ -563,6 +572,128 @@ def _rule_telemetry_callback(ctx: LintContext):
             hint="move the measurement to dispatch level "
                  "(observability.step_monitor phases / metrics), or run "
                  "under FLAGS_telemetry=trace while debugging")
+
+
+_COLLECTIVE_PRIMS = frozenset({
+    "psum", "psum_scatter", "all_gather", "all_to_all", "ppermute",
+    "reduce_scatter", "all_reduce", "pmax", "pmin",
+})
+
+# A psum below this operand size is a per-parameter reduction, not a
+# bucket: ~1 MiB is well under any sane DP bucket (the reference's
+# EagerReducer default is 25 MB).
+_J014_BUCKET_BYTES = 1 << 20
+# This many separate small reductions in one program = an unbucketed
+# per-parameter chain.
+_J014_CHAIN_MIN = 4
+
+
+def _eqn_collectives(eqn) -> List[str]:
+    """Collective primitive names inside an eqn's inner jaxprs (for
+    spotting a shard_map that exists only to run one collective)."""
+    names: List[str] = []
+    stack = [closed.jaxpr for _, closed in inner_jaxprs(eqn)]
+    while stack:
+        j = stack.pop()
+        for e in j.eqns:
+            names.append(e.primitive.name)
+            stack.extend(closed.jaxpr for _, closed in inner_jaxprs(e))
+    return [n for n in names if n in _COLLECTIVE_PRIMS]
+
+
+@register_rule("J014", "overlap-defeating-collectives", WARNING,
+               "communication patterns the latency-hiding scheduler "
+               "cannot overlap: per-parameter unbucketed reduce chains, "
+               "and blocking collectives dispatched outside the compiled "
+               "step")
+def _rule_overlap_defeating(ctx: LintContext):
+    """Two shapes of collective traffic that defeat overlap:
+
+    (a) **Unbucketed per-parameter reduce chains** — many separate small
+    ``psum``/``psum_scatter`` equations (one per parameter). Each is a
+    latency-bound collective the scheduler cannot coalesce; the fix is
+    size-bucketed reduction (``distributed.overlap.BucketedGradReducer``,
+    the EagerReducer discipline).
+
+    (b) **Blocking collectives outside jit on the step path** — a traced
+    step that contains compiled regions (pjit) AND dispatches collectives
+    outside them (a bare collective eqn, or a shard_map whose body is
+    nothing but collectives — the eager collective-wrapper shape). Each
+    such dispatch is its own XLA program: a host round-trip and a
+    synchronization point per call, invisible to the scheduler that
+    overlaps in-graph collectives.
+    """
+    rule = _RULES["J014"]
+
+    # (a) per-parameter unbucketed reduce chains
+    small: List[EqnInfo] = []
+    small_bytes = 0
+    for info in ctx.eqns:
+        if info.eqn.primitive.name not in ("psum", "psum_scatter"):
+            continue
+        nbytes = 0
+        for v in info.eqn.invars:
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            n = 1
+            for d in aval.shape:
+                n *= int(d)
+            nbytes += n * getattr(getattr(aval, "dtype", None),
+                                  "itemsize", 4)
+        if nbytes < _J014_BUCKET_BYTES:
+            small.append(info)
+            small_bytes += nbytes
+    if len(small) >= _J014_CHAIN_MIN:
+        yield _diag(
+            rule,
+            f"{len(small)} separate psum equations, each under "
+            f"{_J014_BUCKET_BYTES // 1024} KiB "
+            f"({small_bytes / 1024:.1f} KiB total) — a per-parameter "
+            "reduce chain of latency-bound collectives the scheduler "
+            "cannot overlap with backward compute",
+            small[-1].eqn,
+            hint="bucket the grads (distributed.overlap."
+                 "BucketedGradReducer.reduce_in_axis): one flat psum per "
+                 "~25 MB bucket overlaps with the remaining backward")
+
+    # (b) blocking collectives outside jit on a step path
+    has_compiled_region = any(
+        i.jit_depth == 0 and i.eqn.primitive.name in ("pjit", "jit",
+                                                      "xla_call")
+        for i in ctx.eqns)
+    if not has_compiled_region:
+        return
+    for info in ctx.eqns:
+        if info.jit_depth > 0:
+            continue
+        name = info.eqn.primitive.name
+        if name in _COLLECTIVE_PRIMS:
+            yield _diag(
+                rule,
+                f"collective '{name}' dispatched outside the compiled "
+                "step (the step path also contains jitted regions) — a "
+                "blocking one-off program per call",
+                info.eqn,
+                hint="move the collective inside the jitted step so XLA "
+                     "schedules it, or bucket it "
+                     "(distributed.overlap)")
+        elif name == "shard_map":
+            colls = _eqn_collectives(info.eqn)
+            inner_total = 0
+            for _, closed in inner_jaxprs(info.eqn):
+                inner_total += len(closed.jaxpr.eqns)
+            if colls and inner_total <= 2 * len(colls):
+                yield _diag(
+                    rule,
+                    f"shard_map wrapping only collectives "
+                    f"({', '.join(sorted(set(colls)))}) dispatched "
+                    "outside the compiled step — an eager blocking "
+                    "collective per call on the step path",
+                    info.eqn,
+                    hint="fuse it into the jitted step, or bucket the "
+                         "transfers (distributed.overlap."
+                         "BucketedGradReducer)")
 
 
 # ---------------------------------------------------------------------------

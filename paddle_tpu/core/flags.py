@@ -166,3 +166,27 @@ define_flag("static_analysis", "off",
             "'off' skips, 'warn' prints diagnostics to stderr, 'error' "
             "raises GraphLintError on error-severity findings.",
             choices=("off", "warn", "error"))
+define_flag("comm_overlap", "off",
+            "Communication-overlap tier (distributed/overlap.py): 'off' "
+            "keeps every collective GSPMD-scheduled (byte-identical to "
+            "the pre-overlap step); 'tp' decomposes the TP/SP "
+            "all-gather->matmul and matmul->reduce-scatter into "
+            "bidirectional ppermute pipelines; 'tp_zero' adds the ZeRO-3 "
+            "param-gather-ahead prefetch; 'all' adds DP gradient-bucket "
+            "overlap on the manual-sharding path.",
+            choices=("off", "tp", "tp_zero", "all"))
+define_flag("comm_overlap_chunks", 0,
+            "Sub-chunk count per decomposed-matmul hop (scheduler "
+            "interleave granularity); 0 consults the persistent "
+            "autotune cache, else 1.")
+define_flag("comm_overlap_bucket_mb", 25,
+            "DP gradient bucket size in MiB for "
+            "overlap.BucketedGradReducer (ref DataParallel "
+            "comm_buffer_size default).")
+define_flag("cp_nested_ring", False,
+            "Run the manual ring-attention CP path even when nested "
+            "inside an enclosing manual shard_map (the pipeline "
+            "runtime's pp axis) instead of falling back to "
+            "GSPMD-scheduled attention. Exercised by the multichip "
+            "dryrun's 4-axis scenario with loss parity against the "
+            "fallback.")

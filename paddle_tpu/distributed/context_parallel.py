@@ -109,6 +109,16 @@ def _inner_mesh(mesh):
     return mesh
 
 
+def _nested_ring_enabled() -> bool:
+    """``FLAGS_cp_nested_ring``: run the manual ring inside an enclosing
+    manual shard_map instead of the GSPMD fallback."""
+    from ..core import flags
+    try:
+        return bool(flags.flag("cp_nested_ring"))
+    except KeyError:
+        return False
+
+
 def _ambient_manual_axes():
     """Axis names already bound manual by an enclosing shard_map (e.g. the
     pipeline runtime's pp axis)."""
@@ -165,7 +175,13 @@ def ring_attention(query, key, value, mesh=None, axis: str = SEP_AXIS,
     if n == 1:
         from ..ops.flash_attention import flash_attention
         return flash_attention(query, key, value, causal=causal, scale=scale)
-    if _ambient_manual_axes():
+    if _ambient_manual_axes() and not _nested_ring_enabled():
+        # FLAGS_cp_nested_ring=0: GSPMD-scheduled fallback when nested in
+        # an enclosing manual region (the pipeline runtime's pp axis).
+        # With the flag on, the manual ppermute ring itself nests: the
+        # vma plumbing below (pcast'd carries/ranks, abstract inner mesh)
+        # exists exactly for that composition, and the multichip dryrun's
+        # 4-axis scenario asserts its loss parity against the fallback.
         return _auto_mode_attention(query, key, value, axis, causal, scale)
     s_local = s_global // n
     perm = [(i, (i + 1) % n) for i in range(n)]

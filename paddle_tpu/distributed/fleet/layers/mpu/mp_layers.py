@@ -36,10 +36,45 @@ from .....nn.layer import Layer, ParamAttr
 from ....topology import get_hybrid_mesh
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
-           "RowParallelLinear", "ParallelCrossEntropy"]
+           "RowParallelLinear", "ParallelCrossEntropy",
+           "maybe_decomposed_column_sp", "maybe_decomposed_row_sp"]
 
 MP_AXIS = "mp"
 SP_AXIS = "sep"
+
+
+def maybe_decomposed_column_sp(x, w, b, gather_output: bool):
+    """Decomposed-collective forward for a sequence-parallel column layer
+    (``FLAGS_comm_overlap``): ``all_gather(x, seq) @ w`` as a
+    bidirectional ppermute pipeline (``distributed/overlap.py``), or None
+    when the GSPMD path should run (flag off, unsupported mesh/shapes, or
+    ``gather_output`` — gathering the output defeats the decomposition)."""
+    from .... import overlap
+    if not overlap.tp_enabled() or gather_output:
+        return None
+    mesh = get_hybrid_mesh()
+    if not overlap.can_decompose(mesh, MP_AXIS):
+        return None
+    n = mesh.shape[MP_AXIS]
+    if x.ndim != 3 or x.shape[1] % n or w.shape[-1] % n:
+        return None
+    return overlap.allgather_matmul(x, w, b, mesh=mesh, axis=MP_AXIS)
+
+
+def maybe_decomposed_row_sp(x, w, b):
+    """Decomposed-collective forward for a sequence-parallel row layer:
+    ``reduce_scatter(x @ w, seq)`` as a bidirectional ppermute pipeline,
+    or None when the GSPMD path should run."""
+    from .... import overlap
+    if not overlap.tp_enabled():
+        return None
+    mesh = get_hybrid_mesh()
+    if not overlap.can_decompose(mesh, MP_AXIS):
+        return None
+    n = mesh.shape[MP_AXIS]
+    if x.ndim != 3 or x.shape[1] % n or x.shape[-1] % n:
+        return None
+    return overlap.matmul_reduce_scatter(x, w, b, mesh=mesh, axis=MP_AXIS)
 
 
 def _spec_axes(spec: P):

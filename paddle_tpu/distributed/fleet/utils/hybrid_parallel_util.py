@@ -21,10 +21,24 @@ __all__ = ["fused_allreduce_gradients", "sync_params_buffers"]
 def fused_allreduce_gradients(parameter_refs: List, hcg=None,
                               axis: str = "dp"):
     """Eager path: allreduce `.grad` of each ParamRef over the dp axis.
-    Inside shard_map: psum each grad. No bucketing needed — XLA coalesces."""
+
+    Inside shard_map, ``FLAGS_comm_overlap=all`` reduces size-bucketed
+    (``distributed/overlap.BucketedGradReducer``): one flat psum per
+    ~bucket instead of a per-parameter chain of latency-bound collectives
+    (rule J014) — bucket k's reduction overlaps the backward segments
+    still producing bucket k+1's grads. Otherwise the per-param psum form
+    is kept (bitwise-identical legacy path)."""
     if in_axis_context(axis):
-        for ref in parameter_refs:
-            if ref.grad is not None:
+        from ...overlap import BucketedGradReducer, dp_enabled
+        refs = [r for r in parameter_refs if r.grad is not None]
+        if dp_enabled() and len(refs) > 1:
+            reducer = BucketedGradReducer(axis=axis)
+            grads = {str(i): r.grad for i, r in enumerate(refs)}
+            reduced = reducer.reduce_in_axis(grads)
+            for i, r in enumerate(refs):
+                r.grad = reduced[str(i)]
+        else:
+            for ref in refs:
                 ref.grad = lax.psum(ref.grad, axis)
         return
     # Eager single-controller: grads are global arrays already (no-op), kept

@@ -18,7 +18,9 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..layers.mpu.mp_layers import (ColumnParallelLinear, RowParallelLinear,
-                                    _constrain, MP_AXIS)
+                                    _constrain, MP_AXIS,
+                                    maybe_decomposed_column_sp,
+                                    maybe_decomposed_row_sp)
 from ..layers.mpu import mp_ops
 
 __all__ = ["scatter", "all_gather", "mark_as_sequence_parallel_parameter",
@@ -65,17 +67,41 @@ def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
 
 class ColumnSequenceParallelLinear(ColumnParallelLinear):
     """Column-parallel linear whose input arrives sequence-sharded: the
-    input constraint triggers the SP all-gather in forward."""
+    input constraint triggers the SP all-gather in forward.
+
+    Under ``FLAGS_comm_overlap`` (tp and up, ``gather_output=False``) the
+    all-gather->matmul pair runs as the decomposed bidirectional ppermute
+    pipeline (``distributed/overlap.allgather_matmul``): each ICI hop's
+    chunk transfer hides under the previous chunk's partial matmul instead
+    of the whole gather fronting the matmul on the critical path."""
 
     def forward(self, x):
+        from ....amp.auto_cast import maybe_cast_input
+        xc, w, b = maybe_cast_input("linear", x, self.weight,
+                                    getattr(self, "bias", None))
+        y = maybe_decomposed_column_sp(xc, w, b, self.gather_output)
+        if y is not None:
+            return y
         x = sequence_parallel_constraint(x)
         return super().forward(x)
 
 
 class RowSequenceParallelLinear(RowParallelLinear):
     """Row-parallel linear whose output leaves sequence-sharded (the SP
-    reduce-scatter instead of allreduce)."""
+    reduce-scatter instead of allreduce).
+
+    Under ``FLAGS_comm_overlap`` the matmul->reduce-scatter pair runs as
+    the decomposed pipeline (``distributed/overlap.
+    matmul_reduce_scatter``): per-destination-chunk partials are computed
+    one hop ahead of the travelling accumulators, with the payload split
+    across both ICI ring directions."""
 
     def forward(self, x):
+        from ....amp.auto_cast import maybe_cast_input
+        xc, w, b = maybe_cast_input("linear", x, self.weight,
+                                    getattr(self, "bias", None))
+        y = maybe_decomposed_row_sp(xc, w, b)
+        if y is not None:
+            return sequence_parallel_constraint(y)
         y = super().forward(x)
         return sequence_parallel_constraint(y)

@@ -88,6 +88,31 @@ class DataParallel(Layer):
         else:
             self.group = world_group()
         self._grad_sync_enabled = True
+        # ref comm_buffer_size is in MB — the reducer bucket for the
+        # manual-sharding path (FLAGS_comm_overlap=all), EagerReducer's
+        # knob mapped onto overlap.BucketedGradReducer.
+        self.comm_buffer_size = comm_buffer_size
+        self._reducer = None
+
+    def grad_reducer(self):
+        """The size-bucketed gradient reducer for manual/eager grad sync
+        (``distributed/overlap.BucketedGradReducer``), bucket size from
+        ``comm_buffer_size`` MB."""
+        if self._reducer is None:
+            from .overlap import BucketedGradReducer
+            self._reducer = BucketedGradReducer(
+                axis="dp", bucket_bytes=self.comm_buffer_size << 20)
+        return self._reducer
+
+    def sync_gradients(self, stacked_grads=None):
+        """Manual-sharding grad sync: reduce stacked-ranks grads
+        (``{name: [nranks, ...]}``) bucket-by-bucket with async dispatch
+        so each bucket's reduction overlaps the remaining packing/backward
+        work; honors ``no_sync``. Returns the reduced dict (or None when
+        sync is disabled / nothing to reduce)."""
+        if not self._grad_sync_enabled or stacked_grads is None:
+            return None
+        return self.grad_reducer().reduce_stacked(stacked_grads, mean=True)
 
     @property
     def dp_degree(self) -> int:

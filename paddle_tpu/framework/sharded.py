@@ -158,6 +158,21 @@ class TrainStep:
         specs = infer_param_specs(params, model.named_param_specs(), mesh,
                                   fsdp_axis)
         self.pshardings = {n: NamedSharding(mesh, specs[n]) for n in params}
+        # FLAGS_comm_overlap=tp_zero|all: ZeRO-3 gather-ahead — per-block
+        # param all-gathers issued ahead of the consuming block's compute
+        # (distributed/overlap.zero_gather_ahead), instead of GSPMD's
+        # gather-at-first-use. Decided at construction like the offload
+        # tier; off leaves the step graph byte-identical.
+        from ..distributed import overlap as _overlap
+        self._gather_specs = None
+        if (_overlap.zero_enabled() and fsdp_axis is not None
+                and fsdp_axis in mesh.axis_names
+                and mesh.shape[fsdp_axis] > 1):
+            gspecs = {n: _overlap.spec_without_axis(specs[n], fsdp_axis)
+                      for n in params}
+            gspecs = {n: s for n, s in gspecs.items() if s != specs[n]}
+            if gspecs:
+                self._gather_specs = gspecs
 
         def _place(v, sh):
             out = jax.device_put(v, sh)
@@ -202,6 +217,13 @@ class TrainStep:
 
         def step(params, opt_state, buffers, batch, lr, key):
             def loss_of(p):
+                # Gather-ahead INSIDE the differentiated fn: the
+                # constraint transpose re-scatters the cotangents, so
+                # grads arrive fsdp-sharded and the update runs on
+                # shards (ZeRO-3 fwd gather / bwd reduce-scatter).
+                if self._gather_specs is not None:
+                    p = _overlap.zero_gather_ahead(
+                        p, self._gather_specs, mesh)
                 with rng_scope(key):
                     if self._threads_buffers:
                         return lf(model_obj, p, buffers, batch)
@@ -224,6 +246,9 @@ class TrainStep:
 
         def grad_step(params, buffers, batch, key):
             def loss_of(p):
+                if self._gather_specs is not None:
+                    p = _overlap.zero_gather_ahead(
+                        p, self._gather_specs, mesh)
                 with rng_scope(key):
                     if self._threads_buffers:
                         return lf(model_obj, p, buffers, batch)

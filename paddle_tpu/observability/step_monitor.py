@@ -6,8 +6,9 @@ identical under every ``FLAGS_telemetry`` mode):
 
 **StepTimeline** — per-step phase accounting. ``framework.sharded.
 TrainStep``, ``framework.offload.StreamingUpdate``, ``distributed.
-pipeline_schedule``, ``io.dataloader`` and the ``hapi`` fit loop report
-into the phases (``data``, ``h2d``, ``compile``, ``device``,
+pipeline_schedule``, ``distributed.overlap`` (dispatch-level bucketed
+gradient reductions), ``io.dataloader`` and the ``hapi`` fit loop report
+into the phases (``data``, ``h2d``, ``compile``, ``device``, ``comm``,
 ``offload_in``, ``offload_out``, ``callbacks``); each completed step is a
 record in a bounded ring, durations also feed the log-bucket histograms in
 :mod:`.metrics`, and under ``FLAGS_telemetry=trace`` every phase opens a
@@ -44,8 +45,8 @@ __all__ = ["StepTimeline", "RecompileSentinel", "current", "reset_default",
            "fingerprint", "fingerprint_diff", "instrument_jitted",
            "PHASES", "GB"]
 
-PHASES = ("data", "h2d", "compile", "device", "offload_in", "offload_out",
-          "callbacks")
+PHASES = ("data", "h2d", "compile", "device", "comm", "offload_in",
+          "offload_out", "callbacks")
 
 GB = float(2 ** 30)
 

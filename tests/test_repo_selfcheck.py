@@ -73,3 +73,37 @@ def test_telemetry_flag_registered():
     with pytest.raises(ValueError):
         flags.set_flags({"telemetry": "verbose"})
     assert "telemetry" not in flags.unknown_env_flags()
+
+
+def test_repo_lint_clean_over_overlap_tier():
+    """The comm-overlap tier sources (distributed/overlap.py,
+    analysis/comm_check.py) pass the repo source rules. R001 host clocks
+    are allowed only at the annotated autotune timing sites."""
+    from paddle_tpu.analysis import repo_lint
+    for rel in (os.path.join("paddle_tpu", "distributed", "overlap.py"),
+                os.path.join("paddle_tpu", "analysis", "comm_check.py")):
+        diags = repo_lint.lint_file(os.path.join(REPO, rel), rel)
+        errors = [d for d in diags if d.severity == "error"]
+        assert errors == [], [d.format() for d in errors]
+
+
+def test_overlap_model_in_lint_graph_catalog():
+    """`tools/lint_graph.py --model overlap` exists and the decomposed
+    programs lint with zero errors (J012/J013/J014 + C0xx accounting)."""
+    from tools import lint_graph
+    assert "overlap" in lint_graph.MODELS
+    diags, n_eqns = lint_graph.MODELS["overlap"]()
+    assert n_eqns > 0, "overlap model must trace on the 8-device mesh"
+    errors = [d for d in diags if d.severity == "error"]
+    assert errors == [], [d.format() for d in errors]
+    assert "J014" not in {d.rule for d in diags}, \
+        "the decomposed pipelines must not trip the rule they motivated"
+
+
+def test_comm_overlap_flags_registered():
+    """FLAGS_comm_overlap and its knobs go through the registry."""
+    from paddle_tpu.core import flags
+    assert flags.flag("comm_overlap") in ("off", "tp", "tp_zero", "all")
+    with pytest.raises(ValueError):
+        flags.set_flags({"comm_overlap": "everything"})
+    assert int(flags.flag("comm_overlap_bucket_mb")) > 0

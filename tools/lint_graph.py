@@ -17,6 +17,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# The overlap model lints a decomposed collective over an 8-device virtual
+# mesh (same provisioning as tests/conftest.py); no-op if jax is already
+# initialized (the in-process selfcheck run has its own 8 devices).
+if "host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                               " --xla_force_host_platform_device_count=8"
+                               ).strip()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
@@ -81,8 +88,61 @@ def lint_offload():
     return diags, len(closed.jaxpr.eqns)
 
 
+def lint_overlap():
+    """The decomposed-collective-matmul programs (distributed/overlap.py):
+    a Megatron-SP column+row pair through the bidirectional ppermute
+    pipelines, traced fwd+grad and linted (J012/J013/J014 — the
+    decomposed loops must not themselves trip the overlap rules), plus
+    the static ICI accounting (C001-C003) of each hop plan at a
+    production-ish shape."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from paddle_tpu.analysis import (comm_check, lint_jaxpr)
+    from paddle_tpu.distributed import overlap
+
+    if jax.device_count() < 2:
+        print("  (skipped: needs >=2 devices for the mp mesh; "
+              "run under the 8-device virtual CPU platform)")
+        return [], 0
+    n = 8 if jax.device_count() >= 8 else 2
+    mesh = Mesh(np.asarray(jax.devices()[:n]).reshape(1, 1, 1, 1, n),
+                ("pp", "dp", "sharding", "sep", "mp"))
+    b, s, d, f = 2, 8 * n, 16, 32
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((b, s, d)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((d, f)), jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((f, d)), jnp.float32)
+
+    def sp_pair(x, w1, w2):
+        h = overlap.allgather_matmul(x, w1, mesh=mesh, chunks=1)
+        h = jax.nn.gelu(h)
+        return overlap.matmul_reduce_scatter(h, w2, mesh=mesh, chunks=1)
+
+    def loss(x, w1, w2):
+        return jnp.sum(sp_pair(x, w1, w2) ** 2)
+
+    closed = jax.make_jaxpr(jax.value_and_grad(loss, argnums=(1, 2)))(
+        x, w1, w2)
+    diags = lint_jaxpr(closed, where="overlap")
+    # static hop-plan accounting at a production-ish shape (GPT-1.3B
+    # layer through mp=4: B*S_local*K chunks well over the latency floor)
+    for spec in (
+            comm_check.spec_for_allgather_matmul(
+                8, 512, 2048, 2048, 4, 2),
+            comm_check.spec_for_matmul_reduce_scatter(
+                8, 512, 2048, 2048, 4, 2)):
+        cd = comm_check.check_comm_spec(spec)
+        print(f"  comm spec {spec.name}: {spec.hops} hops x "
+              f"{spec.bytes_per_hop / 2**20:.2f} MiB, "
+              f"{len(cd)} diagnostic(s)")
+        for d in cd:
+            print("    " + d.format())
+        diags += cd
+    return diags, len(closed.jaxpr.eqns)
+
+
 MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp,
-          "offload": lint_offload}
+          "offload": lint_offload, "overlap": lint_overlap}
 
 _SEV_RANK = {"info": 0, "warning": 1, "error": 2}
 

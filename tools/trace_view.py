@@ -112,6 +112,42 @@ def find_anomalies(steps: List[Dict[str, Any]], factor: float = 3.0,
     return out
 
 
+def comm_summary(steps: List[Dict[str, Any]],
+                 spans: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Aggregate the communication-overlap tier's signal: the ``comm``
+    step phase (dispatch-level bucketed reductions) plus ``comm/*`` spans
+    (decomposed collective-matmul call sites — their attrs carry the
+    static hop plan: hop count, bytes per hop, axis size)."""
+    phase_ms = 0.0
+    phase_calls = 0
+    for s in steps:
+        ms = (s.get("phases") or {}).get("comm")
+        if ms is not None:
+            phase_ms += float(ms)
+            phase_calls += 1
+    ops: Dict[str, Dict[str, float]] = {}
+    for sp in spans:
+        name = sp.get("name", "")
+        if not name.startswith("comm/"):
+            continue
+        attrs = sp.get("attrs") or {}
+        row = ops.setdefault(name[len("comm/"):],
+                             {"calls": 0, "total_ms": 0.0, "hops": 0,
+                              "bytes_moved": 0})
+        row["calls"] += 1
+        row["total_ms"] += float(sp.get("dur_us", 0.0)) / 1e3
+        hops = int(attrs.get("hops", 0))
+        row["hops"] += hops
+        row["bytes_moved"] += hops * int(attrs.get("bytes_per_hop", 0))
+    for row in ops.values():
+        row["total_ms"] = round(row["total_ms"], 3)
+    return {
+        "phase_total_ms": round(phase_ms, 3),
+        "phase_steps": phase_calls,
+        "decomposed_ops": ops,
+    }
+
+
 def summarize(steps: List[Dict[str, Any]], spans: List[Dict[str, Any]],
               factor: float = 3.0, window: int = 32) -> Dict[str, Any]:
     totals = [float(s["total_ms"]) for s in steps if "total_ms" in s]
@@ -126,6 +162,7 @@ def summarize(steps: List[Dict[str, Any]], spans: List[Dict[str, Any]],
         "max_step_ms": round(max(totals), 3) if totals else None,
         "hbm_peak_gb": max(hbm) if hbm else None,
         "phases": phase_table(steps, spans),
+        "comm": comm_summary(steps, spans),
         "anomalies": find_anomalies(steps, factor=factor, window=window),
     }
 
@@ -146,6 +183,17 @@ def render_text(summary: Dict[str, Any]) -> str:
         lines.append(f"{r['phase'][:23]:<24}{r['calls']:>7}"
                      f"{r['total_ms']:>12.3f}{r['avg_ms']:>10.3f}"
                      f"{r['max_ms']:>10.3f}{r['share_pct']:>7.1f}%")
+    comm = summary.get("comm") or {}
+    if comm.get("phase_total_ms") or comm.get("decomposed_ops"):
+        lines.append(bar)
+        lines.append(
+            f"comm overlap: {comm['phase_total_ms']} ms dispatch-level "
+            f"across {comm['phase_steps']} step(s)")
+        for op, row in sorted(comm["decomposed_ops"].items()):
+            lines.append(
+                f"  {op}: {row['calls']} call(s), {row['hops']} hops, "
+                f"{row['bytes_moved'] / 2**20:.2f} MiB moved, "
+                f"{row['total_ms']} ms")
     anomalies = summary["anomalies"]
     lines.append(bar)
     if anomalies:
