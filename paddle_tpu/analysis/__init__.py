@@ -11,6 +11,12 @@ rule catalog):
   native tiles, grid divisibility) without needing a TPU.
 - :mod:`.repo_lint` — AST lint with project source rules (host clocks in
   kernel modules, constant PRNG seeds, flag-registry bypass).
+- :mod:`.plan_check` — step-plan verifier: the declared
+  :class:`~.plan_check.StepPlan` a composed TrainStep assembles from the
+  live tier flags, cross-checked against its traced jaxpr
+  (sharding-flow S-rules) and walked for donation-lifetime hazards
+  (D-rules); ``tools/lint_graph.py --matrix`` sweeps every tier-flag
+  combination through it.
 
 Wiring: ``FLAGS_static_analysis`` (off | warn | error) runs the jaxpr
 linter inside ``jit.to_static`` / ``framework.sharded.TrainStep`` /
@@ -28,8 +34,13 @@ from .pallas_check import (KernelSpec, BlockUse, check_kernel_spec,  # noqa: F40
                            check_jaxpr_pallas, VMEM_BUDGET)
 from .comm_check import (CommSpec, check_comm_spec,  # noqa: F401
                          spec_for_allgather_matmul,
-                         spec_for_matmul_reduce_scatter)
+                         spec_for_matmul_reduce_scatter,
+                         spec_for_cp_ring)
+from .plan_check import (StepPlan, PlanNode, GatherPlan,  # noqa: F401
+                         ParamInfo, check_plan, collect_jaxpr_facts,
+                         all_plan_rules, iter_tier_combos)
 from . import comm_check  # noqa: F401
+from . import plan_check  # noqa: F401
 from . import repo_lint  # noqa: F401
 from . import _jaxpr_utils as jaxpr_utils  # noqa: F401
 
@@ -43,4 +54,8 @@ __all__ = [
     "VMEM_BUDGET", "repo_lint", "jaxpr_utils",
     "CommSpec", "check_comm_spec", "comm_check",
     "spec_for_allgather_matmul", "spec_for_matmul_reduce_scatter",
+    "spec_for_cp_ring",
+    "StepPlan", "PlanNode", "GatherPlan", "ParamInfo", "check_plan",
+    "collect_jaxpr_facts", "all_plan_rules", "iter_tier_combos",
+    "plan_check",
 ]

@@ -19,7 +19,11 @@ Checked per :class:`CommSpec`:
         the transfer cannot hide under compute at these shapes  [warning]
 
 ``enforce`` routes through :func:`jaxpr_lint.emit` under
-``FLAGS_static_analysis``, like the Pallas checker's kernel-entry hook.
+``FLAGS_static_analysis``, like the Pallas checker's kernel-entry hook —
+and it *records*: every spec it sees is appended, keyed by call site, to
+any active :func:`recording` context, so the step-plan verifier
+(:mod:`.plan_check`) can cross-check declared hop plans against the
+collectives that actually traced (rules S001/S002).
 
 Assumed v5e figures (SCALING.md): ~45 GB/s per ICI link direction,
 197 bf16 TFLOP/s per chip.
@@ -27,13 +31,15 @@ Assumed v5e figures (SCALING.md): ~45 GB/s per ICI link direction,
 
 from __future__ import annotations
 
+import contextlib
 from dataclasses import dataclass
-from typing import List
+from typing import Iterator, List, Tuple
 
 from .jaxpr_lint import Diagnostic, ERROR, WARNING, emit
 
-__all__ = ["CommSpec", "check_comm_spec", "enforce",
+__all__ = ["CommSpec", "check_comm_spec", "enforce", "record", "recording",
            "spec_for_allgather_matmul", "spec_for_matmul_reduce_scatter",
+           "spec_for_cp_ring",
            "ICI_GBPS", "PEAK_TFLOPS", "HOP_LATENCY_FLOOR_BYTES"]
 
 # Per-direction, per-link ICI bandwidth (v5e 2D torus) and bf16 peak.
@@ -62,6 +68,7 @@ class CommSpec:
     flops_per_hop: int     # matmul work hiding ONE direction's hop
     chunks: int = 1        # sub-chunk count per hop matmul
     directions: int = 2    # concurrent ring directions (bidirectional ICI)
+    axis: str = "mp"       # mesh axis the decomposed loop permutes over
 
     @property
     def decomposed_bytes(self) -> int:
@@ -70,7 +77,7 @@ class CommSpec:
 
 def spec_for_allgather_matmul(b: int, s_local: int, k: int, m_local: int,
                               n: int, itemsize: int,
-                              chunks: int = 1) -> CommSpec:
+                              chunks: int = 1, axis: str = "mp") -> CommSpec:
     """AG->matmul: n-1 chunk transfers of the [B, s_local, K] activation
     chunk; each hop hides under one chunk x w_local matmul."""
     chunk_bytes = b * s_local * k * itemsize
@@ -79,12 +86,13 @@ def spec_for_allgather_matmul(b: int, s_local: int, k: int, m_local: int,
         bytes_per_hop=chunk_bytes,
         collective_bytes=max(n - 1, 0) * chunk_bytes,
         flops_per_hop=2 * b * s_local * k * m_local,
-        chunks=chunks)
+        chunks=chunks, axis=axis)
 
 
 def spec_for_matmul_reduce_scatter(b: int, s_chunk: int, k_local: int,
                                    m: int, n: int, itemsize: int,
-                                   chunks: int = 1) -> CommSpec:
+                                   chunks: int = 1, axis: str = "mp"
+                                   ) -> CommSpec:
     """matmul->RS: two accumulators of HALF the [B, s_chunk, M] output
     chunk travel n-1 hops each; each hop hides under one
     chunk x w_half partial matmul."""
@@ -95,7 +103,23 @@ def spec_for_matmul_reduce_scatter(b: int, s_chunk: int, k_local: int,
         bytes_per_hop=half_bytes,
         collective_bytes=max(n - 1, 0) * b * s_chunk * m * itemsize,
         flops_per_hop=2 * b * s_chunk * k_local * max(m // 2, 1),
-        chunks=chunks)
+        chunks=chunks, axis=axis)
+
+
+def spec_for_cp_ring(b: int, s_local: int, heads: int, head_dim: int,
+                     n: int, itemsize: int, axis: str = "sep") -> CommSpec:
+    """Ring-attention CP hop plan: each of the n-1 hops moves one rank's
+    [B, H, s_local, D] K and V chunks one step around the single-direction
+    ring while the local Q block attends to the chunk that just arrived
+    (QK^T + PV compute hides the transfer). The collective replaced is the
+    KV all-gather a non-ring CP would issue — same per-rank volume."""
+    kv_bytes = 2 * b * heads * s_local * head_dim * itemsize
+    return CommSpec(
+        name="cp_ring", axis_size=n, hops=max(n - 1, 0),
+        bytes_per_hop=kv_bytes,
+        collective_bytes=max(n - 1, 0) * kv_bytes,
+        flops_per_hop=4 * b * heads * s_local * s_local * head_dim,
+        directions=1, axis=axis)
 
 
 def check_comm_spec(spec: CommSpec) -> List[Diagnostic]:
@@ -149,9 +173,42 @@ def check_comm_spec(spec: CommSpec) -> List[Diagnostic]:
     return diags
 
 
+# ---------------------------------------------------------------------------
+# Per-trace registry: declared specs, keyed by call site
+# ---------------------------------------------------------------------------
+
+# Stack of active recorder lists. The step-plan verifier opens a
+# recording around one step trace; every enforce() fired by a decomposed
+# call site during that trace lands in it, so the declared hop plans and
+# the traced jaxpr describe the SAME program (plan_check S001/S002).
+_RECORDINGS: List[List[Tuple[str, CommSpec]]] = []
+
+
+@contextlib.contextmanager
+def recording() -> Iterator[List[Tuple[str, CommSpec]]]:
+    """Collect every (call site, CommSpec) declared while the context is
+    active. Nestable: an inner recording does not steal from an outer."""
+    rec: List[Tuple[str, CommSpec]] = []
+    _RECORDINGS.append(rec)
+    try:
+        yield rec
+    finally:
+        _RECORDINGS.remove(rec)
+
+
+def record(spec: CommSpec, where: str = "") -> None:
+    """Append one declared spec to every active recording (no-op when
+    none is open)."""
+    entry = (where or f"comm:{spec.name}", spec)
+    for rec in _RECORDINGS:
+        rec.append(entry)
+
+
 def enforce(spec: CommSpec, where: str = "") -> List[Diagnostic]:
-    """Check + route through the shared diagnostic channel
-    (``FLAGS_static_analysis`` off | warn | error)."""
+    """Record into the per-trace registry, check, and route through the
+    shared diagnostic channel (``FLAGS_static_analysis`` off | warn |
+    error)."""
+    record(spec, where)
     diags = check_comm_spec(spec)
     if diags:
         emit(diags, where=where or f"comm:{spec.name}")

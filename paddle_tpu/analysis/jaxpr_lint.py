@@ -58,6 +58,13 @@ class Diagnostic:
         return (f"[{self.severity}] {self.rule}/{self.name}{ctx}: "
                 f"{self.message}{loc}{tail}")
 
+    def to_json(self) -> Dict[str, str]:
+        """Machine-readable form (``tools/lint_graph.py --json``)."""
+        return {"rule": self.rule, "name": self.name,
+                "severity": self.severity, "message": self.message,
+                "source": self.source, "hint": self.hint,
+                "where": self.where}
+
     def __str__(self) -> str:
         return self.format()
 

@@ -1,4 +1,5 @@
-"""Lightweight AST lint with project rules for the paddle_tpu tree.
+"""Lightweight AST lint with project rules for the project sources
+(``paddle_tpu/``, ``tools/``, ``__graft_entry__.py``).
 
 Complements the jaxpr linter: some invariants live in *source*, not in
 traced graphs — host clocks inside kernel modules, constant PRNG seeds in
@@ -27,7 +28,7 @@ from typing import Iterable, List, Optional
 
 from .jaxpr_lint import Diagnostic, ERROR, WARNING
 
-__all__ = ["lint_file", "lint_tree", "ALLOW_MARK"]
+__all__ = ["lint_file", "lint_tree", "ALLOW_MARK", "DEFAULT_SUBTREES"]
 
 ALLOW_MARK = "repo-lint: allow"
 
@@ -136,16 +137,30 @@ def lint_file(path: str, relpath: Optional[str] = None) -> List[Diagnostic]:
     return diags
 
 
-def lint_tree(root: str, subdir: str = "paddle_tpu") -> List[Diagnostic]:
-    """Lint every .py file under ``root/subdir`` (skips native/ blobs)."""
-    base = os.path.join(root, subdir)
+# Default coverage: the package tree, the CLI tools (they carry real
+# logic — hbm accounting, lint drivers, trace viewers), and the driver
+# entry module. A bare filename entry lints that single file.
+DEFAULT_SUBTREES = ("paddle_tpu", "tools", "__graft_entry__.py")
+
+
+def lint_tree(root: str, subdir: Optional[str] = None) -> List[Diagnostic]:
+    """Lint the project's Python sources under ``root`` (skips native/
+    blobs). With ``subdir`` given, only that subtree; by default the
+    :data:`DEFAULT_SUBTREES` — ``paddle_tpu/``, ``tools/`` and
+    ``__graft_entry__.py``."""
+    subtrees = (subdir,) if subdir is not None else DEFAULT_SUBTREES
     out: List[Diagnostic] = []
-    for dirpath, dirnames, filenames in os.walk(base):
-        dirnames[:] = [d for d in dirnames
-                       if d not in ("__pycache__", ".git")]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            full = os.path.join(dirpath, fn)
-            out.extend(lint_file(full, os.path.relpath(full, root)))
+    for sub in subtrees:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base):
+            out.extend(lint_file(base, os.path.relpath(base, root)))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                out.extend(lint_file(full, os.path.relpath(full, root)))
     return out

@@ -81,7 +81,7 @@ __all__ = [
     "shard_map_compat", "can_decompose",
     "allgather_matmul", "matmul_reduce_scatter",
     "pick_chunks", "tune_overlap_chunks",
-    "spec_without_axis", "zero_gather_ahead",
+    "spec_without_axis", "zero_gather_ahead", "gather_ahead_plan",
     "BucketedGradReducer", "MP_AXIS", "GATHER_AHEAD_DEPTH",
 ]
 
@@ -193,10 +193,12 @@ def _is_tracer(*xs) -> bool:
 def _account(op: str, spec, *operands) -> None:
     """Static ICI accounting (analysis.comm_check) + telemetry counters for
     one decomposed call site. Runs on the host at trace time — zero cost
-    inside the compiled program."""
-    from ..analysis import comm_check, jaxpr_lint
-    if jaxpr_lint.analysis_mode() != "off":
-        comm_check.enforce(spec, where=f"overlap.{op}")
+    inside the compiled program. enforce() also RECORDS the spec into any
+    active comm_check.recording(), so a step traced under the plan
+    verifier sees exactly the hop plans its jaxpr contains (plan_check
+    S001/S002); emission still follows FLAGS_static_analysis."""
+    from ..analysis import comm_check
+    comm_check.enforce(spec, where=f"overlap.{op}")
     from ..observability.trace import telemetry_mode
     if telemetry_mode() != "off":
         from ..observability import metrics
@@ -332,7 +334,7 @@ def allgather_matmul(x, w, b=None, *, mesh=None, axis: str = MP_AXIS,
     from ..analysis import comm_check
     spec = comm_check.spec_for_allgather_matmul(
         x.shape[0], s_local, x.shape[2], w.shape[-1] // n, n,
-        jnp.dtype(x.dtype).itemsize, c)
+        jnp.dtype(x.dtype).itemsize, c, axis=axis)
     _account("allgather_matmul", spec, x, w)
 
     def fn(x_l, w_l, b_l, ranks):
@@ -408,7 +410,7 @@ def matmul_reduce_scatter(x, w, b=None, *, mesh=None, axis: str = MP_AXIS,
     from ..analysis import comm_check
     spec = comm_check.spec_for_matmul_reduce_scatter(
         x.shape[0], s, x.shape[2] // n, w.shape[-1], n,
-        jnp.dtype(x.dtype).itemsize, c)
+        jnp.dtype(x.dtype).itemsize, c, axis=axis)
     _account("matmul_reduce_scatter", spec, x, w)
 
     def fn(x_l, w_l, b_full, ranks):
@@ -502,6 +504,33 @@ def _ordered_bwd(res, g):
 
 
 _ordered_after.defvjp(_ordered_fwd, _ordered_bwd)
+
+
+def gather_ahead_plan(param_names: Sequence[str],
+                      gathered_specs: Dict[str, Any],
+                      depth: int = GATHER_AHEAD_DEPTH):
+    """The declared ordering plan of :func:`zero_gather_ahead` for the
+    step-plan verifier (``analysis/plan_check.py``): which stream blocks
+    carry gathered params and the optimization_barrier edges tying block
+    *i*'s gather into block *i - depth*'s. Mirrors the anchor logic of
+    the traced function exactly — a drift between the two is precisely
+    what plan_check rule D003 exists to catch."""
+    from ..analysis.plan_check import GatherPlan
+    from ..framework.offload import group_by_block
+    groups = group_by_block(list(param_names))
+    anchored: List[bool] = []
+    edges: List[Tuple[int, int]] = []
+    gparams: Dict[str, Any] = {}
+    for gi, (_, names) in enumerate(groups):
+        has = any(n in gathered_specs for n in names)
+        if has and gi >= depth and anchored[gi - depth]:
+            edges.append((gi - depth, gi))
+        anchored.append(has)
+        for n in names:
+            if n in gathered_specs:
+                gparams[n] = gathered_specs[n]
+    return GatherPlan(depth=depth, anchored=tuple(anchored),
+                      edges=tuple(edges), params=gparams)
 
 
 def zero_gather_ahead(params: Dict[str, jax.Array],

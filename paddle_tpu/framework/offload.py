@@ -275,6 +275,37 @@ class StreamingUpdate:
                           for k, v in st.items()}
         return {"step": jnp.zeros((), jnp.int32), "param_states": pstates}
 
+    # -- declared plan ------------------------------------------------------
+
+    def plan_nodes(self, param_names: Sequence[str]):
+        """The streaming update's dispatch sequence as declared
+        :class:`~paddle_tpu.analysis.plan_check.PlanNode`\\ s, for the
+        step-plan verifier's donation-lifetime walk (rules D001/D002):
+        per block — H2D moment prefetch, the donating block update
+        (params/grads/in-flight moments), D2H write-back donating the
+        fresh device moments. Mirrors :meth:`update` exactly."""
+        from ..analysis.plan_check import PlanNode
+        nodes = []
+        if self._clip_fn is not None:
+            nodes.append(PlanNode("offload.clip", reads=("grads",),
+                                  writes=("grads",)))
+        groups = group_by_block(list(param_names))
+        for i in range(len(groups)):
+            nodes.append(PlanNode(
+                f"offload.prefetch[{i}]",
+                reads=(f"host_moments[{i}]",),
+                writes=(f"moments[{i}]",)))
+            nodes.append(PlanNode(
+                f"offload.update[{i}]",
+                reads=("opt_scalars",),
+                donates=(f"params[{i}]", f"grads[{i}]", f"moments[{i}]"),
+                writes=(f"params[{i}]", f"moments[{i}]")))
+            nodes.append(PlanNode(
+                f"offload.writeback[{i}]",
+                donates=(f"moments[{i}]",),
+                writes=(f"host_moments[{i}]",)))
+        return nodes
+
     # -- the streaming loop -------------------------------------------------
 
     def _prefetch(self, names, params, pstates):

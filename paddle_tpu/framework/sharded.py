@@ -158,6 +158,9 @@ class TrainStep:
         specs = infer_param_specs(params, model.named_param_specs(), mesh,
                                   fsdp_axis)
         self.pshardings = {n: NamedSharding(mesh, specs[n]) for n in params}
+        self._fsdp_axis = fsdp_axis if (
+            fsdp_axis is not None and fsdp_axis in mesh.axis_names
+            and mesh.shape[fsdp_axis] > 1) else None
         # FLAGS_comm_overlap=tp_zero|all: ZeRO-3 gather-ahead — per-block
         # param all-gathers issued ahead of the consuming block's compute
         # (distributed/overlap.zero_gather_ahead), instead of GSPMD's
@@ -284,27 +287,91 @@ class TrainStep:
         self._linted = False
         self._step_count = 0
         self._base_key = jax.random.key(0)
+        # Declared composition of this step under the live tier flags —
+        # the object analysis/plan_check.py verifies (donation lifetimes,
+        # gather-ahead barrier chain, declared-vs-traced collectives).
+        self.plan = self._build_plan(specs, params, donate)
+
+    def _build_plan(self, specs, params, donate):
+        """Assemble the StepPlan from the decisions made above: one node
+        per dispatch-level sub-program, the gather-ahead ordering plan,
+        and (filled at trace time) the recorded CommSpecs."""
+        from ..analysis import plan_check
+        from ..distributed import overlap as _overlap
+        plan = plan_check.StepPlan(
+            flags={
+                "offload_optimizer": ("moments" if self._offload is not None
+                                      else "off"),
+                "comm_overlap": _overlap.overlap_mode(),
+                "gather_ahead": self._gather_specs is not None,
+                "donate": bool(donate) and self._offload is None,
+            },
+            mesh_axes={str(a): int(self.mesh.shape[a])
+                       for a in self.mesh.axis_names},
+            fsdp_axis=self._fsdp_axis,
+            params={n: plan_check.ParamInfo(
+                tuple(int(d) for d in params[n].shape), specs[n])
+                for n in params})
+        if self._offload is not None:
+            # grad-only compiled step (params NOT donated — the streaming
+            # update consumes and donates them per block right after)
+            plan.nodes.append(plan_check.PlanNode(
+                "grad_step",
+                reads=("params", "opt_scalars", "buffers", "batch"),
+                writes=("loss", "grads", "buffers")))
+            plan.nodes.extend(self._offload.plan_nodes(list(params)))
+        else:
+            plan.nodes.append(plan_check.PlanNode(
+                "train_step",
+                reads=("params", "opt_state", "buffers", "batch"),
+                writes=("loss", "params", "opt_state", "buffers"),
+                donates=("params", "opt_state") if donate else ()))
+        if self._gather_specs is not None:
+            plan.gather = _overlap.gather_ahead_plan(
+                list(params), self._gather_specs)
+        return plan
+
+    def trace_step(self, batch, lr=None, key=None):
+        """Trace the composed step once (no compile) with the comm-spec
+        registry recording, completing ``self.plan`` with the hop plans
+        declared during the trace. Returns ``(closed_jaxpr,
+        donate_argnums)`` — the inputs of ``plan_check.check_plan``."""
+        from ..analysis import comm_check
+        if lr is None:
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        if key is None:
+            key = self._base_key
+        with comm_check.recording() as rec:
+            if self._offload is not None:
+                closed = jax.make_jaxpr(self._step_fn)(
+                    self.params, self.buffers, batch, key)
+                donate = ()
+            else:
+                closed = jax.make_jaxpr(self._step_fn)(
+                    self.params, self.opt_state, self.buffers, batch, lr,
+                    key)
+                donate = (0, 1) if self._donate else ()
+        self.plan.comm_specs = list(rec)
+        return closed, donate
 
     def _maybe_lint(self, batch, lr, key) -> None:
         """FLAGS_static_analysis: lint the whole train step (fwd + grads +
-        update) once at the first batch shape, donation-aware."""
-        from ..analysis import jaxpr_lint
+        update) once at the first batch shape, donation-aware, and verify
+        the declared StepPlan against the same trace (sharding-flow +
+        donation-lifetime rules, analysis/plan_check.py)."""
+        from ..analysis import jaxpr_lint, plan_check
         if self._linted or jaxpr_lint.analysis_mode() == "off":
             return
         self._linted = True
         try:
-            if self._offload is not None:
-                diags = jaxpr_lint.lint_fn(
-                    self._step_fn, self.params, self.buffers, batch, key,
-                    where="sharded.TrainStep")
-            else:
-                diags = jaxpr_lint.lint_fn(
-                    self._step_fn, self.params, self.opt_state, self.buffers,
-                    batch, lr, key,
-                    donate_argnums=(0, 1) if self._donate else (),
-                    where="sharded.TrainStep")
+            closed, donate = self.trace_step(batch, lr, key)
         except Exception:
             return
+        diags = jaxpr_lint.lint_jaxpr(closed, donate_argnums=donate,
+                                      where="sharded.TrainStep")
+        diags += plan_check.check_plan(self.plan, closed,
+                                       donate_argnums=donate,
+                                       where="sharded.TrainStep")
         jaxpr_lint.emit(diags, where="sharded.TrainStep")
 
     def step(self, batch) -> jax.Array:

@@ -946,7 +946,7 @@ def _report_unconverted(fn, reason: str) -> None:
         return
     name = getattr(fn, "__qualname__", repr(fn))
     jaxpr_lint.emit([jaxpr_lint.Diagnostic(
-        rule="D001", name="dy2static-unconverted",
+        rule="Y001", name="dy2static-unconverted",
         severity=jaxpr_lint.WARNING,
         message=f"dy2static could not convert {name}: {reason}; "
                 "data-dependent Python control flow inside it will not "

@@ -655,3 +655,45 @@ class TestCommTelemetry:
         assert agm["bytes_moved"] == 14 << 20
         text = render_text(summarize(steps, spans))
         assert "comm overlap" in text and "allgather_matmul" in text
+
+
+# ---------------------------------------------------------------------------
+# comm_check per-trace registry (plan_check's declared-vs-actual feed)
+# ---------------------------------------------------------------------------
+
+class TestCommSpecRegistry:
+
+    def test_enforce_records_keyed_by_call_site(self, mp8_mesh):
+        """enforce() no longer validates-and-discards: while a recording
+        is open, every decomposed call site's spec lands in it keyed by
+        call site, with the mesh axis it permutes over."""
+        from paddle_tpu.analysis import comm_check
+        x = jnp.ones((2, 64, 16), jnp.float32)
+        w1 = jnp.ones((16, 32), jnp.float32)
+        w2 = jnp.ones((32, 16), jnp.float32)
+        h = jnp.ones((2, 64, 32), jnp.float32)
+        with comm_check.recording() as rec:
+            jax.make_jaxpr(lambda x, w: overlap.allgather_matmul(
+                x, w, mesh=mp8_mesh, chunks=1))(x, w1)
+            jax.make_jaxpr(lambda h, w: overlap.matmul_reduce_scatter(
+                h, w, mesh=mp8_mesh, chunks=1))(h, w2)
+        sites = {w for w, _ in rec}
+        assert sites == {"overlap.allgather_matmul",
+                         "overlap.matmul_reduce_scatter"}
+        for _, spec in rec:
+            assert spec.axis == "mp" and spec.axis_size == 8
+
+    def test_recording_is_scoped_and_nestable(self):
+        from paddle_tpu.analysis import comm_check
+        spec = comm_check.spec_for_allgather_matmul(8, 512, 2048, 2048,
+                                                    4, 2)
+        with comm_check.recording() as outer:
+            comm_check.record(spec, where="a")
+            with comm_check.recording() as inner:
+                comm_check.record(spec, where="b")
+            comm_check.record(spec, where="c")
+        assert [w for w, _ in inner] == ["b"]
+        assert [w for w, _ in outer] == ["a", "b", "c"]
+        # closed recordings never see later specs
+        comm_check.record(spec, where="late")
+        assert [w for w, _ in outer] == ["a", "b", "c"]

@@ -332,3 +332,51 @@ def test_hbm_budget_known_depths():
     assert hbm_budget.main(["--layers", "24"]) == 1
     assert hbm_budget.main(["--layers", "24", "--offload", "moments",
                             "--batch", "2"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Donation hygiene of the streaming block program (lint rule J009)
+# ---------------------------------------------------------------------------
+
+class TestStreamingDonationLint:
+
+    def _block_args(self):
+        from paddle_tpu.optimizer import AdamW
+        model = _mlp(bf16=False)
+        params = get_params(model)
+        opt = AdamW(learning_rate=1e-3)
+        su = offload.StreamingUpdate(opt)
+        state = su.init_state(params)
+        grads = {k: jnp.ones_like(v) for k, v in params.items()}
+        names = offload.group_by_block(list(params))[0][1]
+        p_blk = {n: params[n] for n in names}
+        g_blk = {n: grads[n] for n in names}
+        st_blk = {n: {k: jax.device_put(v, params[n].sharding)
+                      for k, v in state["param_states"][n].items()}
+                  for n in names}
+        return su, (p_blk, g_blk, st_blk, state["step"], jnp.float32(1e-3))
+
+    def test_j009_negative_on_streaming_block(self):
+        """The real per-block update donates (params, grads, moments) and
+        returns TRANSFORMED buffers — the donated-passthrough rule must
+        stay silent on the path that donates the most."""
+        from paddle_tpu.analysis import lint_fn
+        su, args = self._block_args()
+        diags = lint_fn(su._block_fn.__wrapped__, *args,
+                        donate_argnums=(0, 1, 2), where="offload.block")
+        assert "J009" not in {d.rule for d in diags}, \
+            [d.format() for d in diags if d.rule == "J009"]
+
+    def test_j009_positive_on_passthrough_block(self):
+        """A broken block update that forwards a donated buffer unchanged
+        (e.g. skipping a param's update) trips J009."""
+        from paddle_tpu.analysis import lint_fn
+        su, args = self._block_args()
+
+        def bad_block(p_blk, g_blk, st_blk, step, lr):
+            return p_blk, st_blk  # donated inputs flow straight out
+
+        diags = lint_fn(bad_block, *args, donate_argnums=(0, 1, 2),
+                        where="offload.block")
+        hits = [d for d in diags if d.rule == "J009"]
+        assert hits and hits[0].severity == "error"

@@ -485,7 +485,9 @@ def test_dy2static_fallback_reports_under_warn_mode(capsys):
         assert fn(1) == 2
     finally:
         flags.set_flags({"static_analysis": "off"})
-    assert "D001" in capsys.readouterr().err
+    # Y001 (was D001 before the donation-lifetime D-family took the
+    # prefix — analysis/plan_check.py)
+    assert "Y001" in capsys.readouterr().err
 
 
 def test_unknown_flag_error_lists_valid_names():

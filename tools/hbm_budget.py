@@ -115,6 +115,21 @@ def choose_batch(candidates=(4, 2, 1), **kwargs):
     return None, gpt_plan(batch=candidates[-1], **kwargs)
 
 
+def tier_plan(offload: str = "off", remat: bool = True,
+              optimizer: str = "adamw", **kwargs):
+    """The capacity plan a composed tier set is held to by the flag-matrix
+    gate (``tools/lint_graph.py --matrix`` / ``analysis/plan_check`` rule
+    D004): full-depth GPT-1.3B when the moments are offloaded, the L=12
+    half-depth otherwise — resident Adam state alone exceeds HBM at L=24,
+    which is exactly the wall the offload tier exists to remove. Returns
+    the largest-fitting-batch plan (``fits`` False when even batch 1
+    does not fit under the composition)."""
+    layers = kwargs.pop("layers", 24 if offload == "moments" else 12)
+    _, plan = choose_batch(layers=layers, optimizer=optimizer,
+                           offload=offload, remat=remat, **kwargs)
+    return plan
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--layers", type=int, default=24)

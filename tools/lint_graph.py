@@ -5,14 +5,27 @@ the Pallas kernel configs and the source tree) with paddle_tpu.analysis.
     python tools/lint_graph.py --model bert          # one model, CPU, fast
     python tools/lint_graph.py --all                 # models + kernels + AST
     python tools/lint_graph.py --model gpt --min-severity info
+    python tools/lint_graph.py --matrix              # tier-flag matrix gate
+    python tools/lint_graph.py --matrix --json       # machine-readable
 
 Exits nonzero when any error-severity diagnostic is found — the CI gate
 that needs no TPU. Clean models print their diagnostic count (0) and the
 jaxpr size, so regressions in graph hygiene show up in review.
+
+``--matrix`` enumerates every supported combination of the five tier
+flags (offload_optimizer × comm_overlap × cp_nested_ring × pallas_conv ×
+remat), builds each composition's StepPlan on the 8-device virtual mesh,
+and verifies it with ``analysis/plan_check`` (sharding-flow S-rules +
+donation-lifetime D-rules) + ``analysis/comm_check`` hop plans +
+``tools/hbm_budget.py`` capacity — then runs the nine multichip dryrun
+scenarios (skipped with a note on legacy jax, where they cannot trace).
+``--json`` switches stdout to one machine-readable report for CI.
 """
 
 import argparse
+import json
 import os
+import subprocess
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -147,10 +160,27 @@ MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp,
 _SEV_RANK = {"info": 0, "warning": 1, "error": 2}
 
 
-def run(models, with_kernels=False, with_repo=False, min_severity="info"):
+def run(models, with_kernels=False, with_repo=False, min_severity="info",
+        json_mode=False):
+    """Model/kernel/repo lint pass. In json mode the human narration is
+    redirected to stderr and stdout carries one parseable report."""
+    if json_mode:
+        import contextlib
+        with contextlib.redirect_stdout(sys.stderr):
+            rc, report = _run_impl(models, with_kernels, with_repo,
+                                   min_severity)
+        print(json.dumps(report, indent=2))
+        return rc
+    rc, _ = _run_impl(models, with_kernels, with_repo, min_severity)
+    return rc
+
+
+def _run_impl(models, with_kernels=False, with_repo=False,
+              min_severity="info"):
     from paddle_tpu.analysis import check_kernel_spec, repo_lint
     from paddle_tpu.core import flags as core_flags
     all_diags = []
+    report = {"models": {}}
     for name in models:
         diags, n_eqns = MODELS[name]()
         shown = [d for d in diags
@@ -158,8 +188,11 @@ def run(models, with_kernels=False, with_repo=False, min_severity="info"):
         print(f"== {name}: {n_eqns} eqns, {len(diags)} diagnostic(s)")
         for d in shown:
             print("  " + d.format())
+        report["models"][name] = {
+            "eqns": n_eqns, "diagnostics": [d.to_json() for d in diags]}
         all_diags += diags
     if with_kernels:
+        report["kernels"] = []
         from paddle_tpu.analysis import spec_for_flash_packed, spec_for_flash
         from paddle_tpu.ops._pallas.flash_attention_packed import (
             _pick_blocks_packed, pack_group, HEAD_D)
@@ -175,6 +208,7 @@ def run(models, with_kernels=False, with_repo=False, min_severity="info"):
                 print(f"  {tag}: {len(diags)} diagnostic(s)")
                 for d in diags:
                     print("    " + d.format())
+                report["kernels"] += [d.to_json() for d in diags]
                 all_diags += diags
         # the conv family at its default blocks for the byte-dominant
         # ResNet shapes (fwd + wgrad; dgrad reuses the fwd kernel spec)
@@ -206,20 +240,300 @@ def run(models, with_kernels=False, with_repo=False, min_severity="info"):
                 print(f"  {spec.name} {cfg}: {len(diags)} diagnostic(s)")
                 for d in diags:
                     print("    " + d.format())
+                report["kernels"] += [d.to_json() for d in diags]
                 all_diags += diags
     if with_repo:
-        print("== repo AST lint (paddle_tpu/)")
+        print("== repo AST lint (paddle_tpu/ + tools/ + __graft_entry__.py)")
         diags = repo_lint.lint_tree(REPO)
         for d in diags:
             if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]:
                 print("  " + d.format())
+        report["repo"] = [d.to_json() for d in diags]
         all_diags += diags
         unknown = core_flags.unknown_env_flags()
         if unknown:
             print(f"  note: unrecognized FLAGS_* env vars: {unknown}")
     errors = [d for d in all_diags if d.severity == "error"]
     print(f"total: {len(all_diags)} diagnostic(s), {len(errors)} error(s)")
-    return 1 if errors else 0
+    report["total_diagnostics"] = len(all_diags)
+    report["errors"] = len(errors)
+    return (1 if errors else 0), report
+
+
+# ---------------------------------------------------------------------------
+# --matrix: the tier-flag composition gate
+# ---------------------------------------------------------------------------
+
+# the five tier flags (analysis/plan_check.TIER_FLAGS): which parts of a
+# combination need a fresh step trace, vs. arithmetic-only component checks
+_TRACE_KEYS = ("offload_optimizer", "comm_overlap", "remat")
+
+
+def _matrix_micro_step(remat: bool):
+    """A tiny 2-block GPT TrainStep on the dp=2 x sharding=2 x mp=2
+    hybrid mesh — every axis the composed tiers splice into, at shapes
+    that trace in well under a second."""
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.topology import (create_hybrid_mesh,
+                                                 set_hybrid_mesh)
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    use_flash_attention=False, recompute=bool(remat))
+    model = GPTForCausalLM(cfg)
+    mesh = create_hybrid_mesh(dp=2, sharding=2, mp=2)
+    set_hybrid_mesh(mesh)
+
+    def loss_fn(m, p, b):
+        ids, labels = b
+        return functional_call(m, p, ids, labels, training=True)
+
+    ts = make_sharded_train_step(model, AdamW(1e-3), loss_fn, mesh=mesh)
+    ids = jnp.zeros((4, 16), jnp.int32)
+    return ts, (ids, ids)
+
+
+def _matrix_step_diags(remat: bool):
+    """Build + trace the micro TrainStep under the current flags and run
+    the full plan verification; returns (diags, info)."""
+    from paddle_tpu.analysis import plan_check
+    from paddle_tpu.distributed.topology import set_hybrid_mesh
+    try:
+        ts, batch = _matrix_micro_step(remat)
+        closed, donate = ts.trace_step(batch)
+        diags = plan_check.check_plan(ts.plan, closed,
+                                      donate_argnums=donate,
+                                      where="matrix.step")
+        info = {"eqns": len(closed.jaxpr.eqns),
+                "plan": ts.plan.to_json()}
+    finally:
+        set_hybrid_mesh(None)
+    return diags, info
+
+
+def _matrix_sp_pair_diags():
+    """The decomposed TP/SP pair traced fwd+grad on an mp-only mesh (the
+    shape the legacy-jax gate admits), with the comm registry recording —
+    the declared-vs-actual ppermute cross-check (S001/S002) on the real
+    decomposed path, plus the C-rule accounting of each recorded spec and
+    the production-shape hop plans."""
+    import numpy as np
+    from jax.sharding import Mesh
+    from paddle_tpu.analysis import comm_check, plan_check
+    from paddle_tpu.distributed import overlap
+
+    if jax.device_count() < 2:
+        return [], {"skipped": "needs >= 2 devices"}
+    n = 8 if jax.device_count() >= 8 else 2
+    mesh = Mesh(np.asarray(jax.devices()[:n]).reshape(1, 1, 1, 1, n),
+                ("pp", "dp", "sharding", "sep", "mp"))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 8 * n, 16)), jnp.float32)
+    w1 = jnp.asarray(rng.standard_normal((16, 32)), jnp.float32)
+    w2 = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+
+    def loss(x, w1, w2):
+        h = overlap.allgather_matmul(x, w1, mesh=mesh, chunks=1)
+        y = overlap.matmul_reduce_scatter(jax.nn.gelu(h), w2, mesh=mesh,
+                                          chunks=1)
+        return jnp.sum(y ** 2)
+
+    with comm_check.recording() as rec:
+        closed = jax.make_jaxpr(jax.value_and_grad(loss, argnums=(1, 2)))(
+            x, w1, w2)
+    plan = plan_check.StepPlan(
+        flags={"comm_overlap": "tp"},
+        mesh_axes={str(a): int(mesh.shape[a]) for a in mesh.axis_names},
+        nodes=[plan_check.PlanNode("sp_pair", reads=("x", "w1", "w2"),
+                                   writes=("loss", "grads"))],
+        comm_specs=list(rec))
+    diags = plan_check.check_plan(plan, closed, where="matrix.sp_pair")
+    # production-shape hop plans (GPT-1.3B layer through mp=4)
+    for spec in (comm_check.spec_for_allgather_matmul(
+                     8, 512, 2048, 2048, 4, 2),
+                 comm_check.spec_for_matmul_reduce_scatter(
+                     8, 512, 2048, 2048, 4, 2)):
+        diags += comm_check.check_comm_spec(spec)
+    return diags, {"recorded_specs": len(rec),
+                   "eqns": len(closed.jaxpr.eqns)}
+
+
+def _matrix_cp_ring_diags():
+    """Static hop accounting of the ring-CP tier at a long-context shape
+    (S=32k over sep=4, GPT-1.3B heads): the arithmetic half of the
+    cp_nested_ring composition — the nested-ring trace itself needs the
+    pipeline runtime (new-jax dryrun[7])."""
+    from paddle_tpu.analysis import comm_check
+    spec = comm_check.spec_for_cp_ring(
+        b=1, s_local=8192, heads=16, head_dim=128, n=4, itemsize=2)
+    return comm_check.check_comm_spec(spec), {
+        "hops": spec.hops, "mib_per_hop": round(spec.bytes_per_hop / 2**20,
+                                                2)}
+
+
+def _matrix_conv_diags():
+    """The pallas_conv tier's kernel-config checks (P-rules) at its
+    default blocks over the byte-dominant ResNet shapes."""
+    import numpy as np
+    from paddle_tpu.analysis import (check_kernel_spec, spec_for_conv3x3,
+                                     spec_for_conv_matmul)
+    from paddle_tpu.ops._pallas import conv as pconv
+    diags = []
+    bf16 = np.dtype("bfloat16")
+    for kind, n, h, w, cin, cout, s_ in pconv.RESNET50_TOP3_SHAPES:
+        if kind == "conv1x1":
+            m = n * ((h + s_ - 1) // s_) * ((w + s_ - 1) // s_)
+            bm = pconv._pick_block_m(m, cin, cout, jnp.bfloat16)
+            diags += check_kernel_spec(
+                spec_for_conv_matmul(m, cin, cout, bm, dtype=bf16))
+        else:
+            ho = (h + 2 - 3) // s_ + 1
+            bh = pconv._pick_block_h(ho, n, h, w, cin, cout, s_,
+                                     jnp.bfloat16)
+            diags += check_kernel_spec(
+                spec_for_conv3x3(n, h, w, cin, cout, bh, s_, dtype=bf16))
+    return diags, {"shapes": len(pconv.RESNET50_TOP3_SHAPES)}
+
+
+def run_dryruns():
+    """The nine multichip dryrun scenarios (__graft_entry__._dryrun_base)
+    in a subprocess on the 8-device virtual mesh. Needs the maintained
+    jax.shard_map API; on legacy jax this reports skipped — the driver
+    environment runs them for real."""
+    if not hasattr(jax, "shard_map"):
+        return {"skipped": "legacy jax (no jax.shard_map); the dryrun "
+                           "scenarios only trace in the driver env",
+                "ok": True, "scenarios": []}
+    env = dict(os.environ)
+    env["_GRAFT_DRYRUN_NO_ESCALATE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=8")
+    env["XLA_FLAGS"] = " ".join(flags)
+    code = (f"import sys; sys.path.insert(0, {REPO!r}); "
+            "import jax; jax.config.update('jax_platforms', 'cpu'); "
+            "import __graft_entry__ as g; g.dryrun_multichip(8)")
+    proc = subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                          capture_output=True, text=True)
+    import re
+    scenarios = sorted(set(
+        int(m) for m in re.findall(r"dryrun_multichip\[(\d+)\]",
+                                   proc.stdout)))
+    ok = proc.returncode == 0 and len(scenarios) >= 9
+    out = {"ok": ok, "returncode": proc.returncode, "scenarios": scenarios}
+    if not ok:
+        out["tail"] = (proc.stdout + proc.stderr)[-2000:]
+    return out
+
+
+def run_matrix(min_severity="info", json_mode=False, with_dryrun=True,
+               combos=None):
+    """Enumerate the tier-flag combinations, verify each composition, and
+    (optionally) run the nine dryrun scenarios. Exits nonzero on any
+    error-severity diagnostic or dryrun failure."""
+    if json_mode:
+        import contextlib
+        with contextlib.redirect_stdout(sys.stderr):
+            rc, report = _run_matrix_impl(min_severity, with_dryrun, combos)
+        print(json.dumps(report, indent=2))
+        return rc
+    rc, _ = _run_matrix_impl(min_severity, with_dryrun, combos)
+    return rc
+
+
+def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
+    import tools.hbm_budget as hbm_budget
+    from paddle_tpu.analysis import plan_check
+    from paddle_tpu.core import flags as core_flags
+    from paddle_tpu.ops._pallas import conv as _pconv  # registers the flag
+    del _pconv
+
+    tier_names = [n for n, _ in plan_check.TIER_FLAGS]
+    prev = {n: core_flags.flag(n) for n in tier_names
+            if n in core_flags.get_flags()}
+    combos = list(plan_check.iter_tier_combos()) if combos is None \
+        else list(combos)
+    step_cache = {}
+    component_cache = {}
+    report = {"combos": [], "errors": 0}
+    n_errors = 0
+    try:
+        for combo in combos:
+            core_flags.set_flags({
+                "offload_optimizer": combo["offload_optimizer"],
+                "comm_overlap": combo["comm_overlap"],
+                "cp_nested_ring": combo["cp_nested_ring"],
+                "pallas_conv": combo["pallas_conv"],
+            })
+            diags = []
+            entry = {"flags": dict(combo)}
+            # (a) the composed StepPlan, traced + verified (cached per
+            # trace-relevant sub-key: cp/pallas_conv don't change the
+            # micro step's graph — their components are checked below)
+            sub = tuple(combo[k] for k in _TRACE_KEYS)
+            if sub not in step_cache:
+                step_cache[sub] = _matrix_step_diags(combo["remat"])
+            sdiags, sinfo = step_cache[sub]
+            diags += sdiags
+            entry["step"] = {"eqns": sinfo.get("eqns")}
+            # (b) tier components the micro step cannot carry
+            if combo["comm_overlap"] != "off":
+                if "sp" not in component_cache:
+                    component_cache["sp"] = _matrix_sp_pair_diags()
+                diags += component_cache["sp"][0]
+            if combo["cp_nested_ring"]:
+                if "cp" not in component_cache:
+                    component_cache["cp"] = _matrix_cp_ring_diags()
+                diags += component_cache["cp"][0]
+            if combo["pallas_conv"]:
+                if "conv" not in component_cache:
+                    component_cache["conv"] = _matrix_conv_diags()
+                diags += component_cache["conv"][0]
+            # (c) capacity: the flagship config this composition is held
+            # to (full-depth GPT-1.3B when offloaded, L=12 otherwise)
+            cap = hbm_budget.tier_plan(
+                offload=combo["offload_optimizer"],
+                remat=bool(combo["remat"]))
+            diags += plan_check.check_capacity(cap, where="matrix.hbm")
+            entry["hbm"] = {"fits": cap["fits"],
+                            "device_gb": cap["device_gb"],
+                            "layers": cap["config"]["layers"],
+                            "batch": cap["config"]["batch"]}
+            errors = [d for d in diags if d.severity == "error"]
+            n_errors += len(errors)
+            entry["diagnostics"] = [d.to_json() for d in diags]
+            entry["errors"] = len(errors)
+            report["combos"].append(entry)
+            tag = " ".join(f"{k}={combo[k]}" for k in tier_names)
+            print(f"== matrix {tag}: {len(diags)} diagnostic(s), "
+                  f"{len(errors)} error(s)")
+            for d in diags:
+                if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]:
+                    print("  " + d.format())
+    finally:
+        core_flags.set_flags(prev)
+    if with_dryrun:
+        dry = run_dryruns()
+        report["dryrun"] = dry
+        if dry.get("skipped"):
+            print(f"== dryrun scenarios: SKIPPED ({dry['skipped']})")
+        else:
+            print(f"== dryrun scenarios: {dry['scenarios']} "
+                  f"{'ok' if dry['ok'] else 'FAILED'}")
+            if not dry["ok"]:
+                n_errors += 1
+                print(dry.get("tail", ""))
+    report["errors"] = n_errors
+    print(f"matrix total: {len(report['combos'])} combination(s), "
+          f"{n_errors} error(s)")
+    return (1 if n_errors else 0), report
 
 
 def main(argv=None):
@@ -228,15 +542,26 @@ def main(argv=None):
                    help="model graph(s) to lint (repeatable)")
     p.add_argument("--all", action="store_true",
                    help="lint every model + pallas kernel configs + repo AST")
+    p.add_argument("--matrix", action="store_true",
+                   help="verify every tier-flag combination's composed "
+                        "StepPlan + the nine dryrun scenarios")
+    p.add_argument("--no-dryrun", action="store_true",
+                   help="with --matrix: skip the multichip dryrun scenarios")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout (narration "
+                        "moves to stderr)")
     p.add_argument("--min-severity", choices=["info", "warning", "error"],
                    default="info", help="only print findings at or above")
     a = p.parse_args(argv)
+    if a.matrix:
+        return run_matrix(min_severity=a.min_severity, json_mode=a.json,
+                          with_dryrun=not a.no_dryrun)
     if a.all:
         models = sorted(MODELS)
     else:
         models = a.model or ["bert"]
     return run(models, with_kernels=a.all, with_repo=a.all,
-               min_severity=a.min_severity)
+               min_severity=a.min_severity, json_mode=a.json)
 
 
 if __name__ == "__main__":
