@@ -1182,6 +1182,52 @@ def _gpt_13b_measured_path(mode, layers, hidden, heads, seq, vocab,
     return m, n_params, batch, plan
 
 
+def bench_fault(small: bool):
+    """Fault-tolerance goodput, measured (ISSUE 7 / ROADMAP item 5): run
+    the elastic kill-and-resume drill (tools/fault_drill.py machinery —
+    SIGKILL mid-step AND mid-checkpoint-write, relaunch, resume from
+    latest_complete) and emit goodput = useful_step_time /
+    wall_time_including_restart plus restart count, lost steps, and
+    checkpoint save/restore durations. Bitwise loss parity vs the
+    uninterrupted reference is asserted as part of the record — a bench
+    number from a run that did NOT recover exactly would be meaningless."""
+    import tempfile
+
+    from paddle_tpu.fault import drill
+
+    cfg = drill.quick_config()
+    if not small:
+        cfg.update(total_steps=16, ckpt_every=4)
+    workdir = tempfile.mkdtemp(prefix="bench_fault_")
+    report = drill.run_drill(workdir, **cfg)
+    g = report.get("goodput_record", {})
+    parity = report.get("parity", {})
+    if report.get("rc") != 0 or "goodput" not in g:
+        raise RuntimeError(f"fault drill failed: rc={report.get('rc')} "
+                           f"{report.get('error', '')}")
+    _emit("fault_tolerance_goodput_pct", g["goodput"] * 100.0,
+          "pct useful-step/wall", 0.0,
+          {"goodput": g["goodput"],
+           "restarts": g["restarts"],
+           "lost_steps": g["lost_steps"],
+           "useful_step_s": g["useful_step_s"],
+           "wall_s": g["wall_s"],
+           "ckpt_save_ms": g["ckpt_save"],
+           "ckpt_restore_ms": g["ckpt_restore"],
+           "steps": cfg["total_steps"],
+           "plan": report["plan"]["events"],
+           "fired": report.get("fired_events"),
+           "parity_bitwise": parity.get("bitwise_equal"),
+           "method": ("subprocess elastic drill on the CPU mesh: "
+                      "deterministic FaultPlan kills the trainer mid-step "
+                      "and mid-checkpoint-write; ElasticManager "
+                      "relaunches; resume from latest_complete(); wall "
+                      "time includes process startup, recompile, restore "
+                      "and re-executed steps")})
+    if not parity.get("bitwise_equal"):
+        raise RuntimeError(f"fault drill parity broken: {parity}")
+
+
 def bench_gpt_13b():
     """BASELINE config 4, the PRIMARY metric: GPT-3 1.3B tokens/sec/chip.
 
@@ -1434,6 +1480,14 @@ def main():
             bench_comm_overlap(small)
         except Exception as e:
             print(json.dumps({"metric": "bench_comm_overlap_FAILED",
+                              "error": str(e)[:500]}), flush=True)
+    # fault-tolerance drill: kill/relaunch/resume with measured goodput
+    # (subprocesses on the CPU mesh — runs chipless, ~30s quick config)
+    if os.environ.get("BENCH_FAULT", "1") != "0":
+        try:
+            bench_fault(small)
+        except Exception as e:
+            print(json.dumps({"metric": "bench_fault_FAILED",
                               "error": str(e)[:500]}), flush=True)
     if "all" in selected or "gpt" in selected:
         bench_gpt(small)  # primary: printed last

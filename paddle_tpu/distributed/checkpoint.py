@@ -14,13 +14,18 @@ reference's converter machinery by construction.
 
 from __future__ import annotations
 
+import io as _io
+import json
 import os
-from typing import Any, Dict, Optional
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import numpy as np
 
-__all__ = ["save_state", "load_state", "save_sharded", "load_sharded"]
+__all__ = ["save_state", "load_state", "save_sharded", "load_sharded",
+           "write_snapshot", "read_snapshot", "validate_snapshot",
+           "snapshot_manifest", "MANIFEST_NAME", "SNAPSHOT_FORMAT"]
 
 
 def save_state(state: Dict[str, Any], path: str) -> None:
@@ -62,3 +67,151 @@ def load_sharded(directory: str, template=None, step: Optional[int] = None,
             lambda t, s: jax.ShapeDtypeStruct(t.shape, t.dtype, sharding=s),
             template, shardings)
     return ckptr.restore(source, template)
+
+
+# ---------------------------------------------------------------------------
+# Manifest snapshots — the fault-tolerance tier's on-disk format
+# ---------------------------------------------------------------------------
+#
+# A snapshot is one directory:
+#
+#     <dir>/arr_00000.npy ...       one .npy per array leaf
+#     <dir>/manifest.json           written LAST — its presence marks commit
+#
+# The manifest records the pytree structure (dicts/lists/tuples/scalars,
+# array leaves as indices) plus per-array shape/dtype/crc32 of the exact
+# bytes on disk, so a torn write (process killed mid-checkpoint) is
+# detectable without deserializing: a directory with no manifest, a missing
+# array file, or a checksum mismatch is NOT a checkpoint.
+# ``fault.CheckpointManager`` layers tmp-dir + atomic-rename, async saves,
+# and retention on top of these primitives.
+
+MANIFEST_NAME = "manifest.json"
+SNAPSHOT_FORMAT = 1
+
+
+def _encode_tree(obj, arrays: List[np.ndarray]):
+    """JSON-able mirror of ``obj``; array leaves become ``{"__array__": i}``
+    referencing ``arrays[i]``. jax Arrays are fetched to host here — for
+    host-committed leaves (pinned/unpinned host memory kinds, e.g. the
+    offload tier's moments) this is a host-memory read, never an HBM
+    round-trip."""
+    if isinstance(obj, jax.Array) or isinstance(obj, np.ndarray):
+        arrays.append(np.asarray(obj))
+        return {"__array__": len(arrays) - 1}
+    if isinstance(obj, np.generic):
+        arrays.append(np.asarray(obj))
+        return {"__array__": len(arrays) - 1}
+    if isinstance(obj, dict):
+        return {"__dict__": [[str(k), _encode_tree(v, arrays)]
+                             for k, v in obj.items()]}
+    if isinstance(obj, tuple):
+        return {"__tuple__": [_encode_tree(v, arrays) for v in obj]}
+    if isinstance(obj, list):
+        return {"__list__": [_encode_tree(v, arrays) for v in obj]}
+    if obj is None or isinstance(obj, (bool, int, float, str)):
+        return obj
+    raise TypeError(f"snapshot cannot serialize {type(obj).__name__}")
+
+
+def _decode_tree(node, arrays):
+    if isinstance(node, dict):
+        if "__array__" in node:
+            return arrays[node["__array__"]]
+        if "__dict__" in node:
+            return {k: _decode_tree(v, arrays) for k, v in node["__dict__"]}
+        if "__tuple__" in node:
+            return tuple(_decode_tree(v, arrays) for v in node["__tuple__"])
+        if "__list__" in node:
+            return [_decode_tree(v, arrays) for v in node["__list__"]]
+    return node
+
+
+def write_snapshot(state, directory: str,
+                   meta: Optional[Dict[str, Any]] = None,
+                   _mid_write_hook=None) -> Dict[str, Any]:
+    """Write ``state`` (a pytree of arrays/dicts/lists/tuples/scalars) as a
+    manifest snapshot into ``directory`` (created; caller owns atomicity —
+    write into a tmp dir and rename). Returns the manifest dict.
+
+    ``_mid_write_hook()`` fires after the first array file lands and before
+    the manifest — the fault-injection seam the drills kill through."""
+    os.makedirs(directory, exist_ok=True)
+    arrays: List[np.ndarray] = []
+    tree = _encode_tree(state, arrays)
+    entries = []
+    for i, a in enumerate(arrays):
+        fname = f"arr_{i:05d}.npy"
+        buf = _io.BytesIO()
+        np.save(buf, a, allow_pickle=False)
+        raw = buf.getvalue()
+        with open(os.path.join(directory, fname), "wb") as f:
+            f.write(raw)
+            f.flush()
+            os.fsync(f.fileno())
+        entries.append({"file": fname, "shape": list(a.shape),
+                        "dtype": str(a.dtype),
+                        "crc32": zlib.crc32(raw) & 0xFFFFFFFF})
+        if i == 0 and _mid_write_hook is not None:
+            _mid_write_hook()
+    manifest = {"format": SNAPSHOT_FORMAT, "tree": tree, "arrays": entries,
+                "meta": dict(meta or {})}
+    mpath = os.path.join(directory, MANIFEST_NAME)
+    with open(mpath + ".tmp", "w") as f:
+        json.dump(manifest, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(mpath + ".tmp", mpath)
+    return manifest
+
+
+def snapshot_manifest(directory: str) -> Optional[Dict[str, Any]]:
+    """The manifest of ``directory``, or None when absent/unparseable."""
+    try:
+        with open(os.path.join(directory, MANIFEST_NAME)) as f:
+            m = json.load(f)
+        return m if m.get("format") == SNAPSHOT_FORMAT else None
+    except (OSError, ValueError):
+        return None
+
+
+def validate_snapshot(directory: str) -> Tuple[bool, str]:
+    """(ok, reason): manifest present and every array file's bytes match
+    its recorded crc32 — a torn or bit-rotted snapshot reports False."""
+    m = snapshot_manifest(directory)
+    if m is None:
+        return False, "missing or unreadable manifest"
+    for e in m["arrays"]:
+        path = os.path.join(directory, e["file"])
+        try:
+            with open(path, "rb") as f:
+                raw = f.read()
+        except OSError:
+            return False, f"missing array file {e['file']}"
+        if (zlib.crc32(raw) & 0xFFFFFFFF) != e["crc32"]:
+            return False, f"checksum mismatch in {e['file']}"
+    return True, ""
+
+
+def read_snapshot(directory: str, to_device: bool = False):
+    """Load a snapshot written by :func:`write_snapshot`. Returns
+    ``(state, meta)`` with numpy leaves (``to_device=True`` converts array
+    leaves to jax Arrays on the default device). Raises ``ValueError`` on a
+    torn/corrupt snapshot — callers that want skip-don't-crash semantics go
+    through ``fault.CheckpointManager.latest_complete``."""
+    ok, reason = validate_snapshot(directory)
+    if not ok:
+        raise ValueError(f"invalid snapshot {directory}: {reason}")
+    m = snapshot_manifest(directory)
+    arrays = []
+    for e in m["arrays"]:
+        a = np.load(os.path.join(directory, e["file"]), allow_pickle=False)
+        if str(a.dtype) != e["dtype"]:
+            # non-native dtypes (bfloat16 et al.) round-trip through .npy
+            # as opaque void records — reinterpret via the manifest dtype
+            a = a.view(np.dtype(e["dtype"]))
+        arrays.append(a)
+    if to_device:
+        import jax.numpy as jnp
+        arrays = [jnp.asarray(a) for a in arrays]
+    return _decode_tree(m["tree"], arrays), m.get("meta", {})

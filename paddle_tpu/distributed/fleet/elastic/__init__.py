@@ -22,7 +22,7 @@ import os
 import time
 from typing import Callable, Dict, List, Optional
 
-from ....profiler.monitor import stat_add
+from ....observability import metrics
 
 __all__ = ["ElasticLevel", "ElasticStatus", "FileHeartbeatStore",
            "ElasticManager", "ELASTIC_EXIT_CODE",
@@ -99,7 +99,8 @@ class ElasticManager:
                  max_restarts: int = 3,
                  elastic_level: int = ElasticLevel.FAULT_TOLERANCE,
                  heartbeat_interval: float = 5.0,
-                 min_np: int = 1, max_np: Optional[int] = None):
+                 min_np: int = 1, max_np: Optional[int] = None,
+                 max_auto_parallel_restarts: int = 10):
         self.pod_factory = pod_factory
         self.pod_id = str(pod_id)
         self.store = store
@@ -109,6 +110,11 @@ class ElasticManager:
         self.min_np = min_np
         self.max_np = max_np
         self.restarts = 0
+        # Exit code 102 asks for a re-tune + relaunch WITHOUT spending the
+        # failure budget — but a pod that always exits 102 must not loop
+        # forever, so these relaunches get their own (generous) cap.
+        self.max_auto_parallel_restarts = max_auto_parallel_restarts
+        self.auto_parallel_restarts = 0
         self.history: List[Dict] = []
 
     # -- liveness ----------------------------------------------------------
@@ -138,15 +144,48 @@ class ElasticManager:
                     self.store.leave(self.pod_id)
                 return 0
             if rc == ELASTIC_AUTO_PARALLEL_EXIT_CODE:
-                # Reference semantics: re-tune/re-shard then relaunch;
-                # relaunch without counting against the budget.
+                # Reference semantics: re-tune/re-shard then relaunch
+                # without counting against the failure budget — but capped:
+                # an always-102 pod would otherwise relaunch forever.
+                self.auto_parallel_restarts += 1
+                if self.auto_parallel_restarts > \
+                        self.max_auto_parallel_restarts:
+                    self._diagnose_restart_storm(rc)
+                    if self.store is not None:
+                        self.store.leave(self.pod_id)
+                    return rc
+                metrics.counter(
+                    "elastic.auto_parallel_relaunches",
+                    "un-budgeted relaunches after exit code 102").inc()
                 continue
             self.restarts += 1
             if self.restarts > self.max_restarts:
                 if self.store is not None:
                     self.store.leave(self.pod_id)
                 return rc
-            stat_add("elastic.restarts")  # counts actual relaunches only
+            # counts actual relaunches only (registry-native series, in
+            # the Prometheus/JSON exposition like every fault.* metric)
+            metrics.counter(
+                "elastic.restarts",
+                "pod relaunches after trainer failure").inc()
+
+    def _diagnose_restart_storm(self, rc: int) -> None:
+        from ....analysis.jaxpr_lint import Diagnostic, emit
+        d = Diagnostic(
+            rule="E001", name="elastic-restart-storm", severity="error",
+            message=(f"pod {self.pod_id} exited "
+                     f"{ELASTIC_AUTO_PARALLEL_EXIT_CODE} (auto-parallel "
+                     f"relaunch) {self.auto_parallel_restarts} times — "
+                     "over the un-budgeted relaunch cap of "
+                     f"{self.max_auto_parallel_restarts}; giving up with "
+                     f"rc={rc}"),
+            hint="an always-102 trainer loops forever without this cap; "
+                 "raise max_auto_parallel_restarts only if re-tuning "
+                 "legitimately needs more rounds",
+            where="fleet.elastic.ElasticManager")
+        # Operational failure — always visible, independent of
+        # FLAGS_static_analysis (warn mode prints, never raises).
+        emit([d], where="fleet.elastic.ElasticManager", mode="warn")
 
     def _watch_one(self, pod, poll_interval: float) -> int:
         last_beat = 0.0

@@ -1,0 +1,32 @@
+"""Fault-tolerance tier: prove recovery, then measure it.
+
+The elastic manager (``distributed/fleet/elastic``) can relaunch a dead
+pod and ``distributed/checkpoint`` can write snapshots; this package
+connects them into a story a production run can rely on:
+
+- :class:`~paddle_tpu.fault.checkpoint_manager.CheckpointManager` — async
+  train-state snapshots with tmp-dir + atomic-rename commit, per-array
+  checksums, retention, and ``latest_complete()`` that skips torn writes;
+- :class:`~paddle_tpu.fault.injection.FaultPlan` /
+  :class:`~paddle_tpu.fault.injection.FaultInjector` — deterministic,
+  seed-driven kills (mid-step SIGKILL, mid-checkpoint-write SIGKILL,
+  SIGTERM preemption with a grace-window final save);
+- :mod:`~paddle_tpu.fault.goodput` — ``useful_step_time /
+  wall_time_including_restart`` plus restart/lost-step/checkpoint-duration
+  accounting, published as ``fault.*`` metrics;
+- :mod:`~paddle_tpu.fault.drill` — the end-to-end
+  train→kill→relaunch→resume drill (``tools/fault_drill.py``) that asserts
+  bitwise loss parity against an uninterrupted run and emits the goodput
+  record ``bench.py`` carries into ``BENCH_*.json``.
+
+See ``RESILIENCE.md`` for the checkpoint format and drill usage.
+"""
+
+from .checkpoint_manager import CheckpointManager  # noqa: F401
+from .goodput import compute_goodput, parse_train_log  # noqa: F401
+from .injection import (FAULT_KINDS, FaultEvent, FaultInjector,  # noqa: F401
+                        FaultPlan, PREEMPTION_EXIT_CODE)
+
+__all__ = ["CheckpointManager", "FaultPlan", "FaultEvent", "FaultInjector",
+           "FAULT_KINDS", "PREEMPTION_EXIT_CODE", "compute_goodput",
+           "parse_train_log"]

@@ -1,0 +1,193 @@
+"""The fault-drill trainer: a small GPT run that survives being killed.
+
+Runs as one container under the elastic launcher (``drill.py`` wires it
+through ``ElasticManager``) or in-process as the uninterrupted reference
+(:func:`train` is a plain function). Every source of step-to-step state is
+checkpointed — params, optimizer moments, the TrainStep step counter (the
+PRNG stream is ``fold_in(base_key, step_count)``), the eager-RNG generator,
+and the batch-pool cursor — so a relaunch replays the exact trajectory an
+uninterrupted run produces, bitwise.
+
+Env contract (subprocess mode; all prefixed FAULT_, see ``main``):
+``FAULT_WORK_DIR`` (required), ``FAULT_TOTAL_STEPS``, ``FAULT_CKPT_EVERY``,
+``FAULT_PLAN`` (FaultPlan JSON; empty = no faults), ``FAULT_ASYNC``,
+``FAULT_SIZE`` (quick|small), ``FAULT_GRACE_S``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if __name__ == "__main__":  # subprocess mode: the launcher passes a file path
+    sys.path.insert(0, REPO)
+
+SIZES = {
+    # layers, hidden, heads, seq, batch, vocab, pool
+    "quick": dict(layers=1, hidden=32, heads=2, seq=16, batch=2, vocab=128,
+                  pool=4),
+    "small": dict(layers=2, hidden=64, heads=4, seq=32, batch=4, vocab=256,
+                  pool=8),
+}
+DATA_SEED = 1234
+
+
+def make_batches(size: str = "quick"):
+    """The deterministic batch pool the run cycles through; the cursor
+    (``step % pool``) is part of the checkpointed state."""
+    import jax.numpy as jnp
+    import numpy as np
+    cfg = SIZES[size]
+    rng = np.random.default_rng(DATA_SEED)
+    out = []
+    for _ in range(cfg["pool"]):
+        ids = rng.integers(0, cfg["vocab"],
+                           size=(cfg["batch"], cfg["seq"]), dtype=np.int32)
+        labels = rng.integers(0, cfg["vocab"],
+                              size=(cfg["batch"], cfg["seq"]),
+                              dtype=np.int32)
+        out.append((jnp.asarray(ids), jnp.asarray(labels)))
+    return out
+
+
+def build_step(size: str = "quick"):
+    """(TrainStep, batch pool) for the drill model: a tiny GPT with Adam
+    (moments exercise the optimizer-state checkpoint path) on a
+    single-device mesh — subprocess and in-process reference build the
+    byte-identical step regardless of how many virtual devices the parent
+    environment provisioned."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.optimizer import Adam
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = SIZES[size]
+    paddle.seed(0)
+    model = GPTForCausalLM(GPTConfig(
+        vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
+        num_layers=cfg["layers"], num_heads=cfg["heads"],
+        max_position_embeddings=cfg["seq"],
+        hidden_dropout=0.0, attention_dropout=0.0))
+    model.train()
+    opt = Adam(learning_rate=1e-3)
+
+    def loss_fn(mdl, params, batch):
+        ids, labels = batch
+        return functional_call(mdl, params, ids, labels, training=True)
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    ts = make_sharded_train_step(model, opt, loss_fn, mesh=mesh)
+    return ts, make_batches(size)
+
+
+class _Log:
+    """Append-only JSONL log, fsynced per line — a SIGKILL one instruction
+    after :meth:`write` must not lose the line (the parity check depends
+    on every committed step's loss being durable)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._mu = threading.Lock()
+
+    def write(self, rec: Dict[str, Any]) -> None:
+        with self._mu:
+            with open(self.path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+
+def train(work_dir: str, total_steps: int = 8, ckpt_every: int = 2,
+          plan_json: str = "", async_save: bool = True,
+          size: str = "quick", grace_s: float = 5.0) -> None:
+    """One incarnation of the drill trainer: resume from the latest
+    complete checkpoint if any, train to ``total_steps``, die wherever the
+    fault plan says."""
+    from paddle_tpu.core.random import get_rng_state, set_rng_state
+    from paddle_tpu.fault.checkpoint_manager import CheckpointManager
+    from paddle_tpu.fault.injection import FaultInjector, FaultPlan
+
+    os.makedirs(work_dir, exist_ok=True)
+    log = _Log(os.path.join(work_dir, "train_log.jsonl"))
+    plan = FaultPlan.from_json(plan_json)
+    ts, batches = build_step(size)
+    pool = len(batches)
+    mgr = CheckpointManager(
+        os.path.join(work_dir, "ckpt"), keep=3, async_save=async_save,
+        on_commit=lambda step, ms: log.write(
+            {"event": "ckpt_saved", "step": step, "ms": round(ms, 3)}))
+    inj = FaultInjector(plan, work_dir)
+
+    start = 0
+    found = mgr.latest_complete()
+    if found is not None:
+        t0 = time.perf_counter()
+        _, state, _meta = mgr.restore(found)
+        restore_ms = (time.perf_counter() - t0) * 1e3
+        ts.load_state_dict(state["train"])
+        set_rng_state(tuple(state["rng"]))
+        start = int(state["step"])
+        assert int(state["loader_pos"]) == start % pool, \
+            "checkpointed loader cursor disagrees with the step index"
+        log.write({"event": "ckpt_restored", "step": start,
+                   "ms": round(restore_ms, 3)})
+        log.write({"event": "resumed", "step": start})
+    log.write({"event": "start", "start_step": start, "pid": os.getpid()})
+
+    def make_state(next_step: int) -> Dict[str, Any]:
+        return {"train": ts.state_dict(),
+                "rng": list(get_rng_state()),
+                "loader_pos": next_step % pool,
+                "step": next_step}
+
+    current = {"step": start}
+
+    def preemption_save():
+        s = current["step"]
+        log.write({"event": "preempted", "step": s})
+        mgr.save(s, make_state(s), block=True)
+
+    if len(plan):
+        inj.arm(preemption_save=preemption_save, grace_s=grace_s)
+
+    for step in range(start, total_steps):
+        current["step"] = step
+        inj.poll_step_begin(step)
+        t0 = time.perf_counter()
+        loss = float(ts.step(batches[step % pool]))  # float() syncs
+        dt = time.perf_counter() - t0
+        inj.poll_step_end(step)  # mid-step kill: loss computed, never logged
+        log.write({"step": step, "loss": loss, "t": round(dt, 6)})
+        if (step + 1) % ckpt_every == 0 and step + 1 < total_steps:
+            mgr.save(step + 1, make_state(step + 1))
+    mgr.save(total_steps, make_state(total_steps), block=True)
+    mgr.close()
+    if len(plan):
+        inj.disarm()
+    log.write({"event": "done"})
+
+
+def main() -> None:
+    env = os.environ
+    train(work_dir=env["FAULT_WORK_DIR"],
+          total_steps=int(env.get("FAULT_TOTAL_STEPS", "8")),
+          ckpt_every=int(env.get("FAULT_CKPT_EVERY", "2")),
+          plan_json=env.get("FAULT_PLAN", ""),
+          async_save=env.get("FAULT_ASYNC", "1") == "1",
+          size=env.get("FAULT_SIZE", "quick"),
+          grace_s=float(env.get("FAULT_GRACE_S", "5.0")))
+
+
+if __name__ == "__main__":
+    main()

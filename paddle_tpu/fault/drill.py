@@ -1,0 +1,165 @@
+"""The end-to-end fault drill: train → kill → relaunch → resume → measure.
+
+Runs the drill trainer (``fault/_trainer.py``) as a subprocess pod under
+``ElasticManager`` (the same watch/relaunch loop a real deployment uses),
+with a deterministic :class:`~paddle_tpu.fault.injection.FaultPlan` killing
+it mid-step, mid-checkpoint-write, or via SIGTERM; then replays the same
+number of steps uninterrupted and checks **bitwise** loss parity — the
+proof that checkpoint + PRNG + batch-cursor state capture is complete.
+The run's goodput record (useful step time / wall time including
+restarts, restart count, lost steps, checkpoint save/restore durations)
+is what ``bench.py`` emits into ``BENCH_*.json``.
+
+CLI: ``tools/fault_drill.py`` (``--quick`` is the tier-1-safe mode the
+test suite runs as a subprocess).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional, Sequence
+
+from . import _trainer, goodput
+from .injection import FaultPlan
+
+__all__ = ["quick_config", "run_drill"]
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+TRAINER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "_trainer.py")
+
+
+def quick_config() -> Dict[str, Any]:
+    """The tier-1-safe drill: tiny model, 2 kills (one mid-step, one
+    mid-checkpoint-write), well under a minute on a laptop CPU."""
+    return dict(total_steps=8, ckpt_every=2, seed=7, n_kills=2,
+                kinds=("mid_step", "mid_ckpt_write"), size="quick")
+
+
+def _fault_env(workdir: str, total_steps: int, ckpt_every: int,
+               plan: FaultPlan, size: str) -> Dict[str, str]:
+    env = dict(os.environ)
+    env.update({
+        "FAULT_WORK_DIR": workdir,
+        "FAULT_TOTAL_STEPS": str(total_steps),
+        "FAULT_CKPT_EVERY": str(ckpt_every),
+        "FAULT_PLAN": plan.to_json(),
+        "FAULT_SIZE": size,
+        "JAX_PLATFORMS": "cpu",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    return env
+
+
+def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
+              seed: int = 7, n_kills: int = 2,
+              kinds: Sequence[str] = ("mid_step", "mid_ckpt_write"),
+              size: str = "quick", max_restarts: Optional[int] = None,
+              reference: str = "inline") -> Dict[str, Any]:
+    """Run the fault-injected job + the uninterrupted reference, return the
+    full report (goodput record, parity verdict, plan, per-run logs).
+
+    ``reference`` is ``"inline"`` (run the reference trainer in this
+    process — the step builder pins a single-device mesh, so the
+    trajectory is identical to the subprocess run) or ``"subprocess"``.
+    """
+    from ..distributed.launch import LaunchConfig, launch
+
+    plan = FaultPlan.from_seed(seed, total_steps, n_kills=n_kills,
+                               kinds=tuple(kinds), min_step=1)
+    if max_restarts is None:
+        max_restarts = n_kills + 2  # headroom over the planned faults
+    fault_dir = os.path.join(workdir, "fault")
+    ref_dir = os.path.join(workdir, "reference")
+    os.makedirs(fault_dir, exist_ok=True)
+    os.makedirs(ref_dir, exist_ok=True)
+
+    cfg = LaunchConfig(
+        nproc_per_node=1, log_dir=os.path.join(fault_dir, "logs"),
+        envs=_fault_env(fault_dir, total_steps, ckpt_every, plan, size))
+    t0 = time.perf_counter()
+    rc = launch(cfg, TRAINER, max_restarts=max_restarts,
+                elastic_dir=os.path.join(fault_dir, "hb"))
+    wall_s = time.perf_counter() - t0
+
+    report: Dict[str, Any] = {
+        "rc": rc, "plan": json.loads(plan.to_json()),
+        "config": {"total_steps": total_steps, "ckpt_every": ckpt_every,
+                   "seed": seed, "size": size,
+                   "max_restarts": max_restarts},
+    }
+    log_path = os.path.join(fault_dir, "train_log.jsonl")
+    if rc != 0 or not os.path.exists(log_path):
+        report["error"] = f"fault run exited rc={rc}"
+        return report
+    with open(log_path) as f:
+        flog = goodput.parse_train_log(f)
+    report["goodput_record"] = goodput.compute_goodput(flog, wall_s)
+    report["fired_events"] = sorted(
+        _read_fired(os.path.join(fault_dir, "fired.json")))
+    report["done"] = any(e.get("event") == "done" for e in flog["events"])
+
+    # -- the uninterrupted reference + bitwise parity -----------------------
+    if reference == "inline":
+        _trainer.train(ref_dir, total_steps=total_steps,
+                       ckpt_every=ckpt_every, plan_json="", size=size)
+        ref_rc = 0
+    else:
+        cfg_ref = LaunchConfig(
+            nproc_per_node=1, log_dir=os.path.join(ref_dir, "logs"),
+            envs=_fault_env(ref_dir, total_steps, ckpt_every,
+                            FaultPlan([]), size))
+        ref_rc = launch(cfg_ref, TRAINER)
+    with open(os.path.join(ref_dir, "train_log.jsonl")) as f:
+        rlog = goodput.parse_train_log(f)
+    report["parity"] = _parity(flog, rlog, total_steps)
+    report["reference_rc"] = ref_rc
+    return report
+
+
+def _read_fired(path: str):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return []
+
+
+def _parity(flog, rlog, total_steps: int) -> Dict[str, Any]:
+    """Bitwise comparison of the final loss per step. float(loss) is an
+    exact float32→float64 widening and json round-trips doubles exactly,
+    so ``==`` here IS bitwise equality of the computed losses."""
+    fsteps = {s: r["loss"] for s, r in flog["steps"].items()}
+    rsteps = {s: r["loss"] for s, r in rlog["steps"].items()}
+    missing = [s for s in range(total_steps)
+               if s not in fsteps or s not in rsteps]
+    diffs = [{"step": s, "fault": fsteps[s], "reference": rsteps[s]}
+             for s in range(total_steps)
+             if s in fsteps and s in rsteps and fsteps[s] != rsteps[s]]
+    return {"bitwise_equal": not missing and not diffs,
+            "steps": total_steps, "missing_steps": missing,
+            "mismatches": diffs[:8]}
+
+
+def report_summary(report: Dict[str, Any]) -> str:
+    g = report.get("goodput_record", {})
+    p = report.get("parity", {})
+    lines = [
+        f"fault drill rc={report.get('rc')} "
+        f"done={report.get('done')}",
+        f"  plan: {[e['kind'] + '@' + str(e['step']) for e in report['plan']['events']]}",
+        f"  fired: {report.get('fired_events')}",
+        f"  goodput={g.get('goodput')} "
+        f"(useful {g.get('useful_step_s')}s / wall {g.get('wall_s')}s), "
+        f"restarts={g.get('restarts')}, lost_steps={g.get('lost_steps')}",
+        f"  ckpt saves={g.get('ckpt_save', {}).get('count')} "
+        f"(mean {g.get('ckpt_save', {}).get('mean_ms')} ms), "
+        f"restores={g.get('ckpt_restore', {}).get('count')} "
+        f"(mean {g.get('ckpt_restore', {}).get('mean_ms')} ms)",
+        f"  parity: bitwise_equal={p.get('bitwise_equal')} "
+        f"over {p.get('steps')} steps",
+    ]
+    return "\n".join(lines)

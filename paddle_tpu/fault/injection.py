@@ -1,0 +1,292 @@
+"""Deterministic fault injection for recovery drills.
+
+On a real v5e pod preemptions are routine; the only way to trust the
+recovery path is to kill training on purpose and measure what comes back.
+This module provides the kill schedule (:class:`FaultPlan`) and the
+in-process trigger (:class:`FaultInjector`) the drill trainers arm.
+
+Design constraints:
+
+- **Deterministic.** A plan derives entirely from ``(seed, total_steps)``
+  via a seeded generator — no wall-clock randomness, so a drill that fails
+  replays exactly (same steps die, same snapshots get torn).
+- **Fire-once across relaunches.** The injector records every fired event
+  in ``fired.json`` (fsynced BEFORE the kill) so the relaunched process
+  skips already-delivered faults instead of dying in a loop.
+- **Three failure modes**, matching what a pod actually sees:
+  ``mid_step`` (SIGKILL between the step's compute and its log/checkpoint
+  commit — work is lost), ``mid_ckpt_write`` (SIGKILL inside the snapshot
+  write, after array files land but before the manifest — the torn-
+  checkpoint case ``latest_complete`` must skip), and ``sigterm`` (a
+  preemption notice with a grace window: the handler runs a final sync
+  save, then exits ``PREEMPTION_EXIT_CODE`` so the elastic manager
+  relaunches).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["FaultEvent", "FaultPlan", "FaultInjector", "FAULT_KINDS",
+           "PREEMPTION_EXIT_CODE", "fire", "register_fire_point",
+           "clear_fire_points", "check_plan"]
+
+FAULT_KINDS = ("mid_step", "mid_ckpt_write", "sigterm")
+
+# Same code the reference's elastic stack uses for a restart-me exit; the
+# ElasticManager counts it against the restart budget and relaunches.
+PREEMPTION_EXIT_CODE = 101
+
+
+# ---------------------------------------------------------------------------
+# Fire points: named seams other subsystems expose to the injector
+# ---------------------------------------------------------------------------
+
+_fire_points = {}
+_fire_lock = threading.Lock()
+
+
+def register_fire_point(name: str, fn: Optional[Callable[[], None]]) -> None:
+    """Install (or with ``None`` remove) the callback behind a named seam.
+    Production code calls :func:`fire` unconditionally; with nothing
+    registered it is a dict lookup and return."""
+    with _fire_lock:
+        if fn is None:
+            _fire_points.pop(name, None)
+        else:
+            _fire_points[name] = fn
+
+
+def clear_fire_points() -> None:
+    with _fire_lock:
+        _fire_points.clear()
+
+
+def fire(name: str) -> None:
+    """Trigger the named seam if an injector armed it (no-op otherwise)."""
+    with _fire_lock:
+        fn = _fire_points.get(name)
+    if fn is not None:
+        fn()
+
+
+# ---------------------------------------------------------------------------
+# The plan
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FaultEvent:
+    kind: str   # one of FAULT_KINDS
+    step: int   # the training step at/after which the event fires
+
+    @property
+    def key(self) -> str:
+        return f"{self.kind}@{self.step}"
+
+
+class FaultPlan:
+    """An ordered, deterministic schedule of failures for one drill run."""
+
+    def __init__(self, events: Sequence[FaultEvent], seed: Optional[int] = None):
+        self.events: List[FaultEvent] = sorted(events, key=lambda e: e.step)
+        self.seed = seed
+
+    @classmethod
+    def from_seed(cls, seed: int, total_steps: int, n_kills: int = 2,
+                  kinds: Sequence[str] = ("mid_step", "mid_ckpt_write"),
+                  min_step: int = 1) -> "FaultPlan":
+        """``n_kills`` events at distinct steps in
+        ``[min_step, total_steps - 2]``, kinds assigned round-robin — the
+        default pair exercises both the lost-work path and the
+        torn-checkpoint path. Fully determined by the arguments."""
+        for k in kinds:
+            if k not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind {k!r}; "
+                                 f"expected one of {FAULT_KINDS}")
+        hi = total_steps - 1  # never kill the final step: the drill must end
+        candidates = list(range(min_step, hi))
+        if n_kills > len(candidates):
+            raise ValueError(
+                f"cannot place {n_kills} kills in steps "
+                f"[{min_step}, {hi - 1}] ({len(candidates)} candidates)")
+        rng = np.random.default_rng(seed)
+        steps = sorted(int(s) for s in
+                       rng.choice(candidates, size=n_kills, replace=False))
+        events = [FaultEvent(kinds[i % len(kinds)], s)
+                  for i, s in enumerate(steps)]
+        return cls(events, seed=seed)
+
+    def to_json(self) -> str:
+        return json.dumps({"seed": self.seed,
+                           "events": [{"kind": e.kind, "step": e.step}
+                                      for e in self.events]})
+
+    @classmethod
+    def from_json(cls, s: str) -> "FaultPlan":
+        if not s:
+            return cls([])
+        rec = json.loads(s)
+        return cls([FaultEvent(e["kind"], int(e["step"]))
+                    for e in rec.get("events", ())], seed=rec.get("seed"))
+
+    def __len__(self) -> int:
+        return len(self.events)
+
+    def __repr__(self) -> str:
+        return f"FaultPlan({[e.key for e in self.events]}, seed={self.seed})"
+
+
+def check_plan(plan: FaultPlan, total_steps: int):
+    """Static validation of a drill's fault plan — the lint entry
+    (``tools/lint_graph.py --model fault``) runs this so a drill config
+    that can never fire (or fires past the end of training) is caught
+    without running subprocesses. Returns ``analysis.Diagnostic`` records
+    (rule F002)."""
+    from ..analysis.jaxpr_lint import Diagnostic
+    diags = []
+
+    def bad(msg, hint=""):
+        diags.append(Diagnostic(
+            rule="F002", name="fault-plan-invalid", severity="error",
+            message=msg, hint=hint, where="fault.FaultPlan"))
+
+    seen = set()
+    for e in plan.events:
+        if e.kind not in FAULT_KINDS:
+            bad(f"unknown fault kind {e.kind!r}")
+        if not (0 <= e.step < total_steps - 1):
+            bad(f"{e.key} fires outside trainable range "
+                f"[0, {total_steps - 2}] — the drill would never observe "
+                "a post-fault resume",
+                hint="keep kill steps strictly before the final step")
+        if e.key in seen:
+            bad(f"duplicate event {e.key}")
+        seen.add(e.key)
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# The injector
+# ---------------------------------------------------------------------------
+
+class FaultInjector:
+    """Arms a :class:`FaultPlan` inside a trainer process.
+
+    The trainer calls :meth:`poll_step_begin` / :meth:`poll_step_end`
+    around each step; checkpoint writes route through the
+    ``ckpt.mid_write`` fire point (``fault.CheckpointManager`` exposes it).
+    Every event is journaled to ``fired.json`` (fsync) before the process
+    dies so the relaunch resumes cleanly instead of replaying the fault.
+    """
+
+    def __init__(self, plan: FaultPlan, record_dir: str):
+        self.plan = plan
+        self.record_path = os.path.join(record_dir, "fired.json")
+        os.makedirs(record_dir, exist_ok=True)
+        self._fired = self._load_fired()
+        self._step = -1
+        self._preemption_save: Optional[Callable[[], None]] = None
+        self.grace_s = 5.0
+
+    # -- fired-event journal (must survive SIGKILL) -------------------------
+
+    def _load_fired(self):
+        try:
+            with open(self.record_path) as f:
+                return set(json.load(f))
+        except (OSError, ValueError):
+            return set()
+
+    def _mark_fired(self, ev: FaultEvent) -> None:
+        self._fired.add(ev.key)
+        tmp = self.record_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(sorted(self._fired), f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.record_path)
+
+    def fired_events(self):
+        return sorted(self._fired)
+
+    def _pending(self, kind: str, step: int) -> Optional[FaultEvent]:
+        for e in self.plan.events:
+            if e.kind == kind and e.step <= step and e.key not in self._fired:
+                return e
+        return None
+
+    # -- arming -------------------------------------------------------------
+
+    def arm(self, preemption_save: Optional[Callable[[], None]] = None,
+            grace_s: float = 5.0) -> None:
+        """Install the checkpoint-write seam and the SIGTERM preemption
+        handler. ``preemption_save`` runs inside the grace window, then the
+        process exits ``PREEMPTION_EXIT_CODE``."""
+        self._preemption_save = preemption_save
+        self.grace_s = float(grace_s)
+        register_fire_point("ckpt.mid_write", self._on_ckpt_write)
+        signal.signal(signal.SIGTERM, self._on_sigterm)
+
+    def disarm(self) -> None:
+        register_fire_point("ckpt.mid_write", None)
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+
+    # -- trigger points ------------------------------------------------------
+
+    def poll_step_begin(self, step: int) -> None:
+        """SIGTERM-kind events deliver at a step boundary — the preemption
+        notice arrives, the handler saves within the grace window, exits."""
+        self._step = step
+        ev = self._pending("sigterm", step)
+        if ev is not None:
+            self._mark_fired(ev)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def poll_step_end(self, step: int) -> None:
+        """mid_step kills land AFTER the step's compute finished but BEFORE
+        its log line / checkpoint — that step's work is genuinely lost and
+        must be re-executed after the relaunch."""
+        self._step = step
+        ev = self._pending("mid_step", step)
+        if ev is not None:
+            self._mark_fired(ev)
+            self._die()
+
+    def _on_ckpt_write(self) -> None:
+        ev = self._pending("mid_ckpt_write", self._step)
+        if ev is not None:
+            self._mark_fired(ev)
+            self._die()
+
+    def _die(self) -> None:
+        from ..observability import metrics
+        metrics.counter("fault.kills_injected",
+                        "SIGKILLs delivered by the fault injector").inc()
+        os.kill(os.getpid(), signal.SIGKILL)  # no cleanup, by design
+
+    def _on_sigterm(self, signum, frame) -> None:
+        from ..observability import metrics
+        deadline = time.monotonic() + self.grace_s
+        if self._preemption_save is not None:
+            try:
+                self._preemption_save()
+                metrics.counter(
+                    "fault.preemption_saves",
+                    "final checkpoint saves inside the SIGTERM grace "
+                    "window").inc()
+            except Exception as e:  # grace-window save is best-effort
+                print(f"[fault] preemption save failed: {e}",
+                      file=sys.stderr)
+        if time.monotonic() > deadline:
+            print("[fault] preemption save exceeded the "
+                  f"{self.grace_s:.1f}s grace window", file=sys.stderr)
+        os._exit(PREEMPTION_EXIT_CODE)

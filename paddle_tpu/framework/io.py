@@ -48,15 +48,27 @@ def save(obj: Any, path: str, protocol: int = 4) -> None:
     dirname = os.path.dirname(path)
     if dirname:
         os.makedirs(dirname, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_host(obj), f, protocol=protocol)
+    # Atomic commit: a process killed mid-write (preemption, OOM-kill)
+    # must never leave a truncated file where `load` expects a checkpoint
+    # — the old file survives until the fsynced replacement is complete.
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            pickle.dump(_to_host(obj), f, protocol=protocol)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.remove(tmp)
     # Forward-compat sidecar (ref phi/api/yaml/op_version.yaml): record the
     # op-version map so future loads can replay registered upgrades.
     try:
         import json
         from ..core.op_version import op_version_map
-        with open(path + ".opver", "w") as f:
+        with open(tmp + ".opver", "w") as f:
             json.dump(op_version_map(), f)
+        os.replace(tmp + ".opver", path + ".opver")
     except OSError:
         pass
 

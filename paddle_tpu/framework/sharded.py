@@ -433,6 +433,53 @@ class TrainStep:
             sched.step()
         return loss
 
+    def state_dict(self) -> Dict[str, Any]:
+        """Everything needed to resume this step bitwise: params, optimizer
+        state (host-resident moments included — arrays are returned as-is,
+        the checkpoint capture reads host-committed leaves from host
+        memory), buffers, the step counter (the PRNG stream is
+        ``fold_in(base_key, step_count)``, so the counter IS the RNG
+        state), and the LR-scheduler position."""
+        sched = self.optimizer.lr_scheduler
+        return {
+            "params": dict(self.params),
+            "opt_state": self.opt_state,
+            "buffers": dict(self.buffers),
+            "step_count": int(self._step_count),
+            "lr_sched": sched.state_dict() if sched is not None else None,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        """Restore a :meth:`state_dict` (possibly with numpy leaves from a
+        checkpoint). Params/opt state are placed back onto this step's
+        shardings; when the offload tier is active, moment leaves are
+        placed DIRECTLY into the host memory tier (one H2host transfer,
+        never materializing the full moment set in HBM)."""
+        self.params = {n: jax.device_put(jnp.asarray(v), self.pshardings[n])
+                       for n, v in state["params"].items()}
+        ssh = self._state_shardings
+        if self._offload is not None:
+            kind = self._offload.host_kind
+            keys = self._offload._moment_keys
+            ssh = {"step": ssh["step"],
+                   "param_states": {
+                       n: {k: (s.with_memory_kind(kind) if k in keys
+                               and getattr(
+                                   state["opt_state"]["param_states"]
+                                   [n][k], "ndim", 0) > 0 else s)
+                           for k, s in st.items()}
+                       for n, st in ssh["param_states"].items()}}
+        self.opt_state = jax.tree_util.tree_map(
+            lambda v, s: jax.device_put(jnp.asarray(v), s),
+            state["opt_state"], ssh,
+            is_leaf=lambda x: not isinstance(x, dict))
+        self.buffers = {n: jnp.asarray(v)
+                        for n, v in state.get("buffers", {}).items()}
+        self._step_count = int(state["step_count"])
+        sched = self.optimizer.lr_scheduler
+        if sched is not None and state.get("lr_sched") is not None:
+            sched.set_state_dict(state["lr_sched"])
+
     def sync_to_model(self) -> None:
         """Write the current params/buffers back to the Layer tree (for
         state_dict/save; the reference's sharding stage-3 gathers before save

@@ -45,7 +45,8 @@ __all__ = ["StepTimeline", "RecompileSentinel", "current", "reset_default",
            "fingerprint", "fingerprint_diff", "instrument_jitted",
            "PHASES", "GB"]
 
-PHASES = ("data", "h2d", "compile", "device", "comm", "offload_in",
+PHASES = ("data", "h2d", "compile", "device", "comm",
+          "ckpt_save", "ckpt_restore", "offload_in",
           "offload_out", "callbacks")
 
 GB = float(2 ** 30)

@@ -7,6 +7,8 @@ assert bitwise equality of the gathered params. Also covers save/load of a
 full train state (params + optimizer state) and resume parity.
 """
 
+import os
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -123,3 +125,66 @@ def test_train_state_save_resume_parity(tmp_path):
     ls = jax.tree_util.tree_map(jnp.asarray, loaded["opt"])
     _, _, rest = steps(model, opt, lp, ls, data[3:])
     np.testing.assert_allclose(first + rest, straight, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# framework.io.save atomicity (ISSUE 7 satellite): a mid-write death must
+# never leave a truncated file where load expects a checkpoint
+# ---------------------------------------------------------------------------
+
+_KILL_MID_WRITE = """
+import os, pickle, signal, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from paddle_tpu.framework import io as fio
+
+def killing_dump(obj, f, protocol=4):
+    f.write(b"TRUNCATED GARBAGE")   # a partial, unloadable payload
+    f.flush()
+    os.fsync(f.fileno())
+    os.kill(os.getpid(), signal.SIGKILL)   # die mid-write, no cleanup
+
+fio.pickle.dump = killing_dump
+fio.save({{"x": 1}}, {path!r})
+"""
+
+
+def _run_killed_save(path):
+    import subprocess
+    import sys
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         _KILL_MID_WRITE.format(repo=REPO, path=str(path))],
+        capture_output=True, timeout=120)
+    assert proc.returncode == -9, proc.stderr  # SIGKILLed as scripted
+
+
+def test_save_killed_mid_write_preserves_previous_file(tmp_path):
+    """Overwrite case: the old checkpoint must survive a death inside the
+    replacement's write (seeded deterministic kill inside pickle.dump)."""
+    from paddle_tpu.framework import io as fio
+    path = tmp_path / "ckpt.pdparams"
+    fio.save({"x": np.arange(4)}, str(path))
+    _run_killed_save(path)
+    loaded = fio.load(str(path))  # must still be the OLD content
+    np.testing.assert_array_equal(np.asarray(loaded["x"]), np.arange(4))
+    # the torn bytes live only in a tmp file load never looks at
+    leftovers = [n for n in os.listdir(tmp_path) if ".tmp." in n]
+    assert leftovers, "expected the torn tmp file to be left behind"
+
+
+def test_save_killed_mid_write_first_save_leaves_no_file(tmp_path):
+    """Fresh-path case: a death during the very first save must leave the
+    target absent (not truncated) so resume logic falls back cleanly."""
+    path = tmp_path / "fresh.pdparams"
+    _run_killed_save(path)
+    assert not path.exists()
+
+
+def test_save_success_leaves_no_tmp(tmp_path):
+    from paddle_tpu.framework import io as fio
+    path = tmp_path / "clean.pdparams"
+    fio.save({"x": 3}, str(path))
+    assert fio.load(str(path))["x"] == 3
+    assert [n for n in os.listdir(tmp_path) if ".tmp." in n] == []

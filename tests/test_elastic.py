@@ -8,9 +8,9 @@ import time
 
 import pytest
 
-from paddle_tpu.distributed.fleet.elastic import (ELASTIC_EXIT_CODE,
-                                                  ElasticManager,
-                                                  FileHeartbeatStore)
+from paddle_tpu.distributed.fleet.elastic import (
+    ELASTIC_AUTO_PARALLEL_EXIT_CODE, ELASTIC_EXIT_CODE, ElasticManager,
+    FileHeartbeatStore)
 from paddle_tpu.distributed.launch import LaunchConfig, launch
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -65,6 +65,106 @@ def test_restart_budget_exhausted(tmp_path):
     assert rc == 7
     assert calls["n"] == 3  # initial + 2 restarts
     assert len(mgr.history) == 3
+
+
+class _FakeContainer:
+    """Poll-able stand-in for a trainer process: returns None (running)
+    until its deadline, then the scripted exit code."""
+
+    def __init__(self, rc, run_for=0.0):
+        self.rc = rc
+        self._deadline = time.time() + run_for
+
+    def poll(self):
+        return self.rc if time.time() >= self._deadline else None
+
+
+class _FakePod:
+    def __init__(self, rc, run_for=0.0):
+        self.containers = [_FakeContainer(rc, run_for)]
+        self.stopped = False
+
+    def deploy(self):
+        pass
+
+    def stop(self):
+        self.stopped = True
+
+
+class _RecordingStore(FileHeartbeatStore):
+    def __init__(self, directory, ttl=60.0):
+        super().__init__(directory, ttl)
+        self.beats = []
+
+    def beat(self, pod_id, info=None):
+        self.beats.append((pod_id, dict(info or {})))
+        super().beat(pod_id, info)
+
+
+def test_heartbeat_refreshes_during_watch(tmp_path):
+    """While a pod runs, _watch_one must keep re-registering liveness at
+    heartbeat_interval — a silent watcher reads as a dead pod to peers."""
+    store = _RecordingStore(str(tmp_path), ttl=60.0)
+    mgr = ElasticManager(lambda: _FakePod(0, run_for=0.35), store=store,
+                         heartbeat_interval=0.05)
+    rc = mgr.run(poll_interval=0.01)
+    assert rc == 0
+    # one beat at deploy + several refreshes from inside the watch loop
+    assert len(store.beats) >= 3, store.beats
+    assert store.alive_pods() == []  # leave() on clean exit
+
+
+def test_auto_parallel_relaunches_are_capped(tmp_path, capsys):
+    """Regression: exit code 102 relaunches bypass the restart budget —
+    an always-102 pod used to loop forever. Now they get their own cap
+    and a surfaced Diagnostic."""
+    pods = []
+
+    def factory():
+        pods.append(_FakePod(ELASTIC_AUTO_PARALLEL_EXIT_CODE))
+        return pods[-1]
+
+    store = FileHeartbeatStore(str(tmp_path))
+    mgr = ElasticManager(factory, store=store, max_restarts=2,
+                         max_auto_parallel_restarts=3)
+    rc = mgr.run(poll_interval=0.01)
+    assert rc == ELASTIC_AUTO_PARALLEL_EXIT_CODE
+    # initial deploy + exactly max_auto_parallel_restarts relaunches
+    assert len(pods) == 4
+    assert mgr.auto_parallel_restarts == 4  # the over-cap attempt counted
+    assert mgr.restarts == 0                # failure budget untouched
+    assert store.alive_pods() == []         # liveness cleaned up on abort
+    err = capsys.readouterr().err
+    assert "E001" in err and "elastic-restart-storm" in err
+
+
+def test_budget_exhaustion_cleans_up_liveness(tmp_path):
+    store = FileHeartbeatStore(str(tmp_path))
+    mgr = ElasticManager(lambda: _FakePod(7), store=store, max_restarts=1)
+    rc = mgr.run(poll_interval=0.01)
+    assert rc == 7
+    assert store.alive_pods() == []
+
+
+def test_restarts_land_in_metrics_registry(tmp_path):
+    from paddle_tpu.observability import metrics
+    before = _restart_count(metrics)
+    calls = {"n": 0}
+
+    def factory():
+        calls["n"] += 1
+        # fails twice, then exits clean
+        return _FakePod(0 if calls["n"] >= 3 else 1)
+
+    mgr = ElasticManager(factory, max_restarts=5)
+    assert mgr.run(poll_interval=0.01) == 0
+    assert _restart_count(metrics) == before + 2
+    assert "elastic_restarts" in metrics.prometheus_text()
+
+
+def _restart_count(metrics):
+    series = metrics.snapshot().get("elastic.restarts", {}).get("series", [])
+    return series[0]["value"] if series else 0
 
 
 def test_kill_mid_train_resumes_from_checkpoint_with_loss_continuity(

@@ -1,0 +1,343 @@
+"""Fault-tolerance tier unit tests (paddle_tpu/fault/): async atomic
+checkpointing (torn-snapshot skip, retention, retry/degrade), deterministic
+fault plans, TrainStep state round-trip bitwise parity, goodput math."""
+
+import json
+import os
+import shutil
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import checkpoint as dckpt
+from paddle_tpu.fault import (CheckpointManager, FaultEvent, FaultPlan,
+                              compute_goodput, parse_train_log)
+from paddle_tpu.fault import injection
+
+
+# ---------------------------------------------------------------------------
+# Snapshot primitives
+# ---------------------------------------------------------------------------
+
+def test_snapshot_roundtrip_preserves_structure(tmp_path):
+    state = {
+        "params": {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+                   "b": jnp.ones((4,), jnp.bfloat16)},
+        "step": 7,
+        "nested": {"t": (1, 2.5, np.float64(3.5)), "l": [True, None, "s"]},
+    }
+    d = str(tmp_path / "snap")
+    m = dckpt.write_snapshot(state, d, meta={"tag": "x"})
+    assert len(m["arrays"]) == 3  # w, b, and the np.float64 scalar
+    ok, reason = dckpt.validate_snapshot(d)
+    assert ok, reason
+    out, meta = dckpt.read_snapshot(d)
+    assert meta["tag"] == "x"
+    np.testing.assert_array_equal(out["params"]["w"], state["params"]["w"])
+    assert out["params"]["b"].dtype == np.dtype("bfloat16")
+    assert out["step"] == 7
+    assert isinstance(out["nested"]["t"], tuple)
+    assert out["nested"]["t"][:2] == (1, 2.5)
+    assert out["nested"]["l"] == [True, None, "s"]
+
+
+def test_snapshot_detects_corruption(tmp_path):
+    d = str(tmp_path / "snap")
+    dckpt.write_snapshot({"x": np.zeros((8,), np.float32)}, d)
+    f = os.path.join(d, "arr_00000.npy")
+    raw = open(f, "rb").read()
+    with open(f, "wb") as fh:
+        fh.write(raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+    ok, reason = dckpt.validate_snapshot(d)
+    assert not ok and "checksum" in reason
+    with pytest.raises(ValueError):
+        dckpt.read_snapshot(d)
+
+
+def test_snapshot_without_manifest_is_not_a_snapshot(tmp_path):
+    d = str(tmp_path / "snap")
+    dckpt.write_snapshot({"x": np.zeros((2,))}, d)
+    os.remove(os.path.join(d, dckpt.MANIFEST_NAME))
+    ok, reason = dckpt.validate_snapshot(d)
+    assert not ok and "manifest" in reason
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+def test_manager_async_save_and_restore(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=5)
+    cm.save(2, {"x": np.full((4,), 2.0)})
+    cm.save(4, {"x": np.full((4,), 4.0)}, meta={"note": "later"})
+    cm.wait()
+    assert cm.all_steps() == [2, 4]
+    assert cm.latest_complete() == 4
+    step, state, meta = cm.restore()
+    assert step == 4 and meta["note"] == "later"
+    np.testing.assert_array_equal(state["x"], np.full((4,), 4.0))
+    step, state, _ = cm.restore(step=2)
+    np.testing.assert_array_equal(state["x"], np.full((4,), 2.0))
+
+
+def test_latest_complete_skips_torn_and_corrupt(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=5)
+    cm.save(2, {"x": np.ones((4,))}, block=True)
+    cm.save(4, {"x": np.ones((4,))}, block=True)
+    cm.save(6, {"x": np.ones((4,))}, block=True)
+    # step 6: torn (no manifest — as left by a death mid-write after rename
+    # could never happen; emulate a manually-assembled partial dir)
+    os.remove(os.path.join(cm.directory, "step_6", dckpt.MANIFEST_NAME))
+    # step 4: corrupt payload
+    f = os.path.join(cm.directory, "step_4", "arr_00000.npy")
+    raw = open(f, "rb").read()
+    open(f, "wb").write(raw[:10])
+    assert cm.latest_complete() == 2
+    assert len(cm.diagnostics) == 2  # one skip note per bad snapshot
+    assert all(d.rule == "F001" for d in cm.diagnostics)
+
+
+def test_manager_retention_prunes_oldest(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+    for s in (1, 2, 3, 4):
+        cm.save(s, {"x": np.full((2,), float(s))})
+    cm.wait()
+    assert cm.all_steps() == [3, 4]
+
+
+def test_manager_tmp_dirs_are_invisible_to_readers(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=5)
+    cm.save(2, {"x": np.ones((2,))}, block=True)
+    # a stale tmp dir from a killed write must not count as a snapshot
+    os.makedirs(os.path.join(cm.directory, ".tmp.step_9"))
+    assert cm.all_steps() == [2]
+    assert cm.latest_complete() == 2
+
+
+def test_manager_retries_transient_storage_errors(tmp_path, monkeypatch):
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=5,
+                           backoff_s=0.01, max_retries=3)
+    real = dckpt.write_snapshot
+    fails = {"n": 2}
+
+    def flaky(*a, **kw):
+        if fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError("transient storage error")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(dckpt, "write_snapshot", flaky)
+    cm.save(2, {"x": np.ones((2,))})
+    cm.wait()
+    assert cm.latest_complete() == 2
+    assert not cm.degraded
+
+
+def test_manager_degrades_to_sync_with_diagnostic(tmp_path, monkeypatch):
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=5,
+                           backoff_s=0.001, max_retries=1, timeout_s=1.0)
+    monkeypatch.setattr(
+        dckpt, "write_snapshot",
+        lambda *a, **kw: (_ for _ in ()).throw(OSError("disk full")))
+    cm.save(2, {"x": np.ones((2,))})  # async attempt fails after retries
+    cm.wait()
+    assert cm.degraded
+    assert cm.diagnostics and cm.diagnostics[-1].rule == "F001"
+    assert cm.latest_complete() is None
+    monkeypatch.undo()
+    # degraded mode: next save is synchronous and lands
+    cm.save(4, {"x": np.ones((2,))})
+    assert cm.latest_complete() == 4
+
+
+def test_manager_ckpt_metrics_in_registry(tmp_path):
+    from paddle_tpu.observability import metrics
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    cm.save(2, {"x": np.ones((2,))}, block=True)
+    cm.restore()
+    snap = metrics.snapshot()
+    assert snap["fault.ckpt_save_ms"]["series"][0]["value"]["count"] >= 1
+    assert snap["fault.ckpt_restore_ms"]["series"][0]["value"]["count"] >= 1
+    text = metrics.prometheus_text()
+    assert "fault_ckpt_save_ms" in text and "fault_ckpt_restore_ms" in text
+
+
+# ---------------------------------------------------------------------------
+# Fault plans / injection seams
+# ---------------------------------------------------------------------------
+
+def test_fault_plan_deterministic_and_serializable():
+    p1 = FaultPlan.from_seed(7, 20, n_kills=3,
+                             kinds=("mid_step", "mid_ckpt_write", "sigterm"))
+    p2 = FaultPlan.from_seed(7, 20, n_kills=3,
+                             kinds=("mid_step", "mid_ckpt_write", "sigterm"))
+    assert p1.to_json() == p2.to_json()
+    assert len(p1) == 3
+    assert {e.kind for e in p1.events} == \
+        {"mid_step", "mid_ckpt_write", "sigterm"}
+    assert all(1 <= e.step <= 18 for e in p1.events)
+    p3 = FaultPlan.from_seed(8, 20, n_kills=3)
+    assert p3.to_json() != p1.to_json()  # seed actually drives placement
+    assert FaultPlan.from_json(p1.to_json()).to_json() == p1.to_json()
+    assert len(FaultPlan.from_json("")) == 0
+
+
+def test_fault_plan_static_validation():
+    ok = FaultPlan.from_seed(7, 10, n_kills=2)
+    assert injection.check_plan(ok, 10) == []
+    bad = FaultPlan([FaultEvent("mid_step", 9),
+                     FaultEvent("mid_step", 9),
+                     FaultEvent("mid_step", 42)])
+    diags = injection.check_plan(bad, 10)
+    assert any("duplicate" in d.message for d in diags)
+    assert any("outside" in d.message for d in diags)
+    assert all(d.rule == "F002" for d in diags)
+    with pytest.raises(ValueError):
+        FaultPlan.from_seed(0, 4, n_kills=10)
+    with pytest.raises(ValueError):
+        FaultPlan.from_seed(0, 10, kinds=("nope",))
+
+
+def test_fire_point_registry():
+    hits = []
+    injection.fire("nothing.registered")  # no-op
+    injection.register_fire_point("t.point", lambda: hits.append(1))
+    injection.fire("t.point")
+    injection.register_fire_point("t.point", None)
+    injection.fire("t.point")
+    assert hits == [1]
+
+
+def test_injector_fired_journal_survives(tmp_path):
+    plan = FaultPlan([FaultEvent("mid_step", 3)])
+    inj = injection.FaultInjector(plan, str(tmp_path))
+    ev = plan.events[0]
+    assert inj._pending("mid_step", 3) is ev
+    inj._mark_fired(ev)
+    # a fresh injector (the relaunched process) sees the journal
+    inj2 = injection.FaultInjector(plan, str(tmp_path))
+    assert inj2._pending("mid_step", 3) is None
+    assert inj2.fired_events() == ["mid_step@3"]
+
+
+# ---------------------------------------------------------------------------
+# TrainStep state round-trip
+# ---------------------------------------------------------------------------
+
+def _mlp_step():
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import Adam
+    from jax.sharding import Mesh
+
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+    def loss_fn(model, params, batch):
+        x, y = batch
+        return F.cross_entropy(functional_call(model, params, x), y).mean()
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    return make_sharded_train_step(net, Adam(1e-2), loss_fn, mesh=mesh)
+
+
+def _batches(n):
+    rng = np.random.default_rng(99)
+    return [(jnp.asarray(rng.standard_normal((8, 8)).astype("float32")),
+             jnp.asarray(rng.integers(0, 4, size=(8,)).astype("int32")))
+            for _ in range(n)]
+
+
+def test_train_step_state_roundtrip_bitwise(tmp_path):
+    """Save after 3 steps, keep training 2 more; a FRESH TrainStep restored
+    from the snapshot must replay those 2 steps bitwise — params, Adam
+    moments, step counter (= the PRNG stream) all round-tripped."""
+    batches = _batches(5)
+    ts = _mlp_step()
+    for b in batches[:3]:
+        ts.step(b)
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    cm.save(3, {"train": ts.state_dict()}, block=True)
+    ref = [float(ts.step(b)) for b in batches[3:]]
+
+    ts2 = _mlp_step()  # fresh init — different params until restored
+    _, state, _ = cm.restore(3)
+    ts2.load_state_dict(state["train"])
+    assert ts2._step_count == 3
+    got = [float(ts2.step(b)) for b in batches[3:]]
+    assert got == ref  # bitwise: float() widening is exact
+
+
+def test_train_step_state_roundtrip_offloaded_moments(tmp_path):
+    """Same round-trip with FLAGS_offload_optimizer=moments: snapshot
+    captures host-resident moments, restore re-homes them host-side."""
+    from paddle_tpu.core import flags
+    from paddle_tpu.framework import offload
+    if offload.host_memory_kind() is None:
+        pytest.skip("no host memory tier on this runtime")
+    prev = flags.flag("offload_optimizer")
+    flags.set_flags({"offload_optimizer": "moments"})
+    try:
+        batches = _batches(4)
+        ts = _mlp_step()
+        assert ts._offload is not None
+        for b in batches[:2]:
+            ts.step(b)
+        cm = CheckpointManager(str(tmp_path / "ckpt"))
+        cm.save(2, {"train": ts.state_dict()}, block=True)
+        ref = [float(ts.step(b)) for b in batches[2:]]
+
+        ts2 = _mlp_step()
+        _, state, _ = cm.restore(2)
+        ts2.load_state_dict(state["train"])
+        kind = ts2._offload.host_kind
+        for st in ts2.opt_state["param_states"].values():
+            for k, v in st.items():
+                if k in ts2._offload._moment_keys and v.ndim > 0:
+                    assert v.sharding.memory_kind == kind, (k, v.sharding)
+        got = [float(ts2.step(b)) for b in batches[2:]]
+        assert got == ref
+    finally:
+        flags.set_flags({"offload_optimizer": prev})
+
+
+# ---------------------------------------------------------------------------
+# Goodput accounting
+# ---------------------------------------------------------------------------
+
+def test_goodput_math_on_synthetic_log():
+    lines = [
+        json.dumps(r) for r in [
+            {"event": "start", "start_step": 0},
+            {"step": 0, "loss": 1.0, "t": 0.5},
+            {"step": 1, "loss": 0.9, "t": 0.5},
+            {"step": 2, "loss": 0.8, "t": 0.5},   # killed after this
+            {"event": "ckpt_restored", "step": 2, "ms": 40.0},
+            {"event": "resumed", "step": 2},
+            {"event": "start", "start_step": 2},
+            {"step": 2, "loss": 0.8, "t": 0.25},  # re-executed
+            {"step": 3, "loss": 0.7, "t": 0.25},
+            {"event": "ckpt_saved", "step": 4, "ms": 60.0},
+            {"event": "done"},
+        ]
+    ]
+    log = parse_train_log(lines)
+    assert log["executions"] == 5
+    assert log["lost_steps"] == 1            # step 2 ran twice
+    assert sorted(log["steps"]) == [0, 1, 2, 3]
+    assert log["steps"][2]["t"] == 0.25      # final execution wins
+    rec = compute_goodput(log, wall_s=3.0)
+    assert rec["restarts"] == 1
+    assert rec["useful_step_s"] == pytest.approx(1.5)
+    assert rec["goodput"] == pytest.approx(1.5 / 3.0, abs=1e-4)
+    assert rec["ckpt_save"] == {"count": 1, "mean_ms": 60.0, "max_ms": 60.0}
+    assert rec["ckpt_restore"]["count"] == 1
+    from paddle_tpu.observability import metrics
+    snap = metrics.snapshot()
+    assert snap["fault.goodput"]["series"][0]["value"] == rec["goodput"]

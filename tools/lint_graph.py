@@ -154,8 +154,34 @@ def lint_overlap():
     return diags, len(closed.jaxpr.eqns)
 
 
+def lint_fault():
+    """The fault-drill configuration (paddle_tpu/fault/): the drill
+    trainer's composed train step traced + jaxpr-linted + verified
+    against its declared StepPlan (same gate every other tier gets), and
+    the quick drill's deterministic FaultPlan statically validated (F002
+    — a kill scheduled past the end of training would make the drill
+    vacuous)."""
+    from paddle_tpu.analysis import lint_jaxpr, plan_check
+    from paddle_tpu.fault import _trainer, drill, injection
+
+    ts, batches = _trainer.build_step("quick")
+    closed, donate = ts.trace_step(batches[0])
+    diags = lint_jaxpr(closed, donate_argnums=donate, where="fault")
+    diags += plan_check.check_plan(ts.plan, closed, donate_argnums=donate,
+                                   where="fault")
+    cfg = drill.quick_config()
+    plan = injection.FaultPlan.from_seed(
+        cfg["seed"], cfg["total_steps"], n_kills=cfg["n_kills"],
+        kinds=cfg["kinds"])
+    pd = injection.check_plan(plan, cfg["total_steps"])
+    print(f"  fault plan {plan!r}: {len(pd)} diagnostic(s)")
+    diags += pd
+    return diags, len(closed.jaxpr.eqns)
+
+
 MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp,
-          "offload": lint_offload, "overlap": lint_overlap}
+          "offload": lint_offload, "overlap": lint_overlap,
+          "fault": lint_fault}
 
 _SEV_RANK = {"info": 0, "warning": 1, "error": 2}
 
