@@ -41,7 +41,12 @@ at the top-3 ResNet byte shapes + the full-graph ResNet step with
 FLAGS_pallas_conv=1 — the table VERDICT r5 asks the next chip round for),
 BENCH_TELEMETRY=0 (skip the telemetry overhead A/B), BENCH_TRACE_OUT
 (path for the run's step-timeline JSONL, default BENCH_timeline.jsonl —
-render with tools/trace_view.py).
+render with tools/trace_view.py), BENCH_SERVE=0 (skip the serving-engine
+sweep; BENCH_SERVE_REQUESTS/MAX_NEW/LAYERS/HIDDEN/HEADS/VOCAB size it —
+continuous batching vs the sequential one-shot Predictor on one ragged
+trace, concurrency sweep, compile-budget/O001 gate; emits
+serving_tokens_per_s + serving_p50_ms/serving_p99_ms and appends the
+per-request phase records to the timeline JSONL).
 """
 
 from __future__ import annotations
@@ -1228,6 +1233,165 @@ def bench_fault(small: bool):
         raise RuntimeError(f"fault drill parity broken: {parity}")
 
 
+# ---------------------------------------------------------------------------
+# BENCH_SERVE: serving engine — continuous batching vs one-shot predictor
+# ---------------------------------------------------------------------------
+
+def _serve_trace(n_req, vocab, lo, hi, max_new, seed=0):
+    from paddle_tpu.serving import Request
+    rng = np.random.default_rng(seed)
+    return [Request(rid=f"r{i}",
+                    prompt_ids=rng.integers(
+                        0, vocab, int(rng.integers(lo, hi + 1))
+                    ).astype(np.int32),
+                    max_new_tokens=max_new)
+            for i in range(n_req)]
+
+
+def bench_serve(small: bool):
+    """Serving tier (ISSUE 8 / ROADMAP item 1): measured tokens/s and
+    exact p50/p99 request latency for the paged-KV continuous-batching
+    engine over a concurrent ragged-request trace, A/B'd against the
+    sequential one-shot ``Predictor.run`` baseline — the seed inference
+    tier's serving story: one request at a time, a full forward over the
+    growing context per token, no KV reuse (its compile count is held to
+    the bucket ladder by the new symbolic-dim padding). Per-request
+    outputs are anchored against ``model.generate`` (greedy); the
+    compile-budget gate asserts <= n_buckets executable signatures with
+    the O001 sentinel silent on BOTH paths. The concurrency sweep rises
+    from max_batch=1 (sequential, still KV-cached) to the headline
+    width — the continuous-batching win curve."""
+    import tempfile
+
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import Config, create_predictor
+    from paddle_tpu.observability import request_timeline
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models.gpt import GPTForCausalLM, gpt_tiny
+
+    e = os.environ.get
+    n_req = int(e("BENCH_SERVE_REQUESTS", 6 if small else 12))
+    max_new = int(e("BENCH_SERVE_MAX_NEW", 6 if small else 10))
+    layers = int(e("BENCH_SERVE_LAYERS", 2 if small else 3))
+    hidden = int(e("BENCH_SERVE_HIDDEN", 96 if small else 192))
+    heads = int(e("BENCH_SERVE_HEADS", 4 if small else 6))
+    vocab = int(e("BENCH_SERVE_VOCAB", 384 if small else 512))
+    lo, hi, max_pos = 4, 40, 128
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_heads=heads, max_position_embeddings=max_pos))
+    model.eval()
+    trace = _serve_trace(n_req, vocab, lo, hi, max_new)
+    total_new = sum(r.max_new_tokens for r in trace)
+
+    # correctness anchor: greedy generate with the dense per-request cache
+    refs = {r.rid: np.asarray(model.generate(
+        jnp.asarray(r.prompt_ids[None]),
+        max_new_tokens=r.max_new_tokens))[0] for r in trace}
+
+    def run_engine(max_batch):
+        eng = ServingEngine(model, block_size=8, num_blocks=96,
+                            max_batch=max_batch, max_seq_len=max_pos)
+        eng.serve(trace)               # warm pass: pay the bucket compiles
+        rt = request_timeline.reset_default()
+        t0 = time.perf_counter()
+        done = eng.serve(trace)
+        wall = time.perf_counter() - t0
+        s = rt.summary()
+        match = sum(np.array_equal(done[r.rid].output, refs[r.rid])
+                    for r in trace) / len(trace)
+        return {"max_batch": max_batch,
+                "tokens_per_s": round(total_new / wall, 2),
+                "wall_s": round(wall, 4),
+                "p50_ms": s["p50_ms"], "p99_ms": s["p99_ms"],
+                "ttft_p50_ms": s["ttft_p50_ms"],
+                "ttft_p99_ms": s["ttft_p99_ms"],
+                "preemptions": s["preemptions"],
+                "match_fraction": round(match, 4)}, eng
+
+    widths = [1, 2, 4] if small else [1, 2, 4, 8]
+    sweep = []
+    eng = None
+    for w in widths:
+        point, eng = run_engine(w)
+        sweep.append(point)
+    headline = sweep[-1]
+    creport = eng.compile_report()
+    # measured run's per-request phase records ride the shared timeline
+    out_path = os.environ.get("BENCH_TRACE_OUT", "BENCH_timeline.jsonl")
+    try:
+        request_timeline.current().export_jsonl(out_path, append=True)
+    except OSError:
+        pass
+
+    # sequential one-shot baseline (the seed predictor serving flow)
+    workdir = tempfile.mkdtemp(prefix="bench_serve_")
+    paddle.jit.save(model, os.path.join(workdir, "gpt"),
+                    input_spec=[((1, "s"), "int32")])
+    pred = create_predictor(Config(os.path.join(workdir, "gpt")))
+
+    def one_shot(r):
+        ids = list(r.prompt_ids)
+        for _ in range(r.max_new_tokens):
+            logits = pred.run([np.asarray([ids], np.int32)])[0]
+            ids.append(int(np.argmax(logits[0, len(ids) - 1])))
+        return np.asarray(ids, np.int32)
+
+    for r in trace[:2]:
+        one_shot(r)                    # warm the bucket executables
+    t0 = time.perf_counter()
+    seq_out = {r.rid: one_shot(r) for r in trace}
+    seq_wall = time.perf_counter() - t0
+    seq_tps = total_new / seq_wall if seq_wall else 0.0
+    seq_match = sum(np.array_equal(seq_out[r.rid], refs[r.rid])
+                    for r in trace) / len(trace)
+    pred_report = pred.bucket_report()
+
+    speedup = headline["tokens_per_s"] / seq_tps if seq_tps else 0.0
+    extra = {
+        "config": {"layers": layers, "hidden": hidden, "heads": heads,
+                   "vocab": vocab, "requests": n_req, "max_new": max_new,
+                   "prompt_lens": [int(r.prompt_ids.size) for r in trace]},
+        "concurrency_sweep": sweep,
+        "p50_ms": headline["p50_ms"], "p99_ms": headline["p99_ms"],
+        "ttft_p50_ms": headline["ttft_p50_ms"],
+        "ttft_p99_ms": headline["ttft_p99_ms"],
+        "sequential_tokens_per_s": round(seq_tps, 2),
+        "sequential_wall_s": round(seq_wall, 4),
+        "speedup_vs_one_shot": round(speedup, 2),
+        "match_fraction": headline["match_fraction"],
+        "sequential_match_fraction": round(seq_match, 4),
+        "compile_report": creport,
+        "predictor_bucket_report": pred_report,
+        "method": ("continuous batching (paged KV, bucketed shapes) vs "
+                   "the one-shot AOT predictor re-running the full "
+                   "forward per token, same ragged trace, greedy; "
+                   "engine outputs anchored token-exact against "
+                   "model.generate; both paths warmed before timing"),
+    }
+    _emit("serving_tokens_per_s", headline["tokens_per_s"], "tokens/s",
+          0.0, extra)
+    _emit("serving_p50_ms", headline["p50_ms"], "ms", 0.0,
+          {"max_batch": headline["max_batch"]})
+    _emit("serving_p99_ms", headline["p99_ms"], "ms", 0.0,
+          {"max_batch": headline["max_batch"]})
+    if headline["match_fraction"] < 0.75:
+        raise RuntimeError(
+            f"serving outputs diverged from model.generate: "
+            f"match {headline['match_fraction']}")
+    if not creport["within_budget"] or creport["o001_fired"]:
+        raise RuntimeError(f"serving compile budget violated: {creport}")
+    if pred_report["o001_fired"]:
+        raise RuntimeError(
+            f"predictor bucket padding failed (O001 fired): {pred_report}")
+    if speedup < 2.0:
+        raise RuntimeError(
+            f"continuous batching speedup {speedup:.2f}x < 2x over the "
+            f"sequential one-shot baseline")
+
+
 def bench_gpt_13b():
     """BASELINE config 4, the PRIMARY metric: GPT-3 1.3B tokens/sec/chip.
 
@@ -1488,6 +1652,15 @@ def main():
             bench_fault(small)
         except Exception as e:
             print(json.dumps({"metric": "bench_fault_FAILED",
+                              "error": str(e)[:500]}), flush=True)
+    # serving engine: continuous batching + paged KV vs the one-shot
+    # predictor, measured tokens/s and p50/p99 on a ragged trace (CPU-mesh
+    # sized model — runs chipless; the request records join the timeline)
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        try:
+            bench_serve(small)
+        except Exception as e:
+            print(json.dumps({"metric": "bench_serve_FAILED",
                               "error": str(e)[:500]}), flush=True)
     if "all" in selected or "gpt" in selected:
         bench_gpt(small)  # primary: printed last

@@ -13,19 +13,43 @@ layout / memory optimization happen in the compiler, so the pass-pipeline
 surface reduces to compile options), and the per-run path is an AOT-compiled
 executable call. The named-handle copy_from_cpu/run/copy_to_cpu protocol is
 kept verbatim so reference users can port serving code unchanged.
+
+Ragged traffic: a model exported with symbolic dims (``jit.save`` with
+``None``/named dims in ``input_spec``) accepts any size on those dims —
+but every distinct concrete size pays a full XLA compile at call time,
+silently. ``Predictor.run`` therefore pads every symbolic dim up to a
+registered bucket (power-of-two ladder by default,
+``Config.set_shape_buckets`` to override), slices the outputs back via
+the export's shape-polymorphic output avals, and announces the bucket
+set once through the analysis Diagnostic channel (rule O004). A
+:class:`~paddle_tpu.observability.RecompileSentinel` watches the padded
+dispatch signatures, so a bucketing failure surfaces as O001 instead of
+a silent compile storm.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..observability import metrics
+from ..observability.step_monitor import RecompileSentinel
+from ..serving.buckets import BucketSet, pad_axis
+
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
            "PredictorBenchmark"]
+
+# Compile budget the predictor's recompile sentinel tolerates before an
+# O001 churn Diagnostic fires: with the default power-of-two ladder a
+# trace spanning sizes 1..2^k hits k+1 buckets, so 16 distinct padded
+# signatures means bucketing is NOT working (or the operator registered
+# an unusually wide explicit set — then set_shape_buckets sizes the
+# budget).
+DEFAULT_COMPILE_BUDGET = 16
 
 
 class Config:
@@ -46,6 +70,17 @@ class Config:
         self._device = "tpu"
         self._precision = None  # None = saved dtype; "bf16" casts params
         self._cpu_threads = 1
+        self._shape_buckets: Optional[Sequence[int]] = None
+
+    def set_shape_buckets(self, sizes: Sequence[int]):
+        """Register the bucket sizes symbolic input dims are padded to
+        (default: a growing power-of-two ladder). The list length is the
+        predictor's compile budget."""
+        self._shape_buckets = [int(s) for s in sizes]
+
+    def shape_buckets(self) -> Optional[Sequence[int]]:
+        return None if self._shape_buckets is None \
+            else list(self._shape_buckets)
 
     def set_model(self, prog_file: str, params_file: Optional[str] = None):
         if prog_file.endswith(".pdmodel"):
@@ -123,6 +158,21 @@ class Predictor:
                                            for n in self._input_names}
         self._outputs: Dict[str, Tensor] = {}
         self._output_names: List[str] = []
+        # -- symbolic-dim bucketing state -----------------------------------
+        exported = self._translated._exported
+        self._model_in_avals = tuple(exported.in_avals[-n_in:])
+        self._out_avals = tuple(exported.out_avals)
+        self._sym_vars: List[str] = sorted({
+            str(d) for aval in self._model_in_avals
+            for d in aval.shape if not isinstance(d, int)})
+        explicit = config.shape_buckets()
+        self._buckets = BucketSet(explicit, grow=False) \
+            if explicit else BucketSet([1], grow=True)
+        budget = len(explicit) if explicit else DEFAULT_COMPILE_BUDGET
+        self._sentinel = RecompileSentinel(threshold=budget)
+        self._padded_signatures: set = set()
+        self.diagnostics: List[Any] = []
+        self._announced = False
 
     def _n_model_inputs(self) -> int:
         # Exported calling convention: (params_tree, buffers_tree, *xs).
@@ -149,10 +199,94 @@ class Predictor:
     def get_output_handle(self, name: str) -> Tensor:
         return self._outputs[name]
 
+    # -- symbolic-dim bucket padding ----------------------------------------
+
+    def _dim_assignment(self, xs: List[np.ndarray]) -> Dict[str, int]:
+        """Concrete size of every symbolic dim var, from the staged
+        inputs (consistency across shared vars enforced)."""
+        assign: Dict[str, int] = {}
+        for aval, x in zip(self._model_in_avals, xs):
+            if len(aval.shape) != x.ndim:
+                raise ValueError(
+                    f"input rank {x.ndim} does not match exported rank "
+                    f"{len(aval.shape)} ({aval.shape})")
+            for axis, d in enumerate(aval.shape):
+                if isinstance(d, int):
+                    continue
+                name, size = str(d), int(x.shape[axis])
+                if assign.setdefault(name, size) != size:
+                    raise ValueError(
+                        f"symbolic dim {name!r} bound to both "
+                        f"{assign[name]} and {size}")
+        return assign
+
+    def _announce_buckets(self) -> None:
+        """One-time Diagnostic (rule O004, analysis channel) stating the
+        bucket set — the predictor's compile budget in plain sight."""
+        if self._announced:
+            return
+        self._announced = True
+        from ..analysis import jaxpr_lint
+        d = jaxpr_lint.Diagnostic(
+            rule="O004", name="shape-bucket-set",
+            severity=jaxpr_lint.INFO,
+            message=(f"symbolic input dims {self._sym_vars} are padded to "
+                     f"registered buckets {self._buckets.sizes}"
+                     f"{' (power-of-two ladder, grows)' if self._buckets.grow else ''}"
+                     f" — at most {self._sentinel.threshold} distinct "
+                     "compiled signatures before O001 fires"),
+            where="inference.Predictor",
+            hint="set_shape_buckets() on the Config pins an explicit set "
+                 "(and the compile budget) for production traffic")
+        self.diagnostics.append(d)
+        try:
+            jaxpr_lint.emit([d], where=d.where)
+        except Exception:
+            pass
+
+    def _pad_to_buckets(self, xs: List[np.ndarray]
+                        ) -> Tuple[List[np.ndarray], Dict[str, int],
+                                   Dict[str, int]]:
+        assign = self._dim_assignment(xs)
+        padded = {n: self._buckets.fit(v) for n, v in assign.items()}
+        out = []
+        for aval, x in zip(self._model_in_avals, xs):
+            for axis, d in enumerate(aval.shape):
+                if not isinstance(d, int) and str(d) in padded:
+                    x = pad_axis(x, axis, padded[str(d)])
+            out.append(x)
+        return out, assign, padded
+
+    def _slice_outputs(self, flat: List[np.ndarray],
+                       assign: Dict[str, int]) -> List[np.ndarray]:
+        """Undo the bucket padding on outputs: any output axis whose
+        exported aval dim is a bare symbolic var is sliced back to that
+        var's original size (derived expressions like ``2*b`` pass
+        through padded)."""
+        out = []
+        for aval, x in zip(self._out_avals, flat):
+            x = np.asarray(x)
+            for axis, d in enumerate(aval.shape):
+                if not isinstance(d, int) and str(d) in assign:
+                    x = x[(slice(None),) * axis +
+                          (slice(0, assign[str(d)]),)]
+            out.append(x)
+        return out
+
+    def bucket_report(self) -> Dict[str, Any]:
+        """Distinct padded signatures dispatched (== compiled
+        executables) and the live bucket set."""
+        return {"compiles": len(self._padded_signatures),
+                "buckets": self._buckets.sizes,
+                "budget": self._sentinel.threshold,
+                "o001_fired": bool(self._sentinel.diagnostics)}
+
     def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
         """Execute. Either pass arrays positionally (returns outputs like
         the reference's predictor.run(inputs) overload) or stage them via
-        get_input_handle(...).copy_from_cpu(...) first."""
+        get_input_handle(...).copy_from_cpu(...) first. Symbolic-dim
+        exports are padded to the registered shape buckets (outputs
+        sliced back), bounding compiles at the bucket-set size."""
         if inputs is not None:
             for n, a in zip(self._input_names, inputs):
                 self._inputs[n].copy_from_cpu(a)
@@ -161,9 +295,20 @@ class Predictor:
             v = self._inputs[n]._value
             if v is None:
                 raise RuntimeError(f"input {n!r} not set")
-            xs.append(jnp.asarray(v))
-        out = self._translated(*xs)
+            xs.append(np.asarray(v))
+        assign: Dict[str, int] = {}
+        if self._sym_vars:
+            xs, assign, _ = self._pad_to_buckets(xs)
+            self._announce_buckets()
+            sig = tuple((x.shape, str(x.dtype)) for x in xs)
+            self._padded_signatures.add(sig)
+            self._sentinel.observe_tree("inference.Predictor.run",
+                                        tuple(xs),
+                                        where="inference.Predictor.run")
+        out = self._translated(*[jnp.asarray(x) for x in xs])
         flat = jax.tree_util.tree_leaves(out)
+        if assign:
+            flat = self._slice_outputs(flat, assign)
         self._output_names = [f"out{i}" for i in range(len(flat))]
         self._outputs = {}
         for n, v in zip(self._output_names, flat):
@@ -188,17 +333,35 @@ def create_predictor(config: Config) -> Predictor:
 
 
 class PredictorBenchmark:
-    """Latency micro-bench (ref fluid/inference/utils/benchmark.h)."""
+    """Latency micro-bench (ref fluid/inference/utils/benchmark.h).
+
+    Reports through the shared observability metrics registry — each
+    timed run feeds the ``serving.predictor_latency_ms`` histogram and
+    sets the ``serving.predictor_qps`` gauge — instead of keeping ad-hoc
+    timing fields; the returned ``latency_ms``/``qps`` keys are forwards
+    of what this run contributed to the registry."""
 
     def __init__(self, predictor: Predictor):
         self.predictor = predictor
+        self._hist = metrics.histogram(
+            "serving.predictor_latency_ms",
+            "one-shot Predictor.run wall time (ms)").labels()
+        self._qps = metrics.gauge(
+            "serving.predictor_qps",
+            "one-shot Predictor.run throughput (last bench)").labels()
 
     def run(self, inputs: Sequence[np.ndarray], warmup: int = 2,
             repeat: int = 10) -> Dict[str, float]:
         for _ in range(warmup):
             self.predictor.run(list(inputs))
-        t0 = time.perf_counter()
+        before = self._hist.get()
         for _ in range(repeat):
-            out = self.predictor.run(list(inputs))
-        dt = (time.perf_counter() - t0) / repeat
-        return {"latency_ms": dt * 1e3, "qps": (1.0 / dt) if dt else 0.0}
+            t0 = time.perf_counter()
+            self.predictor.run(list(inputs))
+            self._hist.observe((time.perf_counter() - t0) * 1e3)
+        after = self._hist.get()
+        n = max(after["count"] - before["count"], 1)
+        lat_ms = (after["sum"] - before["sum"]) / n
+        qps = 1e3 / lat_ms if lat_ms else 0.0
+        self._qps.set(qps)
+        return {"latency_ms": lat_ms, "qps": qps}

@@ -157,22 +157,58 @@ def ignore_module(modules):
 # AOT export (inference format)
 # ---------------------------------------------------------------------------
 
+def _example_avals(input_spec):
+    """input_spec entries -> ShapeDtypeStructs. A dim may be an int, None
+    (a fresh symbolic dim), or a string name (symbolic, shared across any
+    dims/specs using the same name) — symbolic dims export a
+    shape-polymorphic StableHLO the Predictor can call at any size (it
+    pads them to registered buckets to bound the compile count). All
+    symbolic dims are created in ONE scope so shared names unify."""
+    from jax import export as jax_export
+
+    resolved = []  # (dims with str placeholders, dtype)
+    names: list = []
+    auto = 0
+    for spec in input_spec:
+        if hasattr(spec, "shape") and hasattr(spec, "dtype"):
+            shape, dtype = tuple(spec.shape), spec.dtype
+        else:
+            shape, dtype = spec
+            shape, dtype = tuple(shape), jnp.dtype(dtype)
+        dims = []
+        for d in shape:
+            if d is None:
+                d = f"_dyn{auto}"
+                auto += 1
+            if isinstance(d, str):
+                if d not in names:
+                    names.append(d)
+                dims.append(d)
+            else:
+                dims.append(int(d))
+        resolved.append((dims, dtype))
+    if not names:
+        return [jax.ShapeDtypeStruct(tuple(dims), dtype)
+                for dims, dtype in resolved]
+    by_name = dict(zip(names,
+                       jax_export.symbolic_shape(", ".join(names))))
+    return [jax.ShapeDtypeStruct(
+        tuple(by_name[d] if isinstance(d, str) else d for d in dims),
+        dtype) for dims, dtype in resolved]
+
+
 def save(layer, path: str, input_spec=None, **configs) -> None:
     """Serialize a Layer for inference: params (pickle) + exported StableHLO.
 
-    input_spec: list of (shape, dtype) tuples or example arrays for tracing.
+    input_spec: list of (shape, dtype) tuples or example arrays for
+    tracing; a shape dim of None (or a shared string name) exports that
+    dim shape-polymorphic (see :func:`_example_avals`).
     """
     from jax import export as jax_export
 
     if input_spec is None:
         raise ValueError("jit.save requires input_spec (shapes can't be guessed)")
-    example = []
-    for spec in input_spec:
-        if hasattr(spec, "shape") and hasattr(spec, "dtype"):
-            example.append(jax.ShapeDtypeStruct(tuple(spec.shape), spec.dtype))
-        else:
-            shape, dtype = spec
-            example.append(jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype)))
+    example = _example_avals(input_spec)
 
     params = get_params(layer)
     buffers = get_buffers(layer)

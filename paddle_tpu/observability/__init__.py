@@ -12,6 +12,9 @@ low-overhead measurement layer that is always there (gated by
 - :mod:`.trace` — thread-safe nestable ``span()`` context managers
   buffering into an in-memory ring, exported as chrome-trace JSON or
   JSONL (``FLAGS_telemetry=trace`` only).
+- :mod:`.request_timeline` — the serving tier's per-request phase
+  accounting (queue/prefill/decode/detokenize, exact-value p50/p99),
+  feeding the ``serving.*`` metric families.
 - :mod:`.step_monitor` — the :class:`StepTimeline` (per-step phases:
   data/h2d/compile/device/offload_in/offload_out/callbacks), the
   recompile sentinel (Diagnostic O001 with the exact shape/dtype diff
@@ -29,14 +32,17 @@ timeline; ``tools/trace_view.py`` renders the JSONL. See OBSERVABILITY.md.
 from . import metrics  # noqa: F401
 from . import trace  # noqa: F401
 from . import step_monitor  # noqa: F401
+from . import request_timeline  # noqa: F401
 from .trace import span, telemetry_mode  # noqa: F401
 from .step_monitor import (StepTimeline, RecompileSentinel,  # noqa: F401
                            current, reset_default, instrument_jitted,
                            fingerprint, fingerprint_diff)
+from .request_timeline import RequestTimeline  # noqa: F401
 
 __all__ = [
-    "metrics", "trace", "step_monitor",
+    "metrics", "trace", "step_monitor", "request_timeline",
     "span", "telemetry_mode",
-    "StepTimeline", "RecompileSentinel", "current", "reset_default",
+    "StepTimeline", "RecompileSentinel", "RequestTimeline",
+    "current", "reset_default",
     "instrument_jitted", "fingerprint", "fingerprint_diff",
 ]

@@ -1,0 +1,168 @@
+"""Per-request phase timeline for the serving tier.
+
+The training-side :class:`~.step_monitor.StepTimeline` accounts a *step*;
+a serving engine's unit of accounting is a *request*, and its latency
+decomposes into four phases the operator actually acts on:
+
+- ``queue``   — submit → prefill start (admission wait: batch slots or
+  KV blocks exhausted);
+- ``prefill`` — the bucketed prompt pass that writes paged KV and emits
+  the first token (time-to-first-token = queue + prefill);
+- ``decode``  — accumulated share of the continuous-batching decode
+  iterations the request was resident in;
+- ``detokenize`` — output assembly / tokenizer callback.
+
+Each finished request is one record in a bounded ring (JSONL-exportable
+next to the step timeline — ``tools/trace_view.py`` passes ``kind:
+"request"`` records through untouched) and feeds the ``serving.*``
+metric families in :mod:`.metrics`: ``serving.request_latency_ms`` /
+``serving.ttft_ms`` histograms, per-phase ``serving.phase_ms``, and the
+``serving.requests_completed`` / ``serving.tokens_generated`` counters.
+p50/p99 in :meth:`RequestTimeline.summary` come from the exact recorded
+latencies, not histogram buckets — tail latency is the headline serving
+metric and deserves better than log2-bucket resolution.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from . import metrics
+
+__all__ = ["RequestTimeline", "REQUEST_PHASES", "current", "reset_default",
+           "percentile"]
+
+REQUEST_PHASES = ("queue", "prefill", "decode", "detokenize")
+
+
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Linear-interpolated percentile (q in [0, 100]) of raw values."""
+    if not values:
+        return None
+    vs = sorted(values)
+    if len(vs) == 1:
+        return vs[0]
+    rank = (q / 100.0) * (len(vs) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(vs) - 1)
+    frac = rank - lo
+    return vs[lo] * (1.0 - frac) + vs[hi] * frac
+
+
+class RequestTimeline:
+    """Bounded ring of per-request records + the serving.* metric feed."""
+
+    def __init__(self, capacity: int = 8192):
+        self._mu = threading.Lock()
+        self._records: "deque[Dict[str, Any]]" = deque(maxlen=capacity)
+        self._completed = metrics.counter(
+            "serving.requests_completed", "requests fully served").labels()
+        self._tokens = metrics.counter(
+            "serving.tokens_generated", "new tokens emitted").labels()
+        self._lat = metrics.histogram(
+            "serving.request_latency_ms",
+            "submit-to-last-token wall time per request (ms)").labels()
+        self._ttft = metrics.histogram(
+            "serving.ttft_ms", "submit-to-first-token wall time (ms)").labels()
+
+    def record(self, *, rid: str, prompt_tokens: int, new_tokens: int,
+               phases_ms: Dict[str, float], total_ms: float,
+               ttft_ms: Optional[float] = None,
+               preemptions: int = 0, **extra: Any) -> Dict[str, Any]:
+        """Append one finished request and feed the metric families."""
+        rec: Dict[str, Any] = {
+            "kind": "request", "rid": rid,
+            "prompt_tokens": int(prompt_tokens),
+            "new_tokens": int(new_tokens),
+            "preemptions": int(preemptions),
+            "total_ms": round(float(total_ms), 4),
+            "phases": {k: round(float(v), 4)
+                       for k, v in sorted(phases_ms.items())},
+        }
+        if ttft_ms is not None:
+            rec["ttft_ms"] = round(float(ttft_ms), 4)
+        rec.update(extra)
+        with self._mu:
+            self._records.append(rec)
+        self._completed.inc()
+        self._tokens.inc(int(new_tokens))
+        self._lat.observe(float(total_ms))
+        if ttft_ms is not None:
+            self._ttft.observe(float(ttft_ms))
+        for name, ms in phases_ms.items():
+            metrics.histogram(
+                "serving.phase_ms",
+                "wall time per request phase (ms)").labels(
+                    phase=name).observe(float(ms))
+        return rec
+
+    # -- inspection / export -------------------------------------------------
+
+    def records(self) -> List[Dict[str, Any]]:
+        with self._mu:
+            return list(self._records)
+
+    def summary(self) -> Dict[str, Any]:
+        recs = self.records()
+        lats = [r["total_ms"] for r in recs]
+        ttfts = [r["ttft_ms"] for r in recs if "ttft_ms" in r]
+        phases: Dict[str, Dict[str, float]] = {}
+        for r in recs:
+            for name, ms in r.get("phases", {}).items():
+                agg = phases.setdefault(name, {"calls": 0, "total_ms": 0.0})
+                agg["calls"] += 1
+                agg["total_ms"] += ms
+        for agg in phases.values():
+            agg["avg_ms"] = round(agg["total_ms"] / max(agg["calls"], 1), 4)
+            agg["total_ms"] = round(agg["total_ms"], 4)
+        rnd = lambda v: None if v is None else round(v, 4)  # noqa: E731
+        return {
+            "requests": len(recs),
+            "new_tokens": sum(r["new_tokens"] for r in recs),
+            "preemptions": sum(r["preemptions"] for r in recs),
+            "p50_ms": rnd(percentile(lats, 50)),
+            "p99_ms": rnd(percentile(lats, 99)),
+            "ttft_p50_ms": rnd(percentile(ttfts, 50)),
+            "ttft_p99_ms": rnd(percentile(ttfts, 99)),
+            "phases": {k: phases[k] for k in sorted(phases)},
+        }
+
+    def export_jsonl(self, path: str, append: bool = False) -> int:
+        recs = self.records()
+        with open(path, "a" if append else "w") as f:
+            for r in recs:
+                f.write(json.dumps(r) + "\n")
+        return len(recs)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._records.clear()
+
+
+# ---------------------------------------------------------------------------
+# Process-wide default (mirrors step_monitor.current())
+# ---------------------------------------------------------------------------
+
+_default: Optional[RequestTimeline] = None
+_default_mu = threading.Lock()
+
+
+def current() -> RequestTimeline:
+    global _default
+    tl = _default
+    if tl is None:
+        with _default_mu:
+            if _default is None:
+                _default = RequestTimeline()
+            tl = _default
+    return tl
+
+
+def reset_default() -> RequestTimeline:
+    global _default
+    with _default_mu:
+        _default = RequestTimeline()
+        return _default

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 
 from ..core import flags
 
-__all__ = ["flash_attention", "flash_attn_unpadded", "reference_attention"]
+__all__ = ["flash_attention", "flash_attn_unpadded", "reference_attention",
+           "single_query_attention"]
 
 
 def reference_attention(q, k, v, causal: bool = False,
@@ -54,6 +55,49 @@ def reference_attention(q, k, v, causal: bool = False,
     probs = (e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True),
                              1e-30)).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def single_query_attention(q, k, v, lengths=None,
+                           scale: Optional[float] = None):
+    """Decode-step attention: one query position over gathered KV.
+
+    ``q`` is ``[B, 1, H, D]``; ``k``/``v`` are ``[B, Sk, KH, D]`` with
+    ``KH`` dividing ``H`` — grouped-query KV is read through a head
+    reshape (query head ``h`` uses kv head ``h // (H // KH)``, the same
+    mapping as ``jnp.repeat`` on the head axis) so no repeated KV is ever
+    materialized. ``lengths`` (``[B]`` int, optional) masks each row to
+    its first ``lengths[b]`` keys — the serving engine's per-sequence
+    context lengths over a padded gathered-KV batch; a row with zero
+    valid keys returns 0 (the kernels' masked-row convention).
+
+    With ``lengths=None`` this equals ``reference_attention(q, k, v,
+    causal=True)`` at Sq=1 (the last causal row sees every key), without
+    the dense path's ``[Sq, Sk]`` mask build, head-repeat, or recompute
+    of the full score matrix machinery.
+    """
+    b, sq, h, d = q.shape
+    if sq != 1:
+        raise ValueError(f"single_query_attention needs Sq=1, got {sq}")
+    sk, kh = k.shape[1], k.shape[2]
+    if h % kh:
+        raise ValueError(f"query heads ({h}) not a multiple of kv heads "
+                         f"({kh})")
+    g = h // kh
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    qg = q[:, 0].reshape(b, kh, g, d)
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    if lengths is not None:
+        valid = jnp.arange(sk)[None, :] < jnp.asarray(lengths)[:, None]
+        scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+    # Masked-row-safe softmax, matching reference_attention.
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.where(jnp.isfinite(scores),
+                  jnp.exp(scores - jnp.where(jnp.isfinite(m), m, 0.0)), 0.0)
+    probs = (e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True),
+                             1e-30)).astype(q.dtype)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v)
+    return out.reshape(b, 1, h, d)
 
 
 def _use_pallas(q, k) -> bool:
@@ -130,6 +174,12 @@ def flash_attention(query, key, value, dropout: float = 0.0,
         from ._pallas.flash_attention import flash_attention_pallas
         return flash_attention_pallas(query, key, value, causal=causal,
                                       scale=scale)
+    if query.shape[1] == 1:
+        # Decode step (Sq=1): the dense reference path would rebuild the
+        # causal mask and the full repeated-KV score machinery for a
+        # single row whose causal mask is all-visible — route through
+        # the single-query helper instead.
+        return single_query_attention(query, key, value, scale=scale)
     return reference_attention(query, key, value, causal, scale)
 
 

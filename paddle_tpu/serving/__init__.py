@@ -1,0 +1,28 @@
+"""paddle_tpu.serving — the production inference tier.
+
+The one-shot AOT predictor (:mod:`paddle_tpu.inference`) answers one
+request at a time with no KV reuse; this package is the engine that
+serves *traffic*: a block-paged KV cache in device memory with a
+deterministic free-list allocator and host-memory spill for preempted
+sequences (vLLM/PagedAttention, SOSP'23), a continuous-batching
+scheduler that re-forms the decode batch at token-iteration granularity
+(Orca, OSDI'22), and bucketed-shape compilation so ragged traffic
+compiles a bounded executable set with the O001 recompile sentinel
+standing guard. ``bench.py`` (``BENCH_SERVE``) measures tokens/s and
+p50/p99 request latency against the sequential one-shot baseline;
+``tools/serve_bench.py`` replays request traces; ``lint_graph --model
+serving`` statically verifies the prefill/decode programs and the
+declared dispatch plan.
+"""
+
+from .buckets import BucketSet, pow2_buckets  # noqa: F401
+from .engine import ServingEngine  # noqa: F401
+from .paged_cache import (BlockAllocator, NULL_BLOCK,  # noqa: F401
+                          OutOfBlocksError, PagedKVCache)
+from .scheduler import FCFSScheduler, Request, Sequence, Status  # noqa: F401
+
+__all__ = [
+    "ServingEngine", "Request", "Sequence", "Status", "FCFSScheduler",
+    "PagedKVCache", "BlockAllocator", "OutOfBlocksError", "NULL_BLOCK",
+    "BucketSet", "pow2_buckets",
+]

@@ -1,0 +1,509 @@
+"""The serving engine: continuous batching over paged KV on the AOT stack.
+
+Composition of the two load-bearing serving ideas on our machinery:
+
+- **paged KV** (:mod:`.paged_cache`): every sequence's KV lives in
+  fixed-size blocks of one device pool, allocated from a deterministic
+  free list, spilled to the host memory tier under pressure;
+- **continuous batching** (:mod:`.scheduler`): requests join and leave
+  the decode batch at token-iteration granularity — the decode
+  executable runs every iteration over *whoever is resident*, padded to
+  a registered batch-width bucket;
+- **bucketed-shape compilation** (:mod:`.buckets`): prefill lengths and
+  decode widths are padded to small registered bucket sets, so a ragged
+  request trace compiles at most ``len(prefill_buckets) +
+  len(decode_buckets)`` executables. Each executable family is watched
+  by its own :class:`~paddle_tpu.observability.RecompileSentinel` whose
+  threshold *is* the bucket count — O001 stays silent exactly while the
+  bucketing works, and fires (through the analysis channel) the moment
+  an unregistered signature slips through.
+
+The prefill step runs the model's flash-attention forward on one
+bucket-padded prompt and scatters the per-layer K/V into the sequence's
+pages; the decode step is a batched single-query pass that gathers each
+sequence's pages (``ops.flash_attention.single_query_attention`` masks
+the padded tail by context length) and writes the new token's KV in the
+same program. Both executables take the page pool **donated** — the pool
+is updated in place, never copied — and the whole dispatch sequence is
+declared as a :class:`~paddle_tpu.analysis.plan_check.StepPlan` so the
+donation-lifetime rules (D001/D002) and the sharding-flow rules verify
+the serving path like every training tier (``lint_graph --model
+serving``).
+
+Works with any ``GPTForCausalLM``-shaped model (``.gpt.wte/wpe/h/ln_f``,
+``.logits``); decoding is greedy (argmax), matching ``model.generate``'s
+default.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..observability import metrics, request_timeline
+from ..observability.step_monitor import RecompileSentinel
+from ..ops.flash_attention import flash_attention, single_query_attention
+from .buckets import BucketSet, pow2_buckets, pad_axis
+from .paged_cache import NULL_BLOCK, OutOfBlocksError, PagedKVCache
+from .scheduler import FCFSScheduler, Request, Sequence, Status
+
+__all__ = ["ServingEngine"]
+
+
+def _ceil_div(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+class ServingEngine:
+    """Paged-KV continuous-batching server over one causal-LM model."""
+
+    def __init__(self, model, *, block_size: int = 8, num_blocks: int = 64,
+                 max_batch: int = 8, max_seq_len: Optional[int] = None,
+                 prefill_buckets: Optional[Seq[int]] = None,
+                 decode_buckets: Optional[Seq[int]] = None,
+                 detokenizer: Optional[Callable[[np.ndarray], Any]] = None):
+        model.eval()
+        cfg = model.cfg
+        self.model = model
+        self.block_size = int(block_size)
+        limit = int(cfg.max_position_embeddings)
+        self.max_seq_len = min(int(max_seq_len or limit), limit)
+        self.max_blocks_per_seq = _ceil_div(self.max_seq_len, self.block_size)
+        if num_blocks - 1 < self.max_blocks_per_seq:
+            raise ValueError(
+                f"pool of {num_blocks} blocks cannot hold one max-length "
+                f"sequence ({self.max_blocks_per_seq} blocks of "
+                f"{self.block_size})")
+        self.detokenizer = detokenizer
+
+        # -- bucket sets (the compile budget) --------------------------------
+        max_prefill = self.max_blocks_per_seq * self.block_size
+        if prefill_buckets is None:
+            prefill_buckets = [min(b * self.block_size, max_prefill)
+                               for b in pow2_buckets(
+                                   1, self.max_blocks_per_seq)]
+        for s in prefill_buckets:
+            if s % self.block_size or s > max_prefill:
+                raise ValueError(
+                    f"prefill bucket {s} must be a multiple of "
+                    f"block_size={self.block_size} and <= {max_prefill}")
+        self.prefill_buckets = BucketSet(prefill_buckets)
+        self.decode_buckets = BucketSet(
+            decode_buckets if decode_buckets is not None
+            else pow2_buckets(1, max_batch))
+
+        # -- device state ----------------------------------------------------
+        act_dtype = model.gpt.wte.weight.dtype
+        head_dim = cfg.hidden_size // cfg.num_heads
+        self.cache = PagedKVCache(cfg.num_layers, num_blocks,
+                                  self.block_size, cfg.kv_heads, head_dim,
+                                  dtype=act_dtype)
+        self.sched = FCFSScheduler(max_batch)
+        self._seqs: Dict[str, Sequence] = {}
+        self._t0 = time.perf_counter()
+
+        # -- compiled steps + their sentinels --------------------------------
+        self._prefill_raw = self._make_prefill()
+        self._decode_raw = self._make_decode()
+        self._prefill_fn = jax.jit(self._prefill_raw, donate_argnums=(1, 2))
+        self._decode_fn = jax.jit(self._decode_raw, donate_argnums=(1, 2))
+        self._sent_prefill = RecompileSentinel(
+            threshold=len(self.prefill_buckets))
+        self._sent_decode = RecompileSentinel(
+            threshold=len(self.decode_buckets))
+        self.plan = self._build_plan()
+        self._linted = False
+
+    # ------------------------------------------------------------------
+    # The two bucketed executables
+    # ------------------------------------------------------------------
+
+    def _make_prefill(self):
+        m = self.model
+        bs = self.block_size
+
+        def prefill(ids, k_pages, v_pages, block_ids, n_tokens):
+            """ids [1, S] bucket-padded; block_ids [S//bs] (null-padded);
+            n_tokens: true prompt length. Writes the prompt KV into the
+            pages and returns the first generated token."""
+            s = ids.shape[1]
+            pos = jnp.arange(s)[None, :]
+            x = m.gpt.wte(ids) + m.gpt.wpe(pos)
+            for li, blk in enumerate(m.gpt.h):
+                xn = blk.ln_1(x)
+                q, k, v = blk.attn._project_qkv(xn)
+                o = flash_attention(q, k, v, causal=True, training=False)
+                kv_shape = (s // bs, bs) + k.shape[2:]
+                k_pages = k_pages.at[li, block_ids].set(
+                    k[0].reshape(kv_shape).astype(k_pages.dtype))
+                v_pages = v_pages.at[li, block_ids].set(
+                    v[0].reshape(kv_shape).astype(v_pages.dtype))
+                x = x + blk.attn.out_proj(o.reshape(1, s, -1))
+                x = x + blk.mlp(blk.ln_2(x))
+            hidden = m.gpt.ln_f(x)
+            last = jax.lax.dynamic_index_in_dim(hidden, n_tokens - 1,
+                                                axis=1, keepdims=True)
+            logits = m.logits(last)[0, 0]
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return tok, k_pages, v_pages
+
+        return prefill
+
+    def _make_decode(self):
+        m = self.model
+        bs = self.block_size
+
+        def decode(tokens, k_pages, v_pages, tables, ctx_lens):
+            """tokens [B] (each sequence's latest token, not yet in KV);
+            tables [B, M] null-padded block tables; ctx_lens [B] tokens
+            already cached (0 = inactive pad row, which harmlessly
+            writes the null block and produces a discarded output).
+            One iteration: write each token's KV at position ctx_len,
+            attend over ctx_len+1 keys, return the next token."""
+            b = tokens.shape[0]
+            mx = tables.shape[1] * bs
+            pos = ctx_lens
+            x = m.gpt.wte(tokens[:, None]) + m.gpt.wpe(pos[:, None])
+            bi = jnp.take_along_axis(tables, (pos // bs)[:, None],
+                                     axis=1)[:, 0]
+            si = pos % bs
+            for li, blk in enumerate(m.gpt.h):
+                xn = blk.ln_1(x)
+                q, k, v = blk.attn._project_qkv(xn)
+                k_pages = k_pages.at[li, bi, si].set(
+                    k[:, 0].astype(k_pages.dtype))
+                v_pages = v_pages.at[li, bi, si].set(
+                    v[:, 0].astype(v_pages.dtype))
+                keys = k_pages[li][tables].reshape(b, mx, *k.shape[2:])
+                vals = v_pages[li][tables].reshape(b, mx, *v.shape[2:])
+                o = single_query_attention(q, keys, vals, lengths=pos + 1)
+                x = x + blk.attn.out_proj(o.reshape(b, 1, -1))
+                x = x + blk.mlp(blk.ln_2(x))
+            hidden = m.gpt.ln_f(x)
+            logits = m.logits(hidden)[:, 0]
+            tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return tok, k_pages, v_pages
+
+        return decode
+
+    # ------------------------------------------------------------------
+    # Declared plan + static analysis
+    # ------------------------------------------------------------------
+
+    def _build_plan(self):
+        from ..analysis.plan_check import PlanNode, StepPlan
+        nodes = [
+            PlanNode("serve.prefill", reads=("weights", "prompt_ids"),
+                     donates=("kv_pages",),
+                     writes=("kv_pages", "next_tokens")),
+            PlanNode("serve.decode",
+                     reads=("weights", "block_tables", "ctx_lens"),
+                     donates=("kv_pages",),
+                     writes=("kv_pages", "next_tokens")),
+            PlanNode("serve.spill", reads=("kv_pages",),
+                     writes=("host_kv",)),
+            PlanNode("serve.restore", reads=("host_kv",),
+                     donates=("kv_pages",), writes=("kv_pages",)),
+        ]
+        return StepPlan(
+            flags={"block_size": self.block_size,
+                   "num_blocks": self.cache.num_blocks,
+                   "max_batch": self.sched.max_batch,
+                   "prefill_buckets": str(self.prefill_buckets.sizes),
+                   "decode_buckets": str(self.decode_buckets.sizes)},
+            mesh_axes={}, params={}, nodes=nodes)
+
+    def trace_steps(self):
+        """Closed jaxprs of the two executables at their smallest buckets
+        — the ``lint_graph --model serving`` / plan_check inputs. Returns
+        ``{name: (closed_jaxpr, donate_argnums)}``."""
+        s0 = self.prefill_buckets.sizes[0]
+        b0 = self.decode_buckets.sizes[0]
+        c = self.cache
+        pages = jax.ShapeDtypeStruct(c.k.shape, c.k.dtype)
+        i32 = jnp.int32
+        pre = jax.make_jaxpr(self._prefill_raw)(
+            jax.ShapeDtypeStruct((1, s0), i32), pages, pages,
+            jax.ShapeDtypeStruct((s0 // self.block_size,), i32),
+            jax.ShapeDtypeStruct((), i32))
+        dec = jax.make_jaxpr(self._decode_raw)(
+            jax.ShapeDtypeStruct((b0,), i32), pages, pages,
+            jax.ShapeDtypeStruct((b0, self.max_blocks_per_seq), i32),
+            jax.ShapeDtypeStruct((b0,), i32))
+        return {"prefill": (pre, (1, 2)), "decode": (dec, (1, 2))}
+
+    def _maybe_lint(self) -> None:
+        """FLAGS_static_analysis hook: on first dispatch, lint both step
+        graphs and verify the declared plan (one trace feeds both)."""
+        if self._linted:
+            return
+        self._linted = True
+        from ..analysis import jaxpr_lint, plan_check
+        if jaxpr_lint.analysis_mode() == "off":
+            return
+        diags = []
+        traced = self.trace_steps()
+        for name, (closed, donate) in traced.items():
+            diags += jaxpr_lint.lint_jaxpr(closed, donate_argnums=donate,
+                                           where=f"serving.{name}")
+        diags += plan_check.check_plan(self.plan, traced["decode"][0],
+                                       donate_argnums=traced["decode"][1],
+                                       where="serving")
+        if diags:
+            jaxpr_lint.emit(diags, where="serving")
+
+    # ------------------------------------------------------------------
+    # Request lifecycle
+    # ------------------------------------------------------------------
+
+    def submit(self, request: Request) -> Sequence:
+        total = request.prompt_ids.size + request.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"request {request.rid!r}: prompt {request.prompt_ids.size} "
+                f"+ max_new_tokens {request.max_new_tokens} exceeds "
+                f"max_seq_len {self.max_seq_len}")
+        # a single sequence must fit the pool on its own
+        self.prefill_buckets.fit(request.prompt_ids.size)
+        seq = Sequence(request)
+        seq.t_submit = time.perf_counter()
+        self._seqs[request.rid] = seq
+        self.sched.submit(seq)
+        metrics.counter("serving.requests", "requests submitted").inc()
+        self._gauges()
+        return seq
+
+    def _gauges(self) -> None:
+        metrics.gauge("serving.queue_depth",
+                      "requests waiting for admission").set(
+                          len(self.sched.waiting))
+        metrics.gauge("serving.running",
+                      "sequences resident in the decode batch").set(
+                          len(self.sched.running))
+
+    # -- admission (prefill / restore) --------------------------------------
+
+    def _try_admit(self) -> bool:
+        seq = self.sched.peek_waiting()
+        if seq is None or not self.sched.has_capacity():
+            return False
+        if seq.status is Status.PREEMPTED:
+            n_need = int(seq.host_kv[0].shape[1])
+            ids = self.cache.allocator.alloc(n_need)
+            if ids is None:
+                return False
+            self.sched.admit(seq)
+            self._restore(seq, ids)
+            return True
+        n_need = _ceil_div(seq.prompt_len, self.block_size)
+        ids = self.cache.allocator.alloc(n_need)
+        if ids is None:
+            return False
+        self.sched.admit(seq)
+        self._prefill(seq, ids)
+        return True
+
+    def _prefill(self, seq: Sequence, block_ids: List[int]) -> None:
+        now = time.perf_counter()
+        seq.add_phase("queue", now - seq.t_submit)
+        bucket = self.prefill_buckets.fit(seq.prompt_len)
+        nb_bucket = bucket // self.block_size
+        ids = pad_axis(seq.request.prompt_ids[None, :], 1, bucket)
+        btab = np.full((nb_bucket,), NULL_BLOCK, np.int32)
+        btab[:len(block_ids)] = block_ids
+        args = (jnp.asarray(ids, jnp.int32), self.cache.k, self.cache.v,
+                jnp.asarray(btab), jnp.asarray(seq.prompt_len, jnp.int32))
+        self._maybe_lint()
+        self._sent_prefill.observe_tree(
+            "serving.prefill", (args[0], args[3], args[4]),
+            donate=(1, 2), where="serving.prefill")
+        tok, k2, v2 = self._prefill_fn(*args)
+        tok = int(tok)  # host sync: honest prefill timing
+        self.cache.swap(k2, v2)
+        seq.block_ids = list(block_ids)
+        seq.block_log.extend(block_ids)
+        seq.ctx_len = seq.prompt_len
+        seq.out_tokens.append(tok)
+        seq.t_first_token = time.perf_counter()
+        dur = seq.t_first_token - now
+        seq.add_phase("prefill", dur)
+        metrics.histogram("serving.prefill_ms",
+                          "prefill step wall time (ms)").observe(dur * 1e3)
+        if seq.is_finished_by(tok):
+            self._finish(seq)
+
+    def _restore(self, seq: Sequence, ids: List[int]) -> None:
+        now = time.perf_counter()
+        seq.add_phase("queue", now - seq.t_submit)
+        self.cache.restore(seq.host_kv, ids)
+        seq.host_kv = None
+        seq.block_ids = list(ids)
+        seq.block_log.append(-1)  # spill/restore boundary
+        seq.block_log.extend(ids)
+        # KV re-materialization substitutes for prefill on resume
+        seq.add_phase("prefill", time.perf_counter() - now)
+
+    def _preempt(self, seq: Sequence) -> None:
+        self.sched.preempt(seq)
+        seq.host_kv = self.cache.spill(seq.block_ids)
+        seq.block_ids = []
+        # queue time for the preempted span restarts now
+        seq.t_submit = time.perf_counter()
+        metrics.counter("serving.preemptions",
+                        "sequences preempted for KV capacity").inc()
+
+    # -- the decode iteration ------------------------------------------------
+
+    def _ensure_decode_blocks(self) -> None:
+        """Every running sequence needs a real block for position
+        ctx_len before the next iteration; preempt (youngest first) to
+        make room."""
+        for seq in list(self.sched.running):
+            if seq.status is not Status.RUNNING:
+                continue
+            needed = seq.ctx_len // self.block_size + 1
+            while len(seq.block_ids) < needed:
+                got = self.cache.allocator.alloc(1)
+                if got is not None:
+                    seq.block_ids.extend(got)
+                    seq.block_log.extend(got)
+                    continue
+                victim = self.sched.preempt_victim(exclude=seq)
+                if victim is None:
+                    raise OutOfBlocksError(
+                        f"sequence {seq.rid!r} needs a block and there is "
+                        "nothing left to preempt — pool too small for one "
+                        "sequence (constructor validation should have "
+                        "caught this)")
+                self._preempt(victim)
+
+    def _decode_iteration(self) -> List[Sequence]:
+        batch = self.sched.iteration_batch()
+        if not batch:
+            return []
+        t0 = time.perf_counter()
+        width = self.decode_buckets.fit(len(batch))
+        m_blocks = self.max_blocks_per_seq
+        tokens = np.zeros((width,), np.int32)
+        tables = np.full((width, m_blocks), NULL_BLOCK, np.int32)
+        lens = np.zeros((width,), np.int32)
+        for i, seq in enumerate(batch):
+            tokens[i] = seq.out_tokens[-1]
+            tables[i, :len(seq.block_ids)] = seq.block_ids
+            lens[i] = seq.ctx_len
+        args = (jnp.asarray(tokens), self.cache.k, self.cache.v,
+                jnp.asarray(tables), jnp.asarray(lens))
+        self._maybe_lint()
+        self._sent_decode.observe_tree(
+            "serving.decode", (args[0], args[3], args[4]),
+            donate=(1, 2), where="serving.decode")
+        out, k2, v2 = self._decode_fn(*args)
+        out = np.asarray(out)  # host sync per iteration (token commit)
+        self.cache.swap(k2, v2)
+        dur = time.perf_counter() - t0
+        metrics.histogram("serving.decode_step_ms",
+                          "decode iteration wall time (ms)").observe(
+                              dur * 1e3)
+        finished: List[Sequence] = []
+        for i, seq in enumerate(batch):
+            seq.add_phase("decode", dur)
+            seq.ctx_len += 1
+            tok = int(out[i])
+            seq.out_tokens.append(tok)
+            if seq.is_finished_by(tok):
+                finished.append(seq)
+        for seq in finished:
+            self._finish(seq)
+        return finished
+
+    def _finish(self, seq: Sequence) -> None:
+        t0 = time.perf_counter()
+        self.sched.finish(seq)
+        if seq.block_ids:
+            self.cache.allocator.free(seq.block_ids)
+            seq.block_ids = []
+        out = seq.full_output()
+        seq.output = out
+        if self.detokenizer is not None:
+            seq.text = self.detokenizer(out)
+        end = time.perf_counter()
+        seq.add_phase("detokenize", end - t0)
+        total_ms = (end - seq.t_submit) * 1e3
+        ttft_ms = ((seq.t_first_token - seq.t_submit) * 1e3
+                   if seq.t_first_token is not None else None)
+        request_timeline.current().record(
+            rid=seq.rid, prompt_tokens=seq.prompt_len,
+            new_tokens=seq.n_generated,
+            phases_ms={k: v * 1e3 for k, v in seq.phase_s.items()},
+            total_ms=total_ms, ttft_ms=ttft_ms,
+            preemptions=seq.preemptions)
+
+    # ------------------------------------------------------------------
+    # Driving loop
+    # ------------------------------------------------------------------
+
+    def step(self) -> List[Sequence]:
+        """One scheduler iteration: admit whatever fits (prefill /
+        restore at token granularity), top up decode blocks (preempting
+        under pressure), run one decode iteration. Returns the sequences
+        that finished (including 1-token requests done at admission)."""
+        n0 = len(self.sched.finished)
+        while self._try_admit():
+            pass
+        self._ensure_decode_blocks()
+        self._decode_iteration()
+        self._gauges()
+        return self.sched.finished[n0:]
+
+    def serve(self, requests: Seq[Request],
+              respect_arrivals: bool = False) -> Dict[str, Sequence]:
+        """Drive the full trace to completion; returns rid -> Sequence
+        (with ``.output`` / ``.text``). ``respect_arrivals`` replays each
+        request's ``arrival_s`` offset instead of submitting everything
+        up front."""
+        order = sorted(requests, key=lambda r: r.arrival_s) \
+            if respect_arrivals else list(requests)
+        t0 = time.perf_counter()
+        idx = 0
+        done: Dict[str, Sequence] = {}
+        while idx < len(order) or self.sched.n_pending:
+            now = time.perf_counter() - t0
+            while idx < len(order) and (
+                    not respect_arrivals or order[idx].arrival_s <= now):
+                self.submit(order[idx])
+                idx += 1
+            if not self.sched.n_pending:
+                if idx < len(order) and respect_arrivals:
+                    time.sleep(
+                        max(0.0, order[idx].arrival_s -
+                            (time.perf_counter() - t0)))
+                continue
+            for seq in self.step():
+                done[seq.rid] = seq
+        self.sched.assert_idle()
+        return done
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+
+    def compile_report(self) -> Dict[str, Any]:
+        """Distinct executable signatures dispatched vs the bucket
+        budget — the '≤ n_buckets compilations, O001 silent' check."""
+        n_pre = len(self._sent_prefill._seen.get("serving.prefill", ()))
+        n_dec = len(self._sent_decode._seen.get("serving.decode", ()))
+        return {
+            "prefill_signatures": n_pre,
+            "decode_signatures": n_dec,
+            "budget": len(self.prefill_buckets) + len(self.decode_buckets),
+            "prefill_buckets": self.prefill_buckets.sizes,
+            "decode_buckets": self.decode_buckets.sizes,
+            "within_budget": (n_pre <= len(self.prefill_buckets) and
+                              n_dec <= len(self.decode_buckets)),
+            "o001_fired": bool(self._sent_prefill.diagnostics or
+                               self._sent_decode.diagnostics),
+        }

@@ -1,0 +1,200 @@
+"""Block-paged KV cache: device page pool + free-list allocator + host spill.
+
+The PagedAttention idea (vLLM, SOSP'23) applied to our stack: instead of
+one contiguous ``[B, max_len, KH, D]`` cache per sequence (whose max_len
+reservation wastes ~60-80% of KV memory on real traffic), the KV store
+is a pool of fixed-size *blocks* — ``[L, num_blocks, block_size, KH, D]``
+per k and v — and each sequence owns an ordered block list. Allocation
+is a min-id free list (deterministic: the same request schedule always
+produces the same block assignment, which the tests pin), fragmentation
+is impossible (every block is the same shape), and capacity pressure is
+handled by *preempting* a sequence: its blocks are gathered to host
+memory (``framework/offload.py``'s host tier — ``pinned_host`` on TPU,
+``unpinned_host`` on CPU where the parity tests run), freed, and later
+restored bitwise into freshly allocated blocks.
+
+Block 0 is reserved as the **null sink**: padded table entries point at
+it, so the bucketed prefill/decode executables can scatter the KV of
+padding tokens somewhere harmless instead of branching on raggedness.
+Nothing ever reads block 0 through an attention mask — gathered keys at
+positions >= the sequence's context length are masked to -inf before the
+softmax (``ops.flash_attention.single_query_attention``).
+
+All pool updates run through jitted scatter/gather helpers that donate
+the pool (XLA updates the pages in place — the pool is never copied),
+at dispatch level between executables — never a transfer inside a loop
+body (rule J012).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.offload import host_memory_kind
+from ..observability import metrics
+
+__all__ = ["BlockAllocator", "PagedKVCache", "NULL_BLOCK",
+           "OutOfBlocksError"]
+
+# Block id every padded block-table slot points at (reserved at init).
+NULL_BLOCK = 0
+
+
+class OutOfBlocksError(RuntimeError):
+    """The pool cannot satisfy an allocation even after preemption."""
+
+
+class BlockAllocator:
+    """Min-id free list over ``num_blocks`` KV blocks (block 0 reserved).
+
+    Lowest-id-first allocation keeps the assignment deterministic under a
+    fixed request schedule and re-uses freed blocks immediately (hot
+    pages stay hot). ``alloc`` is all-or-nothing: a partial grant would
+    leave the caller holding blocks it cannot use.
+    """
+
+    def __init__(self, num_blocks: int, reserved: Sequence[int] = (NULL_BLOCK,)):
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (one is the null sink), "
+                             f"got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        self._reserved = frozenset(int(r) for r in reserved)
+        self._free = sorted(set(range(self.num_blocks)) - self._reserved)
+        self._used: set = set()
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return len(self._used)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """n lowest free block ids, or None when fewer than n are free."""
+        if n < 0:
+            raise ValueError(f"alloc({n})")
+        if n > len(self._free):
+            return None
+        got, self._free = self._free[:n], self._free[n:]
+        self._used.update(got)
+        self._gauges()
+        return got
+
+    def free(self, ids: Sequence[int]) -> None:
+        ids = [int(i) for i in ids]
+        for i in ids:
+            if i in self._reserved:
+                raise ValueError(f"freeing reserved block {i}")
+            if i not in self._used:
+                raise ValueError(f"double-free of block {i}")
+            self._used.discard(i)
+        self._free = sorted(self._free + ids)
+        self._gauges()
+
+    def _gauges(self) -> None:
+        metrics.gauge("serving.kv_blocks_free",
+                      "free KV blocks in the paged pool").set(self.n_free)
+        metrics.gauge("serving.kv_blocks_used",
+                      "allocated KV blocks in the paged pool").set(self.n_used)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_blocks(pages, ids, vals):
+    """pages[:, ids] = vals, pool donated (in-place under XLA)."""
+    return pages.at[:, ids].set(vals)
+
+
+@jax.jit
+def _gather_blocks(pages, ids):
+    return pages[:, ids]
+
+
+class PagedKVCache:
+    """The device page pool for one model: k/v arrays of shape
+    ``[n_layers, num_blocks, block_size, kv_heads, head_dim]``.
+
+    The pool arrays are owned here but *written* by the serving engine's
+    prefill/decode executables, which take them as donated arguments and
+    return the updated pool — :meth:`swap` re-homes the references. Spill
+    and restore move whole per-sequence block lists between the pool and
+    the host memory tier.
+    """
+
+    def __init__(self, n_layers: int, num_blocks: int, block_size: int,
+                 kv_heads: int, head_dim: int, dtype=jnp.float32):
+        self.n_layers = int(n_layers)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.kv_heads = int(kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)
+        shape = (n_layers, num_blocks, block_size, kv_heads, head_dim)
+        self.k = jnp.zeros(shape, self.dtype)
+        self.v = jnp.zeros(shape, self.dtype)
+        self.allocator = BlockAllocator(num_blocks)
+        self.host_kind = host_memory_kind()
+
+    @property
+    def bytes_per_block(self) -> int:
+        return (2 * self.n_layers * self.block_size * self.kv_heads *
+                self.head_dim * self.dtype.itemsize)
+
+    def swap(self, k, v) -> None:
+        """Adopt the pool arrays an executable returned (the old ones were
+        donated into it)."""
+        self.k, self.v = k, v
+
+    # -- spill / restore -----------------------------------------------------
+
+    def _to_host(self, x: jax.Array):
+        """Commit one gathered KV stripe to the host memory tier
+        (``pinned_host``/``unpinned_host`` sharding when the runtime
+        exposes one, plain host numpy otherwise)."""
+        if self.host_kind is None:
+            return np.asarray(x)
+        tgt = x.sharding.with_memory_kind(self.host_kind)
+        return jax.device_put(x, tgt)
+
+    def spill(self, block_ids: Sequence[int]) -> Tuple:
+        """Gather ``block_ids`` to host and free them. Returns the opaque
+        host KV pair :meth:`restore` takes; the device blocks are
+        reusable immediately after."""
+        ids = jnp.asarray(list(block_ids), jnp.int32)
+        k_host = self._to_host(_gather_blocks(self.k, ids))
+        v_host = self._to_host(_gather_blocks(self.v, ids))
+        if self.host_kind is not None:
+            # Host commit must complete before the blocks are handed out
+            # again — a donated overwrite racing the D2H would tear the copy.
+            jax.block_until_ready((k_host, v_host))
+        self.allocator.free(list(block_ids))
+        metrics.counter("serving.kv_spills",
+                        "sequence KV spills to host memory").inc()
+        return (k_host, v_host)
+
+    def restore(self, host_kv: Tuple, block_ids: Sequence[int]) -> None:
+        """Scatter a spilled KV pair into freshly allocated blocks (ids
+        may differ from the spilled ones — the block table is rewritten
+        by the caller). Bitwise: the round trip is a copy, not a cast."""
+        k_host, v_host = host_kv
+        ids = jnp.asarray(list(block_ids), jnp.int32)
+        if int(ids.shape[0]) != int(k_host.shape[1]):
+            raise ValueError(
+                f"restore of {k_host.shape[1]} blocks into "
+                f"{ids.shape[0]} ids")
+        self.k = _scatter_blocks(self.k, ids, jnp.asarray(k_host, self.dtype))
+        self.v = _scatter_blocks(self.v, ids, jnp.asarray(v_host, self.dtype))
+        metrics.counter("serving.kv_restores",
+                        "sequence KV restores from host memory").inc()
+
+    def read_blocks(self, block_ids: Sequence[int]) -> Tuple[np.ndarray,
+                                                             np.ndarray]:
+        """Host copies of the given blocks (tests / debugging)."""
+        ids = jnp.asarray(list(block_ids), jnp.int32)
+        return (np.asarray(_gather_blocks(self.k, ids)),
+                np.asarray(_gather_blocks(self.v, ids)))

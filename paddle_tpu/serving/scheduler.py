@@ -1,0 +1,169 @@
+"""Continuous-batching scheduler: iteration-level request admission.
+
+Orca's (OSDI'22) observation, applied here: a serving batch must be
+re-formed at *token-iteration* granularity, not request granularity —
+a static batch runs at the speed of its longest member and admits new
+work only at batch boundaries, while iteration-level scheduling admits a
+request the moment a decode slot and KV blocks are free, and retires a
+sequence the token it finishes. The policy is FCFS with LIFO preemption
+(vLLM's default): requests are admitted in arrival order, and when the
+block pool runs dry the *youngest* running sequence is preempted (its KV
+spilled to host) — the one with the least sunk prefill work and the
+shortest spill payload — then resumed, at the front of the queue, when
+capacity returns.
+
+This module is pure host-side bookkeeping (queues and state machines);
+the engine executes the device work and reports back. Everything is
+deterministic under a fixed submission order — no wall-clock policy
+inputs — which the block-assignment regression test pins.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from collections import deque
+
+__all__ = ["Request", "Sequence", "Status", "FCFSScheduler"]
+
+
+class Status(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED = "finished"
+
+
+@dataclass
+class Request:
+    """One client request: a prompt and a generation budget."""
+
+    rid: str
+    prompt_ids: np.ndarray          # [prompt_len] int32
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    arrival_s: float = 0.0          # offset into the trace (replay traces)
+
+    def __post_init__(self):
+        self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
+        if self.prompt_ids.size < 1:
+            raise ValueError(f"request {self.rid!r}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.rid!r}: max_new_tokens "
+                             f"{self.max_new_tokens}")
+
+
+@dataclass
+class Sequence:
+    """Runtime state of one request inside the engine."""
+
+    request: Request
+    status: Status = Status.WAITING
+    ctx_len: int = 0                     # tokens committed to KV
+    out_tokens: List[int] = field(default_factory=list)
+    block_ids: List[int] = field(default_factory=list)
+    host_kv: Any = None                  # spilled KV while PREEMPTED
+    preemptions: int = 0
+    # every block id ever assigned, in grant order (spill boundaries as
+    # -1): the determinism regression's witness
+    block_log: List[int] = field(default_factory=list)
+    # phase accounting (engine-stamped, seconds)
+    t_submit: float = 0.0
+    t_first_token: Optional[float] = None
+    phase_s: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def rid(self) -> str:
+        return self.request.rid
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.request.prompt_ids.size)
+
+    @property
+    def n_generated(self) -> int:
+        return len(self.out_tokens)
+
+    def add_phase(self, name: str, dur_s: float) -> None:
+        self.phase_s[name] = self.phase_s.get(name, 0.0) + dur_s
+
+    def is_finished_by(self, token: int) -> bool:
+        eos = self.request.eos_token_id
+        return ((eos is not None and token == eos) or
+                self.n_generated >= self.request.max_new_tokens)
+
+    def full_output(self) -> np.ndarray:
+        return np.concatenate([self.request.prompt_ids,
+                               np.asarray(self.out_tokens, np.int32)])
+
+
+class FCFSScheduler:
+    """Arrival-order admission, LIFO preemption, iteration batches."""
+
+    def __init__(self, max_batch: int):
+        if max_batch < 1:
+            raise ValueError(f"max_batch {max_batch}")
+        self.max_batch = int(max_batch)
+        self.waiting: Deque[Sequence] = deque()
+        self.running: List[Sequence] = []   # admission order
+        self.finished: List[Sequence] = []
+
+    # -- queue transitions ---------------------------------------------------
+
+    def submit(self, seq: Sequence) -> None:
+        seq.status = Status.WAITING
+        self.waiting.append(seq)
+
+    def peek_waiting(self) -> Optional[Sequence]:
+        return self.waiting[0] if self.waiting else None
+
+    def has_capacity(self) -> bool:
+        return len(self.running) < self.max_batch
+
+    def admit(self, seq: Sequence) -> None:
+        assert self.waiting and self.waiting[0] is seq, \
+            "admission must be FCFS (engine admitted out of order)"
+        self.waiting.popleft()
+        seq.status = Status.RUNNING
+        self.running.append(seq)
+
+    def preempt_victim(self, exclude: Optional[Sequence] = None
+                       ) -> Optional[Sequence]:
+        """Youngest running sequence other than ``exclude`` (LIFO)."""
+        for seq in reversed(self.running):
+            if seq is not exclude:
+                return seq
+        return None
+
+    def preempt(self, seq: Sequence) -> None:
+        self.running.remove(seq)
+        seq.status = Status.PREEMPTED
+        seq.preemptions += 1
+        # Front of the queue: the preempted sequence has sunk work and,
+        # under FCFS, arrived before everything still waiting.
+        self.waiting.appendleft(seq)
+
+    def finish(self, seq: Sequence) -> None:
+        self.running.remove(seq)
+        seq.status = Status.FINISHED
+        self.finished.append(seq)
+
+    # -- iteration view ------------------------------------------------------
+
+    def iteration_batch(self) -> List[Sequence]:
+        """The sequences decoding this iteration, in admission order."""
+        return list(self.running)
+
+    @property
+    def n_pending(self) -> int:
+        return len(self.waiting) + len(self.running)
+
+    def assert_idle(self) -> None:
+        if self.waiting or self.running:
+            raise RuntimeError(
+                f"scheduler not drained: {len(self.waiting)} waiting, "
+                f"{len(self.running)} running")

@@ -56,3 +56,70 @@ def test_predictor_benchmark(tmp_path):
 def test_predictor_errors():
     with pytest.raises(ValueError, match="model path"):
         create_predictor(Config())
+
+
+def test_symbolic_export_ragged_trace_compiles_le_buckets(tmp_path):
+    """The recompile satellite: a 50-shape ragged trace through a
+    symbolic-dim export pads to the bucket ladder — <= n_buckets
+    distinct compiled signatures, O001 silent, one O004 announcement,
+    outputs sliced back to the true shape and numerically exact."""
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(3)
+    model = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    model.eval()
+    path = str(tmp_path / "dyn_model")
+    paddle.jit.save(model, path, input_spec=[((None, 8), "float32")])
+    pred = create_predictor(Config(path))
+    rng = np.random.default_rng(0)
+    for n in rng.integers(1, 50, 50):
+        x = rng.standard_normal((int(n), 8)).astype(np.float32)
+        out = pred.run([x])
+        assert out[0].shape == (int(n), 4)
+        np.testing.assert_allclose(out[0], np.asarray(model(x)),
+                                   rtol=1e-5, atol=1e-6)
+    rep = pred.bucket_report()
+    assert rep["compiles"] <= len(rep["buckets"]) <= 7, rep
+    assert not rep["o001_fired"], rep
+    assert [d.rule for d in pred.diagnostics] == ["O004"]
+    assert "buckets" in pred.diagnostics[0].message
+
+
+def test_explicit_shape_buckets_and_oversize(tmp_path):
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+
+    paddle.seed(4)
+    model = nn.Sequential(nn.Linear(8, 4))
+    model.eval()
+    path = str(tmp_path / "dyn2")
+    paddle.jit.save(model, path, input_spec=[((None, 8), "float32")])
+    config = Config(path)
+    config.set_shape_buckets([4, 16])
+    pred = create_predictor(config)
+    pred.run([np.zeros((3, 8), np.float32)])
+    pred.run([np.zeros((9, 8), np.float32)])
+    pred.run([np.zeros((13, 8), np.float32)])   # same bucket as 9
+    assert pred.bucket_report()["compiles"] == 2
+    with pytest.raises(ValueError, match="exceeds the largest"):
+        pred.run([np.zeros((17, 8), np.float32)])
+
+
+def test_predictor_benchmark_reports_through_metrics(tmp_path):
+    """The PredictorBenchmark satellite: latency lands in the shared
+    registry (serving.predictor_latency_ms histogram + qps gauge); the
+    returned dict keys forward the registry values."""
+    from paddle_tpu.observability import metrics
+
+    _, path = _save_model(tmp_path, seed=5)
+    pred = create_predictor(Config(path))
+    x = np.zeros((2, 8), np.float32)
+    hist = metrics.histogram("serving.predictor_latency_ms").labels()
+    before = hist.get()["count"]
+    stats = PredictorBenchmark(pred).run([x], warmup=1, repeat=4)
+    after = hist.get()
+    assert after["count"] == before + 4
+    assert stats["latency_ms"] > 0 and stats["qps"] > 0
+    assert metrics.gauge("serving.predictor_qps").get() == \
+        pytest.approx(stats["qps"])

@@ -179,9 +179,44 @@ def lint_fault():
     return diags, len(closed.jaxpr.eqns)
 
 
+def lint_serving():
+    """The serving engine's two bucketed executables (paddle_tpu/serving/):
+    prefill (flash forward + paged KV scatter) and decode (paged gather +
+    single-query attention + in-program KV write) traced at their
+    smallest buckets through the jaxpr linter, plus the declared
+    dispatch plan (prefill/decode/spill/restore donation sequence)
+    verified by plan_check — the same S/D gate the training tiers get."""
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import lint_jaxpr, plan_check
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models.gpt import GPTForCausalLM, gpt_tiny
+
+    paddle.seed(0)
+    cfg = gpt_tiny(vocab_size=128, hidden_size=48, num_layers=2,
+                   num_heads=4, max_position_embeddings=64)
+    model = GPTForCausalLM(cfg)
+    eng = ServingEngine(model, block_size=4, num_blocks=32, max_batch=4)
+    diags, n_eqns = [], 0
+    traced = eng.trace_steps()
+    for name, (closed, donate) in traced.items():
+        d = lint_jaxpr(closed, donate_argnums=donate,
+                       where=f"serving.{name}")
+        print(f"  serving.{name}: {len(closed.jaxpr.eqns)} eqns, "
+              f"{len(d)} diagnostic(s)")
+        diags += d
+        n_eqns += len(closed.jaxpr.eqns)
+    pd = plan_check.check_plan(eng.plan, traced["decode"][0],
+                               donate_argnums=traced["decode"][1],
+                               where="serving")
+    print(f"  serving plan ({len(eng.plan.nodes)} nodes): "
+          f"{len(pd)} diagnostic(s)")
+    diags += pd
+    return diags, n_eqns
+
+
 MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp,
           "offload": lint_offload, "overlap": lint_overlap,
-          "fault": lint_fault}
+          "fault": lint_fault, "serving": lint_serving}
 
 _SEV_RANK = {"info": 0, "warning": 1, "error": 2}
 
