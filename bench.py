@@ -46,7 +46,12 @@ sweep; BENCH_SERVE_REQUESTS/MAX_NEW/LAYERS/HIDDEN/HEADS/VOCAB size it —
 continuous batching vs the sequential one-shot Predictor on one ragged
 trace, concurrency sweep, compile-budget/O001 gate; emits
 serving_tokens_per_s + serving_p50_ms/serving_p99_ms and appends the
-per-request phase records to the timeline JSONL).
+per-request phase records to the timeline JSONL; the resilience leg
+additionally runs the subprocess serve drill — SIGKILL mid-decode +
+mid-spill, exactly-once replay — and a fault-injected overload trace
+with deadlines/bounded admission/shedding, emitting
+serving_slo_attainment_pct + serving_shed_rate with the drill recovery
+stats; the engine surviving pool exhaustion is asserted).
 """
 
 from __future__ import annotations
@@ -1390,6 +1395,131 @@ def bench_serve(small: bool):
         raise RuntimeError(
             f"continuous batching speedup {speedup:.2f}x < 2x over the "
             f"sequential one-shot baseline")
+
+    bench_serve_resilience(model, max_pos, vocab, small)
+
+
+def bench_serve_resilience(model, max_pos, vocab, small: bool):
+    """Serving resilience (ISSUE 9): the SLO half of BENCH_SERVE.
+
+    Two measured components, emitted as serving_slo_attainment_pct +
+    serving_shed_rate:
+
+    - the **subprocess serve drill** (tools/serve_drill.py machinery):
+      SIGKILL the serving worker mid-decode and mid-spill, relaunch,
+      replay unacknowledged requests from the fsynced journal — zero
+      lost, zero duplicated, survivors token-exact vs model.generate;
+    - a **fault-injected overload trace** on a deliberately starved
+      engine: tight deadlines + mixed priorities, bounded admission
+      (max_waiting), the shed policy armed in degrade mode, one request
+      that outgrows the pool (validate_capacity=False — it must FAIL
+      per-request, never crash the loop), and a SpillError injected
+      through the serve.mid_spill seam. SLO attainment = fraction of
+      deadline-carrying requests answered in time; shed rate =
+      (shed + rejected) / submitted.
+    """
+    import tempfile
+
+    from paddle_tpu.fault.injection import register_fire_point
+    from paddle_tpu.observability import request_timeline
+    from paddle_tpu.serving import (Request, ServingEngine, ShedPolicy,
+                                    SpillError, Status)
+    from paddle_tpu.serving import drill as serve_drill
+
+    # -- (1) the kill-and-replay drill (subprocess pod) ---------------------
+    drill_dir = tempfile.mkdtemp(prefix="bench_serve_drill_")
+    drill_report = serve_drill.run_serve_drill(drill_dir)
+    if not drill_report.get("ok"):
+        raise RuntimeError(f"serve drill failed: {drill_report}")
+    once = drill_report["exactly_once"]
+
+    # -- (2) fault-injected overload trace ----------------------------------
+    # The pool hog goes FIRST (closed-loop serve submits in order, so it
+    # lands inside the bounded queue): its 120-token prompt takes all 15
+    # usable blocks at admission and its first decode token needs a 16th
+    # -> it must FAIL per-request (OutOfBlocks isolated), never a crash.
+    rng = np.random.default_rng(11)
+    n_over = 10 if small else 16
+    trace = [Request(rid="hog", prompt_ids=rng.integers(0, vocab, 120),
+                     max_new_tokens=8, deadline_s=120.0, priority=2)]
+    for i in range(n_over):
+        plen = int(rng.integers(16, 33))
+        # a third of the trace gets an unattainable deadline (guaranteed
+        # expiry), the rest a generous one; priorities split the classes;
+        # 2-4 prompt blocks + 2 blocks of growth x 4-wide overcommits the
+        # 15-block pool, so the LIFO preemption/spill path runs hot
+        tight = i % 3 == 2
+        trace.append(Request(
+            rid=f"ov{i}", prompt_ids=rng.integers(0, vocab, plen),
+            max_new_tokens=16, deadline_s=0.001 if tight else 120.0,
+            priority=0 if tight else 1))
+
+    rt = request_timeline.reset_default()
+    eng = ServingEngine(
+        model, block_size=8, num_blocks=16, max_batch=4,
+        max_seq_len=max_pos, max_waiting=8,
+        shed_policy=ShedPolicy(min_free_block_frac=0.2,
+                               max_p99_decode_ms=5e3, degrade=True),
+        validate_capacity=False)
+    state = {"spills": 0}
+
+    def spill_bomb():  # the in-process fault: first spill's host commit dies
+        state["spills"] += 1
+        if state["spills"] == 1:
+            raise SpillError("injected host allocation failure "
+                             "(BENCH_SERVE resilience leg)")
+
+    register_fire_point("serve.mid_spill", spill_bomb)
+    try:
+        results = eng.serve(trace)
+    finally:
+        register_fire_point("serve.mid_spill", None)
+
+    # the engine degraded instead of dying: loop drained, zero leaks
+    eng.sched.assert_idle()
+    if eng.cache.allocator.n_used != 0:
+        raise RuntimeError(
+            f"overload trace leaked {eng.cache.allocator.n_used} KV blocks")
+    hog = results["hog"]
+    if getattr(hog, "status", None) is not Status.FAILED:
+        raise RuntimeError(
+            "pool-exhaustion request was expected to FAIL per-request "
+            f"(engine survival proof), got {hog!r}")
+
+    s = rt.summary()
+    slo = s["slo_attainment_pct"]
+    if slo is None:
+        raise RuntimeError(f"no deadline-carrying records: {s}")
+    extra = {
+        "outcomes": s["outcomes"],
+        "requests": len(trace),
+        "served": s["served"],
+        "deadline_expired": s["outcomes"].get("expired", 0),
+        "engine_mode_final": eng.mode,
+        "injected_spill_fault": True,
+        "pool_exhaustion_isolated": True,
+        "drill": {
+            "wall_s": drill_report["wall_s"],
+            "fired_events": drill_report["fired_events"],
+            "restarts": drill_report["restarts"],
+            "lost": once["lost"], "duplicated": once["duplicated"],
+            "token_exact": drill_report["token_exact"],
+            "served": drill_report["served"],
+        },
+        "method": ("fault-injected overload trace on a starved engine "
+                   "(16-block pool, max_waiting=8, shed policy armed in "
+                   "degrade mode, SpillError injected at the first host "
+                   "spill, one request outgrowing the pool) + the "
+                   "subprocess serve drill (SIGKILL mid-decode and "
+                   "mid-spill, exactly-once journal replay, token-exact "
+                   "survivors)"),
+    }
+    _emit("serving_slo_attainment_pct", slo, "pct requests in deadline",
+          0.0, extra)
+    _emit("serving_shed_rate", s["shed_rate"],
+          "shed+rejected / submitted", 0.0,
+          {"outcomes": s["outcomes"], "max_waiting": 8,
+           "shed_policy": repr(eng.shed_policy)})
 
 
 def bench_gpt_13b():

@@ -40,7 +40,10 @@ __all__ = ["FaultEvent", "FaultPlan", "FaultInjector", "FAULT_KINDS",
            "PREEMPTION_EXIT_CODE", "fire", "register_fire_point",
            "clear_fire_points", "check_plan"]
 
-FAULT_KINDS = ("mid_step", "mid_ckpt_write", "sigterm")
+FAULT_KINDS = ("mid_step", "mid_ckpt_write", "sigterm",
+               # serving-tier kinds (tools/serve_drill.py): the "step" is
+               # the engine's decode-iteration / spill counter
+               "mid_decode", "mid_spill")
 
 # Same code the reference's elastic stack uses for a restart-me exit; the
 # ElasticManager counts it against the restart budget and relaunches.
@@ -255,8 +258,15 @@ class FaultInjector:
         """mid_step kills land AFTER the step's compute finished but BEFORE
         its log line / checkpoint — that step's work is genuinely lost and
         must be re-executed after the relaunch."""
+        self.poll_event("mid_step", step)
+
+    def poll_event(self, kind: str, step: int) -> None:
+        """Generic SIGKILL trigger: deliver the earliest pending ``kind``
+        event whose step <= ``step``. The serving drill routes the
+        engine's ``serve.mid_decode`` / ``serve.mid_spill`` fire points
+        here with its own iteration/spill counters as the step."""
         self._step = step
-        ev = self._pending("mid_step", step)
+        ev = self._pending(kind, step)
         if ev is not None:
             self._mark_fired(ev)
             self._die()

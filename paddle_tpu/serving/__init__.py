@@ -8,21 +8,34 @@ sequences (vLLM/PagedAttention, SOSP'23), a continuous-batching
 scheduler that re-forms the decode batch at token-iteration granularity
 (Orca, OSDI'22), and bucketed-shape compilation so ragged traffic
 compiles a bounded executable set with the O001 recompile sentinel
-standing guard. ``bench.py`` (``BENCH_SERVE``) measures tokens/s and
-p50/p99 request latency against the sequential one-shot baseline;
-``tools/serve_bench.py`` replays request traces; ``lint_graph --model
-serving`` statically verifies the prefill/decode programs and the
-declared dispatch plan.
+standing guard. The resilience tier (:mod:`.resilience`, RESILIENCE.md)
+makes the engine degrade instead of dying: per-request deadlines and
+priorities, bounded admission with typed :class:`Rejected` backpressure,
+overload load shedding (:class:`ShedPolicy`), per-request failure
+isolation (F003 — pool exhaustion and spill errors never cross the
+engine loop), and the exactly-once :class:`RequestJournal` the serve
+drill (``tools/serve_drill.py``) kills the process against.
+``bench.py`` (``BENCH_SERVE``) measures tokens/s and p50/p99 request
+latency against the sequential one-shot baseline plus SLO attainment
+and shed rate from a fault-injected overload trace;
+``tools/serve_bench.py`` replays request traces (``--deadline-ms`` /
+``--fail-on-slo`` is the CI gate form); ``lint_graph --model serving``
+statically verifies the prefill/decode programs and the declared
+dispatch plan.
 """
 
 from .buckets import BucketSet, pow2_buckets  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .paged_cache import (BlockAllocator, NULL_BLOCK,  # noqa: F401
-                          OutOfBlocksError, PagedKVCache)
-from .scheduler import FCFSScheduler, Request, Sequence, Status  # noqa: F401
+                          OutOfBlocksError, PagedKVCache, SpillError)
+from .resilience import (Rejected, RequestJournal,  # noqa: F401
+                         ShedPolicy)
+from .scheduler import (FCFSScheduler, Request, Sequence,  # noqa: F401
+                        Status, TERMINAL_STATUSES)
 
 __all__ = [
     "ServingEngine", "Request", "Sequence", "Status", "FCFSScheduler",
-    "PagedKVCache", "BlockAllocator", "OutOfBlocksError", "NULL_BLOCK",
-    "BucketSet", "pow2_buckets",
+    "PagedKVCache", "BlockAllocator", "OutOfBlocksError", "SpillError",
+    "NULL_BLOCK", "BucketSet", "pow2_buckets",
+    "Rejected", "RequestJournal", "ShedPolicy", "TERMINAL_STATUSES",
 ]

@@ -1,0 +1,117 @@
+"""The serve-drill worker: a serving engine process that survives being
+SIGKILLed mid-decode and mid-spill.
+
+Runs as one container under the elastic launcher (``serving/drill.py``
+wires it through ``ElasticManager``, exactly like the training drill's
+``fault/_trainer.py``). On every incarnation it reads the request trace
+and the exactly-once :class:`~paddle_tpu.serving.resilience.RequestJournal`,
+replays precisely the submitted-but-unacknowledged requests, and arms the
+fault injector's serving fire points:
+
+- ``serve.mid_decode`` — fires after a decode iteration's compute, before
+  any token of that iteration is committed (``mid_decode`` kind; the
+  injector's "step" is the engine's decode-iteration counter);
+- ``serve.mid_spill`` — fires inside ``PagedKVCache.spill`` after the
+  host gather, before the device blocks are freed (``mid_spill`` kind;
+  counter = spill ordinal).
+
+Env contract (all prefixed SERVE_): ``SERVE_WORK_DIR`` (required; holds
+``trace.jsonl``, ``journal.jsonl``, ``fired.json``), ``SERVE_PLAN``
+(FaultPlan JSON; empty = no faults), ``SERVE_CFG`` (JSON engine/model
+config — see ``drill.quick_serve_config``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+if __name__ == "__main__":  # subprocess mode: the launcher passes a path
+    sys.path.insert(0, REPO)
+
+
+def build_model(cfg):
+    import paddle_tpu as paddle
+    from paddle_tpu.text.models.gpt import GPTForCausalLM, gpt_tiny
+    paddle.seed(int(cfg["model_seed"]))
+    model = GPTForCausalLM(gpt_tiny(
+        vocab_size=cfg["vocab"], hidden_size=cfg["hidden"],
+        num_layers=cfg["layers"], num_heads=cfg["heads"],
+        max_position_embeddings=cfg["max_pos"]))
+    model.eval()
+    return model
+
+
+def load_trace(path):
+    """trace.jsonl -> list of Request (deterministic order)."""
+    import numpy as np
+    from paddle_tpu.serving import Request
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            out.append(Request(
+                rid=rec["rid"],
+                prompt_ids=np.asarray(rec["prompt"], np.int32),
+                max_new_tokens=int(rec["max_new_tokens"]),
+                eos_token_id=rec.get("eos_token_id"),
+                deadline_s=rec.get("deadline_s"),
+                priority=int(rec.get("priority", 0))))
+    return out
+
+
+def arm_serving_faults(workdir, plan_json):
+    """Arm the two serving fire points against the (possibly empty)
+    plan. The injector's fired-event journal lives next to the request
+    journal so a relaunch never replays a delivered kill."""
+    from paddle_tpu.fault.injection import (FaultInjector, FaultPlan,
+                                            register_fire_point)
+    plan = FaultPlan.from_json(plan_json or "")
+    inj = FaultInjector(plan, workdir)
+    counters = {"mid_decode": 0, "mid_spill": 0}
+
+    def seam(kind):
+        def cb():
+            counters[kind] += 1
+            inj.poll_event(kind, counters[kind])
+        return cb
+
+    register_fire_point("serve.mid_decode", seam("mid_decode"))
+    register_fire_point("serve.mid_spill", seam("mid_spill"))
+    return inj
+
+
+def run(workdir, cfg, plan_json=""):
+    from paddle_tpu.serving import RequestJournal, ServingEngine
+
+    trace = load_trace(os.path.join(workdir, "trace.jsonl"))
+    journal = RequestJournal(os.path.join(workdir, "journal.jsonl"))
+    pending_rids = set(journal.pending_rids([r.rid for r in trace]))
+    if not pending_rids:
+        return 0  # a previous incarnation acknowledged everything
+    arm_serving_faults(workdir, plan_json)
+
+    model = build_model(cfg)
+    engine = ServingEngine(
+        model, block_size=cfg["block_size"], num_blocks=cfg["num_blocks"],
+        max_batch=cfg["max_batch"], max_seq_len=cfg["max_pos"],
+        journal=journal)
+    pending = [r for r in trace if r.rid in pending_rids]
+    engine.serve(pending)
+    return 0
+
+
+def main():
+    workdir = os.environ["SERVE_WORK_DIR"]
+    cfg = json.loads(os.environ["SERVE_CFG"])
+    return run(workdir, cfg, os.environ.get("SERVE_PLAN", ""))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

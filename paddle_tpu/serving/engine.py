@@ -38,17 +38,22 @@ default.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence as Seq
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence as Seq, Union
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..fault.injection import fire as _fault_fire
 from ..observability import metrics, request_timeline
+from ..observability.request_timeline import percentile
 from ..observability.step_monitor import RecompileSentinel
 from ..ops.flash_attention import flash_attention, single_query_attention
 from .buckets import BucketSet, pow2_buckets, pad_axis
-from .paged_cache import NULL_BLOCK, OutOfBlocksError, PagedKVCache
+from .paged_cache import (NULL_BLOCK, OutOfBlocksError, PagedKVCache,
+                          SpillError)
+from .resilience import Rejected, RequestJournal, ShedPolicy
 from .scheduler import FCFSScheduler, Request, Sequence, Status
 
 __all__ = ["ServingEngine"]
@@ -65,7 +70,21 @@ class ServingEngine:
                  max_batch: int = 8, max_seq_len: Optional[int] = None,
                  prefill_buckets: Optional[Seq[int]] = None,
                  decode_buckets: Optional[Seq[int]] = None,
-                 detokenizer: Optional[Callable[[np.ndarray], Any]] = None):
+                 detokenizer: Optional[Callable[[np.ndarray], Any]] = None,
+                 max_waiting: Optional[int] = None,
+                 max_spilled_bytes: Optional[int] = None,
+                 shed_policy: Optional[ShedPolicy] = None,
+                 journal: Optional[RequestJournal] = None,
+                 validate_capacity: bool = True):
+        """Resilience knobs (all default-off, preserving PR-8 behavior):
+        ``max_waiting``/``max_spilled_bytes`` bound admission (over-budget
+        submissions return a typed :class:`Rejected`), ``shed_policy``
+        arms overload load shedding, ``journal`` records admitted-request
+        state for exactly-once replay across process deaths, and
+        ``validate_capacity=False`` lets a pool smaller than one
+        max-length sequence serve anyway — a request that outgrows it
+        FAILS (F003) instead of the constructor refusing, which is how
+        the drill proves pool exhaustion never crashes the loop."""
         model.eval()
         cfg = model.cfg
         self.model = model
@@ -73,7 +92,7 @@ class ServingEngine:
         limit = int(cfg.max_position_embeddings)
         self.max_seq_len = min(int(max_seq_len or limit), limit)
         self.max_blocks_per_seq = _ceil_div(self.max_seq_len, self.block_size)
-        if num_blocks - 1 < self.max_blocks_per_seq:
+        if validate_capacity and num_blocks - 1 < self.max_blocks_per_seq:
             raise ValueError(
                 f"pool of {num_blocks} blocks cannot hold one max-length "
                 f"sequence ({self.max_blocks_per_seq} blocks of "
@@ -102,9 +121,23 @@ class ServingEngine:
         self.cache = PagedKVCache(cfg.num_layers, num_blocks,
                                   self.block_size, cfg.kv_heads, head_dim,
                                   dtype=act_dtype)
-        self.sched = FCFSScheduler(max_batch)
+        self.sched = FCFSScheduler(max_batch, max_waiting=max_waiting)
         self._seqs: Dict[str, Sequence] = {}
         self._t0 = time.perf_counter()
+
+        # -- resilience state ------------------------------------------------
+        self.max_spilled_bytes = max_spilled_bytes
+        self.shed_policy = shed_policy
+        self.journal = journal
+        self.rejections: List[Rejected] = []
+        self.diagnostics: List[Any] = []     # F003 records, newest last
+        self.mode = "healthy"                # healthy | shedding | degraded
+        self._spilled_bytes = 0
+        self._degraded_width: Optional[int] = None
+        self._decode_ms: deque = deque(
+            maxlen=shed_policy.window if shed_policy else 64)
+        if journal is not None:
+            journal.launch()
 
         # -- compiled steps + their sentinels --------------------------------
         self._prefill_raw = self._make_prefill()
@@ -214,7 +247,12 @@ class ServingEngine:
                    "num_blocks": self.cache.num_blocks,
                    "max_batch": self.sched.max_batch,
                    "prefill_buckets": str(self.prefill_buckets.sizes),
-                   "decode_buckets": str(self.decode_buckets.sizes)},
+                   "decode_buckets": str(self.decode_buckets.sizes),
+                   # resilience knobs change scheduling, not dispatch —
+                   # declared so the verified plan names the whole config
+                   "max_waiting": str(self.sched.max_waiting),
+                   "max_spilled_bytes": str(self.max_spilled_bytes),
+                   "shed_policy": repr(self.shed_policy)},
             mesh_axes={}, params={}, nodes=nodes)
 
     def trace_steps(self):
@@ -260,22 +298,55 @@ class ServingEngine:
     # Request lifecycle
     # ------------------------------------------------------------------
 
-    def submit(self, request: Request) -> Sequence:
+    def submit(self, request: Request) -> Union[Sequence, Rejected]:
+        """Admit one request, or answer with a typed :class:`Rejected`
+        (429-style) when the bounded queue or the host-spill budget is
+        over capacity. Malformed requests (a total that can never fit
+        ``max_seq_len``) still raise — that is a client contract error,
+        not transient overload."""
         total = request.prompt_ids.size + request.max_new_tokens
         if total > self.max_seq_len:
             raise ValueError(
                 f"request {request.rid!r}: prompt {request.prompt_ids.size} "
                 f"+ max_new_tokens {request.max_new_tokens} exceeds "
                 f"max_seq_len {self.max_seq_len}")
-        # a single sequence must fit the pool on its own
+        # the prompt must fit a registered prefill bucket on its own
         self.prefill_buckets.fit(request.prompt_ids.size)
+        metrics.counter("serving.requests", "requests submitted").inc()
+        if not self.sched.can_accept():
+            return self._reject(
+                request, "queue_full",
+                f"waiting queue at max_waiting={self.sched.max_waiting}")
+        if (self.max_spilled_bytes is not None
+                and self._spilled_bytes > self.max_spilled_bytes):
+            return self._reject(
+                request, "spill_budget",
+                f"host spill {self._spilled_bytes}B over budget "
+                f"{self.max_spilled_bytes}B")
         seq = Sequence(request)
         seq.t_submit = time.perf_counter()
         self._seqs[request.rid] = seq
+        if self.journal is not None:
+            self.journal.submitted(request)
         self.sched.submit(seq)
-        metrics.counter("serving.requests", "requests submitted").inc()
         self._gauges()
         return seq
+
+    def _reject(self, request: Request, reason: str,
+                detail: str) -> Rejected:
+        rej = Rejected(request.rid, reason, detail)
+        self.rejections.append(rej)
+        metrics.counter("serving.rejected",
+                        "submissions refused by bounded admission").inc()
+        if self.journal is not None:
+            self.journal.terminal(request.rid, "rejected", reason)
+        request_timeline.current().record(
+            rid=request.rid, prompt_tokens=request.prompt_ids.size,
+            new_tokens=0, phases_ms={}, total_ms=0.0,
+            outcome="rejected", error=f"{reason}: {detail}",
+            deadline_ms=(None if request.deadline_s is None
+                         else request.deadline_s * 1e3))
+        return rej
 
     def _gauges(self) -> None:
         metrics.gauge("serving.queue_depth",
@@ -285,31 +356,167 @@ class ServingEngine:
                       "sequences resident in the decode batch").set(
                           len(self.sched.running))
 
+    # -- terminal non-success paths (isolation, deadlines, shedding) ---------
+
+    def _cancel(self, seq: Sequence, status: Status, reason: str,
+                *, diagnose: bool = False) -> None:
+        """The one exit for every non-FINISHED ending: scheduler
+        retirement, provable reclamation of device blocks AND host-spill
+        buffers, journal acknowledgment, timeline record, counters. The
+        allocator-invariant tests pin the zero-leak property."""
+        self.sched.retire(seq, status)
+        if seq.block_ids:
+            self.cache.allocator.free(seq.block_ids)
+            seq.block_ids = []
+        if seq.host_kv is not None:
+            seq.host_kv = None
+            self._account_spill(-seq.spilled_bytes)
+            seq.spilled_bytes = 0
+        seq.error = reason
+        outcome = status.value
+        metrics.counter(f"serving.{outcome}",
+                        f"requests ending {outcome}").inc()
+        if diagnose:
+            self._diagnose_failure(seq, reason)
+        if self.journal is not None:
+            self.journal.terminal(seq.rid, outcome, reason)
+        req = seq.request
+        end = time.perf_counter()
+        request_timeline.current().record(
+            rid=seq.rid, prompt_tokens=seq.prompt_len,
+            new_tokens=seq.n_generated,
+            phases_ms={k: v * 1e3 for k, v in seq.phase_s.items()},
+            total_ms=(end - seq.t_submit) * 1e3,
+            ttft_ms=((seq.t_first_token - seq.t_submit) * 1e3
+                     if seq.t_first_token is not None else None),
+            preemptions=seq.preemptions, outcome=outcome, error=reason,
+            deadline_ms=(None if req.deadline_s is None
+                         else req.deadline_s * 1e3))
+        self._gauges()
+
+    def _diagnose_failure(self, seq: Sequence, reason: str) -> None:
+        from ..analysis.jaxpr_lint import Diagnostic, emit
+        d = Diagnostic(
+            rule="F003", name="serving-request-failed", severity="warning",
+            message=f"request {seq.rid!r} failed after "
+                    f"{seq.n_generated} token(s): {reason}",
+            hint="the failure is isolated to this request; the engine "
+                 "loop continues and its blocks were reclaimed",
+            where="serving.engine")
+        self.diagnostics.append(d)
+        # Operational finding — forced warn so it is visible even with
+        # FLAGS_static_analysis=off (same contract as F001).
+        emit([d], where="serving.engine", mode="warn")
+
+    def _account_spill(self, delta_bytes: int) -> None:
+        self._spilled_bytes = max(0, self._spilled_bytes + delta_bytes)
+        metrics.gauge("serving.spilled_bytes",
+                      "bytes of preempted KV held in the host tier").set(
+                          self._spilled_bytes)
+
+    def _expire_deadlines(self) -> None:
+        """Cancel every live sequence past its deadline — iteration
+        granularity, measured from TRUE submission time (``t_submit`` is
+        never rewritten by preemption)."""
+        now = time.perf_counter()
+        live = list(self.sched.waiting) + list(self.sched.running)
+        for seq in live:
+            d = seq.request.deadline_s
+            if d is not None and now - seq.t_submit > d:
+                self._cancel(seq, Status.EXPIRED,
+                             f"deadline {d * 1e3:.0f}ms exceeded "
+                             f"({(now - seq.t_submit) * 1e3:.0f}ms elapsed)")
+
+    def _apply_shed_policy(self) -> None:
+        """One policy consult per iteration: set ``mode``, shed at most
+        one request (lowest-priority/youngest, waiting first), and in
+        degraded mode compute the shrunken decode-bucket cap."""
+        pol = self.shed_policy
+        if pol is None:
+            return
+        usable = self.cache.num_blocks - 1
+        free_frac = self.cache.allocator.n_free / usable if usable else 0.0
+        p99 = percentile(list(self._decode_ms), 99)
+        why = pol.overloaded(free_frac, p99)
+        if why is None:
+            self.mode = "healthy"
+            self._degraded_width = None
+            return
+        self.mode = "degraded" if pol.degrade else "shedding"
+        metrics.counter("serving.overload_iterations",
+                        "iterations spent in shed/degraded mode").inc()
+        # degrade mode preserves residents (they get a smaller bucket);
+        # pure shed mode may drop running work to free blocks
+        victim = self.sched.shed_candidate(waiting_only=pol.degrade)
+        if victim is not None:
+            self._cancel(victim, Status.SHED, f"load shed: {why}")
+        if pol.degrade and len(self.sched.running) > 1:
+            fit = self.decode_buckets.fit(len(self.sched.running))
+            smaller = [b for b in self.decode_buckets.sizes if b < fit]
+            self._degraded_width = smaller[-1] if smaller else 1
+
+    def _enforce_degraded_width(self) -> None:
+        """Degraded mode shrinks the active decode bucket: preempt the
+        youngest/lowest-priority residents (the normal LIFO spill path)
+        until the batch fits the smaller bucket."""
+        cap = self._degraded_width
+        if cap is None:
+            return
+        while len(self.sched.running) > cap:
+            victim = self.sched.preempt_victim()
+            if victim is None:
+                break
+            try:
+                self._preempt(victim)
+            except SpillError as e:
+                self._cancel(victim, Status.FAILED,
+                             f"KV spill failed: {e}", diagnose=True)
+
     # -- admission (prefill / restore) --------------------------------------
 
     def _try_admit(self) -> bool:
+        if self.mode != "healthy":
+            return False            # overload: pause fresh admissions
         seq = self.sched.peek_waiting()
         if seq is None or not self.sched.has_capacity():
             return False
         if seq.status is Status.PREEMPTED:
             n_need = int(seq.host_kv[0].shape[1])
-            ids = self.cache.allocator.alloc(n_need)
-            if ids is None:
-                return False
-            self.sched.admit(seq)
-            self._restore(seq, ids)
-            return True
-        n_need = _ceil_div(seq.prompt_len, self.block_size)
+        else:
+            n_need = _ceil_div(seq.prompt_len, self.block_size)
         ids = self.cache.allocator.alloc(n_need)
         if ids is None:
+            if not self.sched.running and self.cache.allocator.n_used == 0:
+                # an idle pool that still cannot grant the front request
+                # will never be able to: fail it (isolation), keep going
+                self._cancel(
+                    seq, Status.FAILED,
+                    f"needs {n_need} KV block(s), pool has only "
+                    f"{self.cache.allocator.n_free}", diagnose=True)
+                return True
             return False
         self.sched.admit(seq)
-        self._prefill(seq, ids)
+        try:
+            if seq.status is Status.RUNNING and seq.host_kv is not None:
+                self._restore(seq, ids)
+            else:
+                self._prefill(seq, ids)
+        except Exception as e:  # per-sequence device error: isolate it
+            if seq.block_ids:
+                # blocks granted this admission that _cancel would miss
+                extra = [i for i in ids if i not in seq.block_ids]
+            else:
+                seq.block_ids = list(ids)
+                extra = []
+            if extra:
+                self.cache.allocator.free(extra)
+            self._cancel(seq, Status.FAILED,
+                         f"{type(e).__name__}: {e}", diagnose=True)
         return True
 
     def _prefill(self, seq: Sequence, block_ids: List[int]) -> None:
         now = time.perf_counter()
-        seq.add_phase("queue", now - seq.t_submit)
+        seq.add_phase("queue", now - seq.t_enqueue)
         bucket = self.prefill_buckets.fit(seq.prompt_len)
         nb_bucket = bucket // self.block_size
         ids = pad_axis(seq.request.prompt_ids[None, :], 1, bucket)
@@ -338,9 +545,11 @@ class ServingEngine:
 
     def _restore(self, seq: Sequence, ids: List[int]) -> None:
         now = time.perf_counter()
-        seq.add_phase("queue", now - seq.t_submit)
+        seq.add_phase("queue", now - seq.t_enqueue)
         self.cache.restore(seq.host_kv, ids)
         seq.host_kv = None
+        self._account_spill(-seq.spilled_bytes)
+        seq.spilled_bytes = 0
         seq.block_ids = list(ids)
         seq.block_log.append(-1)  # spill/restore boundary
         seq.block_log.extend(ids)
@@ -349,10 +558,14 @@ class ServingEngine:
 
     def _preempt(self, seq: Sequence) -> None:
         self.sched.preempt(seq)
+        n_blocks = len(seq.block_ids)
         seq.host_kv = self.cache.spill(seq.block_ids)
         seq.block_ids = []
-        # queue time for the preempted span restarts now
-        seq.t_submit = time.perf_counter()
+        seq.spilled_bytes = n_blocks * self.cache.bytes_per_block
+        self._account_spill(seq.spilled_bytes)
+        # queue time for the preempted span restarts now; t_submit stays
+        # the TRUE arrival so latency + deadlines measure end to end
+        seq.t_requeue = time.perf_counter()
         metrics.counter("serving.preemptions",
                         "sequences preempted for KV capacity").inc()
 
@@ -360,8 +573,10 @@ class ServingEngine:
 
     def _ensure_decode_blocks(self) -> None:
         """Every running sequence needs a real block for position
-        ctx_len before the next iteration; preempt (youngest first) to
-        make room."""
+        ctx_len before the next iteration; preempt (lowest-priority,
+        youngest first) to make room. Pool exhaustion with nothing left
+        to preempt fails *that* sequence (F003) — :class:`OutOfBlocksError`
+        never crosses the engine loop."""
         for seq in list(self.sched.running):
             if seq.status is not Status.RUNNING:
                 continue
@@ -374,12 +589,19 @@ class ServingEngine:
                     continue
                 victim = self.sched.preempt_victim(exclude=seq)
                 if victim is None:
-                    raise OutOfBlocksError(
-                        f"sequence {seq.rid!r} needs a block and there is "
-                        "nothing left to preempt — pool too small for one "
-                        "sequence (constructor validation should have "
-                        "caught this)")
-                self._preempt(victim)
+                    err = OutOfBlocksError(
+                        f"sequence {seq.rid!r} needs block "
+                        f"{len(seq.block_ids) + 1} of {needed} and there "
+                        "is nothing left to preempt — the request "
+                        "outgrew the pool")
+                    self._cancel(seq, Status.FAILED, str(err),
+                                 diagnose=True)
+                    break
+                try:
+                    self._preempt(victim)
+                except SpillError as e:
+                    self._cancel(victim, Status.FAILED,
+                                 f"KV spill failed: {e}", diagnose=True)
 
     def _decode_iteration(self) -> List[Sequence]:
         batch = self.sched.iteration_batch()
@@ -404,7 +626,12 @@ class ServingEngine:
         out, k2, v2 = self._decode_fn(*args)
         out = np.asarray(out)  # host sync per iteration (token commit)
         self.cache.swap(k2, v2)
+        # Drill seam: a kill here lands AFTER the iteration's compute but
+        # BEFORE any token is committed/acknowledged — the relaunch must
+        # replay every in-flight request from scratch, exactly once.
+        _fault_fire("serve.mid_decode")
         dur = time.perf_counter() - t0
+        self._decode_ms.append(dur * 1e3)
         metrics.histogram("serving.decode_step_ms",
                           "decode iteration wall time (ms)").observe(
                               dur * 1e3)
@@ -428,6 +655,10 @@ class ServingEngine:
             seq.block_ids = []
         out = seq.full_output()
         seq.output = out
+        # Acknowledge BEFORE detokenize/record: once the journal holds the
+        # done record (fsynced), a relaunch will not replay this request.
+        if self.journal is not None:
+            self.journal.done(seq.rid, seq.out_tokens)
         if self.detokenizer is not None:
             seq.text = self.detokenizer(out)
         end = time.perf_counter()
@@ -440,18 +671,25 @@ class ServingEngine:
             new_tokens=seq.n_generated,
             phases_ms={k: v * 1e3 for k, v in seq.phase_s.items()},
             total_ms=total_ms, ttft_ms=ttft_ms,
-            preemptions=seq.preemptions)
+            preemptions=seq.preemptions, outcome="ok",
+            deadline_ms=(None if seq.request.deadline_s is None
+                         else seq.request.deadline_s * 1e3))
 
     # ------------------------------------------------------------------
     # Driving loop
     # ------------------------------------------------------------------
 
     def step(self) -> List[Sequence]:
-        """One scheduler iteration: admit whatever fits (prefill /
-        restore at token granularity), top up decode blocks (preempting
-        under pressure), run one decode iteration. Returns the sequences
-        that finished (including 1-token requests done at admission)."""
+        """One scheduler iteration: expire deadlines, consult the shed
+        policy, admit whatever fits (prefill / restore at token
+        granularity), top up decode blocks (preempting under pressure),
+        run one decode iteration. Returns every sequence that reached a
+        terminal state this iteration — FINISHED, and also EXPIRED /
+        SHED / FAILED retirements."""
         n0 = len(self.sched.finished)
+        self._expire_deadlines()
+        self._apply_shed_policy()
+        self._enforce_degraded_width()
         while self._try_admit():
             pass
         self._ensure_decode_blocks()
@@ -460,21 +698,26 @@ class ServingEngine:
         return self.sched.finished[n0:]
 
     def serve(self, requests: Seq[Request],
-              respect_arrivals: bool = False) -> Dict[str, Sequence]:
+              respect_arrivals: bool = False
+              ) -> Dict[str, Union[Sequence, Rejected]]:
         """Drive the full trace to completion; returns rid -> Sequence
-        (with ``.output`` / ``.text``). ``respect_arrivals`` replays each
-        request's ``arrival_s`` offset instead of submitting everything
-        up front."""
+        (with ``.output`` / ``.text`` — check ``.status`` for the
+        EXPIRED/SHED/FAILED endings) or the :class:`Rejected` answer for
+        requests bounded admission refused. ``respect_arrivals`` replays
+        each request's ``arrival_s`` offset instead of submitting
+        everything up front."""
         order = sorted(requests, key=lambda r: r.arrival_s) \
             if respect_arrivals else list(requests)
         t0 = time.perf_counter()
         idx = 0
-        done: Dict[str, Sequence] = {}
+        done: Dict[str, Union[Sequence, Rejected]] = {}
         while idx < len(order) or self.sched.n_pending:
             now = time.perf_counter() - t0
             while idx < len(order) and (
                     not respect_arrivals or order[idx].arrival_s <= now):
-                self.submit(order[idx])
+                res = self.submit(order[idx])
+                if isinstance(res, Rejected):
+                    done[res.rid] = res
                 idx += 1
             if not self.sched.n_pending:
                 if idx < len(order) and respect_arrivals:

@@ -35,18 +35,30 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..fault.injection import fire as _fault_fire
 from ..framework.offload import host_memory_kind
 from ..observability import metrics
 
 __all__ = ["BlockAllocator", "PagedKVCache", "NULL_BLOCK",
-           "OutOfBlocksError"]
+           "OutOfBlocksError", "SpillError"]
 
-# Block id every padded block-table slot points at (reserved at init).
+# Block id every padded table slot points at (reserved at init).
 NULL_BLOCK = 0
 
 
 class OutOfBlocksError(RuntimeError):
-    """The pool cannot satisfy an allocation even after preemption."""
+    """The pool cannot satisfy an allocation even after preemption.
+
+    The engine treats this as a **per-request** failure (the sequence that
+    needed the block ends FAILED with an F003 Diagnostic); it never
+    crosses the engine loop."""
+
+
+class SpillError(RuntimeError):
+    """A host-spill allocation/transfer failed. Surfaced per-request: the
+    engine fails the victim sequence (freeing its device blocks) instead
+    of crashing the serving loop — host memory pressure costs one
+    request's work, not the process."""
 
 
 class BlockAllocator:
@@ -164,14 +176,29 @@ class PagedKVCache:
     def spill(self, block_ids: Sequence[int]) -> Tuple:
         """Gather ``block_ids`` to host and free them. Returns the opaque
         host KV pair :meth:`restore` takes; the device blocks are
-        reusable immediately after."""
+        reusable immediately after.
+
+        A host allocation/transfer failure raises :class:`SpillError`
+        (the blocks stay allocated — the caller owns the cleanup); the
+        ``serve.mid_spill`` fire point lets the fault drill kill or
+        perturb the process inside the spill window, before the blocks
+        are freed."""
         ids = jnp.asarray(list(block_ids), jnp.int32)
-        k_host = self._to_host(_gather_blocks(self.k, ids))
-        v_host = self._to_host(_gather_blocks(self.v, ids))
-        if self.host_kind is not None:
-            # Host commit must complete before the blocks are handed out
-            # again — a donated overwrite racing the D2H would tear the copy.
-            jax.block_until_ready((k_host, v_host))
+        try:
+            k_host = self._to_host(_gather_blocks(self.k, ids))
+            v_host = self._to_host(_gather_blocks(self.v, ids))
+            _fault_fire("serve.mid_spill")
+            if self.host_kind is not None:
+                # Host commit must complete before the blocks are handed
+                # out again — a donated overwrite racing the D2H would
+                # tear the copy.
+                jax.block_until_ready((k_host, v_host))
+        except SpillError:
+            raise
+        except (RuntimeError, MemoryError, ValueError) as e:
+            raise SpillError(
+                f"host spill of {len(block_ids)} block(s) failed: {e}"
+            ) from e
         self.allocator.free(list(block_ids))
         metrics.counter("serving.kv_spills",
                         "sequence KV spills to host memory").inc()

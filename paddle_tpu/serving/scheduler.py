@@ -12,6 +12,19 @@ spilled to host) — the one with the least sunk prefill work and the
 shortest spill payload — then resumed, at the front of the queue, when
 capacity returns.
 
+Resilience semantics (the overload half of the Orca/vLLM story) live in
+the same state machine: the waiting deque can be **bounded**
+(``max_waiting`` — the engine answers over-budget submissions with a
+typed :class:`~paddle_tpu.serving.resilience.Rejected` instead of
+growing the queue forever), every request can carry a **deadline** and a
+**priority**, and three more terminal states exist beyond ``FINISHED``:
+``EXPIRED`` (deadline passed — cancelled at iteration granularity),
+``SHED`` (dropped by the overload policy), and ``FAILED`` (a
+per-request device/capacity error isolated to that request). Victim
+selection for both preemption and shedding is lowest-priority-first with
+the original LIFO (youngest) tie-break, so equal-priority traffic
+behaves exactly as before.
+
 This module is pure host-side bookkeeping (queues and state machines);
 the engine executes the device work and reports back. Everything is
 deterministic under a fixed submission order — no wall-clock policy
@@ -28,7 +41,8 @@ import numpy as np
 
 from collections import deque
 
-__all__ = ["Request", "Sequence", "Status", "FCFSScheduler"]
+__all__ = ["Request", "Sequence", "Status", "FCFSScheduler",
+           "TERMINAL_STATUSES"]
 
 
 class Status(enum.Enum):
@@ -36,6 +50,15 @@ class Status(enum.Enum):
     RUNNING = "running"
     PREEMPTED = "preempted"
     FINISHED = "finished"
+    EXPIRED = "expired"      # deadline passed; cancelled, blocks reclaimed
+    SHED = "shed"            # dropped by the overload policy
+    FAILED = "failed"        # per-request error, isolated from the loop
+
+
+#: Terminal states a sequence can end in (everything but the three live
+#: queue states). ``finished`` holds all of them, in retirement order.
+TERMINAL_STATUSES = frozenset(
+    {Status.FINISHED, Status.EXPIRED, Status.SHED, Status.FAILED})
 
 
 @dataclass
@@ -47,6 +70,8 @@ class Request:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     arrival_s: float = 0.0          # offset into the trace (replay traces)
+    deadline_s: Optional[float] = None  # SLO: finish within this of submit
+    priority: int = 0               # higher = kept longer under overload
 
     def __post_init__(self):
         self.prompt_ids = np.asarray(self.prompt_ids, np.int32).reshape(-1)
@@ -55,6 +80,9 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(f"request {self.rid!r}: max_new_tokens "
                              f"{self.max_new_tokens}")
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"request {self.rid!r}: deadline_s "
+                             f"{self.deadline_s}")
 
 
 @dataclass
@@ -67,18 +95,30 @@ class Sequence:
     out_tokens: List[int] = field(default_factory=list)
     block_ids: List[int] = field(default_factory=list)
     host_kv: Any = None                  # spilled KV while PREEMPTED
+    spilled_bytes: int = 0               # host bytes held while PREEMPTED
     preemptions: int = 0
+    error: Optional[str] = None          # reason for a non-FINISHED ending
     # every block id ever assigned, in grant order (spill boundaries as
     # -1): the determinism regression's witness
     block_log: List[int] = field(default_factory=list)
-    # phase accounting (engine-stamped, seconds)
+    # phase accounting (engine-stamped, seconds). ``t_submit`` is the TRUE
+    # arrival time and is never rewritten; ``t_requeue`` restarts the
+    # queue-phase clock on preemption so end-to-end latency (and the
+    # deadline check) still measure from submission.
     t_submit: float = 0.0
+    t_requeue: Optional[float] = None
     t_first_token: Optional[float] = None
     phase_s: Dict[str, float] = field(default_factory=dict)
 
     @property
     def rid(self) -> str:
         return self.request.rid
+
+    @property
+    def t_enqueue(self) -> float:
+        """Start of the current wait span: the last preemption requeue if
+        one happened, else the original submission."""
+        return self.t_requeue if self.t_requeue is not None else self.t_submit
 
     @property
     def prompt_len(self) -> int:
@@ -102,17 +142,35 @@ class Sequence:
 
 
 class FCFSScheduler:
-    """Arrival-order admission, LIFO preemption, iteration batches."""
+    """Arrival-order admission, LIFO preemption, iteration batches.
 
-    def __init__(self, max_batch: int):
+    ``max_waiting`` bounds the waiting deque: :meth:`can_accept` is the
+    admission-control gate the engine consults before :meth:`submit` —
+    when full, the engine answers with a typed ``Rejected`` (429-style
+    backpressure) instead of queueing unboundedly. ``None`` keeps the
+    historical unbounded behavior.
+    """
+
+    def __init__(self, max_batch: int, max_waiting: Optional[int] = None):
         if max_batch < 1:
             raise ValueError(f"max_batch {max_batch}")
+        if max_waiting is not None and max_waiting < 1:
+            raise ValueError(f"max_waiting {max_waiting}")
         self.max_batch = int(max_batch)
+        self.max_waiting = None if max_waiting is None else int(max_waiting)
         self.waiting: Deque[Sequence] = deque()
         self.running: List[Sequence] = []   # admission order
         self.finished: List[Sequence] = []
 
     # -- queue transitions ---------------------------------------------------
+
+    def can_accept(self) -> bool:
+        """Room in the bounded waiting queue (preempted residents do not
+        count against it — they were already admitted once)."""
+        if self.max_waiting is None:
+            return True
+        fresh = sum(1 for s in self.waiting if s.status is Status.WAITING)
+        return fresh < self.max_waiting
 
     def submit(self, seq: Sequence) -> None:
         seq.status = Status.WAITING
@@ -133,10 +191,31 @@ class FCFSScheduler:
 
     def preempt_victim(self, exclude: Optional[Sequence] = None
                        ) -> Optional[Sequence]:
-        """Youngest running sequence other than ``exclude`` (LIFO)."""
-        for seq in reversed(self.running):
-            if seq is not exclude:
-                return seq
+        """Lowest-priority running sequence other than ``exclude``,
+        youngest (LIFO) within a priority class — with the default
+        priority 0 everywhere this is exactly the historical LIFO pick."""
+        best: Optional[Sequence] = None
+        for seq in reversed(self.running):      # youngest first
+            if seq is exclude:
+                continue
+            if best is None or seq.request.priority < best.request.priority:
+                best = seq
+        return best
+
+    def shed_candidate(self, waiting_only: bool = False
+                       ) -> Optional[Sequence]:
+        """The cheapest work to drop under overload: lowest priority,
+        youngest within the class; waiting work first (no or least sunk
+        device work), then — unless ``waiting_only`` (degrade mode keeps
+        residents and shrinks their bucket instead) — running."""
+        pools = [list(self.waiting)]
+        if not waiting_only:
+            pools.append(self.running)
+        for pool in pools:
+            if pool:
+                # max t_submit = youngest
+                return min(pool, key=lambda s: (s.request.priority,
+                                                -s.t_submit))
         return None
 
     def preempt(self, seq: Sequence) -> None:
@@ -148,8 +227,22 @@ class FCFSScheduler:
         self.waiting.appendleft(seq)
 
     def finish(self, seq: Sequence) -> None:
-        self.running.remove(seq)
-        seq.status = Status.FINISHED
+        self.retire(seq, Status.FINISHED)
+
+    def retire(self, seq: Sequence, status: Status) -> None:
+        """Move ``seq`` from whichever live queue holds it into a terminal
+        state — the one exit used by normal completion, deadline expiry,
+        load shedding, and per-request failure isolation alike."""
+        if status not in TERMINAL_STATUSES:
+            raise ValueError(f"retire to non-terminal status {status}")
+        if seq in self.running:
+            self.running.remove(seq)
+        else:
+            try:
+                self.waiting.remove(seq)
+            except ValueError:
+                pass  # already out of both queues (e.g. failed mid-admit)
+        seq.status = status
         self.finished.append(seq)
 
     # -- iteration view ------------------------------------------------------

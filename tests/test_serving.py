@@ -1,11 +1,14 @@
 """Serving-tier tests: paged allocator invariants, spill/restore bitwise
 round trip, deterministic block assignment, continuous-batching engine
 vs model.generate (token-exact), bucketed-compile budget, request
-timeline, and the declared serving plan through plan_check.
+timeline, the declared serving plan through plan_check, and the
+resilience tier (ISSUE 9): deadlines, bounded admission, load shedding,
+per-request failure isolation, cancellation hygiene, and the
+exactly-once request journal.
 
 Everything runs on the CPU mesh with micro GPT configs — this file is
 the tier-1-safe quick serving gate (the full sweep lives in bench.py
-under BENCH_SERVE).
+under BENCH_SERVE; the subprocess kill drill in test_serve_drill.py).
 """
 
 import json
@@ -17,8 +20,9 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.observability import metrics, request_timeline
 from paddle_tpu.serving import (BlockAllocator, BucketSet, NULL_BLOCK,
-                                PagedKVCache, Request, ServingEngine,
-                                pow2_buckets)
+                                PagedKVCache, Rejected, Request,
+                                RequestJournal, ServingEngine, ShedPolicy,
+                                SpillError, Status, pow2_buckets)
 from paddle_tpu.text.models.gpt import GPTForCausalLM, gpt_tiny
 
 
@@ -251,6 +255,333 @@ class TestGQA:
         for r in requests:
             np.testing.assert_array_equal(results[r.rid].output,
                                           ref_generate(model, r))
+
+
+# ---------------------------------------------------------------------------
+# Resilience tier (ISSUE 9): deadlines, admission, shedding, isolation
+# ---------------------------------------------------------------------------
+
+def assert_allocator_pristine(engine):
+    """Cancellation hygiene: zero leaked blocks AND zero reserved-id
+    drift — the pool is indistinguishable from a fresh allocator."""
+    alloc = engine.cache.allocator
+    assert alloc.n_used == 0
+    assert alloc._reserved == frozenset({NULL_BLOCK})
+    n = alloc.num_blocks - 1
+    got = alloc.alloc(n)
+    assert got == list(range(1, n + 1)), got   # min-id list fully intact
+    alloc.free(got)
+
+
+class TestDeadlines:
+    def test_expired_requests_cancelled_clean(self):
+        model = micro_model()
+        engine = ServingEngine(model, block_size=4, num_blocks=32,
+                               max_batch=4)
+        metrics.reset_all()
+        rt = request_timeline.reset_default()
+        reqs = ragged_requests(3)
+        for r in reqs:
+            r.deadline_s = 1e-9          # unattainable: expire at step 1
+        results = engine.serve(reqs)
+        for r in reqs:
+            assert results[r.rid].status is Status.EXPIRED
+            assert "deadline" in results[r.rid].error
+        assert metrics.counter("serving.expired").get() == len(reqs)
+        assert_allocator_pristine(engine)
+        engine.sched.assert_idle()
+        recs = rt.records()
+        assert all(rec["outcome"] == "expired" and
+                   rec["deadline_met"] is False for rec in recs)
+        s = rt.summary()
+        assert s["slo_attainment_pct"] == 0.0
+        assert s["outcomes"] == {"expired": 3}
+
+    def test_generous_deadline_met_and_recorded(self):
+        model = micro_model()
+        engine = ServingEngine(model, block_size=4, num_blocks=32,
+                               max_batch=4)
+        rt = request_timeline.reset_default()
+        reqs = ragged_requests(2)
+        for r in reqs:
+            r.deadline_s = 300.0
+        results = engine.serve(reqs)
+        for r in reqs:
+            assert results[r.rid].status is Status.FINISHED
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        s = rt.summary()
+        assert s["slo_attainment_pct"] == 100.0
+        assert all(rec["deadline_met"] for rec in rt.records())
+
+    def test_preemption_keeps_true_submit_time(self):
+        """Satellite regression: _preempt must NOT rewrite t_submit —
+        end-to-end latency and the deadline check measure from true
+        submission, the queue phase restarts from t_requeue."""
+        model = micro_model(max_position_embeddings=32)
+        engine = ServingEngine(model, block_size=4, num_blocks=10,
+                               max_batch=4, max_seq_len=32)
+        reqs = ragged_requests(4, lo=8, hi=14, max_new=8, seed=1)
+        results = engine.serve(reqs)
+        preempted = [results[r.rid] for r in reqs
+                     if results[r.rid].preemptions > 0]
+        assert preempted, "trace was expected to preempt"
+        for seq in preempted:
+            assert seq.t_requeue is not None
+            assert seq.t_requeue > seq.t_submit
+            # TTFT can only be measured against the true arrival
+            assert seq.t_first_token > seq.t_submit
+
+
+class TestBoundedAdmission:
+    def test_queue_full_returns_typed_rejection(self):
+        model = micro_model()
+        engine = ServingEngine(model, block_size=4, num_blocks=32,
+                               max_batch=2, max_waiting=2)
+        metrics.reset_all()
+        rt = request_timeline.reset_default()
+        reqs = ragged_requests(6)
+        results = engine.serve(reqs)
+        rejected = {rid: r for rid, r in results.items()
+                    if isinstance(r, Rejected)}
+        served = {rid: r for rid, r in results.items()
+                  if not isinstance(r, Rejected)}
+        assert len(rejected) == 4 and len(served) == 2  # closed-loop trace
+        for rej in rejected.values():
+            assert rej.reason == "queue_full"
+            assert not rej                      # falsy by contract
+        for r in reqs:
+            if r.rid in served:
+                np.testing.assert_array_equal(served[r.rid].output,
+                                              ref_generate(model, r))
+        assert metrics.counter("serving.rejected").get() == 4
+        assert engine.rejections == list(rejected.values())
+        assert_allocator_pristine(engine)
+        s = rt.summary()
+        assert s["outcomes"] == {"ok": 2, "rejected": 4}
+        assert s["shed_rate"] == pytest.approx(4 / 6, abs=1e-3)
+
+    def test_preempted_resident_not_counted_against_queue(self):
+        """A preempted sequence re-queues at the front without consuming
+        a max_waiting slot — backpressure applies to NEW work only."""
+        from paddle_tpu.serving.scheduler import FCFSScheduler, Sequence
+        sched = FCFSScheduler(2, max_waiting=1)
+        a = Sequence(Request(rid="a", prompt_ids=np.ones(4, np.int32),
+                             max_new_tokens=2))
+        sched.submit(a)
+        sched.admit(a)
+        sched.preempt(a)
+        assert a.status is Status.PREEMPTED and len(sched.waiting) == 1
+        assert sched.can_accept()       # the preempted one doesn't count
+
+    def test_spill_budget_rejects(self):
+        model = micro_model(max_position_embeddings=32)
+        engine = ServingEngine(model, block_size=4, num_blocks=10,
+                               max_batch=4, max_seq_len=32,
+                               max_spilled_bytes=0)
+        # force some spill state, then submit against the zero budget
+        reqs = ragged_requests(4, lo=8, hi=14, max_new=8, seed=1)
+        for r in reqs:
+            engine.submit(r)
+        while not engine.sched.running or not any(
+                s.host_kv is not None for s in engine.sched.waiting):
+            if not engine.sched.n_pending:
+                pytest.skip("trace no longer preempts")
+            engine.step()
+        late = Request(rid="late", prompt_ids=np.ones(4, np.int32),
+                       max_new_tokens=2)
+        rej = engine.submit(late)
+        assert isinstance(rej, Rejected) and rej.reason == "spill_budget"
+        while engine.sched.n_pending:
+            engine.step()
+        assert_allocator_pristine(engine)
+
+
+class TestLoadShedding:
+    def test_sheds_lowest_priority_youngest_first(self):
+        model = micro_model()
+        engine = ServingEngine(
+            model, block_size=4, num_blocks=32, max_batch=4,
+            shed_policy=ShedPolicy(min_free_block_frac=2.0))  # always on
+        metrics.reset_all()
+        rng = np.random.default_rng(0)
+        reqs = [Request(rid=f"r{i}", prompt_ids=rng.integers(0, 128, 6),
+                        max_new_tokens=3, priority=(1 if i == 0 else 0))
+                for i in range(4)]
+        results = engine.serve(reqs)
+        assert all(results[r.rid].status is Status.SHED for r in reqs)
+        # shed order: lowest priority first, youngest within the class;
+        # the priority-1 request r0 survives longest
+        order = [s.rid for s in engine.sched.finished]
+        assert order == ["r3", "r2", "r1", "r0"]
+        assert metrics.counter("serving.shed").get() == 4
+        assert engine.mode == "shedding"
+        assert_allocator_pristine(engine)
+
+    def test_degraded_mode_shrinks_decode_bucket(self):
+        """p99-triggered degraded mode: the active decode bucket drops a
+        rung (youngest residents preempted through the normal LIFO spill
+        path) and the survivors still match generate token-exactly."""
+        model = micro_model(max_position_embeddings=32)
+        pol = ShedPolicy(max_p99_decode_ms=1e-6, degrade=True)
+        engine = ServingEngine(model, block_size=4, num_blocks=32,
+                               max_batch=4, max_seq_len=32,
+                               shed_policy=pol)
+        metrics.reset_all()
+        reqs = ragged_requests(4, lo=4, hi=8, max_new=6, seed=5)
+        results = engine.serve(reqs)
+        finished = [r for r in reqs
+                    if results[r.rid].status is Status.FINISHED]
+        shed = [r for r in reqs if results[r.rid].status is Status.SHED]
+        assert finished and shed          # degraded, not dead
+        for r in finished:
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        assert engine.mode == "degraded"
+        assert metrics.counter("serving.overload_iterations").get() > 0
+        assert_allocator_pristine(engine)
+
+    def test_healthy_policy_changes_nothing(self):
+        """An armed-but-never-tripped policy is bitwise inert: same
+        outputs, same block log as the bare engine."""
+        model = micro_model()
+        reqs = ragged_requests(3)
+
+        def run(policy):
+            eng = ServingEngine(model, block_size=4, num_blocks=32,
+                                max_batch=4, shed_policy=policy)
+            res = eng.serve(reqs)
+            return {r.rid: (res[r.rid].output.tolist(),
+                            res[r.rid].block_log) for r in reqs}
+
+        assert run(None) == run(ShedPolicy(min_free_block_frac=0.0))
+
+
+class TestFailureIsolation:
+    def test_pool_exhaustion_fails_request_not_engine(self):
+        """The acceptance-criterion scenario: a request that outgrows the
+        pool mid-decode ends FAILED (F003) and every other request is
+        served token-exact — OutOfBlocksError never crosses the loop."""
+        model = micro_model(max_position_embeddings=64)
+        engine = ServingEngine(model, block_size=4, num_blocks=6,
+                               max_batch=2, validate_capacity=False)
+        metrics.reset_all()
+        rng = np.random.default_rng(2)
+        grower = Request(rid="grower", prompt_ids=rng.integers(0, 128, 16),
+                         max_new_tokens=8)    # 24 tokens > 5 usable blocks
+        small = Request(rid="small", prompt_ids=rng.integers(0, 128, 4),
+                        max_new_tokens=3)
+        results = engine.serve([grower, small])
+        assert results["grower"].status is Status.FAILED
+        assert "nothing left to preempt" in results["grower"].error
+        np.testing.assert_array_equal(results["small"].output,
+                                      ref_generate(model, small))
+        assert metrics.counter("serving.failed").get() == 1
+        assert [d.rule for d in engine.diagnostics] == ["F003"]
+        assert_allocator_pristine(engine)
+
+    def test_impossible_admission_fails_request(self):
+        """A prompt the idle pool can never grant fails at admission
+        instead of deadlocking the serve loop."""
+        model = micro_model(max_position_embeddings=64)
+        engine = ServingEngine(model, block_size=4, num_blocks=4,
+                               max_batch=2, validate_capacity=False)
+        rng = np.random.default_rng(3)
+        big = Request(rid="big", prompt_ids=rng.integers(0, 128, 20),
+                      max_new_tokens=4)      # needs 5 blocks, pool has 3
+        small = Request(rid="small", prompt_ids=rng.integers(0, 128, 4),
+                        max_new_tokens=2)
+        results = engine.serve([big, small])
+        assert results["big"].status is Status.FAILED
+        assert results["small"].status is Status.FINISHED
+        assert_allocator_pristine(engine)
+
+    def test_spill_error_isolated_to_victim(self):
+        """An injected host-spill failure (the serve.mid_spill seam —
+        same mechanism the drill SIGKILLs through) fails only the spill
+        victim; everyone else is served token-exact."""
+        from paddle_tpu.fault.injection import register_fire_point
+        model = micro_model(max_position_embeddings=32)
+        engine = ServingEngine(model, block_size=4, num_blocks=10,
+                               max_batch=4, max_seq_len=32)
+        metrics.reset_all()
+        reqs = ragged_requests(4, lo=8, hi=14, max_new=8, seed=1)
+        state = {"n": 0}
+
+        def bomb():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise SpillError("injected host allocation failure")
+
+        register_fire_point("serve.mid_spill", bomb)
+        try:
+            results = engine.serve(reqs)
+        finally:
+            register_fire_point("serve.mid_spill", None)
+        assert state["n"] >= 1, "trace was expected to spill"
+        failed = [r for r in reqs if results[r.rid].status is Status.FAILED]
+        ok = [r for r in reqs if results[r.rid].status is Status.FINISHED]
+        assert len(failed) == 1
+        assert "KV spill failed" in results[failed[0].rid].error
+        for r in ok:
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        assert_allocator_pristine(engine)
+
+
+class TestRequestJournal:
+    def test_exactly_once_round_trip(self, tmp_path):
+        model = micro_model()
+        path = str(tmp_path / "journal.jsonl")
+        engine = ServingEngine(model, block_size=4, num_blocks=32,
+                               max_batch=2, journal=RequestJournal(path))
+        reqs = ragged_requests(3)
+        results = engine.serve(reqs)
+        replay = RequestJournal(path)
+        rids = [r.rid for r in reqs]
+        report = replay.exactly_once_report(rids)
+        assert report["exactly_once"] and report["launches"] == 1
+        assert replay.pending_rids(rids) == []
+        outs = replay.done_outputs()
+        for r in reqs:
+            prompt = r.prompt_ids.tolist()
+            assert prompt + outs[r.rid] == results[r.rid].output.tolist()
+
+    def test_unacknowledged_requests_replay(self, tmp_path):
+        """Submitted-but-unacked state (what a mid-decode SIGKILL leaves
+        behind) is exactly the replay set; acked requests are not."""
+        path = str(tmp_path / "journal.jsonl")
+        j = RequestJournal(path)
+        j.launch()
+        for rid in ("a", "b", "c"):
+            j.submitted(Request(rid=rid, prompt_ids=np.ones(4, np.int32),
+                                max_new_tokens=2))
+        j.done("a", [5, 6])
+        j.terminal("b", "expired", "deadline")
+        j.close()
+        j2 = RequestJournal(path)
+        assert j2.pending_rids(["a", "b", "c"]) == ["c"]
+        report = j2.exactly_once_report(["a", "b", "c"])
+        assert report["lost"] == ["c"] and report["duplicated"] == []
+
+    def test_torn_tail_line_tolerated(self, tmp_path):
+        path = str(tmp_path / "journal.jsonl")
+        j = RequestJournal(path)
+        j.launch()
+        j.done("a", [1])
+        j.close()
+        with open(path, "a") as f:
+            f.write('{"event": "done", "rid": "b", "tok')  # torn by a kill
+        j2 = RequestJournal(path)
+        assert j2.acknowledged_rids() == {"a"}
+
+    def test_duplicate_ack_detected(self, tmp_path):
+        j = RequestJournal(str(tmp_path / "j.jsonl"))
+        j.done("a", [1])
+        j.done("a", [1])
+        report = j.exactly_once_report(["a"])
+        assert report["duplicated"] == ["a"]
+        assert not report["exactly_once"]
 
 
 # ---------------------------------------------------------------------------

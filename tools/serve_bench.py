@@ -21,6 +21,14 @@ budget check (distinct executable signatures vs registered buckets — the
 O001-silence criterion), preemption/spill counts, and per-phase totals.
 ``--json`` emits it as one machine-readable object on stdout;
 ``--timeline`` additionally writes the per-request JSONL records.
+
+Resilience / SLO gating: ``--deadline-ms`` stamps every request with a
+deadline (per-trace ``deadline_s`` fields win), the report then carries
+``slo_attainment_pct`` (fraction of deadline-carrying requests answered
+in time) and ``shed_rate``; ``--fail-on-slo <pct>`` exits nonzero when
+attainment lands below the target — the CI gate
+``tests/test_serve_drill.py`` runs. ``--max-waiting`` bounds admission
+(rejected requests count against the SLO).
 """
 
 import argparse
@@ -78,10 +86,17 @@ def main(argv=None):
     p.add_argument("--sequential", action="store_true",
                    help="max_batch=1: the sequential (still KV-cached) "
                         "baseline")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-request deadline applied to the whole trace "
+                        "(per-record deadline_s fields win)")
+    p.add_argument("--fail-on-slo", type=float, default=None, metavar="PCT",
+                   help="exit nonzero when SLO attainment < PCT")
     # engine knobs
     p.add_argument("--block-size", type=int, default=4)
     p.add_argument("--num-blocks", type=int, default=64)
     p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--max-waiting", type=int, default=None,
+                   help="bounded admission: reject past this queue depth")
     # model knobs (tiny CPU-mesh GPT by default)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--hidden", type=int, default=64)
@@ -104,11 +119,15 @@ def main(argv=None):
     trace = load_trace(args.trace, args.seed, args.vocab) if args.trace \
         else synth_trace(args.requests, args.seed, args.vocab,
                          args.prompt_lo, args.prompt_hi, args.max_new)
+    default_deadline = (args.deadline_ms / 1e3
+                        if args.deadline_ms is not None else None)
     requests = [Request(rid=r["rid"],
                         prompt_ids=np.asarray(r["prompt"], np.int32),
                         max_new_tokens=int(r["max_new_tokens"]),
                         eos_token_id=r.get("eos_token_id"),
-                        arrival_s=float(r.get("arrival_s", 0.0)))
+                        arrival_s=float(r.get("arrival_s", 0.0)),
+                        deadline_s=r.get("deadline_s", default_deadline),
+                        priority=int(r.get("priority", 0)))
                 for r in trace]
 
     paddle.seed(args.seed)
@@ -120,7 +139,8 @@ def main(argv=None):
     rt = request_timeline.reset_default()
     eng = ServingEngine(model, block_size=args.block_size,
                         num_blocks=args.num_blocks,
-                        max_batch=1 if args.sequential else args.max_batch)
+                        max_batch=1 if args.sequential else args.max_batch,
+                        max_waiting=args.max_waiting)
     say(f"replaying {len(requests)} request(s) through "
         f"{'sequential' if args.sequential else 'continuous-batching'} "
         f"engine (blocks {args.num_blocks}x{args.block_size}, "
@@ -143,6 +163,9 @@ def main(argv=None):
         "phases": summary["phases"],
         "preemptions": summary["preemptions"],
         "kv_spills": metrics.counter("serving.kv_spills").get(),
+        "outcomes": summary["outcomes"],
+        "slo_attainment_pct": summary["slo_attainment_pct"],
+        "shed_rate": summary["shed_rate"],
         "compile_report": eng.compile_report(),
         "mode": "sequential" if args.sequential else "continuous",
     }
@@ -164,7 +187,19 @@ def main(argv=None):
               f"{len(cr['prefill_buckets'])} buckets, decode "
               f"{cr['decode_signatures']}/{len(cr['decode_buckets'])} "
               f"buckets, O001 fired: {cr['o001_fired']}")
-    return 1 if report["compile_report"]["o001_fired"] else 0
+        if report["slo_attainment_pct"] is not None:
+            print(f"slo attainment    {report['slo_attainment_pct']}% "
+                  f"(shed rate {report['shed_rate']}, "
+                  f"outcomes {report['outcomes']})")
+    if report["compile_report"]["o001_fired"]:
+        return 1
+    if (args.fail_on_slo is not None
+            and (report["slo_attainment_pct"] is None
+                 or report["slo_attainment_pct"] < args.fail_on_slo)):
+        say(f"SLO attainment {report['slo_attainment_pct']}% below the "
+            f"--fail-on-slo target {args.fail_on_slo}%")
+        return 2
+    return 0
 
 
 if __name__ == "__main__":
