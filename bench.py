@@ -41,7 +41,8 @@ at the top-3 ResNet byte shapes + the full-graph ResNet step with
 FLAGS_pallas_conv=1 — the table VERDICT r5 asks the next chip round for),
 BENCH_TELEMETRY=0 (skip the telemetry overhead A/B), BENCH_TRACE_OUT
 (path for the run's step-timeline JSONL, default BENCH_timeline.jsonl —
-render with tools/trace_view.py), BENCH_SERVE=0 (skip the serving-engine
+render with tools/trace_view.py), BENCH_MULTISLICE=0 (skip the 2-slice
+hierarchical-vs-flat DCN reduction dryrun), BENCH_SERVE=0 (skip the serving-engine
 sweep; BENCH_SERVE_REQUESTS/MAX_NEW/LAYERS/HIDDEN/HEADS/VOCAB size it —
 continuous batching vs the sequential one-shot Predictor on one ragged
 trace, concurrency sweep, compile-budget/O001 gate; emits
@@ -1034,6 +1035,130 @@ def bench_comm_overlap(small: bool):
         f"vs off {results['off']['loss']}")
 
 
+def bench_multislice(small: bool):
+    """The multi-slice tier (FLAGS_multislice, distributed/multislice):
+    a 2-slice x 4-device dryrun on the CPU mesh — the hierarchical
+    (ICI reduce-scatter -> DCN allreduce on the 1/ici shard -> ICI
+    all-gather) TrainStep vs the naive flat per-axis psum baseline, with
+    BITWISE loss parity asserted every step, the per-link hop-plan table
+    emitted, and `multislice_dcn_bytes_per_step` measured from the
+    declared plan (== bucket_bytes / ici_size; the flat plan's DCN bytes
+    are the full bucket and comm_check C004 flags it). Chipless by
+    design: the next chip round is a flag flip on a real 2-slice mesh."""
+    import jax
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.analysis import comm_check
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.distributed.multislice import (HierarchicalGradReducer,
+                                                   SliceTopology)
+    from paddle_tpu.distributed.topology import set_hybrid_mesh
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    n_dev = jax.device_count()
+    if n_dev < 4:
+        print(json.dumps({
+            "metric": "multislice_dcn_bytes_per_step", "value": 0.0,
+            "unit": "bytes",
+            "extra": {"skipped": "needs >=4 devices for the 2-slice mesh",
+                      "devices": n_dev}}), flush=True)
+        return
+    dp = 4 if n_dev >= 8 else n_dev // 2
+    topo = SliceTopology(2, dp=dp)
+    hidden = 64 if small else 128
+    steps = 3 if small else 5
+    cfg = GPTConfig(vocab_size=128, hidden_size=hidden, num_layers=2,
+                    num_heads=4, max_position_embeddings=64,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    use_flash_attention=False)
+
+    def loss_fn(m, p, b):
+        ids, labels = b
+        return functional_call(m, p, ids, labels, training=True)
+
+    rng = np.random.default_rng(0)
+    batches = [(jnp.asarray(rng.integers(0, 128, (2 * 2 * dp, 32)),
+                            jnp.int32),) * 2 for _ in range(steps)]
+
+    prev = _flags.get_flags(["multislice"])
+    results = {}
+    try:
+        for mode in ("flat", "hierarchical"):
+            _flags.set_flags({"multislice": mode})
+            set_hybrid_mesh(topo.mesh)
+            paddle.seed(0)
+            ts = make_sharded_train_step(
+                GPTForCausalLM(cfg), AdamW(1e-3), loss_fn,
+                mesh=topo.mesh, fsdp_axis=None)
+            t0 = time.perf_counter()
+            losses = [float(ts.step(b)) for b in batches]
+            dt = (time.perf_counter() - t0) / steps
+            results[mode] = {"losses": losses,
+                             "step_ms": round(dt * 1e3, 3),
+                             "grads_bytes": sum(
+                                 int(v.size) * v.dtype.itemsize
+                                 for v in ts.params.values())}
+            set_hybrid_mesh(None)
+    finally:
+        _flags.set_flags(prev)
+        set_hybrid_mesh(None)
+
+    parity_bitwise = results["flat"]["losses"] == \
+        results["hierarchical"]["losses"]
+    # the declared hop plans (per link class) + the DCN-bytes metric
+    reducer = HierarchicalGradReducer(axis="dp", dcn_axis="slice")
+    grads = {f"g{i}": np.zeros((results["hierarchical"]["grads_bytes"]
+                                // 4,), np.float32) for i in range(1)}
+    rows = []
+    for mode in ("hierarchical", "flat"):
+        for spec in reducer.hop_plan(grads, topo.ici_size,
+                                     topo.num_slices, mode=mode):
+            rows.append({
+                "mode": mode, "stage": spec.name, "link": spec.link,
+                "axis": spec.axis, "hops": spec.hops,
+                "payload_mb": round(spec.payload_bytes / 2**20, 4),
+                "diagnostics": [d.rule for d in
+                                comm_check.check_comm_spec(spec)],
+            })
+    dcn_bytes = reducer.dcn_bytes_per_step(grads, topo.ici_size,
+                                           topo.num_slices)
+    flat_dcn = reducer.dcn_bytes_per_step(grads, topo.ici_size,
+                                          topo.num_slices, mode="flat")
+    c004_on_flat = any("C004" in r["diagnostics"] for r in rows
+                      if r["mode"] == "flat")
+    c004_on_hier = any("C004" in r["diagnostics"] for r in rows
+                      if r["mode"] == "hierarchical")
+    print(json.dumps({
+        "metric": "multislice_dcn_bytes_per_step", "value": dcn_bytes,
+        "unit": "bytes/rank (one direction)",
+        "extra": {
+            "mesh": {"slice": topo.num_slices, "dp": dp,
+                     "ici_size": topo.ici_size},
+            "modes": results,
+            "parity_bitwise": bool(parity_bitwise),
+            "flat_dcn_bytes_per_step": flat_dcn,
+            "dcn_reduction_factor": round(flat_dcn / max(dcn_bytes, 1),
+                                          2),
+            "hop_plan": rows,
+            "c004_fires_on_flat": bool(c004_on_flat),
+            "c004_silent_on_hierarchical": bool(not c004_on_hier),
+            "note": ("CPU-mesh wall times are not DCN-meaningful; the "
+                     "plan table and the parity are the chipless "
+                     "deliverable" if jax.default_backend() != "tpu"
+                     else "device-measured"),
+        }}), flush=True)
+    assert parity_bitwise, (
+        f"multislice parity failure: hierarchical losses "
+        f"{results['hierarchical']['losses']} vs flat "
+        f"{results['flat']['losses']}")
+    assert c004_on_flat and not c004_on_hier, (
+        "C004 must fire on the naive flat-over-DCN plan and stay silent "
+        "on the hierarchical one")
+
+
 def _gpt_measure(layers, hidden, heads, seq, batch, steps, remat, vocab):
     """Build + time one GPT train-step config under the anomaly guard.
 
@@ -1774,6 +1899,14 @@ def main():
             bench_comm_overlap(small)
         except Exception as e:
             print(json.dumps({"metric": "bench_comm_overlap_FAILED",
+                              "error": str(e)[:500]}), flush=True)
+    # multi-slice tier: 2-slice dryrun (hierarchical vs flat DP reduction,
+    # bitwise parity + per-link hop plans + DCN bytes/step — chipless)
+    if os.environ.get("BENCH_MULTISLICE", "1") != "0":
+        try:
+            bench_multislice(small)
+        except Exception as e:
+            print(json.dumps({"metric": "bench_multislice_FAILED",
                               "error": str(e)[:500]}), flush=True)
     # fault-tolerance drill: kill/relaunch/resume with measured goodput
     # (subprocesses on the CPU mesh — runs chipless, ~30s quick config)

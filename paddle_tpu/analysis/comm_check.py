@@ -12,11 +12,26 @@ Checked per :class:`CommSpec`:
   C001  decomposed volume exceeds the one-shot collective's ring volume
         by more than the tolerance — the rewrite must overlap, never
         re-send (a mis-scheduled ring re-transfers chunks)      [error]
-  C002  per-hop payload under the latency floor — hop setup time
+  C002  per-hop payload under the ICI latency floor — hop setup time
         dominates and the pipeline is slower than the fused
         collective regardless of overlap                        [warning]
-  C003  per-hop ICI transfer time exceeds the hop's matmul compute —
+  C003  per-hop link transfer time exceeds the hop's matmul compute —
         the transfer cannot hide under compute at these shapes  [warning]
+  C004  a ``dcn``-class collective moves more than the post-reduce-
+        scatter 1/ici_size shard of the bucket it reduces — the naive
+        flat-allreduce-over-DCN blowup the hierarchical reduction
+        (``distributed/multislice``) exists to avoid             [error]
+  C005  per-hop DCN payload under the DCN latency floor — the
+        cross-slice RTT dominates the wire time at this bucket
+        size; grow FLAGS_multislice_dcn_bucket_mb               [warning]
+
+**Link classes.** Every spec carries a ``link`` class: ``ici`` (the
+within-slice torus, ~45 GB/s per direction) or ``dcn`` (the between-slice
+data-center network, ~6 GB/s per chip and orders of magnitude more
+latency). Mesh axes are classified by name through the :func:`dcn_axes`
+registry (``slice`` by default; ``SliceTopology`` registers its axis) —
+the same registry the jaxpr linter's J015 rule consults to flag
+collectives that cross a DCN-class axis inside a scan/decode inner loop.
 
 ``enforce`` routes through :func:`jaxpr_lint.emit` under
 ``FLAGS_static_analysis``, like the Pallas checker's kernel-entry hook —
@@ -33,27 +48,66 @@ from __future__ import annotations
 
 import contextlib
 from dataclasses import dataclass
-from typing import Iterator, List, Tuple
+from typing import FrozenSet, Iterator, List, Tuple
 
 from .jaxpr_lint import Diagnostic, ERROR, WARNING, emit
 
 __all__ = ["CommSpec", "check_comm_spec", "enforce", "record", "recording",
            "spec_for_allgather_matmul", "spec_for_matmul_reduce_scatter",
-           "spec_for_cp_ring",
-           "ICI_GBPS", "PEAK_TFLOPS", "HOP_LATENCY_FLOOR_BYTES"]
+           "spec_for_cp_ring", "spec_for_slice_reduce_scatter",
+           "spec_for_dcn_allreduce", "spec_for_slice_all_gather",
+           "dcn_axes", "register_dcn_axis", "link_class",
+           "ICI_GBPS", "DCN_GBPS", "PEAK_TFLOPS",
+           "HOP_LATENCY_FLOOR_BYTES", "DCN_HOP_LATENCY_FLOOR_BYTES"]
 
 # Per-direction, per-link ICI bandwidth (v5e 2D torus) and bf16 peak.
 ICI_GBPS = 45.0
 PEAK_TFLOPS = 197.0
+
+# Per-chip DCN bandwidth between pod slices (host NICs shared across the
+# slice's chips; assumed v5e-class figure — ~7x below one ICI direction).
+DCN_GBPS = 6.25
 
 # Below this per-hop payload the ~1us collective-permute setup latency
 # dominates the wire time (45 GB/s * 1us ≈ 45 KB); decomposing into such
 # hops loses to the fused collective even with perfect overlap.
 HOP_LATENCY_FLOOR_BYTES = 64 * 1024
 
+# DCN analog: cross-slice RTT is tens of microseconds through the data
+# center fabric (~40us x 6.25 GB/s ≈ 256 KB) — a DCN allreduce on buckets
+# under this is latency-bound; FLAGS_multislice_dcn_bucket_mb sizes the
+# hierarchical reducer's buckets well above it.
+DCN_HOP_LATENCY_FLOOR_BYTES = 256 * 1024
+
 # Decomposed volume may exceed the ring collective's by at most this
 # factor (slack for the odd-n asymmetric direction split).
 VOLUME_TOLERANCE = 1.25
+
+
+# ---------------------------------------------------------------------------
+# Mesh-axis link classes
+# ---------------------------------------------------------------------------
+
+# Axis names whose collectives cross the between-slice DCN rather than
+# the within-slice ICI torus. "slice" is the canonical multi-slice axis
+# (distributed/multislice.SliceTopology registers custom names here).
+_DCN_AXES = {"slice"}
+
+
+def dcn_axes() -> FrozenSet[str]:
+    """Mesh axis names currently classified as DCN-class links."""
+    return frozenset(_DCN_AXES)
+
+
+def register_dcn_axis(name: str) -> None:
+    """Classify a mesh axis name as a DCN-class link (consumed by the
+    C004/C005 budgets and the jaxpr linter's J015 inner-loop rule)."""
+    _DCN_AXES.add(str(name))
+
+
+def link_class(axis: str) -> str:
+    """"dcn" for registered DCN-class axes, else "ici"."""
+    return "dcn" if axis in _DCN_AXES else "ici"
 
 
 @dataclass
@@ -69,6 +123,17 @@ class CommSpec:
     chunks: int = 1        # sub-chunk count per hop matmul
     directions: int = 2    # concurrent ring directions (bidirectional ICI)
     axis: str = "mp"       # mesh axis the decomposed loop permutes over
+    link: str = "ici"      # link class the axis rides: "ici" | "dcn"
+    # Hierarchical-reduction accounting (distributed/multislice): the full
+    # pre-reduction bucket this stage's payload derives from, and the
+    # intra-slice reduce-scatter degree available upstream of it. A
+    # dcn-class stage whose payload is not the 1/ici_size shard of
+    # reduced_from_bytes is the flat-over-DCN blowup C004 catches.
+    reduced_from_bytes: int = 0
+    ici_size: int = 1
+    # One-direction per-rank payload crossing the link per step (the
+    # number the bench's multislice_dcn_bytes_per_step sums).
+    payload_bytes: int = 0
 
     @property
     def decomposed_bytes(self) -> int:
@@ -122,6 +187,58 @@ def spec_for_cp_ring(b: int, s_local: int, heads: int, head_dim: int,
         directions=1, axis=axis)
 
 
+# ---------------------------------------------------------------------------
+# Hierarchical (multi-slice) reduction stages
+# ---------------------------------------------------------------------------
+
+def spec_for_slice_reduce_scatter(bucket_bytes: int, ici_size: int,
+                                  axis: str = "dp") -> CommSpec:
+    """Stage 1 of the hierarchical DP reduction: the intra-slice ring
+    reduce-scatter of one flat grad bucket over the ICI data axis. Each
+    rank moves (n-1)/n of the bucket and ends owning a fully-reduced
+    1/n shard."""
+    n = max(ici_size, 1)
+    shard = -(-bucket_bytes // n)  # ceil: the padded shard
+    return CommSpec(
+        name="slice_reduce_scatter", axis_size=n, hops=max(n - 1, 0),
+        bytes_per_hop=shard, collective_bytes=max(n - 1, 0) * shard,
+        flops_per_hop=0, directions=1, axis=axis, link=link_class(axis),
+        reduced_from_bytes=bucket_bytes, ici_size=n,
+        payload_bytes=max(n - 1, 0) * shard)
+
+
+def spec_for_dcn_allreduce(shard_bytes: int, dcn_size: int,
+                           reduced_from_bytes: int, ici_size: int,
+                           axis: str = "slice") -> CommSpec:
+    """Stage 2: the inter-slice ring allreduce of the (already intra-slice
+    reduced) shard over the DCN axis. ``shard_bytes`` is what actually
+    crosses DCN per rank per direction — for the hierarchical plan it is
+    ``reduced_from_bytes / ici_size``; the naive flat plan puts the whole
+    bucket here and C004 fires."""
+    n = max(dcn_size, 1)
+    return CommSpec(
+        name="dcn_allreduce", axis_size=n, hops=2 * max(n - 1, 0),
+        bytes_per_hop=-(-shard_bytes // n) if n > 1 else shard_bytes,
+        collective_bytes=2 * max(n - 1, 0) * (-(-shard_bytes // n)),
+        flops_per_hop=0, directions=1, axis=axis, link=link_class(axis),
+        reduced_from_bytes=reduced_from_bytes, ici_size=max(ici_size, 1),
+        payload_bytes=shard_bytes)
+
+
+def spec_for_slice_all_gather(bucket_bytes: int, ici_size: int,
+                              axis: str = "dp") -> CommSpec:
+    """Stage 3: the intra-slice all-gather rebuilding the full reduced
+    bucket from the DCN-reduced shards — the reduce-scatter's mirror."""
+    n = max(ici_size, 1)
+    shard = -(-bucket_bytes // n)
+    return CommSpec(
+        name="slice_all_gather", axis_size=n, hops=max(n - 1, 0),
+        bytes_per_hop=shard, collective_bytes=max(n - 1, 0) * shard,
+        flops_per_hop=0, directions=1, axis=axis, link=link_class(axis),
+        reduced_from_bytes=bucket_bytes, ici_size=n,
+        payload_bytes=max(n - 1, 0) * shard)
+
+
 def check_comm_spec(spec: CommSpec) -> List[Diagnostic]:
     diags: List[Diagnostic] = []
     where = f"comm:{spec.name}"
@@ -138,7 +255,7 @@ def check_comm_spec(spec: CommSpec) -> List[Diagnostic]:
             where=where,
             hint="the hop schedule must deliver each chunk exactly once "
                  "per link direction (check the permutation tables)"))
-    if spec.bytes_per_hop < HOP_LATENCY_FLOOR_BYTES:
+    if spec.link == "ici" and spec.bytes_per_hop < HOP_LATENCY_FLOOR_BYTES:
         diags.append(Diagnostic(
             rule="C002", name="hop-below-latency-floor", severity=WARNING,
             message=(f"per-hop payload {spec.bytes_per_hop / 1024:.1f} KiB"
@@ -151,7 +268,8 @@ def check_comm_spec(spec: CommSpec) -> List[Diagnostic]:
     # One pipeline step moves bytes_per_hop on EACH link direction
     # concurrently while `directions` hop-matmuls execute: the transfer
     # that must hide is one link's, the compute hiding it is all of it.
-    hop_transfer_s = spec.bytes_per_hop / (ICI_GBPS * 1e9)
+    link_gbps = DCN_GBPS if spec.link == "dcn" else ICI_GBPS
+    hop_transfer_s = spec.bytes_per_hop / (link_gbps * 1e9)
     hop_compute_s = (spec.directions * spec.flops_per_hop /
                      (PEAK_TFLOPS * 1e12))
     if hop_compute_s > 0 and hop_transfer_s > hop_compute_s:
@@ -160,8 +278,8 @@ def check_comm_spec(spec: CommSpec) -> List[Diagnostic]:
             severity=WARNING,
             message=(f"one hop moves {spec.bytes_per_hop / 2**20:.2f} MiB"
                      f" (~{hop_transfer_s * 1e6:.1f} us on"
-                     f" {ICI_GBPS:.0f} GB/s ICI) but the concurrent"
-                     f" hop matmuls total only"
+                     f" {link_gbps:.0f} GB/s {spec.link.upper()}) but the"
+                     f" concurrent hop matmuls total only"
                      f" {spec.directions * spec.flops_per_hop / 1e9:.2f}"
                      f" GFLOP (~{hop_compute_s * 1e6:.1f} us at"
                      f" {PEAK_TFLOPS:.0f} TFLOP/s) — the transfer cannot"
@@ -170,6 +288,40 @@ def check_comm_spec(spec: CommSpec) -> List[Diagnostic]:
             hint="the layer is bandwidth-bound at this shape; expect the "
                  "decomposition to tie, not win — confirm on the device "
                  "A/B before enabling"))
+    if spec.link == "dcn" and spec.reduced_from_bytes > 0 \
+            and spec.ici_size > 1:
+        shard = -(-spec.reduced_from_bytes // spec.ici_size)
+        if spec.payload_bytes > VOLUME_TOLERANCE * shard:
+            diags.append(Diagnostic(
+                rule="C004", name="dcn-volume-blowup", severity=ERROR,
+                message=(f"{spec.payload_bytes / 2**20:.2f} MiB of a"
+                         f" {spec.reduced_from_bytes / 2**20:.2f} MiB"
+                         f" bucket crosses DCN per rank, but an intra-slice"
+                         f" reduce-scatter over {spec.ici_size} ICI ranks"
+                         f" would shrink the DCN payload to the"
+                         f" {shard / 2**20:.2f} MiB shard — the flat"
+                         " allreduce-over-DCN plan re-sends the whole"
+                         " bucket across the slow link"),
+                where=where,
+                hint="reduce hierarchically: intra-slice reduce-scatter ->"
+                     " DCN allreduce on the 1/ici shard -> intra-slice"
+                     " all-gather (distributed/multislice."
+                     "HierarchicalGradReducer, FLAGS_multislice="
+                     "hierarchical)"))
+    if spec.link == "dcn" and \
+            spec.bytes_per_hop < DCN_HOP_LATENCY_FLOOR_BYTES:
+        diags.append(Diagnostic(
+            rule="C005", name="dcn-hop-below-latency-floor",
+            severity=WARNING,
+            message=(f"per-hop DCN payload {spec.bytes_per_hop / 1024:.1f}"
+                     f" KiB is under the"
+                     f" {DCN_HOP_LATENCY_FLOOR_BYTES // 1024} KiB DCN"
+                     " latency floor — the cross-slice RTT dominates the"
+                     " wire time at this bucket size"),
+            where=where,
+            hint="grow the DCN bucket "
+                 "(FLAGS_multislice_dcn_bucket_mb) so fewer, larger "
+                 "buckets amortize the per-collective DCN latency"))
     return diags
 
 

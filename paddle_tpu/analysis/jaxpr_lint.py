@@ -703,6 +703,54 @@ def _rule_overlap_defeating(ctx: LintContext):
                          "BucketedGradReducer)")
 
 
+def _collective_axes(eqn) -> List[str]:
+    """Named mesh axes a collective equation operates over."""
+    axes: List[str] = []
+    for key in ("axis_name", "axes"):
+        val = eqn.params.get(key)
+        if val is None:
+            continue
+        for a in (val if isinstance(val, (tuple, list)) else (val,)):
+            if isinstance(a, str):
+                axes.append(a)
+    return axes
+
+
+@register_rule("J015", "dcn-collective-in-loop", WARNING,
+               "a collective crossing a DCN-class mesh axis inside a "
+               "scan/while body — a cross-slice round trip per iteration")
+def _rule_dcn_collective_in_loop(ctx: LintContext):
+    """Multi-slice discipline (distributed/multislice): only the once-
+    per-step dp gradient reduction may cross the between-slice DCN; a
+    collective over a dcn-class axis (comm_check.dcn_axes — 'slice' by
+    default) inside a compiled loop body (a scan over layers, a decode
+    inner loop) pays the ~tens-of-microseconds cross-slice RTT every
+    iteration, serializing the loop on the slowest link in the system."""
+    from . import comm_check
+    dcn = comm_check.dcn_axes()
+    if not dcn:
+        return
+    rule = _RULES["J015"]
+    for info in ctx.eqns:
+        if info.loop_depth == 0 or \
+                info.eqn.primitive.name not in _COLLECTIVE_PRIMS:
+            continue
+        crossed = sorted(dcn.intersection(_collective_axes(info.eqn)))
+        if not crossed:
+            continue
+        yield _diag(
+            rule,
+            f"'{info.eqn.primitive.name}' over DCN-class axis "
+            f"{crossed[0]!r} inside a compiled loop body (depth "
+            f"{info.loop_depth}) — a cross-slice DCN round trip per "
+            "iteration",
+            info.eqn,
+            hint="hoist the collective out of the loop (reduce once per "
+                 "step), or keep the inner loop's collectives on ICI "
+                 "axes and reduce across slices hierarchically "
+                 "(distributed/multislice.HierarchicalGradReducer)")
+
+
 # ---------------------------------------------------------------------------
 # Entry points
 # ---------------------------------------------------------------------------

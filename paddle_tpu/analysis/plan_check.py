@@ -27,7 +27,7 @@ checks the whole composed step statically, on a CPU checkout:
   (D004).
 
 ``tools/lint_graph.py --matrix`` enumerates every supported combination
-of the five tier flags, builds each StepPlan on the 8-device virtual
+of the six tier flags, builds each StepPlan on the 8-device virtual
 mesh, and runs these checks plus ``comm_check`` and ``hbm_budget``
 against the composition. Rule catalog: ``analysis/RULES.md``.
 """
@@ -603,14 +603,16 @@ def enforce(plan: StepPlan, closed_jaxpr=None, *,
 # The tier-flag matrix (consumed by tools/lint_graph.py --matrix)
 # ---------------------------------------------------------------------------
 
-# The five flag-gated tiers and their supported values. Every combination
+# The six flag-gated tiers and their supported values. Every combination
 # is a supported composition; parts that cannot activate in a given
 # environment (e.g. the decomposed TP matmul on a legacy-jax multi-axis
-# mesh) gate themselves off at the call site, and the plan records what
-# was actually composed.
+# mesh, or the multislice reduction on a mesh without a 'slice' axis)
+# gate themselves off at the call site, and the plan records what was
+# actually composed.
 TIER_FLAGS: Tuple[Tuple[str, Tuple[Any, ...]], ...] = (
     ("offload_optimizer", ("off", "moments")),
     ("comm_overlap", ("off", "tp", "tp_zero", "all")),
+    ("multislice", ("off", "hierarchical")),
     ("cp_nested_ring", (False, True)),
     ("pallas_conv", (0, 1)),
     ("remat", (False, True)),
@@ -618,7 +620,7 @@ TIER_FLAGS: Tuple[Tuple[str, Tuple[Any, ...]], ...] = (
 
 
 def iter_tier_combos() -> Iterable[Dict[str, Any]]:
-    """Every supported combination of the five tier flags, stable order."""
+    """Every supported combination of the tier flags, stable order."""
     names = [n for n, _ in TIER_FLAGS]
     for values in itertools.product(*(v for _, v in TIER_FLAGS)):
         yield dict(zip(names, values))

@@ -183,6 +183,24 @@ define_flag("comm_overlap_bucket_mb", 25,
             "DP gradient bucket size in MiB for "
             "overlap.BucketedGradReducer (ref DataParallel "
             "comm_buffer_size default).")
+define_flag("multislice", "off",
+            "Multi-slice (cross-DCN) gradient-reduction tier "
+            "(distributed/multislice): 'off' keeps the step on the "
+            "single-mesh GSPMD path (byte-identical — also the behavior "
+            "on meshes without a 'slice' axis); 'hierarchical' reduces "
+            "dp grads intra-slice (ICI reduce-scatter) -> inter-slice "
+            "(DCN allreduce on the 1/ici_size shard) -> intra-slice "
+            "(ICI all-gather); 'flat' is the naive per-axis flat-psum "
+            "baseline that moves the full bucket over DCN (bitwise "
+            "identical values; comm_check C004 flags its plan) — kept "
+            "as the measured A/B arm.",
+            choices=("off", "flat", "hierarchical"))
+define_flag("multislice_dcn_bucket_mb", 100,
+            "DCN gradient bucket size in MiB for "
+            "distributed/multislice.HierarchicalGradReducer — larger "
+            "than FLAGS_comm_overlap_bucket_mb because the cross-slice "
+            "latency floor (comm_check C005) is orders of magnitude "
+            "above ICI's.")
 define_flag("cp_nested_ring", False,
             "Run the manual ring-attention CP path even when nested "
             "inside an enclosing manual shard_map (the pipeline "

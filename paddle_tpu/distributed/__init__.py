@@ -27,6 +27,7 @@ from .objects import (all_gather_object, broadcast_object_list,  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import rpc  # noqa: F401
 from . import overlap  # noqa: F401,E402
+from . import multislice  # noqa: F401,E402
 from . import sharding  # noqa: F401,E402
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401,E402
 from . import utils  # noqa: F401,E402

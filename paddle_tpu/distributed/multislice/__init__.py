@@ -1,0 +1,23 @@
+"""Multi-slice (cross-DCN) scale-out tier.
+
+One pod slice is an ICI torus; a multi-slice job joins several over the
+data-center network. This package makes the two link classes explicit:
+
+- :class:`~.topology.SliceTopology` — the 2-tier mesh with an outermost
+  ``slice`` axis, per-axis link classes, and per-slice local views;
+- :class:`~.reducer.HierarchicalGradReducer` — the intra-slice
+  reduce-scatter → inter-slice DCN allreduce → intra-slice all-gather
+  gradient reduction (DCN moves 1/ici_size of each bucket), with buckets
+  sized per link class and every stage declared to
+  ``analysis.comm_check`` (rules C004/C005).
+
+``framework.sharded.TrainStep`` consumes both behind
+``FLAGS_multislice=off|flat|hierarchical``; ``tools/lint_graph.py
+--model multislice`` and the ``BENCH_MULTISLICE`` bench leg verify and
+measure the composition chiplessly on the CPU mesh.
+"""
+
+from .reducer import HierarchicalGradReducer
+from .topology import SLICE_AXIS, SliceTopology
+
+__all__ = ["SliceTopology", "HierarchicalGradReducer", "SLICE_AXIS"]

@@ -1,0 +1,129 @@
+"""Slice-aware 2-tier topology: one mesh, two link classes.
+
+A multi-slice TPU job spans several pod slices joined by the data-center
+network (DCN): within a slice every mesh axis rides the ICI torus; between
+slices only the DCN exists — orders of magnitude less bandwidth and more
+latency per chip. The standard recipe (SCALING.md §"Beyond one pod
+slice") keeps every high-volume axis (mp/sep/sharding, and the intra-
+slice part of dp) inside a slice and lets exactly one collective class
+cross DCN: the once-per-step data-parallel gradient reduction, reduced
+hierarchically (``.reducer.HierarchicalGradReducer``).
+
+:class:`SliceTopology` builds that structure explicitly: an **outermost**
+``slice`` axis over :func:`~..topology.create_hybrid_mesh` (outermost =
+the largest device strides, so the slice blocks are contiguous device
+ranges — the innermost placement ``extra_axes`` used to get would stripe
+cross-slice traffic onto ICI-adjacent strides), classifies every axis as
+``ici`` or ``dcn``, and exposes the per-slice local view. Constructing
+one registers the slice axis with ``analysis.comm_check``'s DCN-axis
+registry, which feeds the C004/C005 budgets and the J015 inner-loop
+lint.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..topology import AXIS_ORDER, create_hybrid_mesh
+
+__all__ = ["SliceTopology", "SLICE_AXIS"]
+
+# Canonical name of the between-slice (DCN) mesh axis.
+SLICE_AXIS = "slice"
+
+
+class SliceTopology:
+    """The 2-tier mesh of a multi-slice job.
+
+    ``num_slices`` pod slices, each carrying the usual hybrid axes
+    (``pp``/``dp``/``sharding``/``sep``/``mp``) on ICI; the ``slice``
+    axis is outermost so each slice owns a contiguous block of the
+    device enumeration. Axis degrees are per slice (``dp=4`` means 4
+    data-parallel ranks *inside each slice*).
+    """
+
+    def __init__(self, num_slices: int, dp: int = 1, mp: int = 1,
+                 pp: int = 1, sharding: int = 1, sep: int = 1,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 slice_axis: str = SLICE_AXIS):
+        if num_slices < 1:
+            raise ValueError(f"num_slices must be >= 1, got {num_slices}")
+        self.slice_axis = str(slice_axis)
+        if self.slice_axis in AXIS_ORDER:
+            raise ValueError(
+                f"slice axis name {self.slice_axis!r} collides with the "
+                f"hybrid axis order {AXIS_ORDER}")
+        self.mesh = create_hybrid_mesh(
+            dp=dp, mp=mp, pp=pp, sharding=sharding, sep=sep,
+            devices=devices, extra_axes={self.slice_axis: num_slices},
+            extra_axes_position="outer")
+        from ...analysis import comm_check
+        comm_check.register_dcn_axis(self.slice_axis)
+
+    # -- sizes -------------------------------------------------------------
+
+    @property
+    def num_slices(self) -> int:
+        return int(self.mesh.shape[self.slice_axis])
+
+    @property
+    def ici_size(self) -> int:
+        """Devices per slice (the intra-slice reduce-scatter degree)."""
+        return int(np.prod([self.mesh.shape[a] for a in self.mesh.axis_names
+                            if a != self.slice_axis]))
+
+    # -- link classes ------------------------------------------------------
+
+    def link_class(self, axis: str) -> str:
+        """"dcn" for the slice axis, "ici" for every within-slice axis."""
+        if axis not in self.mesh.axis_names:
+            raise KeyError(f"unknown mesh axis {axis!r}; "
+                           f"axes: {self.mesh.axis_names}")
+        return "dcn" if axis == self.slice_axis else "ici"
+
+    def link_classes(self) -> Dict[str, str]:
+        return {str(a): self.link_class(a) for a in self.mesh.axis_names}
+
+    def dcn_axes(self) -> List[str]:
+        return [a for a in self.mesh.axis_names
+                if self.link_class(a) == "dcn"]
+
+    def ici_axes(self) -> List[str]:
+        return [a for a in self.mesh.axis_names
+                if self.link_class(a) == "ici"]
+
+    # -- per-slice views ---------------------------------------------------
+
+    def slice_devices(self, slice_id: int) -> List[jax.Device]:
+        """The contiguous device block of one slice, in mesh order."""
+        if not 0 <= slice_id < self.num_slices:
+            raise IndexError(f"slice_id {slice_id} out of range "
+                             f"[0, {self.num_slices})")
+        return list(self.mesh.devices[slice_id].ravel())
+
+    def slice_id(self, device: jax.Device) -> int:
+        """Which slice a device belongs to (its index on the slice axis)."""
+        pos = np.argwhere(self.mesh.devices == device)
+        if pos.size == 0:
+            raise KeyError(f"device {device} is not in the mesh")
+        return int(pos[0][0])
+
+    def local_mesh(self, slice_id: int) -> Mesh:
+        """One slice's ICI-only mesh: the same hybrid axes minus the
+        slice axis, over that slice's contiguous device block."""
+        block = self.mesh.devices[slice_id]
+        names = tuple(a for a in self.mesh.axis_names
+                      if a != self.slice_axis)
+        return Mesh(block, axis_names=names)
+
+    def describe(self) -> str:
+        parts = [f"{a}={int(self.mesh.shape[a])}[{self.link_class(a)}]"
+                 for a in self.mesh.axis_names]
+        return ", ".join(parts)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"SliceTopology({self.describe()})"

@@ -639,13 +639,33 @@ class BucketedGradReducer:
             gs = [grads[n] for n in names]
             flat = self._flatten(gs)
             if op == "reduce_scatter":
-                red = lax.psum_scatter(flat, self.axis, tiled=True)
-                red = lax.all_gather(red, self.axis, tiled=True)
+                red = self._psum_scatter_gather(flat)
             else:
                 red = lax.psum(flat, self.axis)
             for n, g in zip(names, self._unflatten(red, gs)):
                 out[n] = g
         return out
+
+    def _psum_scatter_gather(self, flat: jax.Array,
+                             axis_size: Optional[int] = None) -> jax.Array:
+        """``psum_scatter`` + ``all_gather`` of one flat bucket, padded:
+        ``lax.psum_scatter(tiled=True)`` requires the bucket length to
+        divide the axis size, but ``bucketize`` produces arbitrary
+        lengths — pad with zeros to the next multiple, slice back after
+        the gather. Values are bitwise-identical to a plain ``psum`` (the
+        zero tail reduces separately and is dropped)."""
+        if axis_size is None:
+            axis_size = lax.psum(1, self.axis)
+        n = int(axis_size)
+        pad = (-int(flat.size)) % n
+        if pad:
+            padded = jnp.concatenate(
+                [flat, jnp.zeros((pad,), flat.dtype)])
+        else:
+            padded = flat
+        red = lax.psum_scatter(padded, self.axis, tiled=True)
+        red = lax.all_gather(red, self.axis, tiled=True)
+        return red[:flat.size] if pad else red
 
     def reduce_stacked(self, grads: Dict[str, jax.Array],
                        mean: bool = False) -> Dict[str, jax.Array]:

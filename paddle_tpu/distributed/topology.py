@@ -34,14 +34,33 @@ AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")
 def create_hybrid_mesh(dp: int = 1, mp: int = 1, pp: int = 1,
                        sharding: int = 1, sep: int = 1,
                        devices: Optional[Sequence[jax.Device]] = None,
-                       extra_axes: Optional[Dict[str, int]] = None) -> Mesh:
+                       extra_axes: Optional[Dict[str, int]] = None,
+                       extra_axes_position: str = "inner") -> Mesh:
     """Build the hybrid mesh. Degrees must multiply to the device count
-    (a degree of -1 is inferred)."""
+    (a degree of -1 is inferred).
+
+    ``extra_axes_position`` places the extra axes relative to
+    :data:`AXIS_ORDER`: ``"inner"`` (default) appends them after ``mp``
+    — innermost, ICI-adjacent device strides, right for an extra
+    high-bandwidth axis (e.g. ``ep``); ``"outer"`` prepends them before
+    ``pp`` — outermost, the largest device strides, required for a
+    between-slice axis (``slice``) whose traffic crosses DCN: placed
+    innermost it would map cross-slice collectives onto the strides the
+    device enumeration reserves for ICI neighbours.
+    """
     devices = list(devices if devices is not None else jax.devices())
     degrees = {"pp": pp, "dp": dp, "sharding": sharding, "sep": sep, "mp": mp}
     if extra_axes:
         degrees.update(extra_axes)
-    names = list(AXIS_ORDER) + [a for a in (extra_axes or {}) if a not in AXIS_ORDER]
+    if extra_axes_position not in ("inner", "outer"):
+        raise ValueError(
+            f"extra_axes_position must be 'inner' or 'outer', got "
+            f"{extra_axes_position!r}")
+    extras = [a for a in (extra_axes or {}) if a not in AXIS_ORDER]
+    if extra_axes_position == "outer":
+        names = extras + list(AXIS_ORDER)
+    else:
+        names = list(AXIS_ORDER) + extras
     sizes = [degrees[n] for n in names]
     n_dev = len(devices)
     if -1 in sizes:

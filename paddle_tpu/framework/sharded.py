@@ -30,6 +30,7 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .functional import functional_call, get_buffers, get_params
@@ -161,6 +162,14 @@ class TrainStep:
         self._fsdp_axis = fsdp_axis if (
             fsdp_axis is not None and fsdp_axis in mesh.axis_names
             and mesh.shape[fsdp_axis] > 1) else None
+        # FLAGS_multislice=flat|hierarchical: explicit 2-tier dp gradient
+        # reduction over a slice-aware mesh (distributed/multislice) — the
+        # grad computation moves into a shard_map over {slice, dp} and the
+        # reduction is issued by the declared reducer instead of GSPMD.
+        # Inert (byte-identical step) without a >1 'slice' axis.
+        self._multislice = self._resolve_multislice(mesh)
+        if self._multislice is not None and "slice" not in self.data_axes:
+            self.data_axes = ("slice",) + tuple(self.data_axes)
         # FLAGS_comm_overlap=tp_zero|all: ZeRO-3 gather-ahead — per-block
         # param all-gathers issued ahead of the consuming block's compute
         # (distributed/overlap.zero_gather_ahead), instead of GSPMD's
@@ -218,7 +227,7 @@ class TrainStep:
         self._threads_buffers = n_args >= 4
         from ..core.random import rng_scope
 
-        def step(params, opt_state, buffers, batch, lr, key):
+        def plain_grads(params, buffers, batch, key):
             def loss_of(p):
                 # Gather-ahead INSIDE the differentiated fn: the
                 # constraint transpose re-scatters the cotangents, so
@@ -234,6 +243,60 @@ class TrainStep:
 
             (loss, new_buffers), grads = jax.value_and_grad(
                 loss_of, has_aux=True)(params)
+            return loss, grads, new_buffers
+
+        def multislice_grads(params, buffers, batch, key):
+            # The multi-slice grad path: per-device local loss/grads in a
+            # shard_map over the data axes, grads reduced by the declared
+            # 2-tier reducer (FLAGS_multislice=flat keeps the naive
+            # full-bucket-over-DCN plan as the A/B arm; both modes are
+            # bitwise-identical in values). Params are replicated over the
+            # manual {slice, dp} axes — fsdp/gather-ahead do not compose
+            # here (gated in _resolve_multislice).
+            mode, manual, reducer, world = self._multislice
+
+            def local_fn(p, bufs, b, k):
+                def loss_of(pp):
+                    with rng_scope(k):
+                        if self._threads_buffers:
+                            return lf(model_obj, pp, bufs, b)
+                        return lf(model_obj, pp, b), bufs
+
+                (loss, newb), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(p)
+                grads = reducer.reduce_in_axes(grads, mode=mode)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * jnp.asarray(1.0 / world, g.dtype), grads)
+                loss = lax.psum(loss, manual) * jnp.asarray(
+                    1.0 / world, loss.dtype)
+                if self._threads_buffers:
+                    newb = jax.tree_util.tree_map(
+                        lambda x: lax.psum(x, manual) * jnp.asarray(
+                            1.0 / world, x.dtype), newb)
+                return loss, grads, newb
+
+            data_spec = tuple(a for a in self.data_axes
+                              if a in mesh.axis_names
+                              and mesh.shape[a] > 1 and a in manual)
+            repl_tree = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+                lambda _: P(), tree)
+            batch_specs = jax.tree_util.tree_map(
+                lambda x: P(data_spec if len(data_spec) > 1
+                            else (data_spec[0] if data_spec else None),
+                            *([None] * (jnp.ndim(x) - 1))), batch)
+            fn = _overlap.shard_map_compat(
+                local_fn, mesh,
+                (repl_tree(params), repl_tree(buffers), batch_specs, P()),
+                (P(), repl_tree(params), repl_tree(buffers)),
+                manual)
+            return fn(params, buffers, batch, key)
+
+        compute_grads = (multislice_grads if self._multislice is not None
+                         else plain_grads)
+
+        def step(params, opt_state, buffers, batch, lr, key):
+            loss, grads, new_buffers = compute_grads(params, buffers,
+                                                     batch, key)
             from ..amp import debugging as _dbg
             if _dbg.enabled():  # FLAGS_check_nan_inf (ref nan_inf_utils.h:38)
                 _dbg.check_numerics(loss, "loss", where="train_step")
@@ -248,17 +311,8 @@ class TrainStep:
             return loss, new_params, new_state, new_buffers
 
         def grad_step(params, buffers, batch, key):
-            def loss_of(p):
-                if self._gather_specs is not None:
-                    p = _overlap.zero_gather_ahead(
-                        p, self._gather_specs, mesh)
-                with rng_scope(key):
-                    if self._threads_buffers:
-                        return lf(model_obj, p, buffers, batch)
-                    return lf(model_obj, p, batch), buffers
-
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
+            loss, grads, new_buffers = compute_grads(params, buffers,
+                                                     batch, key)
             from ..amp import debugging as _dbg
             if _dbg.enabled():
                 _dbg.check_numerics(loss, "loss", where="train_step")
@@ -292,6 +346,40 @@ class TrainStep:
         # gather-ahead barrier chain, declared-vs-traced collectives).
         self.plan = self._build_plan(specs, params, donate)
 
+    def _resolve_multislice(self, mesh):
+        """Resolve ``FLAGS_multislice`` against this mesh. Returns
+        ``(mode, manual_axes, reducer, world)`` when the 2-tier grad path
+        is active, else ``None`` (flag off, or no >1 'slice' axis — the
+        step stays byte-identical to the single-mesh path)."""
+        from ..core.flags import flag
+        mode = str(flag("multislice"))
+        if mode == "off" or "slice" not in mesh.axis_names \
+                or mesh.shape["slice"] <= 1:
+            return None
+        if self._fsdp_axis is not None:
+            raise ValueError(
+                "FLAGS_multislice does not compose with fsdp param "
+                "sharding yet: params must be replicated over the manual "
+                "{slice, dp} axes (pass fsdp_axis=None or a size-1 "
+                "sharding degree)")
+        if "dp" not in mesh.axis_names:
+            raise ValueError(
+                "FLAGS_multislice needs a 'dp' axis for the intra-slice "
+                f"reduce-scatter; mesh axes: {mesh.axis_names}")
+        manual = ("slice", "dp")
+        others = [a for a in mesh.axis_names
+                  if a not in manual and mesh.shape[a] > 1]
+        if others and not hasattr(jax, "shard_map"):
+            raise ValueError(
+                "FLAGS_multislice on legacy jax requires every non-data "
+                f"mesh axis at degree 1 (got >1 on {others}); the "
+                "partial-auto composition needs the maintained "
+                "jax.shard_map API")
+        from ..distributed.multislice import HierarchicalGradReducer
+        reducer = HierarchicalGradReducer(axis="dp", dcn_axis="slice")
+        world = int(mesh.shape["slice"]) * int(mesh.shape["dp"])
+        return mode, manual, reducer, world
+
     def _build_plan(self, specs, params, donate):
         """Assemble the StepPlan from the decisions made above: one node
         per dispatch-level sub-program, the gather-ahead ordering plan,
@@ -303,6 +391,8 @@ class TrainStep:
                 "offload_optimizer": ("moments" if self._offload is not None
                                       else "off"),
                 "comm_overlap": _overlap.overlap_mode(),
+                "multislice": (self._multislice[0]
+                               if self._multislice is not None else "off"),
                 "gather_ahead": self._gather_specs is not None,
                 "donate": bool(donate) and self._offload is None,
             },
@@ -312,6 +402,38 @@ class TrainStep:
             params={n: plan_check.ParamInfo(
                 tuple(int(d) for d in params[n].shape), specs[n])
                 for n in params})
+        if self._multislice is not None:
+            # The in-step 2-tier reduction as declared sub-nodes (the
+            # stages live inside the compiled step — no donations among
+            # them; the CommSpecs the reducer enforces at trace time fill
+            # plan.comm_specs via trace_step's recording, which is what
+            # the S001/S002 declared-vs-traced rules verify).
+            mode = self._multislice[0]
+            plan.nodes.append(plan_check.PlanNode(
+                "multislice_local_grads",
+                reads=("params", "buffers", "batch"),
+                writes=("grads_local",)))
+            if mode == "hierarchical":
+                plan.nodes.extend([
+                    plan_check.PlanNode("multislice_reduce_scatter[ici]",
+                                        reads=("grads_local",),
+                                        writes=("grads_shard",)),
+                    plan_check.PlanNode("multislice_allreduce[dcn]",
+                                        reads=("grads_shard",),
+                                        writes=("grads_shard",)),
+                    plan_check.PlanNode("multislice_all_gather[ici]",
+                                        reads=("grads_shard",),
+                                        writes=("grads",)),
+                ])
+            else:
+                plan.nodes.extend([
+                    plan_check.PlanNode("multislice_flat_allreduce[ici]",
+                                        reads=("grads_local",),
+                                        writes=("grads_full",)),
+                    plan_check.PlanNode("multislice_flat_allreduce[dcn]",
+                                        reads=("grads_full",),
+                                        writes=("grads",)),
+                ])
         if self._offload is not None:
             # grad-only compiled step (params NOT donated — the streaming
             # update consumes and donates them per block right after)

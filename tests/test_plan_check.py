@@ -364,5 +364,7 @@ def test_matrix_json_subset(capsys):
 
 def test_tier_combo_enumeration_is_complete():
     combos = list(plan_check.iter_tier_combos())
-    assert len(combos) == 2 * 4 * 2 * 2 * 2
+    # offload x comm_overlap x multislice x cp_ring x pallas_conv x remat
+    assert len(combos) == 2 * 4 * 2 * 2 * 2 * 2
     assert len({tuple(sorted(c.items())) for c in combos}) == len(combos)
+    assert {c["multislice"] for c in combos} == {"off", "hierarchical"}

@@ -209,6 +209,45 @@ def test_repo_lint_clean_over_serving_tier():
     assert errors == [], [d.format() for d in errors]
 
 
+def test_repo_lint_clean_over_multislice_tier():
+    """The multi-slice tier sources (distributed/multislice/, the
+    link-class comm_check extension) pass the repo source rules."""
+    from paddle_tpu.analysis import repo_lint
+    diags = repo_lint.lint_tree(REPO, subdir=os.path.join(
+        "paddle_tpu", "distributed", "multislice"))
+    diags += repo_lint.lint_file(
+        os.path.join(REPO, "paddle_tpu", "analysis", "comm_check.py"),
+        os.path.join("paddle_tpu", "analysis", "comm_check.py"))
+    errors = [d for d in diags if d.severity == "error"]
+    assert errors == [], [d.format() for d in errors]
+
+
+def test_multislice_model_in_lint_graph_catalog():
+    """`tools/lint_graph.py --model multislice` exists; the hierarchical
+    2-tier TrainStep and its declared hop plan lint with zero errors, and
+    the C004 self-test (the naive flat-over-DCN plan must fire) passes."""
+    from tools import lint_graph
+    from paddle_tpu.core import flags
+    assert "multislice" in lint_graph.MODELS
+    diags, n_eqns = lint_graph.MODELS["multislice"]()
+    assert n_eqns > 0, "the multislice step must trace on the 2-slice mesh"
+    errors = [d for d in diags if d.severity == "error"]
+    assert errors == [], [d.format() for d in errors]
+    assert "J015" not in {d.rule for d in diags}, \
+        "the hierarchical reduction must not trip the rule it motivated"
+    assert flags.flag("multislice") == "off", \
+        "the lint model must restore FLAGS_multislice"
+
+
+def test_multislice_flags_registered():
+    from paddle_tpu.core import flags
+    import pytest as _pytest
+    assert flags.flag("multislice") in ("off", "flat", "hierarchical")
+    with _pytest.raises(ValueError):
+        flags.set_flags({"multislice": "everything"})
+    assert int(flags.flag("multislice_dcn_bucket_mb")) > 0
+
+
 def test_serving_model_in_lint_graph_catalog():
     """`tools/lint_graph.py --model serving` exists; the bucketed
     prefill/decode executables and the declared dispatch plan lint with

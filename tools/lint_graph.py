@@ -12,12 +12,13 @@ Exits nonzero when any error-severity diagnostic is found — the CI gate
 that needs no TPU. Clean models print their diagnostic count (0) and the
 jaxpr size, so regressions in graph hygiene show up in review.
 
-``--matrix`` enumerates every supported combination of the five tier
-flags (offload_optimizer × comm_overlap × cp_nested_ring × pallas_conv ×
-remat), builds each composition's StepPlan on the 8-device virtual mesh,
+``--matrix`` enumerates every supported combination of the six tier
+flags (offload_optimizer × comm_overlap × multislice × cp_nested_ring ×
+pallas_conv × remat), builds each composition's StepPlan on the 8-device
+virtual mesh,
 and verifies it with ``analysis/plan_check`` (sharding-flow S-rules +
 donation-lifetime D-rules) + ``analysis/comm_check`` hop plans +
-``tools/hbm_budget.py`` capacity — then runs the nine multichip dryrun
+``tools/hbm_budget.py`` capacity — then runs the ten multichip dryrun
 scenarios (skipped with a note on legacy jax, where they cannot trace).
 ``--json`` switches stdout to one machine-readable report for CI.
 """
@@ -214,9 +215,108 @@ def lint_serving():
     return diags, n_eqns
 
 
+def _multislice_micro_step(mode: str = "hierarchical"):
+    """A tiny GPT TrainStep on the 2-slice x 4-device virtual mesh with
+    the 2-tier grad reduction active (shared by --model multislice and
+    the --matrix multislice component)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.multislice import SliceTopology
+    from paddle_tpu.distributed.topology import set_hybrid_mesh
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.optimizer import AdamW
+    from paddle_tpu.text.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    dp = 4 if jax.device_count() >= 8 else jax.device_count() // 2
+    topo = SliceTopology(2, dp=dp)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=32,
+                    hidden_dropout=0.0, attention_dropout=0.0,
+                    use_flash_attention=False)
+
+    def loss_fn(m, p, b):
+        ids, labels = b
+        return functional_call(m, p, ids, labels, training=True)
+
+    set_flags({"multislice": mode})
+    set_hybrid_mesh(topo.mesh)
+    ts = make_sharded_train_step(GPTForCausalLM(cfg), AdamW(1e-3), loss_fn,
+                                 mesh=topo.mesh, fsdp_axis=None)
+    ids = jnp.zeros((2 * dp, 16), jnp.int32)
+    return topo, ts, (ids, ids)
+
+
+def lint_multislice():
+    """The multi-slice tier (distributed/multislice): the hierarchical
+    2-tier TrainStep traced on the 2-slice virtual mesh through the jaxpr
+    linter (incl. J015 — the reduction must not put a DCN collective in a
+    loop body) and the S/D plan rules, the recorded hop plan through the
+    C-rules (C001-C005), plus a self-test that the naive flat-over-DCN
+    plan DOES fire C004 — the rule exists to catch exactly that plan."""
+    from paddle_tpu.analysis import comm_check, lint_jaxpr, plan_check
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.topology import set_hybrid_mesh
+
+    if jax.device_count() < 4:
+        print("  (skipped: needs >=4 devices for the 2-slice mesh; "
+              "run under the 8-device virtual CPU platform)")
+        return [], 0
+    try:
+        topo, ts, batch = _multislice_micro_step("hierarchical")
+        closed, donate = ts.trace_step(batch)
+        diags = lint_jaxpr(closed, donate_argnums=donate,
+                           where="multislice")
+        diags += plan_check.check_plan(ts.plan, closed,
+                                       donate_argnums=donate,
+                                       where="multislice")
+        for where, spec in ts.plan.comm_specs:
+            cd = comm_check.check_comm_spec(spec)
+            print(f"  comm spec {spec.name} [{spec.link}] axis="
+                  f"{spec.axis}: {spec.hops} hops x "
+                  f"{spec.bytes_per_hop / 1024:.1f} KiB, "
+                  f"{len(cd)} diagnostic(s)")
+            diags += [d for d in cd if d.severity == "error"]
+    finally:
+        set_flags({"multislice": "off"})
+        set_hybrid_mesh(None)
+    # production-shape hop plan: a 100 MiB DCN bucket over 2 slices of 64
+    # chips — every stage must clear the C002/C005 latency floors
+    bucket = 100 << 20
+    for spec in (comm_check.spec_for_slice_reduce_scatter(bucket, 64),
+                 comm_check.spec_for_dcn_allreduce(
+                     bucket // 64, 2, reduced_from_bytes=bucket,
+                     ici_size=64),
+                 comm_check.spec_for_slice_all_gather(bucket, 64)):
+        cd = comm_check.check_comm_spec(spec)
+        print(f"  production {spec.name} [{spec.link}]: "
+              f"{spec.payload_bytes / 2**20:.2f} MiB payload, "
+              f"{len(cd)} diagnostic(s)")
+        for d in cd:
+            print("    " + d.format())
+        diags += cd
+    # C004 self-test: the naive plan (full bucket over DCN) must fire
+    naive = comm_check.spec_for_dcn_allreduce(
+        bucket, 2, reduced_from_bytes=bucket, ici_size=64)
+    fired = [d for d in comm_check.check_comm_spec(naive)
+             if d.rule == "C004"]
+    print(f"  C004 on the naive flat-over-DCN plan: "
+          f"{'fires' if fired else 'MISSING'}")
+    if not fired:
+        from paddle_tpu.analysis.jaxpr_lint import Diagnostic
+        diags.append(Diagnostic(
+            rule="C004", name="dcn-volume-blowup", severity="error",
+            message="self-test: C004 did not fire on the naive "
+                    "flat-allreduce-over-DCN hop plan",
+            where="multislice"))
+    return diags, len(closed.jaxpr.eqns)
+
+
 MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp,
           "offload": lint_offload, "overlap": lint_overlap,
-          "fault": lint_fault, "serving": lint_serving}
+          "fault": lint_fault, "serving": lint_serving,
+          "multislice": lint_multislice}
 
 _SEV_RANK = {"info": 0, "warning": 1, "error": 2}
 
@@ -325,7 +425,7 @@ def _run_impl(models, with_kernels=False, with_repo=False,
 # --matrix: the tier-flag composition gate
 # ---------------------------------------------------------------------------
 
-# the five tier flags (analysis/plan_check.TIER_FLAGS): which parts of a
+# the six tier flags (analysis/plan_check.TIER_FLAGS): which parts of a
 # combination need a fresh step trace, vs. arithmetic-only component checks
 _TRACE_KEYS = ("offload_optimizer", "comm_overlap", "remat")
 
@@ -425,6 +525,36 @@ def _matrix_sp_pair_diags():
                    "eqns": len(closed.jaxpr.eqns)}
 
 
+def _matrix_multislice_diags():
+    """The multislice tier's composition check: the hierarchical 2-tier
+    TrainStep traced on the 2-slice virtual mesh and verified against its
+    declared StepPlan (S/D rules) + the recorded hop plan's C-rule
+    errors — the micro step of the main matrix sweep has no 'slice' axis,
+    so the tier is exercised here as a component (like the SP pair)."""
+    from paddle_tpu.analysis import comm_check, plan_check
+    from paddle_tpu.core.flags import set_flags
+    from paddle_tpu.distributed.topology import set_hybrid_mesh
+
+    if jax.device_count() < 4:
+        return [], {"skipped": "needs >= 4 devices"}
+    try:
+        topo, ts, batch = _multislice_micro_step("hierarchical")
+        closed, donate = ts.trace_step(batch)
+        diags = plan_check.check_plan(ts.plan, closed,
+                                      donate_argnums=donate,
+                                      where="matrix.multislice")
+        for _, spec in ts.plan.comm_specs:
+            diags += [d for d in comm_check.check_comm_spec(spec)
+                      if d.severity == "error"]
+        info = {"eqns": len(closed.jaxpr.eqns),
+                "dcn_axes": topo.dcn_axes(),
+                "comm_specs": len(ts.plan.comm_specs)}
+    finally:
+        set_flags({"multislice": "off"})
+        set_hybrid_mesh(None)
+    return diags, info
+
+
 def _matrix_cp_ring_diags():
     """Static hop accounting of the ring-CP tier at a long-context shape
     (S=32k over sep=4, GPT-1.3B heads): the arithmetic half of the
@@ -463,7 +593,7 @@ def _matrix_conv_diags():
 
 
 def run_dryruns():
-    """The nine multichip dryrun scenarios (__graft_entry__._dryrun_base)
+    """The ten multichip dryrun scenarios (__graft_entry__._dryrun_base)
     in a subprocess on the 8-device virtual mesh. Needs the maintained
     jax.shard_map API; on legacy jax this reports skipped — the driver
     environment runs them for real."""
@@ -487,7 +617,7 @@ def run_dryruns():
     scenarios = sorted(set(
         int(m) for m in re.findall(r"dryrun_multichip\[(\d+)\]",
                                    proc.stdout)))
-    ok = proc.returncode == 0 and len(scenarios) >= 9
+    ok = proc.returncode == 0 and len(scenarios) >= 10
     out = {"ok": ok, "returncode": proc.returncode, "scenarios": scenarios}
     if not ok:
         out["tail"] = (proc.stdout + proc.stderr)[-2000:]
@@ -497,7 +627,7 @@ def run_dryruns():
 def run_matrix(min_severity="info", json_mode=False, with_dryrun=True,
                combos=None):
     """Enumerate the tier-flag combinations, verify each composition, and
-    (optionally) run the nine dryrun scenarios. Exits nonzero on any
+    (optionally) run the ten dryrun scenarios. Exits nonzero on any
     error-severity diagnostic or dryrun failure."""
     if json_mode:
         import contextlib
@@ -530,6 +660,7 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
             core_flags.set_flags({
                 "offload_optimizer": combo["offload_optimizer"],
                 "comm_overlap": combo["comm_overlap"],
+                "multislice": combo.get("multislice", "off"),
                 "cp_nested_ring": combo["cp_nested_ring"],
                 "pallas_conv": combo["pallas_conv"],
             })
@@ -549,6 +680,14 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
                 if "sp" not in component_cache:
                     component_cache["sp"] = _matrix_sp_pair_diags()
                 diags += component_cache["sp"][0]
+            if combo.get("multislice", "off") != "off":
+                # the micro step's mesh has no 'slice' axis (the tier is
+                # inert there by design); the 2-slice composition is
+                # checked once as a component
+                if "multislice" not in component_cache:
+                    component_cache["multislice"] = \
+                        _matrix_multislice_diags()
+                diags += component_cache["multislice"][0]
             if combo["cp_nested_ring"]:
                 if "cp" not in component_cache:
                     component_cache["cp"] = _matrix_cp_ring_diags()
@@ -572,7 +711,8 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
             entry["diagnostics"] = [d.to_json() for d in diags]
             entry["errors"] = len(errors)
             report["combos"].append(entry)
-            tag = " ".join(f"{k}={combo[k]}" for k in tier_names)
+            tag = " ".join(f"{k}={combo.get(k, 'off')}"
+                           for k in tier_names)
             print(f"== matrix {tag}: {len(diags)} diagnostic(s), "
                   f"{len(errors)} error(s)")
             for d in diags:
@@ -605,7 +745,7 @@ def main(argv=None):
                    help="lint every model + pallas kernel configs + repo AST")
     p.add_argument("--matrix", action="store_true",
                    help="verify every tier-flag combination's composed "
-                        "StepPlan + the nine dryrun scenarios")
+                        "StepPlan + the ten dryrun scenarios")
     p.add_argument("--no-dryrun", action="store_true",
                    help="with --matrix: skip the multichip dryrun scenarios")
     p.add_argument("--json", action="store_true",
