@@ -319,6 +319,32 @@ def _device_step_time(step, state, args_fn, steps):
         shutil.rmtree(tracedir, ignore_errors=True)
 
 
+# Per-leg compiled-HLO verify stats (analysis/hlo_check X-rules over the
+# leg's own compiled step, measured in _compiled_cost): verifier wall
+# time plus the undeclared-collective count — which must stay 0, so
+# BENCH_timeline.jsonl tracks both the verifier's cost and any GSPMD
+# drift across rounds. Reset per leg; None = the leg compiled nothing.
+_HLO_VERIFY = {"hlo_verify_ms": None, "hlo_undeclared_collectives": None}
+
+
+def _hlo_verify_compiled(compiled):
+    """X-rule pass over one compiled bench step. Bench legs declare no
+    plan (single-chip programs), so ANY compiled collective counts as
+    undeclared — the drift signal the timeline diffs."""
+    try:
+        from paddle_tpu.analysis import hlo_check, plan_check
+        t0 = time.perf_counter()
+        diags = hlo_check.check_hlo(plan_check.StepPlan(), compiled,
+                                    where="bench.hlo")
+        _HLO_VERIFY["hlo_verify_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 2)
+        _HLO_VERIFY["hlo_undeclared_collectives"] = sum(
+            1 for d in diags if d.rule == "X001")
+    except Exception:
+        _HLO_VERIFY["hlo_verify_ms"] = None
+        _HLO_VERIFY["hlo_undeclared_collectives"] = None
+
+
 def _emit(name, value, unit, mfu, extra):
     import jax
     peak = _peak_flops(jax.devices()[0])
@@ -327,15 +353,24 @@ def _emit(name, value, unit, mfu, extra):
         "vs_baseline": round(mfu / 0.40, 4) if mfu else 0.0,
         "extra": {**extra, "mfu": round(mfu, 4),
                   "device": str(jax.devices()[0]),
-                  "peak_tflops": peak / 1e12},
+                  "peak_tflops": peak / 1e12,
+                  **_HLO_VERIFY},
     }), flush=True)
 
 
 def _compiled_cost(jitted, *args):
     """(flops, bytes_accessed) from XLA's compiled cost analysis — the
-    inputs to the roofline floor the anomaly guard checks against."""
+    inputs to the roofline floor the anomaly guard checks against. The
+    same compiled executable feeds the leg's X-rule verify
+    (_hlo_verify_compiled), so hlo_verify_ms / hlo_undeclared_collectives
+    ride along in the leg's emitted extra."""
     try:
-        cost = jitted.lower(*args).compile().cost_analysis()
+        compiled = jitted.lower(*args).compile()
+    except Exception:
+        return 0.0, 0.0
+    _hlo_verify_compiled(compiled)
+    try:
+        cost = compiled.cost_analysis()
         if isinstance(cost, list):
             cost = cost[0]
         return (float(cost.get("flops", 0.0)),

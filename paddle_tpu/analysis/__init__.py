@@ -17,6 +17,13 @@ rule catalog):
   (sharding-flow S-rules) and walked for donation-lifetime hazards
   (D-rules); ``tools/lint_graph.py --matrix`` sweeps every tier-flag
   combination through it.
+- :mod:`.hlo_check` — compiled-HLO verifier (X-rules): the same declared
+  StepPlan cross-checked against what XLA *actually built* — the
+  optimized HLO of the lowered+compiled step (GSPMD-inserted
+  collectives, unrealized donations, compiled peak vs the HBM envelope,
+  dtype churn, DCN collectives in compiled loop bodies); shares the
+  AOT-compile helpers in :mod:`._hlo_utils` with ``cost_model`` and
+  ``utils.flops``.
 
 Wiring: ``FLAGS_static_analysis`` (off | warn | error) runs the jaxpr
 linter inside ``jit.to_static`` / ``framework.sharded.TrainStep`` /
@@ -39,10 +46,15 @@ from .comm_check import (CommSpec, check_comm_spec,  # noqa: F401
 from .plan_check import (StepPlan, PlanNode, GatherPlan,  # noqa: F401
                          ParamInfo, check_plan, collect_jaxpr_facts,
                          all_plan_rules, iter_tier_combos)
+from .hlo_check import (HloFacts, collect_hlo_facts, check_hlo,  # noqa: F401
+                        all_hlo_rules)
+from ._hlo_utils import aot_compile, cost_dict  # noqa: F401
 from . import comm_check  # noqa: F401
 from . import plan_check  # noqa: F401
+from . import hlo_check  # noqa: F401
 from . import repo_lint  # noqa: F401
 from . import _jaxpr_utils as jaxpr_utils  # noqa: F401
+from . import _hlo_utils as hlo_utils  # noqa: F401
 
 __all__ = [
     "Diagnostic", "GraphLintError", "lint_jaxpr", "lint_fn",
@@ -58,4 +70,6 @@ __all__ = [
     "StepPlan", "PlanNode", "GatherPlan", "ParamInfo", "check_plan",
     "collect_jaxpr_facts", "all_plan_rules", "iter_tier_combos",
     "plan_check",
+    "HloFacts", "collect_hlo_facts", "check_hlo", "all_hlo_rules",
+    "aot_compile", "cost_dict", "hlo_check", "hlo_utils",
 ]

@@ -1,0 +1,235 @@
+"""Shared lowering/compile + optimized-HLO introspection helpers.
+
+One home for two idioms that were growing ad hoc:
+
+- the AOT dance — ``jax.jit(fn).lower(*args).compile()`` — previously
+  hand-rolled in ``cost_model.profile_measure`` / ``get_static_op_time``
+  and ``utils.flops``, now :func:`aot_compile` (+ :func:`cost_dict` for
+  the ``cost_analysis()`` read both shared);
+- parsing the *optimized* HLO text a compiled executable carries
+  (``compiled.as_text()``): computation blocks, while-loop bodies,
+  collective ops with their replica groups, the module's
+  ``input_output_alias`` table, and ``memory_analysis()`` byte totals —
+  the "what XLA actually built" facts :mod:`.hlo_check` verifies against
+  the declared :class:`~.plan_check.StepPlan`.
+
+Pure text parsing, best effort by design: an attribute format this XLA
+version does not print (e.g. iota replica groups) degrades to "unknown",
+never to a crash — the analyzers must not kill the step path.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["aot_compile", "cost_dict", "hlo_text", "memory_stats",
+           "parse_hlo", "HloInstr", "HloModule", "COLLECTIVE_OPS"]
+
+# Optimized-HLO opcodes that move data across devices. The async pairs
+# (all-reduce-start/-done) are folded onto their base opcode by the
+# parser, so counts stay per-collective, not per-half.
+COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all",
+})
+
+
+# ---------------------------------------------------------------------------
+# AOT compile + compiled-object reads
+# ---------------------------------------------------------------------------
+
+def aot_compile(fn, *args, donate_argnums=(), **jit_kwargs):
+    """``jit -> lower -> compile`` in one place. ``fn`` may already be a
+    jitted callable (anything with ``.lower``); plain callables are
+    wrapped with ``jax.jit(fn, donate_argnums=..., **jit_kwargs)``.
+    Returns the ``Compiled`` executable (``cost_analysis()`` /
+    ``memory_analysis()`` / ``as_text()`` carriers)."""
+    import jax
+    jitted = fn if hasattr(fn, "lower") else jax.jit(
+        fn, donate_argnums=donate_argnums, **jit_kwargs)
+    return jitted.lower(*args).compile()
+
+
+def cost_dict(compiled) -> Dict[str, float]:
+    """``compiled.cost_analysis()`` flattened to a float dict (the list
+    wrapper some backends return is unwrapped; failures -> ``{}``)."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0] if cost else {}
+        return {k: float(v) for k, v in cost.items()
+                if isinstance(v, (int, float))}
+    except Exception:
+        return {}
+
+
+def hlo_text(compiled) -> str:
+    """The optimized HLO module text (``""`` when unavailable)."""
+    try:
+        return compiled.as_text() or ""
+    except Exception:
+        return ""
+
+
+def memory_stats(compiled) -> Optional[Dict[str, int]]:
+    """``memory_analysis()`` as a byte dict plus a derived ``peak_bytes``
+    (arguments + temps + non-aliased outputs — donated buffers counted
+    once). ``None`` when the backend does not report it."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    keys = ("argument_size_in_bytes", "output_size_in_bytes",
+            "temp_size_in_bytes", "alias_size_in_bytes",
+            "generated_code_size_in_bytes")
+    out = {}
+    for k in keys:
+        try:
+            out[k] = int(getattr(ma, k, 0) or 0)
+        except Exception:
+            out[k] = 0
+    out["peak_bytes"] = (out["argument_size_in_bytes"]
+                         + out["temp_size_in_bytes"]
+                         + max(out["output_size_in_bytes"]
+                               - out["alias_size_in_bytes"], 0))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Optimized-HLO text parsing
+# ---------------------------------------------------------------------------
+
+@dataclass
+class HloInstr:
+    """One instruction line of a parsed HLO computation."""
+
+    name: str
+    op: str                 # base opcode ("all-reduce", not "-start")
+    dtype: str              # result element type ("f32", "" if opaque)
+    computation: str
+    line: str
+    # collective topology, when printed: replica_groups as id lists, or
+    # collective-permute source_target_pairs folded to {src, dst} groups.
+    # None = the attribute was absent or in a format we don't parse.
+    groups: Optional[List[List[int]]] = None
+
+
+@dataclass
+class HloModule:
+    """Parsed view of one optimized HLO module text."""
+
+    entry: str = ""
+    # output index -> (param_number, param_index) from input_output_alias
+    aliases: List[Tuple[int, str]] = field(default_factory=list)
+    computations: Dict[str, List[HloInstr]] = field(default_factory=dict)
+    # computation -> computations it references (calls/to_apply/body/...)
+    refs: Dict[str, set] = field(default_factory=dict)
+    # computations reachable from a while op's body/condition
+    loop_computations: set = field(default_factory=set)
+
+    def instructions(self):
+        for instrs in self.computations.values():
+            for ins in instrs:
+                yield ins
+
+
+_COMP_HDR = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->")
+# result type is either one token (f32[4,8]{1,0}) or a paren-wrapped
+# tuple — tuple element types never nest parens, so [^)]* suffices
+_SIMPLE_INSTR = re.compile(
+    r"^\s+(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(\([^)]*\)|\S+)\s+([\w\-]+)\(")
+_REF = re.compile(r"(?:to_apply|calls|body|condition)=%([\w.\-]+)")
+_BRANCHES = re.compile(r"branches=\{([^}]*)\}")
+_GROUPS = re.compile(r"replica_groups=\{((?:\{[\d,\s]*\},?)*)\}")
+_PAIRS = re.compile(r"source_target_pairs=\{((?:\{[\d,\s]*\},?)*)\}")
+# an input_output_alias entry: "{out_index}: (param_number, {param_index}"
+# — distinctive enough to scan the module header line directly (the
+# layout attributes never put a ':' after a brace group)
+_ALIAS_ENTRY = re.compile(
+    r"\{([\d,\s]*)\}:\s*\((\d+),\s*\{([\d,\s]*)\}")
+
+
+def _base_op(op: str) -> str:
+    for suffix in ("-start", "-done"):
+        if op.endswith(suffix):
+            return op[: -len(suffix)]
+    return op
+
+
+def _parse_groups(line: str) -> Optional[List[List[int]]]:
+    m = _GROUPS.search(line)
+    if m:
+        groups = []
+        for g in re.findall(r"\{([\d,\s]*)\}", m.group(1)):
+            ids = [int(t) for t in g.replace(",", " ").split()]
+            if ids:
+                groups.append(ids)
+        return groups or None
+    m = _PAIRS.search(line)
+    if m:
+        groups = []
+        for g in re.findall(r"\{([\d,\s]*)\}", m.group(1)):
+            ids = [int(t) for t in g.replace(",", " ").split()]
+            if len(ids) == 2 and ids[0] != ids[1]:
+                groups.append(ids)
+        return groups or None
+    return None
+
+
+def parse_hlo(text: str) -> HloModule:
+    """Parse one optimized HLO module text into computations,
+    instruction opcodes (with collective replica groups), the
+    input/output alias table, and the while-body closure."""
+    mod = HloModule()
+    if not text:
+        return mod
+    header = text.split("\n", 1)[0]
+    if "input_output_alias" in header:
+        for am in _ALIAS_ENTRY.finditer(header):
+            mod.aliases.append((int(am.group(2)), am.group(3).strip()))
+    current = ""
+    loop_roots = set()
+    for raw in text.splitlines():
+        hdr = _COMP_HDR.match(raw)
+        if hdr and raw.rstrip().endswith("{"):
+            current = hdr.group(2)
+            mod.computations.setdefault(current, [])
+            if hdr.group(1):
+                mod.entry = current
+            continue
+        if not current:
+            continue
+        im = _SIMPLE_INSTR.match(raw)
+        if im is None:
+            continue
+        name, rtype, op = im.group(1), im.group(2), im.group(3)
+        dtype = rtype.lstrip("(").split("[", 1)[0] if "[" in rtype else ""
+        base = _base_op(op)
+        instr = HloInstr(name=name, op=base, dtype=dtype,
+                         computation=current, line=raw.strip())
+        if base in COLLECTIVE_OPS:
+            instr.groups = _parse_groups(raw)
+        mod.computations[current].append(instr)
+        refs = set(_REF.findall(raw))
+        bm = _BRANCHES.search(raw)
+        if bm:
+            refs.update(re.findall(r"%([\w.\-]+)", bm.group(1)))
+        if refs:
+            mod.refs.setdefault(current, set()).update(refs)
+        if base == "while":
+            loop_roots.update(
+                re.findall(r"(?:body|condition)=%([\w.\-]+)", raw))
+    # transitive closure: everything a while body/condition calls runs
+    # once per iteration too (fusions, to_apply reducers, nested calls)
+    frontier = list(loop_roots)
+    while frontier:
+        comp = frontier.pop()
+        if comp in mod.loop_computations:
+            continue
+        mod.loop_computations.add(comp)
+        frontier.extend(mod.refs.get(comp, ()))
+    return mod

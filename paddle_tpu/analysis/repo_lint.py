@@ -1,5 +1,5 @@
 """Lightweight AST lint with project rules for the project sources
-(``paddle_tpu/``, ``tools/``, ``__graft_entry__.py``).
+(``paddle_tpu/``, ``tools/``, ``examples/``, ``__graft_entry__.py``).
 
 Complements the jaxpr linter: some invariants live in *source*, not in
 traced graphs — host clocks inside kernel modules, constant PRNG seeds in
@@ -138,16 +138,18 @@ def lint_file(path: str, relpath: Optional[str] = None) -> List[Diagnostic]:
 
 
 # Default coverage: the package tree, the CLI tools (they carry real
-# logic — hbm accounting, lint drivers, trace viewers), and the driver
-# entry module. A bare filename entry lints that single file.
-DEFAULT_SUBTREES = ("paddle_tpu", "tools", "__graft_entry__.py")
+# logic — hbm accounting, lint drivers, trace viewers), the example
+# scripts (the first code users copy — a constant seed or a flag bypass
+# there propagates), and the driver entry module. A bare filename entry
+# lints that single file.
+DEFAULT_SUBTREES = ("paddle_tpu", "tools", "examples", "__graft_entry__.py")
 
 
 def lint_tree(root: str, subdir: Optional[str] = None) -> List[Diagnostic]:
     """Lint the project's Python sources under ``root`` (skips native/
     blobs). With ``subdir`` given, only that subtree; by default the
-    :data:`DEFAULT_SUBTREES` — ``paddle_tpu/``, ``tools/`` and
-    ``__graft_entry__.py``."""
+    :data:`DEFAULT_SUBTREES` — ``paddle_tpu/``, ``tools/``,
+    ``examples/`` and ``__graft_entry__.py``."""
     subtrees = (subdir,) if subdir is not None else DEFAULT_SUBTREES
     out: List[Diagnostic] = []
     for sub in subtrees:

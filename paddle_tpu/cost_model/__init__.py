@@ -19,6 +19,8 @@ from typing import Any, Callable, Dict, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..analysis._hlo_utils import aot_compile, cost_dict as _cost_dict
+
 __all__ = ["CostModel"]
 
 # Canonical single-op bodies for get_static_op_time, chosen MXU-shaped.
@@ -37,17 +39,6 @@ _OP_BODIES: Dict[str, Callable] = {
     "sigmoid": lambda x: jax.nn.sigmoid(x),
     "gelu": lambda x: jax.nn.gelu(x),
 }
-
-
-def _cost_dict(compiled) -> Dict[str, float]:
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):
-            cost = cost[0] if cost else {}
-        return {k: float(v) for k, v in cost.items()
-                if isinstance(v, (int, float))}
-    except Exception:
-        return {}
 
 
 class CostModel:
@@ -69,8 +60,7 @@ class CostModel:
         if hasattr(program, "compile") and not callable(
                 getattr(program, "lower", None)):
             fn = program.compile()
-        jitted = fn if hasattr(fn, "lower") else jax.jit(fn)
-        compiled = jitted.lower(*args).compile()
+        compiled = aot_compile(fn, *args)
         cost = _cost_dict(compiled)
         out: Dict[str, Any] = {
             "flops": cost.get("flops", 0.0),
@@ -112,7 +102,7 @@ class CostModel:
                 fwd = body
                 body = jax.grad(lambda x: jnp.sum(fwd(x)))
             x = jnp.ones((1024, 1024), jnp.dtype(dtype))
-            compiled = jax.jit(body).lower(x).compile()
+            compiled = aot_compile(body, x)
             jax.block_until_ready(compiled(x))  # warmup, fully drained
             t0 = time.perf_counter()
             for _ in range(5):

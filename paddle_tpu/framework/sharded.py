@@ -476,12 +476,47 @@ class TrainStep:
         self.plan.comm_specs = list(rec)
         return closed, donate
 
+    def compile_step(self, batch, lr=None, key=None):
+        """AOT lower+compile the composed step at this batch signature —
+        the compiled-HLO verifier's input (``analysis/hlo_check``).
+        Returns ``(compiled, donated_leaves)``: the executable whose
+        optimized HLO / ``memory_analysis()`` / alias table the X-rules
+        read, and the number of flat buffers the dispatch donates into
+        it (0 on the offload path — the streaming update owns those
+        lifetimes at dispatch level)."""
+        if lr is None:
+            lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        if key is None:
+            key = self._base_key
+        from ..distributed.topology import get_hybrid_mesh, set_hybrid_mesh
+        prev_mesh = get_hybrid_mesh()
+        set_hybrid_mesh(self.mesh)
+        try:
+            if self._offload is not None:
+                compiled = self._compiled.lower(
+                    self.params, self.buffers, batch, key).compile()
+                return compiled, 0
+            compiled = self._compiled.lower(
+                self.params, self.opt_state, self.buffers, batch, lr,
+                key).compile()
+        finally:
+            set_hybrid_mesh(prev_mesh)
+        donated = 0
+        if self._donate:
+            donated = (len(jax.tree_util.tree_leaves(self.params))
+                       + len(jax.tree_util.tree_leaves(self.opt_state)))
+        return compiled, donated
+
     def _maybe_lint(self, batch, lr, key) -> None:
         """FLAGS_static_analysis: lint the whole train step (fwd + grads +
-        update) once at the first batch shape, donation-aware, and verify
-        the declared StepPlan against the same trace (sharding-flow +
-        donation-lifetime rules, analysis/plan_check.py)."""
-        from ..analysis import jaxpr_lint, plan_check
+        update) once at the first batch shape, donation-aware, verify the
+        declared StepPlan against the same trace (sharding-flow +
+        donation-lifetime rules, analysis/plan_check.py), and — final
+        stage — verify what XLA actually built: the step is AOT-compiled
+        and its optimized HLO checked against the same plan (X-rules,
+        analysis/hlo_check.py — GSPMD-inserted collectives, unrealized
+        donations, dtype churn)."""
+        from ..analysis import hlo_check, jaxpr_lint, plan_check
         if self._linted or jaxpr_lint.analysis_mode() == "off":
             return
         self._linted = True
@@ -494,6 +529,14 @@ class TrainStep:
         diags += plan_check.check_plan(self.plan, closed,
                                        donate_argnums=donate,
                                        where="sharded.TrainStep")
+        try:
+            compiled, donated = self.compile_step(batch, lr, key)
+        except Exception:
+            compiled = None  # the dispatch will surface the compile error
+        if compiled is not None:
+            diags += hlo_check.check_hlo(self.plan, compiled,
+                                         donated_leaves=donated,
+                                         where="sharded.TrainStep.hlo")
         jaxpr_lint.emit(diags, where="sharded.TrainStep")
 
     def step(self, batch) -> jax.Array:

@@ -274,13 +274,33 @@ class ServingEngine:
             jax.ShapeDtypeStruct((b0,), i32))
         return {"prefill": (pre, (1, 2)), "decode": (dec, (1, 2))}
 
+    def compile_decode(self):
+        """AOT lower+compile the decode executable at its smallest
+        bucket — the compiled-HLO verifier's serving input
+        (``analysis/hlo_check``). Returns ``(compiled,
+        donated_leaves)``: the page pool's two donated buffers must
+        realize input/output aliases (X002 — an unaliased pool doubles
+        the engine's HBM footprint), and a single-partition decode
+        module must compile with zero collectives (X001)."""
+        b0 = self.decode_buckets.sizes[0]
+        c = self.cache
+        pages = jax.ShapeDtypeStruct(c.k.shape, c.k.dtype)
+        i32 = jnp.int32
+        compiled = self._decode_fn.lower(
+            jax.ShapeDtypeStruct((b0,), i32), pages, pages,
+            jax.ShapeDtypeStruct((b0, self.max_blocks_per_seq), i32),
+            jax.ShapeDtypeStruct((b0,), i32)).compile()
+        return compiled, 2
+
     def _maybe_lint(self) -> None:
         """FLAGS_static_analysis hook: on first dispatch, lint both step
-        graphs and verify the declared plan (one trace feeds both)."""
+        graphs, verify the declared plan (one trace feeds both), and —
+        final stage — verify the compiled decode module's optimized HLO
+        against the plan (X-rules, analysis/hlo_check.py)."""
         if self._linted:
             return
         self._linted = True
-        from ..analysis import jaxpr_lint, plan_check
+        from ..analysis import hlo_check, jaxpr_lint, plan_check
         if jaxpr_lint.analysis_mode() == "off":
             return
         diags = []
@@ -291,6 +311,14 @@ class ServingEngine:
         diags += plan_check.check_plan(self.plan, traced["decode"][0],
                                        donate_argnums=traced["decode"][1],
                                        where="serving")
+        try:
+            compiled, donated = self.compile_decode()
+        except Exception:
+            compiled = None  # first dispatch will surface the error
+        if compiled is not None:
+            diags += hlo_check.check_hlo(self.plan, compiled,
+                                         donated_leaves=donated,
+                                         where="serving.decode.hlo")
         if diags:
             jaxpr_lint.emit(diags, where="serving")
 

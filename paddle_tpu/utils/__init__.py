@@ -102,10 +102,10 @@ def flops(net, input_size=None, inputs=None, custom_ops=None,
     (fusions included). ``custom_ops`` is accepted for API parity but
     unnecessary: every op XLA compiles is counted.
     """
-    import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from ..analysis._hlo_utils import aot_compile, cost_dict
     from ..framework.functional import functional_call, get_buffers, get_params
 
     if inputs is None:
@@ -122,11 +122,8 @@ def flops(net, input_size=None, inputs=None, custom_ops=None,
         return functional_call(net, p, *args, buffers=buffers, training=False)
 
     with _flops_lock:
-        compiled = jax.jit(fwd).lower(params, *inputs).compile()
-    cost = compiled.cost_analysis()
-    if isinstance(cost, list):
-        cost = cost[0] if cost else {}
-    total = int(cost.get("flops", 0))
+        compiled = aot_compile(fwd, params, *inputs)
+    total = int(cost_dict(compiled).get("flops", 0))
     if print_detail:
         print(f"Total Flops: {total} (XLA compiled cost analysis)")
     return total

@@ -93,16 +93,21 @@ def test_default_coverage_includes_tools_and_graft_entry(tmp_path):
     """lint_tree's default sweep covers paddle_tpu/, tools/ AND
     __graft_entry__.py — a planted violation in any of them is found."""
     assert repo_lint.DEFAULT_SUBTREES == ("paddle_tpu", "tools",
-                                          "__graft_entry__.py")
+                                          "examples", "__graft_entry__.py")
     root = tmp_path / "repo"
     (root / "paddle_tpu").mkdir(parents=True)
     (root / "tools").mkdir()
+    (root / "examples").mkdir()
     (root / "tools" / "helper.py").write_text(
         "import os\nv = os.environ['FLAGS_log_level']\n")
+    (root / "examples" / "train_demo.py").write_text(
+        "import jax\nkey = jax.random.PRNGKey(0)\n")
     (root / "__graft_entry__.py").write_text(
         "import jax\nk = jax.random.PRNGKey(7)\n")
     diags = repo_lint.lint_tree(str(root))
     assert any(d.rule == "R003" and d.source.startswith("tools/")
+               for d in diags), [d.format() for d in diags]
+    assert any(d.rule == "R002" and d.source.startswith("examples/")
                for d in diags), [d.format() for d in diags]
     assert any(d.rule == "R002" and
                d.source.startswith("__graft_entry__")

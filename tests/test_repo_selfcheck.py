@@ -115,10 +115,11 @@ def test_rules_md_catalog_matches_code():
     catalog documents exists in code — the catalog cannot silently rot."""
     import glob
     import re
-    from paddle_tpu.analysis import jaxpr_lint, plan_check
+    from paddle_tpu.analysis import hlo_check, jaxpr_lint, plan_check
 
     code_ids = {r.rule_id for r in jaxpr_lint.all_rules()}
     code_ids |= {r.rule_id for r in plan_check.all_plan_rules()}
+    code_ids |= {r.rule_id for r in hlo_check.all_hlo_rules()}
     sources = (
         glob.glob(os.path.join(REPO, "paddle_tpu", "analysis", "*.py")) +
         glob.glob(os.path.join(REPO, "paddle_tpu", "observability",
@@ -164,17 +165,18 @@ def test_plan_rules_registered():
 
 
 def test_repo_lint_default_coverage_is_wide():
-    """The self-lint gate runs over paddle_tpu/ + tools/ +
+    """The self-lint gate runs over paddle_tpu/ + tools/ + examples/ +
     __graft_entry__.py and stays error-free."""
     from paddle_tpu.analysis import repo_lint
     diags = repo_lint.lint_tree(REPO)
     linted = {d.source.split(":")[0] for d in diags}
     errors = [d for d in diags if d.severity == "error"]
     assert errors == [], [d.format() for d in errors]
-    # tools sources ARE part of the sweep (finding-free, but walked):
-    # plant nothing — instead assert the walker visits them via the
-    # DEFAULT_SUBTREES contract
+    # tools/examples sources ARE part of the sweep (finding-free, but
+    # walked): plant nothing — instead assert the walker visits them via
+    # the DEFAULT_SUBTREES contract
     assert "tools" in repo_lint.DEFAULT_SUBTREES
+    assert "examples" in repo_lint.DEFAULT_SUBTREES
     del linted
 
 

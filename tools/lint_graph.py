@@ -7,6 +7,7 @@ the Pallas kernel configs and the source tree) with paddle_tpu.analysis.
     python tools/lint_graph.py --model gpt --min-severity info
     python tools/lint_graph.py --matrix              # tier-flag matrix gate
     python tools/lint_graph.py --matrix --json       # machine-readable
+    python tools/lint_graph.py --hlo                 # compiled-HLO X-rules
 
 Exits nonzero when any error-severity diagnostic is found — the CI gate
 that needs no TPU. Clean models print their diagnostic count (0) and the
@@ -18,9 +19,14 @@ pallas_conv × remat), builds each composition's StepPlan on the 8-device
 virtual mesh,
 and verifies it with ``analysis/plan_check`` (sharding-flow S-rules +
 donation-lifetime D-rules) + ``analysis/comm_check`` hop plans +
-``tools/hbm_budget.py`` capacity — then runs the ten multichip dryrun
-scenarios (skipped with a note on legacy jax, where they cannot trace).
-``--json`` switches stdout to one machine-readable report for CI.
+``tools/hbm_budget.py`` capacity, AOT-compiles each trace-distinct step
+and runs the compiled-HLO X-rules (``analysis/hlo_check`` — skip with
+``--no-hlo``) — then runs the ten multichip dryrun scenarios (skipped
+with a note on legacy jax, where they cannot trace). ``--hlo`` runs the
+X-rules standalone over the representative composed steps plus a seeded
+X001 self-test. ``--json`` switches stdout to one machine-readable
+report for CI (schema v2: ``schema_version`` + per-family
+``rule_index``).
 """
 
 import argparse
@@ -212,6 +218,17 @@ def lint_serving():
     print(f"  serving plan ({len(eng.plan.nodes)} nodes): "
           f"{len(pd)} diagnostic(s)")
     diags += pd
+    # compiled-HLO pass (X-rules): the single-partition decode module
+    # must build with zero collectives and both page-pool donations
+    # realized as aliases
+    from paddle_tpu.analysis import hlo_check
+    compiled, donated = eng.compile_decode()
+    facts = hlo_check.collect_hlo_facts(compiled)
+    xd = hlo_check.check_hlo(eng.plan, facts, donated_leaves=donated,
+                             where="serving.decode.hlo")
+    print(f"  serving.decode compiled HLO: {facts.to_json()}, "
+          f"{len(xd)} diagnostic(s)")
+    diags += xd
     return diags, n_eqns
 
 
@@ -320,6 +337,25 @@ MODELS = {"bert": lint_bert, "gpt": lint_gpt, "mlp": lint_mlp,
 
 _SEV_RANK = {"info": 0, "warning": 1, "error": 2}
 
+# --json report schema. v2 adds schema_version itself plus the
+# rule_index section (family -> {count, ids -> per-id counts}) so CI can
+# diff reports across PRs without re-deriving the rule taxonomy.
+SCHEMA_VERSION = 2
+
+
+def _rule_index(diags):
+    """family -> {"count": N, "ids": {rule_id: count}} over a diagnostic
+    list (Diagnostic objects or their to_json dicts)."""
+    idx = {}
+    for d in diags:
+        rid = d["rule"] if isinstance(d, dict) else d.rule
+        fam = idx.setdefault(rid[:1], {"count": 0, "ids": {}})
+        fam["count"] += 1
+        fam["ids"][rid] = fam["ids"].get(rid, 0) + 1
+    return {k: {"count": v["count"],
+                "ids": dict(sorted(v["ids"].items()))}
+            for k, v in sorted(idx.items())}
+
 
 def run(models, with_kernels=False, with_repo=False, min_severity="info",
         json_mode=False):
@@ -404,7 +440,8 @@ def _run_impl(models, with_kernels=False, with_repo=False,
                 report["kernels"] += [d.to_json() for d in diags]
                 all_diags += diags
     if with_repo:
-        print("== repo AST lint (paddle_tpu/ + tools/ + __graft_entry__.py)")
+        print("== repo AST lint (paddle_tpu/ + tools/ + examples/ + "
+              "__graft_entry__.py)")
         diags = repo_lint.lint_tree(REPO)
         for d in diags:
             if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]:
@@ -416,6 +453,8 @@ def _run_impl(models, with_kernels=False, with_repo=False,
             print(f"  note: unrecognized FLAGS_* env vars: {unknown}")
     errors = [d for d in all_diags if d.severity == "error"]
     print(f"total: {len(all_diags)} diagnostic(s), {len(errors)} error(s)")
+    report["schema_version"] = SCHEMA_VERSION
+    report["rule_index"] = _rule_index(all_diags)
     report["total_diagnostics"] = len(all_diags)
     report["errors"] = len(errors)
     return (1 if errors else 0), report
@@ -460,10 +499,13 @@ def _matrix_micro_step(remat: bool):
     return ts, (ids, ids)
 
 
-def _matrix_step_diags(remat: bool):
+def _matrix_step_diags(remat: bool, with_hlo: bool = True):
     """Build + trace the micro TrainStep under the current flags and run
-    the full plan verification; returns (diags, info)."""
-    from paddle_tpu.analysis import plan_check
+    the full plan verification — and, with ``with_hlo``, AOT-compile the
+    same step and run the X-rules over what XLA actually built; returns
+    (diags, info)."""
+    import time
+    from paddle_tpu.analysis import hlo_check, plan_check
     from paddle_tpu.distributed.topology import set_hybrid_mesh
     try:
         ts, batch = _matrix_micro_step(remat)
@@ -473,6 +515,16 @@ def _matrix_step_diags(remat: bool):
                                       where="matrix.step")
         info = {"eqns": len(closed.jaxpr.eqns),
                 "plan": ts.plan.to_json()}
+        if with_hlo:
+            t0 = time.perf_counter()
+            compiled, donated = ts.compile_step(batch)
+            facts = hlo_check.collect_hlo_facts(compiled)
+            diags += hlo_check.check_hlo(ts.plan, facts,
+                                         donated_leaves=donated,
+                                         where="matrix.step.hlo")
+            info["hlo"] = dict(facts.to_json(),
+                               verify_ms=round(
+                                   (time.perf_counter() - t0) * 1e3, 1))
     finally:
         set_hybrid_mesh(None)
     return diags, info
@@ -525,13 +577,17 @@ def _matrix_sp_pair_diags():
                    "eqns": len(closed.jaxpr.eqns)}
 
 
-def _matrix_multislice_diags():
+def _matrix_multislice_diags(with_hlo: bool = True):
     """The multislice tier's composition check: the hierarchical 2-tier
     TrainStep traced on the 2-slice virtual mesh and verified against its
     declared StepPlan (S/D rules) + the recorded hop plan's C-rule
     errors — the micro step of the main matrix sweep has no 'slice' axis,
-    so the tier is exercised here as a component (like the SP pair)."""
-    from paddle_tpu.analysis import comm_check, plan_check
+    so the tier is exercised here as a component (like the SP pair).
+    With ``with_hlo`` the step is also AOT-compiled and X-rule-verified:
+    the compiled reduce-scatter / all-reduce / all-gather kinds must all
+    be justified by the recorded hierarchical-stage CommSpecs, and no
+    DCN-crossing collective may sit in a compiled loop body (X005)."""
+    from paddle_tpu.analysis import comm_check, hlo_check, plan_check
     from paddle_tpu.core.flags import set_flags
     from paddle_tpu.distributed.topology import set_hybrid_mesh
 
@@ -549,6 +605,13 @@ def _matrix_multislice_diags():
         info = {"eqns": len(closed.jaxpr.eqns),
                 "dcn_axes": topo.dcn_axes(),
                 "comm_specs": len(ts.plan.comm_specs)}
+        if with_hlo:
+            compiled, donated = ts.compile_step(batch)
+            facts = hlo_check.collect_hlo_facts(compiled)
+            diags += hlo_check.check_hlo(ts.plan, facts,
+                                         donated_leaves=donated,
+                                         where="matrix.multislice.hlo")
+            info["hlo"] = facts.to_json()
     finally:
         set_flags({"multislice": "off"})
         set_hybrid_mesh(None)
@@ -625,21 +688,25 @@ def run_dryruns():
 
 
 def run_matrix(min_severity="info", json_mode=False, with_dryrun=True,
-               combos=None):
-    """Enumerate the tier-flag combinations, verify each composition, and
-    (optionally) run the ten dryrun scenarios. Exits nonzero on any
-    error-severity diagnostic or dryrun failure."""
+               combos=None, with_hlo=True):
+    """Enumerate the tier-flag combinations, verify each composition —
+    including the compiled-HLO X-rule pass per trace-distinct step,
+    unless ``with_hlo=False`` — and (optionally) run the ten dryrun
+    scenarios. Exits nonzero on any error-severity diagnostic or dryrun
+    failure."""
     if json_mode:
         import contextlib
         with contextlib.redirect_stdout(sys.stderr):
-            rc, report = _run_matrix_impl(min_severity, with_dryrun, combos)
+            rc, report = _run_matrix_impl(min_severity, with_dryrun, combos,
+                                          with_hlo)
         print(json.dumps(report, indent=2))
         return rc
-    rc, _ = _run_matrix_impl(min_severity, with_dryrun, combos)
+    rc, _ = _run_matrix_impl(min_severity, with_dryrun, combos, with_hlo)
     return rc
 
 
-def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
+def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None,
+                     with_hlo=True):
     import tools.hbm_budget as hbm_budget
     from paddle_tpu.analysis import plan_check
     from paddle_tpu.core import flags as core_flags
@@ -655,6 +722,7 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
     component_cache = {}
     report = {"combos": [], "errors": 0}
     n_errors = 0
+    all_diags = []
     try:
         for combo in combos:
             core_flags.set_flags({
@@ -671,10 +739,13 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
             # micro step's graph — their components are checked below)
             sub = tuple(combo[k] for k in _TRACE_KEYS)
             if sub not in step_cache:
-                step_cache[sub] = _matrix_step_diags(combo["remat"])
+                step_cache[sub] = _matrix_step_diags(combo["remat"],
+                                                     with_hlo=with_hlo)
             sdiags, sinfo = step_cache[sub]
             diags += sdiags
             entry["step"] = {"eqns": sinfo.get("eqns")}
+            if "hlo" in sinfo:
+                entry["step"]["hlo"] = sinfo["hlo"]
             # (b) tier components the micro step cannot carry
             if combo["comm_overlap"] != "off":
                 if "sp" not in component_cache:
@@ -686,7 +757,7 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
                 # checked once as a component
                 if "multislice" not in component_cache:
                     component_cache["multislice"] = \
-                        _matrix_multislice_diags()
+                        _matrix_multislice_diags(with_hlo=with_hlo)
                 diags += component_cache["multislice"][0]
             if combo["cp_nested_ring"]:
                 if "cp" not in component_cache:
@@ -708,6 +779,7 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
                             "batch": cap["config"]["batch"]}
             errors = [d for d in diags if d.severity == "error"]
             n_errors += len(errors)
+            all_diags += diags
             entry["diagnostics"] = [d.to_json() for d in diags]
             entry["errors"] = len(errors)
             report["combos"].append(entry)
@@ -731,10 +803,132 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None):
             if not dry["ok"]:
                 n_errors += 1
                 print(dry.get("tail", ""))
+    report["schema_version"] = SCHEMA_VERSION
+    report["rule_index"] = _rule_index(all_diags)
     report["errors"] = n_errors
     print(f"matrix total: {len(report['combos'])} combination(s), "
           f"{n_errors} error(s)")
     return (1 if n_errors else 0), report
+
+
+# ---------------------------------------------------------------------------
+# --hlo: the compiled-HLO verifier, standalone
+# ---------------------------------------------------------------------------
+
+def run_hlo(min_severity="info", json_mode=False):
+    """AOT-compile the representative composed steps and run the X-rules
+    (analysis/hlo_check) over what XLA actually built: the hybrid-mesh
+    micro TrainStep, the serving decode executable, the 2-slice
+    multislice step, plus a seeded undeclared-collective self-test (X001
+    must fire on GSPMD resharding nothing declared — the rule exists to
+    catch exactly that)."""
+    if json_mode:
+        import contextlib
+        with contextlib.redirect_stdout(sys.stderr):
+            rc, report = _run_hlo_impl(min_severity)
+        print(json.dumps(report, indent=2))
+        return rc
+    rc, _ = _run_hlo_impl(min_severity)
+    return rc
+
+
+def _hlo_seeded_x001_selftest():
+    """X001 must fire on a compiled resharding all-gather nothing
+    declared (replicated params, an intermediate constrained onto a mesh
+    axis: GSPMD gathers it back — the sneaked-in collective)."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+    from paddle_tpu.analysis import hlo_check, plan_check
+
+    n = jax.device_count()
+    mesh = Mesh(np.asarray(jax.devices()).reshape(n), ("dp",))
+    repl = NamedSharding(mesh, P())
+
+    def sneaky(w, x):
+        h = jax.lax.with_sharding_constraint(
+            x @ w, NamedSharding(mesh, P(None, "dp")))
+        return jnp.tanh(h) @ w
+
+    compiled = jax.jit(sneaky, in_shardings=(repl, repl),
+                       out_shardings=repl).lower(
+        jnp.ones((16, 16)), jnp.ones((8, 16))).compile()
+    plan = plan_check.StepPlan(mesh_axes={"dp": n})
+    diags = hlo_check.check_hlo(plan, compiled, where="hlo.selftest")
+    return [d for d in diags if d.rule == "X001"]
+
+
+def _run_hlo_impl(min_severity="info"):
+    from paddle_tpu.analysis import hlo_check
+    from paddle_tpu.analysis.jaxpr_lint import Diagnostic
+    all_diags = []
+    report = {"targets": {}, "schema_version": SCHEMA_VERSION}
+
+    def verify(name, compiled, plan, donated):
+        import time
+        t0 = time.perf_counter()
+        facts = hlo_check.collect_hlo_facts(compiled)
+        diags = hlo_check.check_hlo(plan, facts, donated_leaves=donated,
+                                    where=f"hlo.{name}")
+        ms = round((time.perf_counter() - t0) * 1e3, 1)
+        print(f"== hlo {name}: {facts.to_json()}, verify {ms} ms, "
+              f"{len(diags)} diagnostic(s)")
+        for d in diags:
+            if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]:
+                print("  " + d.format())
+        report["targets"][name] = dict(facts.to_json(), verify_ms=ms,
+                                       diagnostics=[d.to_json()
+                                                    for d in diags])
+        all_diags.extend(diags)
+
+    # (a) the hybrid-mesh micro TrainStep (the --matrix micro model)
+    from paddle_tpu.distributed.topology import set_hybrid_mesh
+    try:
+        ts, batch = _matrix_micro_step(False)
+        ts.trace_step(batch)  # fills plan.comm_specs
+        compiled, donated = ts.compile_step(batch)
+        verify("train_step", compiled, ts.plan, donated)
+    finally:
+        set_hybrid_mesh(None)
+    # (b) the serving decode executable at its smallest bucket
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.text.models.gpt import GPTForCausalLM, gpt_tiny
+    paddle.seed(0)
+    cfg = gpt_tiny(vocab_size=128, hidden_size=48, num_layers=2,
+                   num_heads=4, max_position_embeddings=64)
+    eng = ServingEngine(GPTForCausalLM(cfg), block_size=4, num_blocks=32,
+                        max_batch=4)
+    compiled, donated = eng.compile_decode()
+    verify("serving_decode", compiled, eng.plan, donated)
+    # (c) the 2-slice multislice step (hierarchical reduction compiled)
+    if jax.device_count() >= 4:
+        from paddle_tpu.core.flags import set_flags
+        try:
+            topo, ms_ts, ms_batch = _multislice_micro_step("hierarchical")
+            ms_ts.trace_step(ms_batch)
+            compiled, donated = ms_ts.compile_step(ms_batch)
+            verify("multislice_step", compiled, ms_ts.plan, donated)
+        finally:
+            set_flags({"multislice": "off"})
+            set_hybrid_mesh(None)
+    # (d) X001 self-test: the seeded undeclared collective must fire
+    fired = _hlo_seeded_x001_selftest()
+    print(f"== hlo X001 on the seeded undeclared resharding gather: "
+          f"{'fires' if fired else 'MISSING'}")
+    report["x001_selftest_fires"] = bool(fired)
+    if not fired:
+        all_diags.append(Diagnostic(
+            rule="X001", name="undeclared-compiled-collective",
+            severity="error",
+            message="self-test: X001 did not fire on a compiled "
+                    "resharding all-gather with nothing declared",
+            where="hlo.selftest"))
+    errors = [d for d in all_diags if d.severity == "error"]
+    report["rule_index"] = _rule_index(all_diags)
+    report["errors"] = len(errors)
+    print(f"hlo total: {len(all_diags)} diagnostic(s), "
+          f"{len(errors)} error(s)")
+    return (1 if errors else 0), report
 
 
 def main(argv=None):
@@ -745,9 +939,16 @@ def main(argv=None):
                    help="lint every model + pallas kernel configs + repo AST")
     p.add_argument("--matrix", action="store_true",
                    help="verify every tier-flag combination's composed "
-                        "StepPlan + the ten dryrun scenarios")
+                        "StepPlan (+ compiled-HLO X-rules) + the ten "
+                        "dryrun scenarios")
+    p.add_argument("--hlo", action="store_true",
+                   help="compiled-HLO verifier (X-rules) over the "
+                        "representative composed steps + the X001 "
+                        "seeded self-test")
     p.add_argument("--no-dryrun", action="store_true",
                    help="with --matrix: skip the multichip dryrun scenarios")
+    p.add_argument("--no-hlo", action="store_true",
+                   help="with --matrix: skip the compiled-HLO X-rule pass")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report on stdout (narration "
                         "moves to stderr)")
@@ -756,7 +957,10 @@ def main(argv=None):
     a = p.parse_args(argv)
     if a.matrix:
         return run_matrix(min_severity=a.min_severity, json_mode=a.json,
-                          with_dryrun=not a.no_dryrun)
+                          with_dryrun=not a.no_dryrun,
+                          with_hlo=not a.no_hlo)
+    if a.hlo:
+        return run_hlo(min_severity=a.min_severity, json_mode=a.json)
     if a.all:
         models = sorted(MODELS)
     else:
