@@ -1397,6 +1397,64 @@ def bench_fault(small: bool):
     if not parity.get("bitwise_equal"):
         raise RuntimeError(f"fault drill parity broken: {parity}")
 
+    # -- the training-health leg: the chained --health drill (2 kills +
+    # inject_nan + inject_hang over the guarded trainer) measured the
+    # same way — detection latency in steps and the goodput of a run
+    # that detected, rewound, skipped and still matched bitwise
+    hcfg = drill.quick_health_config()
+    hworkdir = tempfile.mkdtemp(prefix="bench_health_")
+    hreport = drill.run_drill(hworkdir, **hcfg)
+    hg = hreport.get("goodput_record", {})
+    hparity = hreport.get("parity", {})
+    hh = hreport.get("health", {})
+    if hreport.get("rc") != 0 or "goodput" not in hg:
+        raise RuntimeError(
+            f"health drill failed: rc={hreport.get('rc')} "
+            f"{hreport.get('error', '')}")
+    latency = hg.get("detection_latency_steps", {})
+    _emit("health_detection_latency_steps", float(latency.get("max", 0)),
+          "steps (max over anomalies)", 0.0,
+          {"latencies": hh.get("detection_latency_steps"),
+           "anomalies": [
+               {k: a.get(k) for k in ("kind", "step", "latency_steps")}
+               for a in hh.get("anomalies", [])],
+           "plan": hreport["plan"]["events"],
+           "parity_bitwise": hparity.get("bitwise_equal")})
+    _emit("health_recovery_goodput_pct", hg["goodput"] * 100.0,
+          "pct useful-step/wall", 0.0,
+          {"goodput": hg["goodput"],
+           "restarts": hg["restarts"],
+           "lost_steps": hg["lost_steps"],
+           "rewound_steps": hg["rewound_steps"],
+           "skipped_batches": hg["skipped_batches"],
+           "parity_bitwise": hparity.get("bitwise_equal"),
+           "method": ("tools/fault_drill.py --quick --health machinery: "
+                      "guarded trainer (fused sentinel, hang watchdog, "
+                      "SDC canary, Guardian rewind-and-skip) under 2 "
+                      "SIGKILLs + 1 injected NaN + 1 injected hang; "
+                      "parity vs a clean run handed the same "
+                      "poisoned-batch skip set")})
+    if not hparity.get("bitwise_equal"):
+        raise RuntimeError(f"health drill parity broken: {hparity}")
+    # the health records ride the shared timeline JSONL like the serving
+    # request records do
+    out_path = os.environ.get("BENCH_TRACE_OUT", "BENCH_timeline.jsonl")
+    try:
+        with open(out_path, "a") as f:
+            f.write(json.dumps({
+                "kind": "health_drill",
+                "detection_latency_steps_max": latency.get("max", 0),
+                "recovery_goodput": hg["goodput"],
+                "restarts": hg["restarts"],
+                "rewound_steps": hg["rewound_steps"],
+                "skipped_batches": hg["skipped_batches"],
+                "anomaly_kinds": [a.get("kind")
+                                  for a in hh.get("anomalies", [])],
+                "parity_bitwise": hparity.get("bitwise_equal"),
+            }) + "\n")
+    except OSError:
+        pass
+
 
 # ---------------------------------------------------------------------------
 # BENCH_SERVE: serving engine — continuous batching vs one-shot predictor

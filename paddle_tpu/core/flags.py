@@ -201,6 +201,15 @@ define_flag("multislice_dcn_bucket_mb", 100,
             "than FLAGS_comm_overlap_bucket_mb because the cross-slice "
             "latency floor (comm_check C005) is orders of magnitude "
             "above ICI's.")
+define_flag("health_sentinel", "off",
+            "Training-health step sentinel (fault/health.py): 'off' "
+            "keeps the train step byte-identical; 'on' fuses one "
+            "[loss, grad-global-norm] anomaly check into the compiled "
+            "step (no host callbacks, no clean-path sync) and gates the "
+            "optimizer update in-graph on finiteness + rolling-median "
+            "spike/explosion thresholds, returning the stats vector for "
+            "the host-side verdict (fault/guardian.py drives recovery).",
+            choices=("off", "on"))
 define_flag("cp_nested_ring", False,
             "Run the manual ring-attention CP path even when nested "
             "inside an enclosing manual shard_map (the pipeline "

@@ -11,13 +11,21 @@ data-center network. This package makes the two link classes explicit:
   sized per link class and every stage declared to
   ``analysis.comm_check`` (rules C004/C005).
 
-``framework.sharded.TrainStep`` consumes both behind
+- :class:`~.heartbeat.SliceHeartbeatMonitor` — per-slice liveness +
+  progress beats so the training-health watchdog's escalation can tell a
+  **dead** slice (stale beat → relaunch) from a **slow** one (fresh beat,
+  trailing step counter → back off).
+
+``framework.sharded.TrainStep`` consumes the reducer behind
 ``FLAGS_multislice=off|flat|hierarchical``; ``tools/lint_graph.py
 --model multislice`` and the ``BENCH_MULTISLICE`` bench leg verify and
-measure the composition chiplessly on the CPU mesh.
+measure the composition chiplessly on the CPU mesh; the guarded drill
+trainer (``fault/_trainer.py`` health mode) beats the monitor per step.
 """
 
+from .heartbeat import SliceHeartbeatMonitor
 from .reducer import HierarchicalGradReducer
 from .topology import SLICE_AXIS, SliceTopology
 
-__all__ = ["SliceTopology", "HierarchicalGradReducer", "SLICE_AXIS"]
+__all__ = ["SliceTopology", "HierarchicalGradReducer", "SLICE_AXIS",
+           "SliceHeartbeatMonitor"]

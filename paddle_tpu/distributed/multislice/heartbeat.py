@@ -1,0 +1,91 @@
+"""Per-slice liveness: tell a *dead* slice from a *slow* one.
+
+A multi-slice step blocks on the DCN allreduce, so from inside slice A a
+dead slice B and a merely slow slice B look identical — the collective
+just doesn't complete. The hang watchdog (``fault/health.py``) bounds
+how long that ambiguity is tolerated; this monitor resolves it so the
+escalation is *typed*: each slice's host beats a shared store (the same
+:class:`~paddle_tpu.distributed.fleet.elastic.FileHeartbeatStore`
+machinery the elastic manager rides — any shared-dir/etcd-like KV) with
+its wall time and step counter, and :meth:`classify` reports per slice:
+
+- ``dead`` — no beat within ``ttl_s``: the slice process is gone; the
+  elastic relaunch path is the only fix (a watchdog escalation is
+  correct);
+- ``slow`` — beats are fresh but the slice's step counter trails the
+  fleet maximum by more than ``lag_steps``: the slice is alive and
+  making progress; killing it would convert a straggler into an outage
+  (back off, let the watchdog's scaled deadline absorb it);
+- ``alive`` — fresh beat, step within the lag budget.
+
+The guarded drill trainer beats once per step when configured with a
+slice id; the hang watchdog's escalation callback consults
+:meth:`classify` to label the journal record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, Optional
+
+__all__ = ["SliceHeartbeatMonitor"]
+
+
+class SliceHeartbeatMonitor:
+    """One shared-directory heartbeat file per slice."""
+
+    def __init__(self, directory: str, slice_id: int, num_slices: int,
+                 ttl_s: float = 10.0, lag_steps: int = 3):
+        self.directory = directory
+        self.slice_id = int(slice_id)
+        self.num_slices = int(num_slices)
+        self.ttl_s = float(ttl_s)
+        self.lag_steps = int(lag_steps)
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, sid: int) -> str:
+        return os.path.join(self.directory, f"slice.{int(sid)}.json")
+
+    def beat(self, step: int, now: Optional[float] = None) -> None:
+        """Record this slice's liveness + progress (atomic replace, same
+        discipline as the elastic pod heartbeat)."""
+        tmp = self._path(self.slice_id) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"time": float(now if now is not None
+                                     else time.time()),
+                       "step": int(step)}, f)
+        os.replace(tmp, self._path(self.slice_id))
+
+    def read(self, sid: int) -> Optional[Dict]:
+        try:
+            with open(self._path(sid)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def classify(self, now: Optional[float] = None) -> Dict[int, str]:
+        """Per-slice status: ``alive`` / ``slow`` / ``dead``."""
+        now = float(now if now is not None else time.time())
+        recs = {sid: self.read(sid) for sid in range(self.num_slices)}
+        fresh = {sid: r for sid, r in recs.items()
+                 if r is not None and now - r.get("time", 0) <= self.ttl_s}
+        max_step = max((r.get("step", 0) for r in fresh.values()),
+                       default=0)
+        out: Dict[int, str] = {}
+        for sid in range(self.num_slices):
+            r = fresh.get(sid)
+            if r is None:
+                out[sid] = "dead"
+            elif max_step - r.get("step", 0) > self.lag_steps:
+                out[sid] = "slow"
+            else:
+                out[sid] = "alive"
+        return out
+
+    def summary(self, now: Optional[float] = None) -> Dict[str, object]:
+        cls = self.classify(now)
+        return {"statuses": {str(k): v for k, v in cls.items()},
+                "dead": sorted(k for k, v in cls.items() if v == "dead"),
+                "slow": sorted(k for k, v in cls.items() if v == "slow")}

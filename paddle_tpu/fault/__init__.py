@@ -17,16 +17,28 @@ connects them into a story a production run can rely on:
 - :mod:`~paddle_tpu.fault.drill` — the end-to-end
   train→kill→relaunch→resume drill (``tools/fault_drill.py``) that asserts
   bitwise loss parity against an uninterrupted run and emits the goodput
-  record ``bench.py`` carries into ``BENCH_*.json``.
+  record ``bench.py`` carries into ``BENCH_*.json``;
+- :mod:`~paddle_tpu.fault.health` /
+  :mod:`~paddle_tpu.fault.guardian` — the training-health tier for runs
+  that are *alive and wrong*: the fused step sentinel (NaN/spike/
+  explosion, update gated in-graph), the hang watchdog, the SDC canary,
+  and the :class:`~paddle_tpu.fault.guardian.Guardian` policy engine
+  (skip-batch / rewind-to-last-good / relaunch / halt) driven by the
+  checkpoint manager's promoted last-good pointer
+  (``tools/health_drill.py`` proves the loop end to end).
 
 See ``RESILIENCE.md`` for the checkpoint format and drill usage.
 """
 
 from .checkpoint_manager import CheckpointManager  # noqa: F401
 from .goodput import compute_goodput, parse_train_log  # noqa: F401
+from .guardian import Decision, Guardian  # noqa: F401
+from .health import (BatchCursor, HangWatchdog, SdcCanary,  # noqa: F401
+                     StepSentinel, HANG_EXIT_CODE)
 from .injection import (FAULT_KINDS, FaultEvent, FaultInjector,  # noqa: F401
                         FaultPlan, PREEMPTION_EXIT_CODE)
 
 __all__ = ["CheckpointManager", "FaultPlan", "FaultEvent", "FaultInjector",
            "FAULT_KINDS", "PREEMPTION_EXIT_CODE", "compute_goodput",
-           "parse_train_log"]
+           "parse_train_log", "Guardian", "Decision", "StepSentinel",
+           "HangWatchdog", "SdcCanary", "BatchCursor", "HANG_EXIT_CODE"]

@@ -12,6 +12,17 @@ Env contract (subprocess mode; all prefixed FAULT_, see ``main``):
 ``FAULT_WORK_DIR`` (required), ``FAULT_TOTAL_STEPS``, ``FAULT_CKPT_EVERY``,
 ``FAULT_PLAN`` (FaultPlan JSON; empty = no faults), ``FAULT_ASYNC``,
 ``FAULT_SIZE`` (quick|small), ``FAULT_GRACE_S``.
+
+Health (guarded) mode — ``FAULT_HEALTH=1`` — arms the training-health
+tier on the same model: the fused step sentinel
+(``FLAGS_health_sentinel=on``), the hang watchdog, the SDC canary
+(``FAULT_CANARY_EVERY``), and the ``fault.Guardian`` recovery loop
+(skip-batch / rewind-to-last-good / relaunch / halt). The loss function
+gains a per-step poison scale seam the ``inject_nan`` /
+``inject_loss_spike`` fault kinds drive, batches flow through the
+skip-aware ``health.BatchCursor`` (``FAULT_SKIPS`` pre-seeds the clean
+reference's skip set), and ``FAULT_HANG_SLEEP_S`` /
+``FAULT_WATCHDOG_FLOOR_S`` size the injected stall vs the deadline.
 """
 
 from __future__ import annotations
@@ -56,17 +67,25 @@ def make_batches(size: str = "quick"):
     return out
 
 
-def build_step(size: str = "quick"):
+def build_step(size: str = "quick", health: bool = False):
     """(TrainStep, batch pool) for the drill model: a tiny GPT with Adam
     (moments exercise the optimizer-state checkpoint path) on a
     single-device mesh — subprocess and in-process reference build the
     byte-identical step regardless of how many virtual devices the parent
-    environment provisioned."""
+    environment provisioned.
+
+    ``health=True`` builds the *guarded* variant: the loss function gains
+    a poison-scale seam (batches become ``(ids, labels, poison[1])``;
+    ``poison == 1.0`` on the clean path is an exact IEEE no-op, NaN/1e4
+    are the ``inject_nan`` / ``inject_loss_spike`` effects) and the
+    sentinel flag is armed around construction so the compiled step
+    carries the fused stats vector + in-graph update gate."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
 
     import paddle_tpu as paddle
+    from paddle_tpu.core import flags as _flags
     from paddle_tpu.framework.functional import functional_call
     from paddle_tpu.framework.sharded import make_sharded_train_step
     from paddle_tpu.optimizer import Adam
@@ -82,12 +101,24 @@ def build_step(size: str = "quick"):
     model.train()
     opt = Adam(learning_rate=1e-3)
 
-    def loss_fn(mdl, params, batch):
-        ids, labels = batch
-        return functional_call(mdl, params, ids, labels, training=True)
+    if health:
+        def loss_fn(mdl, params, batch):
+            ids, labels, poison = batch
+            return functional_call(
+                mdl, params, ids, labels, training=True) * poison[0]
+    else:
+        def loss_fn(mdl, params, batch):
+            ids, labels = batch
+            return functional_call(mdl, params, ids, labels, training=True)
 
     mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
-    ts = make_sharded_train_step(model, opt, loss_fn, mesh=mesh)
+    prev = _flags.get_flags(["health_sentinel"])
+    if health:
+        _flags.set_flags({"health_sentinel": "on"})
+    try:
+        ts = make_sharded_train_step(model, opt, loss_fn, mesh=mesh)
+    finally:
+        _flags.set_flags(prev)
     return ts, make_batches(size)
 
 
@@ -110,10 +141,22 @@ class _Log:
 
 def train(work_dir: str, total_steps: int = 8, ckpt_every: int = 2,
           plan_json: str = "", async_save: bool = True,
-          size: str = "quick", grace_s: float = 5.0) -> None:
+          size: str = "quick", grace_s: float = 5.0,
+          health: bool = False, skips=(), canary_every: int = 0,
+          spike_scale: float = 1e4, hang_sleep_s: float = 3.0,
+          watchdog_floor_s: float = 0.6, max_recoveries: int = 8) -> None:
     """One incarnation of the drill trainer: resume from the latest
     complete checkpoint if any, train to ``total_steps``, die wherever the
-    fault plan says."""
+    fault plan says. ``health=True`` routes to the guarded loop
+    (:func:`_train_guarded`) with the sentinel/watchdog/canary armed."""
+    if health:
+        return _train_guarded(
+            work_dir, total_steps=total_steps, ckpt_every=ckpt_every,
+            plan_json=plan_json, async_save=async_save, size=size,
+            grace_s=grace_s, skips=skips, canary_every=canary_every,
+            spike_scale=spike_scale, hang_sleep_s=hang_sleep_s,
+            watchdog_floor_s=watchdog_floor_s,
+            max_recoveries=max_recoveries)
     from paddle_tpu.core.random import get_rng_state, set_rng_state
     from paddle_tpu.fault.checkpoint_manager import CheckpointManager
     from paddle_tpu.fault.injection import FaultInjector, FaultPlan
@@ -178,15 +221,258 @@ def train(work_dir: str, total_steps: int = 8, ckpt_every: int = 2,
     log.write({"event": "done"})
 
 
+def _train_guarded(work_dir: str, total_steps: int, ckpt_every: int,
+                   plan_json: str, async_save: bool, size: str,
+                   grace_s: float, skips, canary_every: int,
+                   spike_scale: float, hang_sleep_s: float,
+                   watchdog_floor_s: float, max_recoveries: int) -> None:
+    """The guarded incarnation: every step runs under the fused sentinel,
+    the hang watchdog and (every K steps) the SDC canary; anomalies route
+    through the Guardian's typed policies. Applied steps are keyed by
+    explicit index (``TrainStep.step(batch, index=...)``) and batches by
+    the skip-aware cursor, so the rewind-and-skip trajectory is bitwise
+    comparable to a clean run handed the same skip set."""
+    import functools
+    import sys
+
+    from paddle_tpu.core.random import get_rng_state, set_rng_state
+    from paddle_tpu.fault import health, injection as _inj_mod
+    from paddle_tpu.fault.checkpoint_manager import CheckpointManager
+    from paddle_tpu.fault.guardian import Guardian
+    from paddle_tpu.fault.injection import FaultInjector, FaultPlan
+    from paddle_tpu.observability import step_monitor
+
+    os.makedirs(work_dir, exist_ok=True)
+    log = _Log(os.path.join(work_dir, "train_log.jsonl"))
+    plan = FaultPlan.from_json(plan_json)
+    ts, batches = build_step(size, health=True)
+    pool = len(batches)
+    mgr = CheckpointManager(
+        os.path.join(work_dir, "ckpt"), keep=4, async_save=async_save,
+        on_commit=lambda step, ms: log.write(
+            {"event": "ckpt_saved", "step": step, "ms": round(ms, 3)}))
+    guardian = Guardian(
+        mgr, promote_after=2, max_recoveries=max_recoveries,
+        journal_path=os.path.join(work_dir, "health.jsonl"))
+    cursor = health.BatchCursor(pool,
+                                skips=set(int(s) for s in skips)
+                                | guardian.skips())
+    inj = FaultInjector(plan, work_dir)
+
+    def make_state(next_step: int) -> Dict[str, Any]:
+        return {"train": ts.state_dict(),
+                "rng": list(get_rng_state()),
+                "loader_pos": cursor.position_for(next_step),
+                "step": next_step}
+
+    current = {"step": 0}
+
+    # per-slice heartbeat (distributed/multislice): in a multi-slice
+    # drill each slice's trainer beats its liveness + step counter, so
+    # the hang escalation can say WHICH slice is dead vs merely slow
+    hb = None
+    sid = os.environ.get("FAULT_SLICE_ID")
+    if sid is not None:
+        from paddle_tpu.distributed.multislice import SliceHeartbeatMonitor
+        hb = SliceHeartbeatMonitor(
+            os.environ.get("FAULT_SLICE_HB_DIR",
+                           os.path.join(work_dir, "slice_hb")),
+            int(sid), int(os.environ.get("FAULT_NUM_SLICES", "1")))
+
+    def on_hang(info) -> None:
+        # fsync the classification BEFORE dying: the relaunch must know
+        # this was a detected hang, not an unexplained death
+        if hb is not None:
+            info = dict(info, slices=hb.summary())
+        log.write({"event": "anomaly", "kind": "hang",
+                   "step": info.get("step"),
+                   "deadline_s": info.get("deadline_s"),
+                   "slices": info.get("slices"),
+                   "inject_step": info.get("step"), "latency_steps": 0})
+        guardian.record({"event": "anomaly", "kind": "hang",
+                         "step": info.get("step"),
+                         "deadline_s": info.get("deadline_s")})
+        guardian.record({"event": "decision", "kind": "hang",
+                         "step": info.get("step"), "action": "relaunch",
+                         "reason": "watchdog deadline exceeded"})
+        os._exit(health.HANG_EXIT_CODE)
+
+    watchdog = health.HangWatchdog(floor_s=watchdog_floor_s,
+                                   on_hang=on_hang)
+    canary = (health.SdcCanary(every=canary_every)
+              if canary_every > 0 else None)
+
+    start = 0
+    found = mgr.latest_complete()
+    if found is not None:
+        t0 = time.perf_counter()
+        _, state, _meta = mgr.restore(found)
+        restore_ms = (time.perf_counter() - t0) * 1e3
+        ts.load_state_dict(state["train"])
+        set_rng_state(tuple(state["rng"]))
+        start = int(state["step"])
+        log.write({"event": "ckpt_restored", "step": start,
+                   "ms": round(restore_ms, 3)})
+        log.write({"event": "resumed", "step": start})
+    else:
+        # the step-0 snapshot: init state is untainted by definition, so
+        # it is immediately the always-available rewind target
+        mgr.save(0, make_state(0), block=True)
+        mgr.mark_good(0)
+    log.write({"event": "start", "start_step": start, "pid": os.getpid(),
+               "health": True})
+
+    def preemption_save():
+        s = current["step"]
+        log.write({"event": "preempted", "step": s})
+        mgr.save(s, make_state(s), block=True)
+
+    if len(plan):
+        inj.arm(preemption_save=preemption_save, grace_s=grace_s)
+        inj.arm_hang(hang_sleep_s)
+
+    def batch_at(pos, poison=1.0):
+        ids, labels = batches[pos % pool]
+        import numpy as np
+        return (ids, labels, np.asarray([poison], np.float32))
+
+    def do_rewind(dec):
+        if dec.skip_pos is not None:
+            cursor.skip(dec.skip_pos)
+            log.write({"event": "skip_batch", "pos": dec.skip_pos,
+                       "step": dec.step})
+        log.write({"event": "rewind", "from": dec.step,
+                   "to": dec.rewind_to})
+        with step_monitor.current().phase("rewind"):
+            _, state, _ = mgr.restore(dec.rewind_to)
+            ts.load_state_dict(state["train"])
+            set_rng_state(tuple(state["rng"]))
+        return int(state["step"])
+
+    applied = start
+    first_dispatch = True  # includes the incarnation's XLA compile
+    while applied < total_steps:
+        current["step"] = applied
+        pos = cursor.position_for(applied)
+
+        # -- SDC canary: re-execute the grad computation, compare bitwise
+        if canary is not None and canary.due(applied):
+            corrupt = None
+            sev = inj.consume("inject_sdc", applied)
+            if sev is not None:
+                corrupt = functools.partial(health.flip_one_bit,
+                                            seed=1000003 * sev.step + 17)
+            cv = canary.check(
+                applied,
+                lambda: ts.canary_step(batch_at(pos), applied + 1),
+                corrupt=corrupt)
+            log.write({"event": "canary", "step": applied,
+                       "clean": cv.clean})
+            if not cv.clean:
+                dec = guardian.on_anomaly(
+                    "sdc", step=applied, pos=None,
+                    inject_step=(sev.step if sev is not None else None),
+                    detail=cv.detail)
+                log.write({"event": "anomaly", "kind": "sdc",
+                           "step": applied,
+                           "inject_step": (sev.step if sev is not None
+                                           else None),
+                           "latency_steps": (applied - sev.step
+                                             if sev is not None else None),
+                           "action": dec.action})
+                if dec.action == "rewind":
+                    applied = do_rewind(dec)
+                    continue
+                log.write({"event": "halt", "step": applied,
+                           "reason": dec.reason})
+                mgr.close()
+                sys.exit(2)
+
+        # -- poison seam: inject_nan / inject_loss_spike
+        poison, inject_ev = 1.0, None
+        ev = inj.consume("inject_nan", applied)
+        if ev is not None:
+            poison, inject_ev = float("nan"), ev
+        ev = inj.consume("inject_loss_spike", applied)
+        if ev is not None:
+            poison, inject_ev = float(spike_scale), ev
+
+        inj.poll_step_begin(applied)
+        t0 = time.perf_counter()
+        with watchdog.guard(step=applied, armed=not first_dispatch,
+                            record=not first_dispatch):
+            loss_arr = ts.step(batch_at(pos, poison), index=applied + 1)
+            _inj_mod.fire("health.hang")
+            verdict = ts.sentinel_verdict()  # syncs the stats vector
+        dt = time.perf_counter() - t0
+        first_dispatch = False
+
+        if not verdict.ok:
+            dec = guardian.on_anomaly(
+                verdict.kind, step=applied, pos=pos,
+                inject_step=(inject_ev.step if inject_ev is not None
+                             else None),
+                detail=verdict.detail)
+            log.write({"event": "anomaly", "kind": verdict.kind,
+                       "step": applied, "pos": pos,
+                       "inject_step": (inject_ev.step
+                                       if inject_ev is not None else None),
+                       "latency_steps": (applied - inject_ev.step
+                                         if inject_ev is not None
+                                         else None),
+                       "applied": verdict.applied, "action": dec.action})
+            if dec.action == "skip_batch":
+                # the in-graph gate kept the update from applying; drop
+                # the batch and re-run THIS applied step on the next one
+                cursor.skip(pos)
+                log.write({"event": "skip_batch", "pos": pos,
+                           "step": applied})
+                continue
+            if dec.action == "rewind":
+                applied = do_rewind(dec)
+                continue
+            log.write({"event": "halt", "step": applied,
+                       "reason": dec.reason})
+            mgr.close()
+            sys.exit(2)
+
+        loss = float(loss_arr)
+        inj.poll_step_end(applied)
+        log.write({"step": applied, "loss": loss, "t": round(dt, 6)})
+        if hb is not None:
+            hb.beat(applied)
+        guardian.note_clean_step(applied)
+        nxt = applied + 1
+        if nxt % ckpt_every == 0 and nxt < total_steps:
+            mgr.save(nxt, make_state(nxt))
+            guardian.note_save(nxt)
+        applied = nxt
+
+    mgr.save(total_steps, make_state(total_steps), block=True)
+    mgr.close()
+    if len(plan):
+        inj.disarm()
+    log.write({"event": "done"})
+
+
 def main() -> None:
     env = os.environ
+    skips = tuple(int(s) for s in env.get("FAULT_SKIPS", "").split(",")
+                  if s.strip())
     train(work_dir=env["FAULT_WORK_DIR"],
           total_steps=int(env.get("FAULT_TOTAL_STEPS", "8")),
           ckpt_every=int(env.get("FAULT_CKPT_EVERY", "2")),
           plan_json=env.get("FAULT_PLAN", ""),
           async_save=env.get("FAULT_ASYNC", "1") == "1",
           size=env.get("FAULT_SIZE", "quick"),
-          grace_s=float(env.get("FAULT_GRACE_S", "5.0")))
+          grace_s=float(env.get("FAULT_GRACE_S", "5.0")),
+          health=env.get("FAULT_HEALTH", "0") == "1",
+          skips=skips,
+          canary_every=int(env.get("FAULT_CANARY_EVERY", "0")),
+          spike_scale=float(env.get("FAULT_SPIKE_SCALE", "1e4")),
+          hang_sleep_s=float(env.get("FAULT_HANG_SLEEP_S", "3.0")),
+          watchdog_floor_s=float(env.get("FAULT_WATCHDOG_FLOOR_S", "0.6")),
+          max_recoveries=int(env.get("FAULT_MAX_RECOVERIES", "8")))
 
 
 if __name__ == "__main__":

@@ -20,7 +20,14 @@ Layers on ``distributed.checkpoint``'s manifest snapshots
   fails, a Diagnostic (rule F001) is surfaced and the manager degrades to
   synchronous saves so the next checkpoint fails loudly in the caller's
   frame instead of silently in a thread.
-- **Retention.** Keeps the newest ``keep`` complete snapshots.
+- **Retention.** Keeps the newest ``keep`` complete snapshots — plus the
+  **last-good** snapshot (see below), which is pinned.
+- **Last-good pointer.** The training-health guardian
+  (``fault/guardian.py``) promotes a snapshot to *last-good* only after
+  K clean sentinel steps (:meth:`mark_good`, an atomic fsynced pointer
+  file). :meth:`last_good` is the rewind target the recovery policies
+  use — by construction it never points at a poisoned checkpoint, and
+  retention never deletes it.
 
 Durations land in the shared metrics registry (``fault.ckpt_save_ms`` /
 ``fault.ckpt_capture_ms`` / ``fault.ckpt_restore_ms``) and on the
@@ -47,6 +54,7 @@ __all__ = ["CheckpointManager"]
 
 _STEP_DIR = re.compile(r"^step_(\d+)$")
 _TMP_PREFIX = ".tmp."
+_GOOD_POINTER = "last_good.json"
 
 
 def _now() -> float:
@@ -211,8 +219,48 @@ class CheckpointManager:
 
     def _retain(self) -> None:
         steps = self.all_steps()
+        good = self.last_good(validate=False)
         for s in steps[:-self.keep] if self.keep > 0 else []:
+            if good is not None and s == good:
+                continue  # the rewind target outlives the retention window
             shutil.rmtree(self._final_dir(s), ignore_errors=True)
+
+    # -- last-good pointer (the guardian's rewind target) --------------------
+
+    def mark_good(self, step: int) -> None:
+        """Atomically record ``step`` as the last-good snapshot. Callers
+        (``fault.Guardian``) promote a snapshot only after K clean
+        sentinel steps — this pointer must never name a poisoned state."""
+        path = os.path.join(self.directory, _GOOD_POINTER)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            import json
+            json.dump({"step": int(step)}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def last_good(self, validate: bool = True) -> Optional[int]:
+        """The promoted last-good step, or None when nothing was promoted
+        (or — with ``validate`` — the pointed-at snapshot no longer
+        passes validation, which is itself surfaced as an F001 note)."""
+        import json
+        try:
+            with open(os.path.join(self.directory, _GOOD_POINTER)) as f:
+                step = int(json.load(f)["step"])
+        except (OSError, ValueError, KeyError, TypeError):
+            return None
+        if not validate:
+            return step
+        ok, reason = dckpt.validate_snapshot(self._final_dir(step))
+        if not ok:
+            self._diagnose(
+                f"last-good pointer names invalid snapshot step_{step}: "
+                f"{reason}",
+                hint="falling back to no rewind target; the guardian "
+                     "halts instead of rewinding onto garbage")
+            return None
+        return step
 
     def _diagnose(self, message: str, hint: str = "") -> None:
         from ..analysis.jaxpr_lint import Diagnostic, emit

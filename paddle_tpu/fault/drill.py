@@ -39,6 +39,16 @@ def quick_config() -> Dict[str, Any]:
                 kinds=("mid_step", "mid_ckpt_write"), size="quick")
 
 
+def quick_health_config() -> Dict[str, Any]:
+    """``--quick --health``: the 2-kill drill chained with one
+    ``inject_nan`` and one ``inject_hang`` event — four faults, the same
+    bitwise parity gate, still well under 90 s."""
+    return dict(total_steps=12, ckpt_every=3, seed=7, n_kills=4,
+                kinds=("mid_step", "mid_ckpt_write", "inject_nan",
+                       "inject_hang"),
+                size="quick", health=True)
+
+
 def _fault_env(workdir: str, total_steps: int, ckpt_every: int,
                plan: FaultPlan, size: str) -> Dict[str, str]:
     env = dict(os.environ)
@@ -54,22 +64,74 @@ def _fault_env(workdir: str, total_steps: int, ckpt_every: int,
     return env
 
 
+def _dodge_resume_boundaries(plan: FaultPlan, ckpt_every: int,
+                             total_steps: int) -> FaultPlan:
+    """Give every ``inject_hang`` event >= 2 steps of runway after any
+    checkpoint-resume boundary (step 0 and multiples of ``ckpt_every``):
+    an incarnation's first dispatch is the XLA compile (watchdog unarmed,
+    unrecorded) and its second seeds the step-time median — a hang
+    landing earlier would stall undetected. Deterministic (pure
+    arithmetic on the seeded plan). Requires ``ckpt_every >= 3`` so such
+    steps exist."""
+    from .injection import FaultEvent
+    if not any(e.kind == "inject_hang" for e in plan.events):
+        return plan
+    if ckpt_every < 3:
+        raise ValueError(
+            "health drills with inject_hang need ckpt_every >= 3: the "
+            "watchdog arms two steps after each resume boundary, and "
+            f"with ckpt_every={ckpt_every} no step is that far from one")
+    taken = {e.step for e in plan.events}
+    moved = []
+    for e in plan.events:
+        s = e.step
+        if e.kind == "inject_hang":
+            taken.discard(e.step)
+            cands = [x for x in range(2, total_steps - 1)
+                     if x % ckpt_every >= 2 and x not in taken]
+            if not cands:
+                raise ValueError(
+                    f"no watchdog-armable step for inject_hang in "
+                    f"[2, {total_steps - 2}] with ckpt_every={ckpt_every}")
+            s = min(cands, key=lambda x: (abs(x - e.step), x))
+            taken.add(s)
+        moved.append(FaultEvent(e.kind, s))
+    return FaultPlan(moved, seed=plan.seed)
+
+
 def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
               seed: int = 7, n_kills: int = 2,
               kinds: Sequence[str] = ("mid_step", "mid_ckpt_write"),
               size: str = "quick", max_restarts: Optional[int] = None,
-              reference: str = "inline") -> Dict[str, Any]:
+              reference: str = "inline",
+              health: bool = False, canary_every: int = 3
+              ) -> Dict[str, Any]:
     """Run the fault-injected job + the uninterrupted reference, return the
     full report (goodput record, parity verdict, plan, per-run logs).
 
     ``reference`` is ``"inline"`` (run the reference trainer in this
     process — the step builder pins a single-device mesh, so the
     trajectory is identical to the subprocess run) or ``"subprocess"``.
+
+    ``health=True`` arms the guarded trainer (sentinel + watchdog +
+    canary + Guardian) in BOTH runs; the reference is handed the batch
+    positions the fault run's recovery policies will poison (derived
+    statically from the plan — ``inject_nan``/``inject_loss_spike``
+    events skip their batch), so parity compares against "the clean run
+    that never saw that batch".
     """
     from ..distributed.launch import LaunchConfig, launch
 
     plan = FaultPlan.from_seed(seed, total_steps, n_kills=n_kills,
                                kinds=tuple(kinds), min_step=1)
+    if health:
+        plan = _dodge_resume_boundaries(plan, ckpt_every, total_steps)
+    # batch positions the poison-kind events will skip: with one poisoned
+    # event the stream position IS the step (later events shift by the
+    # number of earlier skips — mirror the cursor arithmetic)
+    poison_steps = sorted(e.step for e in plan.events
+                          if e.kind in ("inject_nan", "inject_loss_spike"))
+    skips = [s + i for i, s in enumerate(poison_steps)]
     if max_restarts is None:
         max_restarts = n_kills + 2  # headroom over the planned faults
     fault_dir = os.path.join(workdir, "fault")
@@ -77,9 +139,17 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
     os.makedirs(fault_dir, exist_ok=True)
     os.makedirs(ref_dir, exist_ok=True)
 
+    env = _fault_env(fault_dir, total_steps, ckpt_every, plan, size)
+    if health:
+        env.update({"FAULT_HEALTH": "1",
+                    "FAULT_CANARY_EVERY": str(canary_every),
+                    # the stall comfortably outlives any plausible
+                    # deadline — the watchdog kills the process at the
+                    # deadline, so a longer sleep costs no wall time
+                    "FAULT_HANG_SLEEP_S": "8.0"})
     cfg = LaunchConfig(
         nproc_per_node=1, log_dir=os.path.join(fault_dir, "logs"),
-        envs=_fault_env(fault_dir, total_steps, ckpt_every, plan, size))
+        envs=env)
     t0 = time.perf_counter()
     rc = launch(cfg, TRAINER, max_restarts=max_restarts,
                 elastic_dir=os.path.join(fault_dir, "hb"))
@@ -89,7 +159,8 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
         "rc": rc, "plan": json.loads(plan.to_json()),
         "config": {"total_steps": total_steps, "ckpt_every": ckpt_every,
                    "seed": seed, "size": size,
-                   "max_restarts": max_restarts},
+                   "max_restarts": max_restarts, "health": health,
+                   "skips": skips},
     }
     log_path = os.path.join(fault_dir, "train_log.jsonl")
     if rc != 0 or not os.path.exists(log_path):
@@ -101,17 +172,33 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
     report["fired_events"] = sorted(
         _read_fired(os.path.join(fault_dir, "fired.json")))
     report["done"] = any(e.get("event") == "done" for e in flog["events"])
+    if health:
+        report["health"] = {
+            "anomalies": [e for e in flog["events"]
+                          if e.get("event") == "anomaly"],
+            "skipped_batches": flog["skipped_batches"],
+            "rewound_steps": flog["rewound_steps"],
+            "detection_latency_steps": flog["detection_latency_steps"],
+        }
 
     # -- the uninterrupted reference + bitwise parity -----------------------
     if reference == "inline":
         _trainer.train(ref_dir, total_steps=total_steps,
-                       ckpt_every=ckpt_every, plan_json="", size=size)
+                       ckpt_every=ckpt_every, plan_json="", size=size,
+                       health=health, skips=tuple(skips),
+                       canary_every=(canary_every if health else 0))
         ref_rc = 0
     else:
+        env_ref = _fault_env(ref_dir, total_steps, ckpt_every,
+                             FaultPlan([]), size)
+        if health:
+            env_ref.update({
+                "FAULT_HEALTH": "1",
+                "FAULT_CANARY_EVERY": str(canary_every),
+                "FAULT_SKIPS": ",".join(str(s) for s in skips)})
         cfg_ref = LaunchConfig(
             nproc_per_node=1, log_dir=os.path.join(ref_dir, "logs"),
-            envs=_fault_env(ref_dir, total_steps, ckpt_every,
-                            FaultPlan([]), size))
+            envs=env_ref)
         ref_rc = launch(cfg_ref, TRAINER)
     with open(os.path.join(ref_dir, "train_log.jsonl")) as f:
         rlog = goodput.parse_train_log(f)
@@ -162,4 +249,12 @@ def report_summary(report: Dict[str, Any]) -> str:
         f"  parity: bitwise_equal={p.get('bitwise_equal')} "
         f"over {p.get('steps')} steps",
     ]
+    h = report.get("health")
+    if h:
+        lines.append(
+            f"  health: anomalies="
+            f"{[a.get('kind') for a in h.get('anomalies', [])]} "
+            f"latency_steps={h.get('detection_latency_steps')} "
+            f"skipped={h.get('skipped_batches')} "
+            f"rewound={h.get('rewound_steps')}")
     return "\n".join(lines)

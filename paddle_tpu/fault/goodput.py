@@ -24,14 +24,22 @@ def parse_train_log(lines: Iterable[str]) -> Dict[str, Any]:
     Returns ``steps`` (step -> final {"loss", "t"} — re-executed steps keep
     the LAST occurrence), ``executions`` (total step-lines, counting
     re-runs), ``events`` (ordered event records: start/resumed/ckpt_saved/
-    ckpt_restored/done), and ``lost_steps`` (step-lines that a later
-    incarnation re-executed — committed work thrown away by a fault).
-    """
+    ckpt_restored/anomaly/rewind/skip_batch/done), ``lost_steps``
+    (step-lines that a later incarnation re-executed — committed work
+    thrown away by a fault), and the training-health aggregates:
+    ``skipped_batches`` (poisoned positions dropped),
+    ``rewound_steps`` (steps re-executed because the guardian rewound to
+    last-good — a subset of ``lost_steps``' causes), and
+    ``detection_latency_steps`` (per-anomaly ``detected - injected``
+    step counts, where the log carries both)."""
     import json
     steps: Dict[int, Dict[str, Any]] = {}
     events: List[Dict[str, Any]] = []
     executions = 0
     lost = 0
+    skipped = 0
+    rewound = 0
+    latencies: List[int] = []
     for line in lines:
         line = line.strip()
         if not line:
@@ -45,8 +53,19 @@ def parse_train_log(lines: Iterable[str]) -> Dict[str, Any]:
             steps[s] = rec
         elif "event" in rec:
             events.append(rec)
+            kind = rec["event"]
+            if kind == "skip_batch":
+                skipped += 1
+            elif kind == "rewind":
+                rewound += max(0, int(rec.get("from", 0))
+                               - int(rec.get("to", 0)))
+            elif kind == "anomaly" and \
+                    rec.get("latency_steps") is not None:
+                latencies.append(int(rec["latency_steps"]))
     return {"steps": steps, "events": events, "executions": executions,
-            "lost_steps": lost}
+            "lost_steps": lost, "skipped_batches": skipped,
+            "rewound_steps": rewound,
+            "detection_latency_steps": latencies}
 
 
 def compute_goodput(log: Dict[str, Any], wall_s: float,
@@ -74,6 +93,7 @@ def compute_goodput(log: Dict[str, Any], wall_s: float,
                 "max_ms": round(max(xs), 2)}
 
     goodput = (useful_s / wall_s) if wall_s > 0 else 0.0
+    latencies = list(log.get("detection_latency_steps", ()))
     record = {
         "goodput": round(goodput, 4),
         "useful_step_s": round(useful_s, 4),
@@ -84,6 +104,15 @@ def compute_goodput(log: Dict[str, Any], wall_s: float,
         "step_executions": int(log["executions"]),
         "ckpt_save": stats(save_ms),
         "ckpt_restore": stats(restore_ms),
+        # training-health aggregates (zero on a crash-only drill)
+        "skipped_batches": int(log.get("skipped_batches", 0)),
+        "rewound_steps": int(log.get("rewound_steps", 0)),
+        "detection_latency_steps": {
+            "count": len(latencies),
+            "max": max(latencies) if latencies else 0,
+            "mean": (round(sum(latencies) / len(latencies), 3)
+                     if latencies else 0.0),
+        },
     }
     _publish(record)
     return record
@@ -102,3 +131,12 @@ def _publish(record: Dict[str, Any]) -> None:
     metrics.gauge("fault.restarts",
                   "relaunches observed by the drill").labels().set(
                       record["restarts"])
+    metrics.gauge("fault.skipped_batches",
+                  "poisoned batch positions the guardian dropped"
+                  ).labels().set(record["skipped_batches"])
+    metrics.gauge("fault.rewound_steps",
+                  "steps re-executed by rewind-to-last-good recoveries"
+                  ).labels().set(record["rewound_steps"])
+    metrics.gauge("fault.detection_latency_steps",
+                  "max anomaly detection latency in steps"
+                  ).labels().set(record["detection_latency_steps"]["max"])

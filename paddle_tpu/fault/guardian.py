@@ -1,0 +1,229 @@
+"""The recovery policy engine: detect -> decide -> rewind -> skip -> resume.
+
+The health primitives (:mod:`paddle_tpu.fault.health`) *classify* a bad
+step; the :class:`Guardian` decides what to do about it, deterministically
+and durably:
+
+- **Typed policies** per anomaly kind: ``skip_batch`` (drop the poisoned
+  batch, keep going — the in-graph sentinel gate already kept the update
+  from applying), ``rewind`` (restore the *last-good* snapshot and replay
+  with the poisoned position skipped — for classes where corruption may
+  predate detection), ``relaunch`` (process-level escalation, the hang
+  path) and ``halt``.
+- **Last-good promotion**: a snapshot becomes the rewind target only
+  after ``promote_after`` consecutive clean sentinel steps following it —
+  rewind can never land on a poisoned checkpoint. Any anomaly voids every
+  not-yet-promoted snapshot (they sit inside the suspicion window). The
+  pointer itself lives in :class:`~paddle_tpu.fault.checkpoint_manager.
+  CheckpointManager` (``mark_good`` / ``last_good``), pinned against
+  retention.
+- **Durable journal**: every anomaly, decision, skip and promotion is an
+  fsynced JSONL record *before* its effect is applied, so a relaunch
+  (hang escalation, preemption) reconstructs the poisoned-batch skip set
+  instead of re-eating the batch that killed it.
+
+Policy tables are statically validated (rule F004,
+:func:`paddle_tpu.fault.health.check_health_plan`) at construction.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Set
+
+from . import health
+
+__all__ = ["Guardian", "Decision", "ACTIONS", "DEFAULT_POLICIES"]
+
+ACTIONS = ("skip_batch", "rewind", "relaunch", "halt")
+
+# Which anomaly classes implicate the *batch* (skip it on recovery) vs
+# the *state/hardware* (replay everything).
+BATCH_POISONING_KINDS = ("nan_loss", "nan_grad", "loss_spike",
+                         "grad_explosion")
+
+DEFAULT_POLICIES: Dict[str, str] = {
+    "nan_loss": "rewind",        # corruption may predate the NaN surfacing
+    "nan_grad": "rewind",
+    "loss_spike": "skip_batch",  # gate already blocked the update
+    "grad_explosion": "skip_batch",
+    "sdc": "rewind",             # transient bit-flip: state is suspect
+    "hang": "relaunch",          # a hung dispatch never returns in-process
+}
+
+
+@dataclass(frozen=True)
+class Decision:
+    """One typed, deterministic recovery decision."""
+    action: str                      # one of ACTIONS
+    kind: str                        # the anomaly class decided on
+    step: int                        # applied-step index of the anomaly
+    rewind_to: Optional[int] = None  # last-good step (action == "rewind")
+    skip_pos: Optional[int] = None   # poisoned stream position to drop
+    reason: str = ""
+
+
+class Guardian:
+    """Drives recovery for one guarded training run."""
+
+    def __init__(self, manager, policies: Optional[Dict[str, str]] = None,
+                 promote_after: int = 2, max_recoveries: int = 8,
+                 journal_path: Optional[str] = None):
+        self.manager = manager
+        self.policies = dict(DEFAULT_POLICIES)
+        self.policies.update(policies or {})
+        self.promote_after = int(promote_after)
+        self.max_recoveries = int(max_recoveries)
+        diags = health.check_health_plan(
+            self.policies, promote_after=self.promote_after,
+            max_recoveries=self.max_recoveries)
+        if diags:
+            from ..analysis.jaxpr_lint import emit
+            emit(diags, where="fault.Guardian", mode="warn")
+            raise ValueError(
+                "invalid health plan: " + "; ".join(d.message for d in diags))
+        self.journal_path = journal_path
+        self._mu = threading.Lock()
+        self.recoveries = 0
+        # save-step -> clean steps still required before promotion
+        self._pending: Dict[int, int] = {}
+        self._events: List[Dict[str, Any]] = []
+        if journal_path and os.path.exists(journal_path):
+            self._events = self._load_journal()
+            self.recoveries = sum(
+                1 for e in self._events
+                if e.get("event") == "decision"
+                and e.get("action") in ("skip_batch", "rewind"))
+
+    # -- durable journal -----------------------------------------------------
+
+    def _load_journal(self) -> List[Dict[str, Any]]:
+        out = []
+        try:
+            with open(self.journal_path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        break  # torn tail from a mid-write death
+        except OSError:
+            pass
+        return out
+
+    def record(self, rec: Dict[str, Any]) -> None:
+        """Append + fsync one journal record BEFORE its effect applies."""
+        with self._mu:
+            self._events.append(dict(rec))
+            if not self.journal_path:
+                return
+            with open(self.journal_path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+
+    def events(self) -> List[Dict[str, Any]]:
+        return list(self._events)
+
+    def skips(self) -> Set[int]:
+        """Poisoned stream positions journaled so far (survives
+        relaunches — the skip record lands before the rewind/skip)."""
+        return {int(e["skip_pos"]) for e in self._events
+                if e.get("event") == "decision"
+                and e.get("skip_pos") is not None}
+
+    # -- last-good promotion -------------------------------------------------
+
+    def note_save(self, step: int) -> None:
+        """A snapshot for ``step`` was scheduled; it promotes to
+        last-good after ``promote_after`` clean steps at/after it."""
+        self._pending[int(step)] = self.promote_after
+
+    def note_clean_step(self, step: int) -> None:
+        """One clean sentinel step observed; promote matured snapshots."""
+        for s in self._pending:
+            if s <= step:
+                self._pending[s] -= 1
+        ready = [s for s, left in self._pending.items() if left <= 0]
+        if not ready:
+            return
+        # an async save may not have committed yet — then it simply
+        # promotes on a later clean step
+        committed = set(self.manager.all_steps())
+        ready = [s for s in ready if s in committed]
+        if not ready:
+            return
+        good = max(ready)
+        for s in [s for s in self._pending if s <= good]:
+            del self._pending[s]
+        self.manager.mark_good(good)
+        self.record({"event": "promote", "step": good})
+        from ..observability import metrics
+        metrics.gauge(
+            "fault.last_good_step",
+            "newest snapshot promoted to rewind target"
+        ).labels().set(good)
+
+    # -- the decision --------------------------------------------------------
+
+    def decide(self, kind: str, step: int,
+               pos: Optional[int] = None) -> Decision:
+        """Map one classified anomaly to its typed recovery decision
+        (pure — no side effects; :meth:`on_anomaly` journals + applies
+        bookkeeping)."""
+        action = self.policies.get(kind, "halt")
+        if action in ("skip_batch", "rewind") and \
+                self.recoveries >= self.max_recoveries:
+            return Decision(action="halt", kind=kind, step=int(step),
+                            reason=f"recovery budget exhausted "
+                                   f"({self.recoveries} >= "
+                                   f"{self.max_recoveries})")
+        skip = int(pos) if (pos is not None
+                            and kind in BATCH_POISONING_KINDS) else None
+        if action == "skip_batch":
+            return Decision(action="skip_batch", kind=kind, step=int(step),
+                            skip_pos=skip,
+                            reason="update gated in-graph; drop the batch")
+        if action == "rewind":
+            good = self.manager.last_good()
+            if good is None:
+                return Decision(action="halt", kind=kind, step=int(step),
+                                reason="no promoted last-good snapshot to "
+                                       "rewind to")
+            return Decision(action="rewind", kind=kind, step=int(step),
+                            rewind_to=int(good), skip_pos=skip,
+                            reason=f"rewind to last-good step {good}")
+        if action == "relaunch":
+            return Decision(action="relaunch", kind=kind, step=int(step),
+                            reason="escalate to the elastic relaunch path")
+        return Decision(action="halt", kind=kind, step=int(step),
+                        reason=f"policy for {kind!r} is halt")
+
+    def on_anomaly(self, kind: str, step: int, pos: Optional[int] = None,
+                   inject_step: Optional[int] = None,
+                   detail: str = "") -> Decision:
+        """Journal the anomaly + decision (fsync, BEFORE the caller acts
+        on it), void unpromoted snapshots, count the recovery."""
+        dec = self.decide(kind, step, pos=pos)
+        self._pending.clear()  # in the suspicion window — never promote
+        latency = (int(step) - int(inject_step)
+                   if inject_step is not None else None)
+        self.record({"event": "anomaly", "kind": kind, "step": int(step),
+                     "detail": detail, "inject_step": inject_step,
+                     "latency_steps": latency})
+        self.record({"event": "decision", "kind": kind, "step": int(step),
+                     "action": dec.action, "rewind_to": dec.rewind_to,
+                     "skip_pos": dec.skip_pos, "reason": dec.reason})
+        if dec.action in ("skip_batch", "rewind"):
+            self.recoveries += 1
+        from ..observability import metrics
+        metrics.counter(
+            "fault.recoveries",
+            "guardian recovery decisions applied"
+        ).labels(action=dec.action).inc()
+        return dec

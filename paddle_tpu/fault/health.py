@@ -1,0 +1,491 @@
+"""Training-health primitives: detect a run that is *alive and wrong*.
+
+PR 7's fault tier recovers a training process that **dies** (SIGKILL /
+SIGTERM drills, bitwise resume). This module covers the failure classes
+that dominate at pod scale precisely because nothing crashes:
+
+- **Step sentinel** (:class:`StepSentinel` + :func:`fused_stats` /
+  :func:`fused_ok`): one fused on-device ``[loss, grad_global_norm]``
+  reduction per step, gated in-graph against finiteness and host-fed
+  rolling-median thresholds. The clean path adds **no host sync** — the
+  verdict vector returns with the loss the training loop already fetches,
+  and the update is skipped *inside* the compiled step (``jnp.where``)
+  when the check fails, so a NaN/spiking batch can never poison params.
+- **Hang watchdog** (:class:`HangWatchdog`): a wall-clock deadline around
+  device dispatch, scaled from the observed step-time median, that
+  classifies a stuck step as *hung* and escalates to the elastic relaunch
+  path (exit :data:`HANG_EXIT_CODE`) — a hung DCN collective never
+  returns, so detection must live outside the device program.
+- **SDC canary** (:class:`SdcCanary`): every K steps re-execute the grad
+  computation on the same inputs and compare bitwise (CPU mesh) or
+  tolerance-gated (real device) — the only way to catch a
+  corrupt-but-finite gradient no finiteness check can see.
+- **Shared numerics scan** (:func:`check_numerics`): the single entry the
+  train-step builders call for the ``FLAGS_check_nan_inf`` scans
+  (previously scattered across ``framework/sharded.py``,
+  ``framework/eager.py`` and ``hapi/model.py``).
+- **Batch cursor** (:class:`BatchCursor`): the deterministic
+  applied-step -> batch mapping with poisoned-position skip, shared by the
+  guarded trainer and its clean reference so "the run that never saw that
+  batch" is a well-defined, bitwise-comparable object.
+
+Static validation (rules F004/F005, same Diagnostic channel as every
+analyzer): :func:`check_health_plan` rejects policy tables that cannot
+run and :func:`check_canary` rejects canary cadences that cannot detect.
+The recovery *policy* side lives in :mod:`paddle_tpu.fault.guardian`.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["StepSentinel", "Verdict", "HangWatchdog", "SdcCanary",
+           "CanaryVerdict", "BatchCursor", "fused_stats", "fused_ok",
+           "check_numerics", "flip_one_bit", "sentinel_on",
+           "check_health_plan", "check_canary", "HANG_EXIT_CODE",
+           "SENTINEL_KINDS", "ANOMALY_KINDS"]
+
+# Distinct from the preemption exit (101) and the auto-parallel re-tune
+# exit (102): the elastic manager relaunches on it (budgeted), and the
+# drill report can tell a hang escalation from a preemption.
+HANG_EXIT_CODE = 103
+
+# Anomaly kinds the sentinel classifies (detection latency <= 1 step)...
+SENTINEL_KINDS = ("nan_loss", "nan_grad", "loss_spike", "grad_explosion")
+# ...plus the out-of-band detectors (canary / watchdog).
+ANOMALY_KINDS = SENTINEL_KINDS + ("sdc", "hang")
+
+
+def sentinel_on() -> bool:
+    from ..core import flags
+    return str(flags.flag("health_sentinel")) == "on"
+
+
+# ---------------------------------------------------------------------------
+# Shared FLAGS_check_nan_inf scan entry (dedupes the per-step call sites)
+# ---------------------------------------------------------------------------
+
+def check_numerics(loss=None, grads=None, opt_state=None,
+                   where: str = "step", force: bool = False) -> None:
+    """The one shared NaN/Inf scan the step builders call.
+
+    Behavior-identical composition of the ``amp.debugging`` primitives the
+    call sites used to invoke individually: ``loss`` through
+    ``check_numerics``, ``grads`` through ``check_numerics_tree`` (named
+    ``<where>/grads``), ``opt_state`` through ``check_optimizer_state``
+    (named ``<where>/opt_state``). No-op unless ``FLAGS_check_nan_inf``
+    is set (or ``force``)."""
+    from ..amp import debugging as _dbg
+    if not (force or _dbg.enabled()):
+        return
+    if loss is not None:
+        _dbg.check_numerics(loss, "loss", where=where, force=force)
+    if grads is not None:
+        _dbg.check_numerics_tree(grads, where=where + "/grads", force=force)
+    if opt_state is not None:
+        _dbg.check_optimizer_state(opt_state, where=where, force=force)
+
+
+# ---------------------------------------------------------------------------
+# The fused in-graph sentinel
+# ---------------------------------------------------------------------------
+
+def fused_stats(loss, grads):
+    """``f32[2] = [loss, grad_global_norm]`` — one fused reduction tree
+    over the grads, computed on device inside the compiled step. This is
+    the sentinel's whole per-step device cost."""
+    import jax
+    import jax.numpy as jnp
+    sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+          for g in jax.tree_util.tree_leaves(grads)
+          if hasattr(g, "dtype") and jnp.issubdtype(g.dtype, jnp.floating)]
+    gnorm = (jnp.sqrt(jnp.sum(jnp.stack(sq))) if sq
+             else jnp.asarray(0.0, jnp.float32))
+    return jnp.stack([jnp.asarray(loss, jnp.float32).reshape(()), gnorm])
+
+
+def fused_ok(stats, guard):
+    """In-graph verdict: finite AND below the host-fed rolling-median
+    thresholds. ``guard = f32[4] = [median_loss, median_gnorm,
+    spike_factor, explode_factor]`` (medians 0 during warmup disable the
+    threshold half). Returns a boolean scalar the step uses to gate the
+    optimizer update (``jnp.where(ok, new, old)``)."""
+    import jax.numpy as jnp
+    loss, gnorm = stats[0], stats[1]
+    finite = jnp.isfinite(loss) & jnp.isfinite(gnorm)
+    spike = (guard[0] > 0) & (loss > guard[2] * guard[0])
+    explode = (guard[1] > 0) & (gnorm > guard[3] * guard[1])
+    return finite & (~spike) & (~explode)
+
+
+@dataclass(frozen=True)
+class Verdict:
+    """One step's sentinel classification (host side)."""
+    kind: str           # "ok" or one of SENTINEL_KINDS
+    ok: bool
+    loss: float
+    grad_norm: float
+    applied: bool       # did the in-graph gate let the update through?
+    detail: str = ""
+
+
+class StepSentinel:
+    """Host half of the step sentinel: rolling medians + classification.
+
+    Per step the trainer feeds :meth:`guard_vector` into the compiled
+    step and classifies the returned stats with :meth:`verdict` (that
+    read coincides with the loss fetch the loop already performs, so the
+    clean path stays sync-free). Windows only advance on clean steps —
+    an anomaly never drags the median toward itself."""
+
+    def __init__(self, spike_factor: float = 10.0,
+                 explode_factor: float = 50.0,
+                 window: int = 16, warmup: int = 3):
+        self.spike_factor = float(spike_factor)
+        self.explode_factor = float(explode_factor)
+        self.warmup = int(warmup)
+        self._loss = deque(maxlen=int(window))
+        self._gnorm = deque(maxlen=int(window))
+
+    def _medians(self) -> Tuple[float, float]:
+        if len(self._loss) < self.warmup:
+            return 0.0, 0.0
+        return (float(np.median(self._loss)), float(np.median(self._gnorm)))
+
+    def guard_vector(self) -> np.ndarray:
+        ml, mg = self._medians()
+        return np.asarray([ml, mg, self.spike_factor, self.explode_factor],
+                          np.float32)
+
+    def verdict(self, stats) -> Verdict:
+        """Classify one step's fused stats (syncs ``stats`` to host)."""
+        a = np.asarray(stats, np.float64)
+        loss, gnorm = float(a[0]), float(a[1])
+        applied = bool(a[2] >= 0.5) if a.shape[0] > 2 else True
+        ml, mg = self._medians()
+        if not np.isfinite(loss):
+            kind, det = "nan_loss", f"loss={loss}"
+        elif not np.isfinite(gnorm):
+            kind, det = "nan_grad", f"grad_norm={gnorm}"
+        elif ml > 0 and loss > self.spike_factor * ml:
+            kind, det = "loss_spike", \
+                f"loss={loss:.6g} > {self.spike_factor}x median {ml:.6g}"
+        elif mg > 0 and gnorm > self.explode_factor * mg:
+            kind, det = "grad_explosion", \
+                f"grad_norm={gnorm:.6g} > {self.explode_factor}x " \
+                f"median {mg:.6g}"
+        else:
+            kind, det = "ok", ""
+        if kind == "ok":
+            self._loss.append(loss)
+            self._gnorm.append(gnorm)
+        else:
+            from ..observability import metrics
+            metrics.counter(
+                "fault.anomalies",
+                "anomalous steps flagged by the health sentinel"
+            ).labels(kind=kind).inc()
+        return Verdict(kind=kind, ok=(kind == "ok"), loss=loss,
+                       grad_norm=gnorm, applied=applied, detail=det)
+
+    def reset(self) -> None:
+        self._loss.clear()
+        self._gnorm.clear()
+
+
+# ---------------------------------------------------------------------------
+# Hang watchdog
+# ---------------------------------------------------------------------------
+
+class HangWatchdog:
+    """Wall-clock deadline around device dispatch.
+
+    The deadline scales from the observed step-time median
+    (``max(scale * median, floor_s)``); until enough steps are observed
+    the guard is inert (the first dispatch of an incarnation includes an
+    XLA compile and must not count). When a guarded region overruns, the
+    timer thread classifies the step as *hung*, bumps ``fault.hangs``,
+    and calls ``on_hang(info)`` — the default escalates to the elastic
+    relaunch path via ``os._exit(HANG_EXIT_CODE)``: a hung collective
+    never returns, so in-process recovery is not an option."""
+
+    def __init__(self, scale: float = 6.0, floor_s: float = 0.5,
+                 window: int = 16,
+                 on_hang: Optional[Callable[[Dict[str, Any]], None]] = None):
+        self.scale = float(scale)
+        self.floor_s = float(floor_s)
+        self.on_hang = on_hang
+        self._times: deque = deque(maxlen=int(window))
+        self.fired = False
+
+    def observe(self, dt_s: float) -> None:
+        self._times.append(float(dt_s))
+
+    def deadline_s(self) -> Optional[float]:
+        if not self._times:
+            return None
+        import statistics
+        return max(self.scale * statistics.median(self._times), self.floor_s)
+
+    @contextmanager
+    def guard(self, step: Optional[int] = None, armed: bool = True,
+              record: bool = True):
+        """Run one dispatch under the deadline. ``armed=False`` (or no
+        median yet) disables the timer; ``record=False`` keeps this
+        region's duration out of the median (compile steps)."""
+        dl = self.deadline_s() if armed else None
+        timer = None
+        if dl is not None:
+            timer = threading.Timer(dl, self._fire, args=(step, dl))
+            timer.daemon = True
+            timer.start()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if timer is not None:
+                timer.cancel()
+            if record and not self.fired:
+                self.observe(time.perf_counter() - t0)
+
+    def _fire(self, step, deadline_s) -> None:
+        self.fired = True
+        from ..observability import metrics
+        metrics.counter(
+            "fault.hangs", "steps classified hung by the watchdog").inc()
+        info = {"kind": "hang", "step": step,
+                "deadline_s": round(float(deadline_s), 4)}
+        if self.on_hang is not None:
+            self.on_hang(info)
+            return
+        print(f"[fault.health] step {step} exceeded the hang deadline "
+              f"({deadline_s:.2f}s); escalating to relaunch "
+              f"(exit {HANG_EXIT_CODE})", file=sys.stderr)
+        import os
+        os._exit(HANG_EXIT_CODE)
+
+
+# ---------------------------------------------------------------------------
+# SDC canary
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CanaryVerdict:
+    clean: bool
+    step: int
+    mismatches: Tuple[str, ...] = ()
+    detail: str = ""
+
+
+class SdcCanary:
+    """Every ``every`` steps, re-execute a pure step function on the same
+    inputs and compare the two results — bitwise on deterministic
+    backends (the CPU mesh), tolerance-gated (``mode="tolerance"``) where
+    reductions are not run-to-run deterministic. A mismatch is silent
+    data corruption: the value is finite, plausible, and wrong."""
+
+    def __init__(self, every: int = 16, mode: str = "bitwise",
+                 rtol: float = 1e-5, atol: float = 1e-6):
+        if mode not in ("bitwise", "tolerance"):
+            raise ValueError(f"unknown canary mode {mode!r}")
+        self.every = int(every)
+        self.mode = mode
+        self.rtol, self.atol = float(rtol), float(atol)
+
+    def due(self, step: int) -> bool:
+        # step 0 is the compile step — the first canary window ends at
+        # ``every``, not at 0
+        return self.every > 0 and step > 0 and step % self.every == 0
+
+    def check(self, step: int, fn: Callable[[], Any],
+              corrupt: Optional[Callable[[Any], Any]] = None
+              ) -> CanaryVerdict:
+        """Run ``fn`` twice and compare. ``corrupt`` (tests / the
+        ``inject_sdc`` drill seam) post-processes the FIRST execution's
+        host copy — modeling a bit flip during one of the two runs."""
+        import jax
+        from ..observability import metrics, step_monitor
+        with step_monitor.current().phase("canary"):
+            a = jax.tree_util.tree_map(np.asarray, fn())
+            b = jax.tree_util.tree_map(np.asarray, fn())
+        if corrupt is not None:
+            a = corrupt(a)
+        mism = self._diff(a, b)
+        metrics.counter("fault.canary_runs",
+                        "SDC canary double-executions").inc()
+        if mism:
+            metrics.counter(
+                "fault.anomalies",
+                "anomalous steps flagged by the health sentinel"
+            ).labels(kind="sdc").inc()
+        return CanaryVerdict(
+            clean=not mism, step=int(step), mismatches=tuple(mism[:8]),
+            detail=("" if not mism else
+                    f"{len(mism)} leaf(s) differ between re-executions "
+                    f"({self.mode})"))
+
+    def _diff(self, a, b) -> List[str]:
+        import jax
+        fa, _ = jax.tree_util.tree_flatten_with_path(a)
+        fb, _ = jax.tree_util.tree_flatten_with_path(b)
+        out = []
+        for (pa, la), (_, lb) in zip(fa, fb):
+            la, lb = np.asarray(la), np.asarray(lb)
+            if self.mode == "bitwise":
+                same = (la.shape == lb.shape and la.dtype == lb.dtype
+                        and la.tobytes() == lb.tobytes())
+            else:
+                same = la.shape == lb.shape and bool(np.allclose(
+                    la.astype(np.float64), lb.astype(np.float64),
+                    rtol=self.rtol, atol=self.atol, equal_nan=True))
+            if not same:
+                out.append(jax.tree_util.keystr(pa) or "leaf")
+        return out
+
+
+def flip_one_bit(tree, seed: int):
+    """Deterministically flip ONE bit of one floating leaf of ``tree``
+    (host numpy copies) — the seeded SDC corruption the drill injects
+    into a canary run. Returns the corrupted tree."""
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    idx = [i for i, l in enumerate(leaves)
+           if isinstance(l, np.ndarray)
+           and np.issubdtype(l.dtype, np.floating) and l.size > 0]
+    if not idx:
+        return tree
+    rng = np.random.default_rng(int(seed))
+    li = int(idx[int(rng.integers(0, len(idx)))])
+    a = np.array(leaves[li], copy=True)
+    flat = a.reshape(-1).view(np.uint8)
+    byte = int(rng.integers(0, flat.size))
+    bit = int(rng.integers(0, 8))
+    flat[byte] ^= np.uint8(1 << bit)
+    leaves = list(leaves)
+    leaves[li] = a
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+# ---------------------------------------------------------------------------
+# Batch cursor: applied-step -> pool batch with poisoned-position skip
+# ---------------------------------------------------------------------------
+
+class BatchCursor:
+    """Deterministic mapping from *applied* step index to a position in
+    the cyclic batch stream, skipping poisoned positions.
+
+    Position ``p`` addresses batch ``pool[p % pool_size]`` of an infinite
+    cyclic stream. With no skips, step ``n`` consumes position ``n`` —
+    exactly the legacy ``step % pool`` cursor. Skipping a position shifts
+    every later step by one, identically in the guarded run (which
+    discovers the poison) and the clean reference (which is handed the
+    skip set up front) — that shared arithmetic is what makes the
+    rewind-and-skip run bitwise-comparable to "the run that never saw
+    that batch"."""
+
+    def __init__(self, pool_size: int, skips: Iterable[int] = ()):
+        self.pool_size = int(pool_size)
+        self.skips = set(int(s) for s in skips)
+
+    def position_for(self, applied_step: int) -> int:
+        pos, seen = 0, 0
+        while True:
+            if pos not in self.skips:
+                if seen == applied_step:
+                    return pos
+                seen += 1
+            pos += 1
+
+    def batch_index(self, applied_step: int) -> int:
+        return self.position_for(applied_step) % self.pool_size
+
+    def skip(self, pos: int) -> None:
+        self.skips.add(int(pos))
+
+
+# ---------------------------------------------------------------------------
+# Static validation — rules F004 (health plan) / F005 (canary cadence)
+# ---------------------------------------------------------------------------
+
+def check_health_plan(policies: Dict[str, str],
+                      promote_after: int = 2,
+                      spike_factor: float = 10.0,
+                      explode_factor: float = 50.0,
+                      max_recoveries: int = 8):
+    """Static validation of a Guardian configuration — a policy table
+    that names an unknown anomaly kind or action, a last-good promotion
+    threshold that can never promote, or thresholds below the medians
+    they compare against would make the recovery loop vacuous (or
+    permanently tripping). Returns ``analysis.Diagnostic`` records
+    (rule F004)."""
+    from ..analysis.jaxpr_lint import Diagnostic
+    from .guardian import ACTIONS
+    diags = []
+
+    def bad(msg, hint=""):
+        diags.append(Diagnostic(
+            rule="F004", name="health-plan-invalid", severity="error",
+            message=msg, hint=hint, where="fault.health"))
+
+    for kind, action in dict(policies or {}).items():
+        if kind not in ANOMALY_KINDS:
+            bad(f"policy declared for unknown anomaly kind {kind!r}; "
+                f"known kinds: {ANOMALY_KINDS}")
+        if action not in ACTIONS:
+            bad(f"unknown recovery action {action!r} for {kind!r}; "
+                f"known actions: {ACTIONS}")
+    if int(promote_after) < 1:
+        bad(f"promote_after={promote_after} — a snapshot must survive at "
+            "least one clean sentinel step before becoming the rewind "
+            "target, else rewind can land on a poisoned checkpoint")
+    if float(spike_factor) <= 1.0:
+        bad(f"spike_factor={spike_factor} <= 1: every step above the "
+            "rolling median would be classified a loss spike")
+    if float(explode_factor) <= 1.0:
+        bad(f"explode_factor={explode_factor} <= 1: every step above the "
+            "rolling median would be classified a gradient explosion")
+    if int(max_recoveries) < 1:
+        bad(f"max_recoveries={max_recoveries} — the guardian could never "
+            "run a recovery before halting")
+    return diags
+
+
+def check_canary(every: int, total_steps: Optional[int] = None,
+                 mode: str = "bitwise"):
+    """Canary-cadence sanity (rule F005): a cadence of 1 doubles step
+    compute (warning — detection latency 0 is rarely worth 2x cost), a
+    cadence past the run length never executes (error), and an unknown
+    compare mode cannot run (error)."""
+    from ..analysis.jaxpr_lint import Diagnostic
+    diags = []
+
+    def add(sev, msg, hint=""):
+        diags.append(Diagnostic(
+            rule="F005", name="canary-cadence", severity=sev,
+            message=msg, hint=hint, where="fault.health"))
+
+    every = int(every)
+    if mode not in ("bitwise", "tolerance"):
+        add("error", f"unknown canary compare mode {mode!r}; expected "
+            "'bitwise' (deterministic backends) or 'tolerance'")
+    if every < 0:
+        add("error", f"canary cadence {every} is negative")
+    elif every == 1:
+        add("warning", "canary cadence 1 re-executes EVERY step — 2x "
+            "step compute for a latency win over cadence 2 of one step",
+            hint="K in [8, 64] bounds detection latency at a few percent "
+                 "re-execution cost")
+    if total_steps is not None and every > 0 and every >= int(total_steps):
+        add("error", f"canary cadence {every} >= total_steps "
+            f"{total_steps}: after step 0 the canary never runs again "
+            "inside this run",
+            hint="pick a cadence that divides the run into >1 windows")
+    return diags

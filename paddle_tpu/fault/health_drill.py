@@ -1,0 +1,203 @@
+"""The training-health drill: inject → detect → decide → recover → prove.
+
+Four injected-failure scenarios over the guarded drill trainer
+(``fault/_trainer.py`` in health mode) plus a false-positive gate:
+
+- ``nan``: ``inject_nan`` poisons one step's loss — the fused sentinel
+  detects it the same step, the Guardian rewinds to last-good and
+  replays with the poisoned batch skipped; the final per-step losses
+  must be **bitwise-equal** to a clean run that never saw that batch.
+- ``spike``: ``inject_loss_spike`` — detected same step via the rolling
+  median, policy ``skip_batch`` (the in-graph gate already blocked the
+  update, so no rewind); bitwise parity against the skip reference.
+- ``hang``: ``inject_hang`` stalls a dispatch — the wall-clock watchdog
+  classifies it hung and escalates to the elastic relaunch path
+  (exit 103); the relaunched incarnation resumes from the latest
+  checkpoint; bitwise parity against a clean run (a hang poisons
+  nothing). Runs as a subprocess pod under the elastic launcher.
+- ``sdc``: ``inject_sdc`` flips one bit in one gradient leaf of a canary
+  re-execution — detected at the next canary step (latency <= K), policy
+  rewind WITHOUT a batch skip (the corruption is transient, the batch is
+  innocent); bitwise parity against a clean run.
+- ``clean``: 200 steps with the sentinel and canary armed and **no**
+  injected faults — zero anomalies tolerated (the false-positive gate).
+
+CLI: ``tools/health_drill.py`` (``--quick`` runs all five).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from . import _trainer, goodput
+from .injection import FaultEvent, FaultPlan
+
+__all__ = ["run_scenario", "run_health_drill", "report_summary"]
+
+SCENARIOS = ("nan", "spike", "hang", "sdc", "clean")
+
+
+def _read_log(workdir: str) -> Dict[str, Any]:
+    with open(os.path.join(workdir, "train_log.jsonl")) as f:
+        return goodput.parse_train_log(f)
+
+
+def _losses(log: Dict[str, Any]) -> Dict[int, float]:
+    return {int(s): r["loss"] for s, r in log["steps"].items()}
+
+
+def _parity(flog, rlog, total_steps: int) -> Dict[str, Any]:
+    fl, rl = _losses(flog), _losses(rlog)
+    missing = [s for s in range(total_steps) if s not in fl or s not in rl]
+    diffs = [{"step": s, "fault": fl[s], "reference": rl[s]}
+             for s in range(total_steps)
+             if s in fl and s in rl and fl[s] != rl[s]]
+    return {"bitwise_equal": not missing and not diffs,
+            "steps": total_steps, "missing_steps": missing,
+            "mismatches": diffs[:8]}
+
+
+def run_scenario(scenario: str, workdir: str, total_steps: int = 10,
+                 ckpt_every: int = 2, canary_every: int = 3,
+                 inject_step: int = 5) -> Dict[str, Any]:
+    """Run one scenario (fault run + its matching clean reference) and
+    return the verdict record: anomalies, detection latency, recovery
+    events, parity."""
+    os.makedirs(workdir, exist_ok=True)
+    fdir = os.path.join(workdir, "fault")
+    rdir = os.path.join(workdir, "reference")
+    expect_kind, skips = None, ()
+    plan = FaultPlan([])
+    if scenario == "nan":
+        plan = FaultPlan([FaultEvent("inject_nan", inject_step)])
+        expect_kind, skips = "nan_loss", (inject_step,)
+    elif scenario == "spike":
+        plan = FaultPlan([FaultEvent("inject_loss_spike", inject_step)])
+        expect_kind, skips = "loss_spike", (inject_step,)
+    elif scenario == "sdc":
+        # placed just past a canary step so detection latency is the
+        # canary cadence minus one — a real (nonzero, <= K) latency
+        inject_step = canary_every + 1
+        plan = FaultPlan([FaultEvent("inject_sdc", inject_step)])
+        expect_kind = "sdc"
+    elif scenario == "hang":
+        return _run_hang(workdir, total_steps=total_steps,
+                         canary_every=canary_every)
+    elif scenario == "clean":
+        pass  # no plan, no reference — the caller sizes the gate run
+    else:
+        raise ValueError(f"unknown scenario {scenario!r}; "
+                         f"expected one of {SCENARIOS}")
+
+    t0 = time.perf_counter()
+    _trainer.train(fdir, total_steps=total_steps, ckpt_every=ckpt_every,
+                   plan_json=plan.to_json(), health=True,
+                   canary_every=canary_every)
+    wall_s = time.perf_counter() - t0
+    flog = _read_log(fdir)
+    record: Dict[str, Any] = {
+        "scenario": scenario, "total_steps": total_steps,
+        "goodput_record": goodput.compute_goodput(flog, wall_s),
+        "anomalies": [e for e in flog["events"]
+                      if e.get("event") == "anomaly"],
+        "rewinds": [e for e in flog["events"]
+                    if e.get("event") == "rewind"],
+        "skipped_batches": flog["skipped_batches"],
+        "detection_latency_steps": flog["detection_latency_steps"],
+    }
+    if scenario == "clean":
+        record["ok"] = (not record["anomalies"]
+                        and len(flog["steps"]) == total_steps)
+        record["false_positives"] = len(record["anomalies"])
+        return record
+
+    _trainer.train(rdir, total_steps=total_steps, ckpt_every=ckpt_every,
+                   plan_json="", health=True, skips=skips,
+                   canary_every=canary_every)
+    record["parity"] = _parity(flog, _read_log(rdir), total_steps)
+    kinds = [a["kind"] for a in record["anomalies"]]
+    latencies = record["detection_latency_steps"]
+    latency_ok = bool(latencies) and (
+        max(latencies) <= (canary_every if scenario == "sdc" else 1))
+    record["ok"] = (kinds == [expect_kind] and latency_ok
+                    and record["parity"]["bitwise_equal"])
+    return record
+
+
+def _run_hang(workdir: str, total_steps: int, canary_every: int
+              ) -> Dict[str, Any]:
+    """The hang scenario needs a real process to kill: run the guarded
+    trainer as a subprocess pod under the elastic launcher, stall one
+    dispatch, and require exactly one watchdog escalation + relaunch +
+    bitwise parity with an uninterrupted clean run."""
+    from ..distributed.launch import LaunchConfig, launch
+    from .drill import TRAINER, _fault_env
+
+    ckpt_every = 3  # hang steps need >= 2 steps of watchdog runway
+    hang_step = next(s for s in range(2, total_steps - 1)
+                     if s % ckpt_every >= 2)
+    plan = FaultPlan([FaultEvent("inject_hang", hang_step)])
+    fdir = os.path.join(workdir, "fault")
+    rdir = os.path.join(workdir, "reference")
+    os.makedirs(fdir, exist_ok=True)
+    env = _fault_env(fdir, total_steps, ckpt_every, plan, "quick")
+    env.update({"FAULT_HEALTH": "1",
+                "FAULT_CANARY_EVERY": str(canary_every),
+                "FAULT_HANG_SLEEP_S": "8.0"})
+    cfg = LaunchConfig(nproc_per_node=1,
+                       log_dir=os.path.join(fdir, "logs"), envs=env)
+    t0 = time.perf_counter()
+    rc = launch(cfg, TRAINER, max_restarts=2,
+                elastic_dir=os.path.join(fdir, "hb"))
+    wall_s = time.perf_counter() - t0
+    record: Dict[str, Any] = {"scenario": "hang",
+                              "total_steps": total_steps, "rc": rc}
+    if rc != 0:
+        record.update(ok=False, error=f"hang run exited rc={rc}")
+        return record
+    flog = _read_log(fdir)
+    record["goodput_record"] = goodput.compute_goodput(flog, wall_s)
+    record["anomalies"] = [e for e in flog["events"]
+                           if e.get("event") == "anomaly"]
+    _trainer.train(rdir, total_steps=total_steps, ckpt_every=ckpt_every,
+                   plan_json="", health=True, canary_every=canary_every)
+    record["parity"] = _parity(flog, _read_log(rdir), total_steps)
+    kinds = [a["kind"] for a in record["anomalies"]]
+    record["ok"] = (kinds == ["hang"]
+                    and record["goodput_record"]["restarts"] == 1
+                    and record["parity"]["bitwise_equal"])
+    return record
+
+
+def run_health_drill(workdir: str,
+                     scenarios: Optional[List[str]] = None,
+                     clean_steps: int = 200) -> Dict[str, Any]:
+    """Run the requested scenarios (default: all five) and aggregate."""
+    os.makedirs(workdir, exist_ok=True)
+    out: Dict[str, Any] = {"scenarios": {}}
+    for sc in (scenarios or list(SCENARIOS)):
+        steps = clean_steps if sc == "clean" else 10
+        out["scenarios"][sc] = run_scenario(
+            sc, os.path.join(workdir, sc), total_steps=steps)
+    out["ok"] = all(r.get("ok") for r in out["scenarios"].values())
+    return out
+
+
+def report_summary(report: Dict[str, Any]) -> str:
+    lines = [f"health drill ok={report.get('ok')}"]
+    for name, r in report.get("scenarios", {}).items():
+        kinds = [a["kind"] for a in r.get("anomalies", [])]
+        lat = r.get("detection_latency_steps") or \
+            [a.get("latency_steps") for a in r.get("anomalies", [])
+             if a.get("latency_steps") is not None]
+        par = r.get("parity", {}).get("bitwise_equal")
+        extra = (f" false_positives={r.get('false_positives')}"
+                 if name == "clean" else
+                 f" detected={kinds} latency_steps={lat} "
+                 f"parity_bitwise={par} "
+                 f"rewound={r.get('goodput_record', {}).get('rewound_steps')} "
+                 f"skipped={r.get('skipped_batches')}")
+        lines.append(f"  {name}: ok={r.get('ok')}{extra}")
+    return "\n".join(lines)

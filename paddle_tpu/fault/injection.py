@@ -43,7 +43,15 @@ __all__ = ["FaultEvent", "FaultPlan", "FaultInjector", "FAULT_KINDS",
 FAULT_KINDS = ("mid_step", "mid_ckpt_write", "sigterm",
                # serving-tier kinds (tools/serve_drill.py): the "step" is
                # the engine's decode-iteration / spill counter
-               "mid_decode", "mid_spill")
+               "mid_decode", "mid_spill",
+               # training-health kinds (tools/health_drill.py): the
+               # process survives, the *step* is wrong — a NaN-poisoned
+               # loss, a loss spike, a stuck dispatch, a silent bit flip
+               # in one gradient leaf. The guarded trainer consumes these
+               # (fire-once, journaled) and the health guardian must
+               # detect + recover.
+               "inject_nan", "inject_loss_spike", "inject_hang",
+               "inject_sdc")
 
 # Same code the reference's elastic stack uses for a restart-me exit; the
 # ElasticManager counts it against the restart budget and relaunches.
@@ -241,6 +249,7 @@ class FaultInjector:
 
     def disarm(self) -> None:
         register_fire_point("ckpt.mid_write", None)
+        register_fire_point("health.hang", None)
         signal.signal(signal.SIGTERM, signal.SIG_DFL)
 
     # -- trigger points ------------------------------------------------------
@@ -270,6 +279,32 @@ class FaultInjector:
         if ev is not None:
             self._mark_fired(ev)
             self._die()
+
+    def consume(self, kind: str, step: int) -> Optional[FaultEvent]:
+        """Non-killing events (the ``inject_*`` health kinds): journal and
+        RETURN the earliest pending ``kind`` at/before ``step`` so the
+        caller applies the effect itself (a poisoned loss scale, a canary
+        bit flip). Journaling BEFORE the effect keeps a relaunched
+        process from replaying the fault — same contract as the kills."""
+        self._step = step
+        ev = self._pending(kind, step)
+        if ev is not None:
+            self._mark_fired(ev)
+        return ev
+
+    def arm_hang(self, sleep_s: float = 3.0) -> None:
+        """Install the ``health.hang`` seam: when an ``inject_hang``
+        event is pending at the current step, the seam blocks for
+        ``sleep_s`` — simulating a stuck device dispatch (a hung DCN
+        collective) that only the wall-clock watchdog can classify. The
+        event is journaled before the stall so the post-relaunch
+        incarnation replays the step without it."""
+        def on_hang() -> None:
+            ev = self._pending("inject_hang", self._step)
+            if ev is not None:
+                self._mark_fired(ev)
+                time.sleep(sleep_s)
+        register_fire_point("health.hang", on_hang)
 
     def _on_ckpt_write(self) -> None:
         ev = self._pending("mid_ckpt_write", self._step)

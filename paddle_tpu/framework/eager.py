@@ -746,10 +746,36 @@ def backward_multi(tensors, seeds=None, retain_graph: bool = False):
         acc[id(t)] = seed if prev is None else prev + seed
     for node in sorted(nodes.values(), key=lambda n: -n.counter):
         node.run_backward(acc, nodes, leaf_sink)
+    _check_leaf_grads(leaf_sink)
     _finalize_leaf_sink(leaf_sink)
     if not retain_graph:
         for node in nodes.values():
             node.release()
+
+
+def _check_leaf_grads(leaf_sink: Dict[int, Tuple]) -> None:
+    """FLAGS_check_nan_inf on the eager autograd path: one scan over the
+    fully-summed leaf/parameter gradients through the shared
+    ``fault/health.check_numerics`` entry (the same helper the compiled
+    train steps use). Eager values are concrete, so the scan runs
+    immediately — no compiled callback."""
+    if not leaf_sink:
+        return
+    from ..amp import debugging as _dbg
+    if not _dbg.enabled():
+        return
+    from ..fault import health
+
+    def _name(t, i):
+        # ParamRef handles carry attr_name; plain Tensors get an index
+        # (their __getattr__ resolves op names, so probing is unsafe)
+        n = t.__dict__.get("attr_name") if hasattr(t, "__dict__") else None
+        return n or f"leaf{i}"
+
+    health.check_numerics(
+        grads={_name(t, i): g
+               for i, (t, g) in enumerate(leaf_sink.values())},
+        where="eager.backward")
 
 
 def _finalize_leaf_sink(leaf_sink: Dict[int, Tuple]):

@@ -216,6 +216,23 @@ class TrainStep:
                 and _offload.host_memory_kind() is not None):
             self._offload = _offload.StreamingUpdate(optimizer)
             self.opt_state = self._offload.place(self.opt_state)
+        # FLAGS_health_sentinel=on: fuse the training-health anomaly
+        # check into the compiled step (fault/health.py) — one
+        # [loss, grad-global-norm] reduction, the update gated in-graph
+        # on finiteness + host-fed rolling-median thresholds. Off leaves
+        # the step byte-identical. The verdict/recovery side is host
+        # bookkeeping (StepSentinel / fault.Guardian).
+        from ..fault import health as _health
+        self._sentinel = None
+        self.last_stats = None
+        if _health.sentinel_on():
+            if self._offload is not None:
+                raise ValueError(
+                    "FLAGS_health_sentinel does not compose with "
+                    "FLAGS_offload_optimizer=moments yet: the streamed "
+                    "update cannot be gated in-graph — use the "
+                    "FLAGS_check_nan_inf scans for detection there")
+            self._sentinel = _health.StepSentinel()
         repl = NamedSharding(mesh, P())
 
         model_obj, lf = model, loss_fn
@@ -297,27 +314,50 @@ class TrainStep:
         def step(params, opt_state, buffers, batch, lr, key):
             loss, grads, new_buffers = compute_grads(params, buffers,
                                                      batch, key)
-            from ..amp import debugging as _dbg
-            if _dbg.enabled():  # FLAGS_check_nan_inf (ref nan_inf_utils.h:38)
-                _dbg.check_numerics(loss, "loss", where="train_step")
-                _dbg.check_numerics_tree(grads, where="train_step/grads")
+            # FLAGS_check_nan_inf (ref nan_inf_utils.h:38); moment/
+            # variance corruption hides in optimizer state long after
+            # the offending grad step — scan new_state too
+            _health.check_numerics(loss=loss, grads=grads,
+                                   where="train_step")
             new_params, new_state = optimizer.apply_gradients(
                 params, grads, opt_state, lr)
-            if _dbg.enabled():
-                # moment/variance corruption hides in optimizer state long
-                # after the offending grad step — scan it too
-                _dbg.check_numerics_tree(new_state,
-                                         where="train_step/opt_state")
+            _health.check_numerics(opt_state=new_state, where="train_step")
             return loss, new_params, new_state, new_buffers
+
+        def sentinel_step(params, opt_state, buffers, batch, lr, key,
+                          guard):
+            loss, grads, new_buffers = compute_grads(params, buffers,
+                                                     batch, key)
+            _health.check_numerics(loss=loss, grads=grads,
+                                   where="train_step")
+            stats = _health.fused_stats(loss, grads)
+            ok = _health.fused_ok(stats, guard)
+            new_params, new_state = optimizer.apply_gradients(
+                params, grads, opt_state, lr)
+            _health.check_numerics(opt_state=new_state, where="train_step")
+            # gate the whole update in-graph: an anomalous step can never
+            # poison params/opt-state/buffers (the jnp.where select is
+            # the sentinel's only non-reduction cost)
+            keep = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+            new_params = jax.tree_util.tree_map(keep, new_params, params)
+            new_state = jax.tree_util.tree_map(keep, new_state, opt_state)
+            new_buffers = jax.tree_util.tree_map(keep, new_buffers,
+                                                 buffers)
+            stats = jnp.concatenate(
+                [stats, ok.astype(jnp.float32)[None]])
+            return loss, stats, new_params, new_state, new_buffers
 
         def grad_step(params, buffers, batch, key):
             loss, grads, new_buffers = compute_grads(params, buffers,
                                                      batch, key)
-            from ..amp import debugging as _dbg
-            if _dbg.enabled():
-                _dbg.check_numerics(loss, "loss", where="train_step")
-                _dbg.check_numerics_tree(grads, where="train_step/grads")
+            _health.check_numerics(loss=loss, grads=grads,
+                                   where="train_step")
             return loss, grads, new_buffers
+
+        # the SDC canary re-executes exactly this (nothing donated, no
+        # state mutated) — see canary_step()
+        self._compute_grads = compute_grads
+        self._canary_jit = None
 
         if self._offload is not None:
             # Params are NOT donated here — the streaming update consumes
@@ -327,6 +367,14 @@ class TrainStep:
                 in_shardings=(self.pshardings, None, None, None),
                 out_shardings=(repl, self.pshardings, None))
             self._step_fn = grad_step
+        elif self._sentinel is not None:
+            self._compiled = jax.jit(
+                sentinel_step,
+                in_shardings=(self.pshardings, ssh, None, None, repl, None,
+                              repl),
+                out_shardings=(repl, repl, self.pshardings, ssh, None),
+                donate_argnums=(0, 1) if donate else ())
+            self._step_fn = sentinel_step
         else:
             self._compiled = jax.jit(
                 step,
@@ -395,6 +443,7 @@ class TrainStep:
                                if self._multislice is not None else "off"),
                 "gather_ahead": self._gather_specs is not None,
                 "donate": bool(donate) and self._offload is None,
+                "health_sentinel": self._sentinel is not None,
             },
             mesh_axes={str(a): int(self.mesh.shape[a])
                        for a in self.mesh.axis_names},
@@ -443,10 +492,13 @@ class TrainStep:
                 writes=("loss", "grads", "buffers")))
             plan.nodes.extend(self._offload.plan_nodes(list(params)))
         else:
+            writes = ("loss", "params", "opt_state", "buffers")
+            if self._sentinel is not None:
+                writes = ("loss", "stats") + writes[1:]
             plan.nodes.append(plan_check.PlanNode(
                 "train_step",
                 reads=("params", "opt_state", "buffers", "batch"),
-                writes=("loss", "params", "opt_state", "buffers"),
+                writes=writes,
                 donates=("params", "opt_state") if donate else ()))
         if self._gather_specs is not None:
             plan.gather = _overlap.gather_ahead_plan(
@@ -468,6 +520,11 @@ class TrainStep:
                 closed = jax.make_jaxpr(self._step_fn)(
                     self.params, self.buffers, batch, key)
                 donate = ()
+            elif self._sentinel is not None:
+                closed = jax.make_jaxpr(self._step_fn)(
+                    self.params, self.opt_state, self.buffers, batch, lr,
+                    key, jnp.asarray(self._sentinel.guard_vector()))
+                donate = (0, 1) if self._donate else ()
             else:
                 closed = jax.make_jaxpr(self._step_fn)(
                     self.params, self.opt_state, self.buffers, batch, lr,
@@ -496,9 +553,15 @@ class TrainStep:
                 compiled = self._compiled.lower(
                     self.params, self.buffers, batch, key).compile()
                 return compiled, 0
-            compiled = self._compiled.lower(
-                self.params, self.opt_state, self.buffers, batch, lr,
-                key).compile()
+            if self._sentinel is not None:
+                compiled = self._compiled.lower(
+                    self.params, self.opt_state, self.buffers, batch, lr,
+                    key, jnp.asarray(self._sentinel.guard_vector())
+                ).compile()
+            else:
+                compiled = self._compiled.lower(
+                    self.params, self.opt_state, self.buffers, batch, lr,
+                    key).compile()
         finally:
             set_hybrid_mesh(prev_mesh)
         donated = 0
@@ -539,13 +602,20 @@ class TrainStep:
                                          where="sharded.TrainStep.hlo")
         jaxpr_lint.emit(diags, where="sharded.TrainStep")
 
-    def step(self, batch) -> jax.Array:
+    def step(self, batch, index: Optional[int] = None) -> jax.Array:
+        """Run one train step. ``index`` (guarded trainers) pins this
+        dispatch's step index — the PRNG stream is
+        ``fold_in(base_key, index)`` and ``_step_count`` is set to it —
+        so a run that skips poisoned batches keys each *applied* step
+        identically to a clean run that never saw them. Default (None)
+        keeps the auto-incrementing counter."""
         from ..observability import step_monitor
         tm = step_monitor.current()
         with tm.step():
-            return self._step_inner(batch, tm)
+            return self._step_inner(batch, tm, index=index)
 
-    def _step_inner(self, batch, tm) -> jax.Array:
+    def _step_inner(self, batch, tm, index: Optional[int] = None
+                    ) -> jax.Array:
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         ndim_cache: Dict[int, NamedSharding] = {}
 
@@ -559,7 +629,10 @@ class TrainStep:
 
         with tm.phase("h2d"):
             batch = jax.tree_util.tree_map(place, batch)
-        self._step_count += 1
+        if index is None:
+            self._step_count += 1
+        else:
+            self._step_count = int(index)
         key = jax.random.fold_in(self._base_key, self._step_count)
         # Trace-time consumers (sharding constraints, CP attention) resolve
         # the mesh via get_hybrid_mesh(); install THIS step's mesh for the
@@ -586,6 +659,13 @@ class TrainStep:
                         self.params, self.buffers, batch, key)
                 self.params, self.opt_state = self._offload.update(
                     self.params, grads, self.opt_state, lr)
+            elif self._sentinel is not None:
+                guard = jnp.asarray(self._sentinel.guard_vector())
+                with tm.phase(dispatch_phase):
+                    (loss, self.last_stats, self.params, self.opt_state,
+                     self.buffers) = self._compiled(
+                        self.params, self.opt_state, self.buffers, batch,
+                        lr, key, guard)
             else:
                 with tm.phase(dispatch_phase):
                     loss, self.params, self.opt_state, self.buffers = \
@@ -597,6 +677,33 @@ class TrainStep:
         if sched is not None:
             sched.step()
         return loss
+
+    def sentinel_verdict(self):
+        """Classify the last dispatched step's fused stats
+        (``fault.health.Verdict``; syncs the stats vector — the read the
+        guarded trainer performs in place of/with its loss fetch).
+        None when FLAGS_health_sentinel is off or nothing dispatched."""
+        if self._sentinel is None or self.last_stats is None:
+            return None
+        return self._sentinel.verdict(self.last_stats)
+
+    def canary_step(self, batch, index: int):
+        """Re-executable grad computation — ``(loss, grads, buffers)``
+        with NOTHING donated and no state mutated. Same inputs -> same
+        compiled program -> bitwise-equal outputs on a deterministic
+        backend; the SDC canary (``fault.health.SdcCanary``) runs this
+        twice and a mismatch is silent data corruption."""
+        if self._canary_jit is None:
+            self._canary_jit = jax.jit(self._compute_grads)
+        batch = jax.tree_util.tree_map(jnp.asarray, batch)
+        key = jax.random.fold_in(self._base_key, int(index))
+        from ..distributed.topology import get_hybrid_mesh, set_hybrid_mesh
+        prev_mesh = get_hybrid_mesh()
+        set_hybrid_mesh(self.mesh)
+        try:
+            return self._canary_jit(self.params, self.buffers, batch, key)
+        finally:
+            set_hybrid_mesh(prev_mesh)
 
     def state_dict(self) -> Dict[str, Any]:
         """Everything needed to resume this step bitwise: params, optimizer

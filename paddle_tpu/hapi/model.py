@@ -155,11 +155,11 @@ class Model:
             (trainable, frozen, grads, total, out, new_buf,
              found_inf) = grads_of(params, buffers, scaler_state, inputs,
                                    labels, key)
-            from ..amp import debugging as _dbg
-            if _dbg.enabled():  # FLAGS_check_nan_inf (ref nan_inf_utils.h:38)
-                _dbg.check_numerics(total, "loss", where="Model.train_batch")
-                _dbg.check_numerics_tree(grads,
-                                         where="Model.train_batch/grads")
+            # FLAGS_check_nan_inf (ref nan_inf_utils.h:38) — the shared
+            # fault/health scan entry
+            from ..fault import health as _health
+            _health.check_numerics(loss=total, grads=grads,
+                                   where="Model.train_batch")
             if use_scaler:
                 new_scaler_state = scaler.update_state(scaler_state, found_inf)
             else:
@@ -175,11 +175,10 @@ class Model:
                 new_opt_state = jax.tree_util.tree_map(
                     lambda new, old: jnp.where(found_inf, old, new),
                     new_opt_state, opt_state)
-            if _dbg.enabled():
-                # also scan the optimizer state pytree (moments can go
-                # NaN a step after the grads did and survive the skip)
-                _dbg.check_numerics_tree(new_opt_state,
-                                         where="Model.train_batch/opt_state")
+            # also scan the optimizer state pytree (moments can go NaN a
+            # step after the grads did and survive the skip)
+            _health.check_numerics(opt_state=new_opt_state,
+                                   where="Model.train_batch")
             new_params = {**new_trainable, **frozen}
             return (new_params, new_buf, new_opt_state, new_scaler_state,
                     total, out)

@@ -47,7 +47,10 @@ __all__ = ["StepTimeline", "RecompileSentinel", "current", "reset_default",
 
 PHASES = ("data", "h2d", "compile", "device", "comm",
           "ckpt_save", "ckpt_restore", "offload_in",
-          "offload_out", "callbacks")
+          "offload_out", "callbacks",
+          # training-health tier (fault/health.py): the SDC canary's
+          # double-execution window and the guardian's rewind restore
+          "canary", "rewind")
 
 GB = float(2 ** 30)
 

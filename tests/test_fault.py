@@ -102,6 +102,32 @@ def test_latest_complete_skips_torn_and_corrupt(tmp_path):
     assert all(d.rule == "F001" for d in cm.diagnostics)
 
 
+def test_latest_complete_rejects_zero_length_npy(tmp_path):
+    """Torn-write variant: a ZERO-length array file alongside a fully
+    valid manifest (the fsync'd manifest landed, the array data didn't —
+    e.g. a crash between a filesystem's metadata and data commits). The
+    crc path must reject it with an F001 note and fall back — never
+    raise out of latest_complete()."""
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=5)
+    cm.save(2, {"x": np.ones((4,))}, block=True)
+    cm.save(4, {"x": np.ones((4,))}, block=True)
+    # truncate step_4's array to zero bytes, manifest left intact
+    f = os.path.join(cm.directory, "step_4", "arr_00000.npy")
+    with open(f, "wb"):
+        pass
+    assert os.path.getsize(f) == 0
+    ok, reason = dckpt.validate_snapshot(os.path.join(cm.directory,
+                                                      "step_4"))
+    assert not ok and "checksum" in reason
+    assert cm.latest_complete() == 2  # skipped, no exception
+    assert cm.diagnostics and cm.diagnostics[-1].rule == "F001"
+    assert "step_4" in cm.diagnostics[-1].message
+    # restore() through the manager lands on the good snapshot
+    step, state, _ = cm.restore()
+    assert step == 2
+    np.testing.assert_array_equal(state["x"], np.ones((4,)))
+
+
 def test_manager_retention_prunes_oldest(tmp_path):
     cm = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
     for s in (1, 2, 3, 4):

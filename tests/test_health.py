@@ -1,0 +1,475 @@
+"""Training-health tier unit tests (paddle_tpu/fault/health.py +
+guardian.py + the TrainStep sentinel fusion): fused stats/gate semantics,
+rolling-median classification, hang watchdog, SDC canary, batch cursor,
+Guardian policies + last-good promotion, F004/F005 static validation,
+the deduped check_numerics entry, and the per-slice heartbeat."""
+
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.core import flags
+from paddle_tpu.fault import (BatchCursor, CheckpointManager, Guardian,
+                              HangWatchdog, SdcCanary, StepSentinel)
+from paddle_tpu.fault import guardian as guardian_mod
+from paddle_tpu.fault import health
+
+
+@pytest.fixture
+def sentinel_on():
+    flags.set_flags({"health_sentinel": "on"})
+    yield
+    flags.set_flags({"health_sentinel": "off"})
+
+
+def _mlp_step(poison_seam=False):
+    from jax.sharding import Mesh
+
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import Adam
+
+    paddle.seed(11)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+    if poison_seam:
+        def loss_fn(model, params, batch):
+            x, y, poison = batch
+            return F.cross_entropy(
+                functional_call(model, params, x), y).mean() * poison[0]
+    else:
+        def loss_fn(model, params, batch):
+            x, y = batch
+            return F.cross_entropy(
+                functional_call(model, params, x), y).mean()
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    return make_sharded_train_step(net, Adam(1e-2), loss_fn, mesh=mesh)
+
+
+def _batches(n, poison_seam=False):
+    rng = np.random.default_rng(99)
+    out = []
+    for _ in range(n):
+        b = (rng.standard_normal((8, 8)).astype("float32"),
+             rng.integers(0, 4, size=(8,)).astype("int32"))
+        if poison_seam:
+            b = b + (np.asarray([1.0], np.float32),)
+        out.append(b)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Fused sentinel: in-graph stats + gate
+# ---------------------------------------------------------------------------
+
+def test_fused_stats_and_ok():
+    stats = health.fused_stats(jnp.asarray(2.0),
+                               {"w": jnp.ones((3,)), "b": jnp.ones((2,))})
+    assert stats.shape == (2,)
+    assert float(stats[0]) == 2.0
+    assert abs(float(stats[1]) - np.sqrt(5.0)) < 1e-6
+    guard = jnp.asarray([1.0, 1.0, 10.0, 10.0], jnp.float32)
+    assert bool(health.fused_ok(stats, guard))
+    assert not bool(health.fused_ok(jnp.asarray([jnp.nan, 1.0]), guard))
+    assert not bool(health.fused_ok(jnp.asarray([1.0, jnp.inf]), guard))
+    # spike: loss 20 > 10 x median 1
+    assert not bool(health.fused_ok(jnp.asarray([20.0, 1.0]), guard))
+    # warmup (median 0) disables the threshold half
+    warm = jnp.asarray([0.0, 0.0, 10.0, 10.0], jnp.float32)
+    assert bool(health.fused_ok(jnp.asarray([20.0, 1.0]), warm))
+
+
+def test_sentinel_classification_and_windows():
+    s = StepSentinel(spike_factor=4.0, explode_factor=8.0, window=8,
+                     warmup=2)
+    for _ in range(3):
+        assert s.verdict(np.asarray([1.0, 1.0, 1.0])).ok
+    assert s.verdict(np.asarray([np.nan, 1.0, 0.0])).kind == "nan_loss"
+    assert s.verdict(np.asarray([1.0, np.inf, 0.0])).kind == "nan_grad"
+    assert s.verdict(np.asarray([100.0, 1.0, 0.0])).kind == "loss_spike"
+    v = s.verdict(np.asarray([1.0, 100.0, 0.0]))
+    assert v.kind == "grad_explosion" and not v.applied
+    # anomalies must not drag the median toward themselves
+    assert s.guard_vector()[0] == pytest.approx(1.0)
+    s.reset()
+    assert s.guard_vector()[0] == 0.0  # back in warmup
+
+
+def test_sentinel_off_is_inert_and_on_matches_bitwise(sentinel_on):
+    """The armed step's clean-path losses are bitwise-identical to the
+    unarmed step's — the fused check changes no computed value."""
+    bs = _batches(3)
+    flags.set_flags({"health_sentinel": "off"})
+    ts_off = _mlp_step()
+    assert ts_off._sentinel is None and ts_off.sentinel_verdict() is None
+    ref = [float(ts_off.step(b)) for b in bs]
+    flags.set_flags({"health_sentinel": "on"})
+    ts_on = _mlp_step()
+    got = []
+    for b in bs:
+        got.append(float(ts_on.step(b)))
+        v = ts_on.sentinel_verdict()
+        assert v.ok and v.applied
+    assert got == ref
+
+
+def test_sentinel_gate_blocks_poisoned_update(sentinel_on):
+    """A NaN loss must leave params/opt-state bitwise-untouched (the
+    in-graph where() gate), and re-dispatching the same step index with a
+    clean batch must match the never-poisoned trajectory bitwise."""
+    bs = _batches(4, poison_seam=True)
+    ts_ref = _mlp_step(poison_seam=True)
+    ref = [float(ts_ref.step(b, index=i + 1)) for i, b in enumerate(bs)]
+
+    ts = _mlp_step(poison_seam=True)
+    for i, b in enumerate(bs[:2]):
+        ts.step(b, index=i + 1)
+    before = jax.tree_util.tree_map(np.asarray, ts.params)
+    poisoned = (bs[2][0], bs[2][1], np.asarray([np.nan], np.float32))
+    ts.step(poisoned, index=3)
+    v = ts.sentinel_verdict()
+    assert v.kind == "nan_loss" and not v.applied
+    after = jax.tree_util.tree_map(np.asarray, ts.params)
+    for k in before:
+        assert before[k].tobytes() == after[k].tobytes(), k
+    assert float(ts.step(bs[2], index=3)) == ref[2]
+    assert float(ts.step(bs[3], index=4)) == ref[3]
+
+
+def test_sentinel_rejects_offload_composition(sentinel_on):
+    from paddle_tpu.framework import offload
+    if offload.host_memory_kind() is None:
+        pytest.skip("no host memory tier on this runtime")
+    flags.set_flags({"offload_optimizer": "moments"})
+    try:
+        with pytest.raises(ValueError, match="health_sentinel"):
+            _mlp_step()
+    finally:
+        flags.set_flags({"offload_optimizer": "off"})
+
+
+def test_canary_step_bitwise_and_nondonating(sentinel_on):
+    ts = _mlp_step(poison_seam=True)
+    bs = _batches(2, poison_seam=True)
+    ts.step(bs[0], index=1)
+    a = jax.tree_util.tree_map(np.asarray, ts.canary_step(bs[1], 2))
+    b = jax.tree_util.tree_map(np.asarray, ts.canary_step(bs[1], 2))
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert la and all(x.tobytes() == y.tobytes() for x, y in zip(la, lb))
+    # params still alive (nothing donated by the canary)
+    float(ts.step(bs[1], index=2))
+
+
+# ---------------------------------------------------------------------------
+# Hang watchdog
+# ---------------------------------------------------------------------------
+
+def test_watchdog_fires_on_stall_not_on_fast_steps():
+    fired = []
+    wd = HangWatchdog(scale=3.0, floor_s=0.05,
+                      on_hang=lambda info: fired.append(info))
+    with wd.guard(step=0, armed=False, record=False):
+        time.sleep(0.01)  # "compile" step: unarmed, unrecorded
+    assert wd.deadline_s() is None
+    for s in (1, 2):
+        with wd.guard(step=s):
+            time.sleep(0.002)
+    assert not fired and wd.deadline_s() == pytest.approx(0.05)
+    with wd.guard(step=3):
+        time.sleep(0.2)
+    assert fired and fired[0]["step"] == 3 and wd.fired
+    assert fired[0]["kind"] == "hang"
+
+
+def test_watchdog_deadline_scales_with_median():
+    wd = HangWatchdog(scale=5.0, floor_s=0.001, window=4,
+                      on_hang=lambda info: None)
+    for dt in (0.1, 0.2, 0.3):
+        wd.observe(dt)
+    assert wd.deadline_s() == pytest.approx(1.0)
+
+
+# ---------------------------------------------------------------------------
+# SDC canary + bit flip
+# ---------------------------------------------------------------------------
+
+def test_canary_clean_and_corrupted():
+    can = SdcCanary(every=4)
+    assert not can.due(0) and not can.due(3) and can.due(4)
+    fn = lambda: {"g": jnp.ones((8,), jnp.float32)}  # noqa: E731
+    assert can.check(4, fn).clean
+    cv = can.check(4, fn, corrupt=lambda t: health.flip_one_bit(t, 3))
+    assert not cv.clean and cv.mismatches
+
+
+def test_canary_tolerance_mode():
+    can = SdcCanary(every=2, mode="tolerance", atol=1e-3)
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        # sub-tolerance jitter between executions must NOT trip it
+        return {"g": jnp.ones((4,), jnp.float32) + 1e-6 * calls["n"]}
+
+    assert can.check(2, fn).clean
+    with pytest.raises(ValueError):
+        SdcCanary(every=2, mode="nope")
+
+
+def test_flip_one_bit_deterministic_single_flip():
+    tree = {"a": np.ones((4,), np.float32), "b": np.ones((3,), np.float32)}
+    t1 = health.flip_one_bit(tree, 7)
+    t2 = health.flip_one_bit(tree, 7)
+    assert all(np.array_equal(t1[k], t2[k]) for k in tree)
+    diff_bytes = 0
+    for k in tree:
+        a = np.frombuffer(tree[k].tobytes(), np.uint8)
+        b = np.frombuffer(t1[k].tobytes(), np.uint8)
+        diff_bytes += int((a != b).sum())
+    assert diff_bytes == 1  # exactly one byte (one bit) differs
+
+
+# ---------------------------------------------------------------------------
+# Batch cursor
+# ---------------------------------------------------------------------------
+
+def test_batch_cursor_matches_legacy_without_skips():
+    c = BatchCursor(4)
+    assert [c.batch_index(i) for i in range(9)] == \
+        [i % 4 for i in range(9)]
+
+
+def test_batch_cursor_skip_shifts_later_steps():
+    c = BatchCursor(4, skips=(2,))
+    assert [c.position_for(i) for i in range(5)] == [0, 1, 3, 4, 5]
+    c.skip(4)
+    assert [c.position_for(i) for i in range(5)] == [0, 1, 3, 5, 6]
+    # a run that discovers the skips incrementally converges to the same
+    # mapping as one handed them up front
+    d = BatchCursor(4, skips=(2, 4))
+    assert [d.position_for(i) for i in range(5)] == \
+        [c.position_for(i) for i in range(5)]
+
+
+# ---------------------------------------------------------------------------
+# Guardian: policies, promotion, journal
+# ---------------------------------------------------------------------------
+
+def test_guardian_promotion_requires_k_clean_steps(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    g = Guardian(cm, promote_after=2,
+                 journal_path=str(tmp_path / "health.jsonl"))
+    cm.save(2, {"x": np.ones(2)}, block=True)
+    g.note_save(2)
+    g.note_clean_step(2)
+    assert cm.last_good() is None
+    g.note_clean_step(3)
+    assert cm.last_good() == 2
+
+
+def test_guardian_anomaly_voids_unpromoted_snapshots(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    g = Guardian(cm, promote_after=2,
+                 journal_path=str(tmp_path / "health.jsonl"))
+    cm.save(2, {"x": np.ones(2)}, block=True)
+    g.note_save(2)
+    g.note_clean_step(2)
+    g.on_anomaly("sdc", step=3)  # inside the suspicion window
+    g.note_clean_step(4)
+    g.note_clean_step(5)
+    assert cm.last_good() is None  # step-2 snapshot never promotes
+
+
+def test_guardian_decisions_per_policy(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    cm.save(0, {"x": np.ones(2)}, block=True)
+    cm.mark_good(0)
+    g = Guardian(cm, journal_path=str(tmp_path / "health.jsonl"))
+    d = g.decide("nan_loss", 5, pos=5)
+    assert d.action == "rewind" and d.rewind_to == 0 and d.skip_pos == 5
+    d = g.decide("loss_spike", 5, pos=5)
+    assert d.action == "skip_batch" and d.skip_pos == 5
+    d = g.decide("sdc", 6)
+    assert d.action == "rewind" and d.skip_pos is None
+    assert g.decide("hang", 7).action == "relaunch"
+    # unknown kind falls back to halt
+    assert g.decide("weird", 8).action == "halt"
+
+
+def test_guardian_halts_without_last_good_and_on_budget(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    g = Guardian(cm, max_recoveries=1,
+                 journal_path=str(tmp_path / "health.jsonl"))
+    assert g.decide("nan_loss", 3, pos=3).action == "halt"  # no last-good
+    cm.save(0, {"x": np.ones(2)}, block=True)
+    cm.mark_good(0)
+    assert g.on_anomaly("nan_loss", step=3, pos=3).action == "rewind"
+    assert g.decide("nan_loss", 4, pos=4).action == "halt"  # budget spent
+
+
+def test_guardian_journal_survives_reload(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    cm.save(0, {"x": np.ones(2)}, block=True)
+    cm.mark_good(0)
+    g = Guardian(cm, journal_path=str(tmp_path / "health.jsonl"))
+    g.on_anomaly("nan_loss", step=4, pos=4, inject_step=4)
+    g2 = Guardian(cm, journal_path=str(tmp_path / "health.jsonl"))
+    assert g2.skips() == {4} and g2.recoveries == 1
+
+
+def test_guardian_rejects_invalid_policy_table(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"))
+    with pytest.raises(ValueError, match="invalid health plan"):
+        Guardian(cm, policies={"nan_loss": "explode"})
+    with pytest.raises(ValueError, match="invalid health plan"):
+        Guardian(cm, promote_after=0)
+
+
+# ---------------------------------------------------------------------------
+# Last-good pointer on the CheckpointManager
+# ---------------------------------------------------------------------------
+
+def test_mark_good_last_good_roundtrip_and_validation(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=5)
+    assert cm.last_good() is None
+    cm.save(2, {"x": np.ones(2)}, block=True)
+    cm.mark_good(2)
+    assert cm.last_good() == 2
+    # corrupt the pointed-at snapshot: last_good degrades to None + F001
+    f = os.path.join(cm.directory, "step_2", "arr_00000.npy")
+    with open(f, "wb") as fh:
+        fh.write(b"")
+    n_diags = len(cm.diagnostics)
+    assert cm.last_good() is None
+    assert len(cm.diagnostics) > n_diags
+    assert cm.diagnostics[-1].rule == "F001"
+
+
+def test_retention_pins_last_good(tmp_path):
+    cm = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+    cm.save(2, {"x": np.ones(2)}, block=True)
+    cm.mark_good(2)
+    for s in (4, 6, 8):
+        cm.save(s, {"x": np.ones(2)}, block=True)
+    assert cm.all_steps() == [2, 6, 8]  # 2 pinned, 4 pruned
+
+
+# ---------------------------------------------------------------------------
+# F004 / F005 static validation
+# ---------------------------------------------------------------------------
+
+def test_check_health_plan_positive_negative():
+    assert health.check_health_plan(guardian_mod.DEFAULT_POLICIES) == []
+    diags = health.check_health_plan(
+        {"bogus_kind": "rewind", "nan_loss": "explode"},
+        promote_after=0, spike_factor=0.5, max_recoveries=0)
+    assert len(diags) == 5
+    assert all(d.rule == "F004" and d.severity == "error" for d in diags)
+
+
+def test_check_canary_positive_negative():
+    assert health.check_canary(8, 100) == []
+    assert any(d.severity == "warning"
+               for d in health.check_canary(1, 100))
+    diags = health.check_canary(100, 10)
+    assert any(d.severity == "error" for d in diags)
+    assert all(d.rule == "F005"
+               for d in health.check_canary(1, 100) + diags)
+    assert any(d.severity == "error"
+               for d in health.check_canary(4, 10, mode="nope"))
+
+
+# ---------------------------------------------------------------------------
+# The deduped check_numerics entry (behavior-identical regression)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def nan_check_on():
+    flags.set_flags({"check_nan_inf": True, "check_nan_inf_level": 0})
+    yield
+    flags.set_flags({"check_nan_inf": False, "check_nan_inf_level": 0})
+    try:
+        jax.effects_barrier()
+    except Exception:
+        pass
+    try:
+        from jax._src import dispatch as _dispatch
+        _dispatch.runtime_tokens.clear()
+    except Exception:
+        pass
+
+
+def test_check_numerics_helper_matches_primitives(nan_check_on):
+    """The shared entry raises exactly like the amp.debugging primitives
+    it wraps (level 0 => FloatingPointError naming the tensor)."""
+    with pytest.raises(FloatingPointError, match="loss"):
+        health.check_numerics(loss=jnp.asarray(np.nan))
+    with pytest.raises(FloatingPointError, match="grads"):
+        health.check_numerics(grads={"w": jnp.asarray([np.nan, 1.0])})
+    with pytest.raises(FloatingPointError, match="opt_state"):
+        health.check_numerics(
+            opt_state={"m": jnp.asarray([np.inf])}, where="unit")
+    # flag off: pure no-op
+    flags.set_flags({"check_nan_inf": False})
+    health.check_numerics(loss=jnp.asarray(np.nan),
+                          grads={"w": jnp.asarray([np.nan])})
+
+
+def test_train_step_scan_still_fires_through_helper(nan_check_on):
+    """Regression for the dedupe: the sharded train step's scans (now
+    routed through fault/health.check_numerics) still catch a NaN loss."""
+    ts = _mlp_step(poison_seam=True)
+    bad = _batches(1, poison_seam=True)[0]
+    bad = (bad[0], bad[1], np.asarray([np.nan], np.float32))
+    # inside a compiled step the callback failure surfaces wrapped
+    # (XlaRuntimeError chaining the FloatingPointError) — same assertion
+    # idiom as tests/test_nan_inf_check.py
+    with pytest.raises(Exception, match="loss"):
+        jax.block_until_ready(ts.step(bad))
+
+
+def test_eager_backward_scan_through_helper(nan_check_on):
+    """The eager autograd path scans its summed leaf grads through the
+    shared helper."""
+    t = paddle.to_tensor([0.0, 1.0], stop_gradient=False)
+    loss = paddle.mean(1.0 / t)  # d/dt (1/t) at 0 -> -inf grad
+    with pytest.raises(Exception, match="check_nan_inf"):
+        loss.backward()
+
+
+# ---------------------------------------------------------------------------
+# Per-slice heartbeat: dead vs slow
+# ---------------------------------------------------------------------------
+
+def test_slice_heartbeat_dead_vs_slow(tmp_path):
+    from paddle_tpu.distributed.multislice import SliceHeartbeatMonitor
+    d = str(tmp_path / "hb")
+    m0 = SliceHeartbeatMonitor(d, 0, 3, ttl_s=10.0, lag_steps=2)
+    m1 = SliceHeartbeatMonitor(d, 1, 3, ttl_s=10.0, lag_steps=2)
+    m2 = SliceHeartbeatMonitor(d, 2, 3, ttl_s=10.0, lag_steps=2)
+    now = 1000.0
+    m0.beat(step=10, now=now)      # healthy
+    m1.beat(step=3, now=now)       # alive but 7 steps behind -> slow
+    m2.beat(step=10, now=now - 60)  # stale beat -> dead
+    cls = m0.classify(now=now)
+    assert cls == {0: "alive", 1: "slow", 2: "dead"}
+    s = m0.summary(now=now)
+    assert s["dead"] == [2] and s["slow"] == [1]
+
+
+def test_slice_heartbeat_all_fresh_within_lag(tmp_path):
+    from paddle_tpu.distributed.multislice import SliceHeartbeatMonitor
+    d = str(tmp_path / "hb")
+    mons = [SliceHeartbeatMonitor(d, i, 2, lag_steps=3) for i in range(2)]
+    now = 500.0
+    mons[0].beat(step=8, now=now)
+    mons[1].beat(step=6, now=now)
+    assert set(mons[0].classify(now=now).values()) == {"alive"}

@@ -1,0 +1,163 @@
+"""End-to-end training-health drill (ISSUE 12 acceptance): injected NaN,
+loss-spike, and SDC bit-flip each detected at their declared latency,
+recovery runs the declared policy, and the rewind-and-skip run's final
+losses are BITWISE-equal to a clean reference that never saw the poisoned
+batch. The hang scenario and the chained ``fault_drill --health`` mode run
+as subprocesses (a watchdog escalation kills the process). A shortened
+clean run pins zero false positives; the full 200-step gate runs in
+``tools/health_drill.py --quick``."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _scenario(name, tmp_path, **kw):
+    from paddle_tpu.fault import health_drill
+    return health_drill.run_scenario(name, str(tmp_path / name), **kw)
+
+
+def test_nan_scenario_rewind_and_skip_bitwise(tmp_path):
+    r = _scenario("nan", tmp_path, total_steps=8, inject_step=4)
+    assert r["ok"], r
+    assert [a["kind"] for a in r["anomalies"]] == ["nan_loss"]
+    assert r["anomalies"][0]["latency_steps"] == 0  # detected same step
+    assert r["rewinds"], "nan policy must rewind to last-good"
+    assert r["rewinds"][0]["to"] < r["rewinds"][0]["from"]
+    assert r["parity"]["bitwise_equal"], r["parity"]
+    assert r["goodput_record"]["rewound_steps"] > 0
+    assert r["skipped_batches"] == 1  # the poisoned position was dropped
+
+
+def test_spike_scenario_skip_batch_no_rewind(tmp_path):
+    r = _scenario("spike", tmp_path, total_steps=8, inject_step=5)
+    assert r["ok"], r
+    assert [a["kind"] for a in r["anomalies"]] == ["loss_spike"]
+    assert r["anomalies"][0]["applied"] is False  # in-graph gate held
+    assert not r["rewinds"], "skip_batch must not rewind"
+    assert r["skipped_batches"] == 1
+    assert r["parity"]["bitwise_equal"], r["parity"]
+
+
+def test_sdc_scenario_canary_detects_within_cadence(tmp_path):
+    r = _scenario("sdc", tmp_path, total_steps=10, canary_every=3)
+    assert r["ok"], r
+    assert [a["kind"] for a in r["anomalies"]] == ["sdc"]
+    lat = r["anomalies"][0]["latency_steps"]
+    assert 0 < lat <= 3, lat  # <= K, and genuinely deferred
+    assert r["rewinds"], "sdc policy must rewind (state is suspect)"
+    assert r["skipped_batches"] == 0  # the batch is innocent — no skip
+    assert r["parity"]["bitwise_equal"], r["parity"]
+
+
+def test_clean_run_zero_false_positives(tmp_path):
+    """Shortened false-positive gate (the 200-step version runs in the
+    CLI drill): sentinel + canary armed, nothing injected, zero
+    anomalies and every step committed."""
+    r = _scenario("clean", tmp_path, total_steps=60, canary_every=5)
+    assert r["ok"], r
+    assert r["false_positives"] == 0
+    assert r["goodput_record"]["steps_committed"] == 60
+
+
+def test_hang_scenario_watchdog_relaunch(tmp_path):
+    """inject_hang stalls one dispatch; the watchdog classifies it hung,
+    escalates (exit 103), the elastic manager relaunches, the resumed
+    run finishes with bitwise parity vs a clean run."""
+    r = _scenario("hang", tmp_path, total_steps=10)
+    assert r["ok"], r
+    assert [a["kind"] for a in r["anomalies"]] == ["hang"]
+    assert r["goodput_record"]["restarts"] == 1
+    assert r["parity"]["bitwise_equal"], r["parity"]
+
+
+def test_fault_drill_health_mode_subprocess(tmp_path):
+    """``tools/fault_drill.py --quick --health``: one inject_nan and one
+    inject_hang chained into the existing 2-kill drill, same bitwise
+    parity gate, under 90 s."""
+    out = str(tmp_path / "report.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "fault_drill.py"),
+         "--quick", "--health", "--workdir", str(tmp_path / "drill"),
+         "--out", out],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        report = json.load(f)
+    assert report["rc"] == 0 and report["done"] is True
+    assert report["parity"]["bitwise_equal"] is True, report["parity"]
+    fired = {e.split("@")[0] for e in report["fired_events"]}
+    assert fired == {"mid_step", "mid_ckpt_write", "inject_nan",
+                     "inject_hang"}, fired
+    g = report["goodput_record"]
+    assert g["restarts"] == 3  # 2 kills + 1 hang escalation
+    kinds = {a["kind"] for a in report["health"]["anomalies"]}
+    assert kinds == {"nan_loss", "hang"}
+    assert all(a["latency_steps"] <= 1
+               for a in report["health"]["anomalies"])
+    assert g["skipped_batches"] == 1 and g["rewound_steps"] > 0
+
+
+def test_dodge_resume_boundaries_properties():
+    """Hang events land >= 2 steps past every possible resume boundary
+    (deterministically), and ckpt_every < 3 is rejected up front."""
+    from paddle_tpu.fault.drill import _dodge_resume_boundaries
+    from paddle_tpu.fault.injection import FaultEvent, FaultPlan
+
+    plan = FaultPlan([FaultEvent("inject_hang", 3),
+                      FaultEvent("mid_step", 5)])
+    out = _dodge_resume_boundaries(plan, ckpt_every=3, total_steps=12)
+    hang = [e for e in out.events if e.kind == "inject_hang"][0]
+    assert hang.step % 3 >= 2 and hang.step >= 2
+    assert len({e.step for e in out.events}) == len(out.events)
+    # deterministic
+    out2 = _dodge_resume_boundaries(plan, ckpt_every=3, total_steps=12)
+    assert out.to_json() == out2.to_json()
+    with pytest.raises(ValueError, match="ckpt_every"):
+        _dodge_resume_boundaries(plan, ckpt_every=2, total_steps=12)
+    # no hang events -> untouched, any ckpt_every fine
+    kills = FaultPlan([FaultEvent("mid_step", 4)])
+    assert _dodge_resume_boundaries(kills, 2, 8).to_json() == \
+        kills.to_json()
+
+
+def test_goodput_health_fields_from_synthetic_log():
+    """parse_train_log / compute_goodput carry the health aggregates
+    (detection latency, skipped batches, rewound steps) and publish the
+    fault.* gauges."""
+    from paddle_tpu.fault import compute_goodput, parse_train_log
+
+    lines = [json.dumps(r) for r in [
+        {"event": "start", "start_step": 0},
+        {"step": 0, "loss": 1.0, "t": 0.5},
+        {"step": 1, "loss": 0.9, "t": 0.5},
+        {"event": "anomaly", "kind": "sdc", "step": 2, "inject_step": 1,
+         "latency_steps": 1},
+        {"event": "skip_batch", "pos": 2, "step": 2},
+        {"event": "rewind", "from": 2, "to": 0},
+        {"step": 0, "loss": 1.0, "t": 0.4},
+        {"step": 1, "loss": 0.9, "t": 0.4},
+        {"step": 2, "loss": 0.8, "t": 0.4},
+        {"event": "done"},
+    ]]
+    log = parse_train_log(lines)
+    assert log["skipped_batches"] == 1
+    assert log["rewound_steps"] == 2
+    assert log["detection_latency_steps"] == [1]
+    assert log["lost_steps"] == 2  # steps 0/1 re-executed after rewind
+    rec = compute_goodput(log, wall_s=5.0)
+    assert rec["skipped_batches"] == 1
+    assert rec["rewound_steps"] == 2
+    assert rec["detection_latency_steps"] == \
+        {"count": 1, "max": 1, "mean": 1.0}
+    from paddle_tpu.observability import metrics
+    snap = metrics.snapshot()
+    assert snap["fault.detection_latency_steps"]["series"][0]["value"] == 1
+    assert snap["fault.skipped_batches"]["series"][0]["value"] == 1
+    assert snap["fault.rewound_steps"]["series"][0]["value"] == 2

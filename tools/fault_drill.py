@@ -3,6 +3,9 @@
 
     python tools/fault_drill.py --quick            # tier-1-safe: tiny model,
                                                    # 2 kills, <60s, CPU
+    python tools/fault_drill.py --quick --health   # + one inject_nan and one
+                                                   # inject_hang chained in,
+                                                   # same parity gate, <90s
     python tools/fault_drill.py --steps 40 --kills 3 --seed 11 --size small
     python tools/fault_drill.py --quick --json     # report JSON on stdout
     python tools/fault_drill.py --quick --out REPORT.json
@@ -35,6 +38,12 @@ def main(argv=None):
     p.add_argument("--quick", action="store_true",
                    help="tier-1-safe drill: tiny model, 2 kills "
                         "(mid-step + mid-checkpoint-write)")
+    p.add_argument("--health", action="store_true",
+                   help="chain one inject_nan + one inject_hang into the "
+                        "drill with the guarded trainer (sentinel + "
+                        "watchdog + Guardian) armed; the parity gate "
+                        "compares against a clean run handed the same "
+                        "poisoned-batch skip set")
     p.add_argument("--workdir", default=None,
                    help="drill scratch dir (default: a fresh temp dir)")
     p.add_argument("--steps", type=int, default=None)
@@ -53,8 +62,9 @@ def main(argv=None):
 
     from paddle_tpu.fault import drill
 
-    cfg = drill.quick_config()
-    if not args.quick and args.steps is None:
+    cfg = drill.quick_health_config() if args.health else \
+        drill.quick_config()
+    if not args.quick and not args.health and args.steps is None:
         cfg.update(total_steps=24, ckpt_every=4, n_kills=3,
                    kinds=("mid_step", "mid_ckpt_write", "sigterm"))
     for key, val in (("total_steps", args.steps),
@@ -85,6 +95,10 @@ def main(argv=None):
 
     ok = (report.get("rc") == 0 and report.get("done")
           and report.get("parity", {}).get("bitwise_equal"))
+    if args.health and ok:
+        kinds = [a.get("kind")
+                 for a in report.get("health", {}).get("anomalies", [])]
+        ok = "nan_loss" in kinds and "hang" in kinds
     return 0 if ok else 1
 
 

@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Training-health drill CLI: inject -> detect -> decide -> recover -> prove.
+
+    python tools/health_drill.py --quick            # all five scenarios
+    python tools/health_drill.py --scenario nan     # one scenario
+    python tools/health_drill.py --quick --json     # report JSON on stdout
+    python tools/health_drill.py --quick --clean-steps 200
+
+Scenarios (paddle_tpu/fault/health_drill.py):
+
+- nan    : inject_nan -> sentinel detects same step -> rewind to
+           last-good -> replay skipping the poisoned batch -> final loss
+           BITWISE-equal to a clean run that never saw that batch
+- spike  : inject_loss_spike -> sentinel (rolling median) -> skip_batch
+           (the in-graph gate already blocked the update) -> parity
+- hang   : inject_hang stalls a dispatch -> wall-clock watchdog ->
+           elastic relaunch (exit 103) -> resume -> parity
+- sdc    : inject_sdc flips one bit in one gradient leaf of a canary
+           re-execution -> detected at the next canary step (<= K) ->
+           rewind WITHOUT batch skip -> parity
+- clean  : 200 steps, sentinel + canary armed, zero injected faults —
+           zero anomalies tolerated (the false-positive gate)
+
+Exits nonzero when any scenario fails to detect, recover, or match.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--quick", action="store_true",
+                   help="run all five scenarios at tier-1-safe sizes")
+    p.add_argument("--scenario", choices=("nan", "spike", "hang", "sdc",
+                                          "clean"), default=None,
+                   help="run a single scenario")
+    p.add_argument("--workdir", default=None,
+                   help="drill scratch dir (default: a fresh temp dir)")
+    p.add_argument("--clean-steps", type=int, default=200,
+                   help="length of the false-positive gate run")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--out", default=None, help="also write the report here")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.fault import health_drill
+
+    scenarios = [args.scenario] if args.scenario else None
+    workdir = args.workdir or tempfile.mkdtemp(prefix="health_drill_")
+    report = health_drill.run_health_drill(
+        workdir, scenarios=scenarios, clean_steps=args.clean_steps)
+    report["workdir"] = workdir
+
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(health_drill.report_summary(report))
+        print(json.dumps({
+            "metric": "health_drill", "ok": report["ok"],
+            "scenarios": {k: v.get("ok")
+                          for k, v in report["scenarios"].items()}}))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

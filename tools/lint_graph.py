@@ -164,12 +164,15 @@ def lint_overlap():
 def lint_fault():
     """The fault-drill configuration (paddle_tpu/fault/): the drill
     trainer's composed train step traced + jaxpr-linted + verified
-    against its declared StepPlan (same gate every other tier gets), and
-    the quick drill's deterministic FaultPlan statically validated (F002
-    — a kill scheduled past the end of training would make the drill
-    vacuous)."""
+    against its declared StepPlan (same gate every other tier gets), the
+    GUARDED step (FLAGS_health_sentinel=on — fused stats + in-graph
+    update gate) through the identical rules, the quick drill's
+    deterministic FaultPlan statically validated (F002), and the health
+    tier's own static rules: the Guardian policy table (F004) and the
+    SDC canary cadence (F005)."""
+    import numpy as np
     from paddle_tpu.analysis import lint_jaxpr, plan_check
-    from paddle_tpu.fault import _trainer, drill, injection
+    from paddle_tpu.fault import _trainer, drill, guardian, health, injection
 
     ts, batches = _trainer.build_step("quick")
     closed, donate = ts.trace_step(batches[0])
@@ -183,7 +186,35 @@ def lint_fault():
     pd = injection.check_plan(plan, cfg["total_steps"])
     print(f"  fault plan {plan!r}: {len(pd)} diagnostic(s)")
     diags += pd
-    return diags, len(closed.jaxpr.eqns)
+
+    # the guarded step: sentinel fused in, same jaxpr + plan gates
+    gts, gbatches = _trainer.build_step("quick", health=True)
+    ids, labels = gbatches[0]
+    gbatch = (ids, labels, np.asarray([1.0], np.float32))
+    gclosed, gdonate = gts.trace_step(gbatch)
+    gd = lint_jaxpr(gclosed, donate_argnums=gdonate, where="fault.guarded")
+    gd += plan_check.check_plan(gts.plan, gclosed, donate_argnums=gdonate,
+                                where="fault.guarded")
+    print(f"  guarded step (sentinel fused): {len(gclosed.jaxpr.eqns)} "
+          f"eqns, {len(gd)} diagnostic(s)")
+    diags += gd
+
+    # health-tier static rules over the quick drill's configuration
+    hcfg = drill.quick_health_config()
+    hd = health.check_health_plan(guardian.DEFAULT_POLICIES)
+    hd += health.check_canary(3, hcfg["total_steps"])
+    print(f"  health plan (F004) + canary cadence (F005): "
+          f"{len(hd)} diagnostic(s)")
+    diags += hd
+    hplan = injection.FaultPlan.from_seed(
+        hcfg["seed"], hcfg["total_steps"], n_kills=hcfg["n_kills"],
+        kinds=hcfg["kinds"])
+    hplan = drill._dodge_resume_boundaries(
+        hplan, hcfg["ckpt_every"], hcfg["total_steps"])
+    hpd = injection.check_plan(hplan, hcfg["total_steps"])
+    print(f"  health drill plan {hplan!r}: {len(hpd)} diagnostic(s)")
+    diags += hpd
+    return diags, len(closed.jaxpr.eqns) + len(gclosed.jaxpr.eqns)
 
 
 def lint_serving():
