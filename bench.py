@@ -1615,6 +1615,8 @@ def bench_serve(small: bool):
             f"sequential one-shot baseline")
 
     bench_serve_resilience(model, max_pos, vocab, small)
+    if os.environ.get("BENCH_SERVE_TIERS", "1") != "0":
+        bench_serve_throughput_tiers(small)
 
 
 def bench_serve_resilience(model, max_pos, vocab, small: bool):
@@ -1738,6 +1740,253 @@ def bench_serve_resilience(model, max_pos, vocab, small: bool):
           "shed+rejected / submitted", 0.0,
           {"outcomes": s["outcomes"], "max_waiting": 8,
            "shed_policy": repr(eng.shed_policy)})
+
+
+def bench_serve_throughput_tiers(small: bool):
+    """Serving throughput rung 2 (ISSUE 13): the three flag-gated tiers
+    measured on a compute-dominant CPU-mesh config (prompts long enough
+    that prefill FLOPs, not dispatch latency, carry the comparison):
+
+    - **prefix leg** — a shared-system-prompt workload replayed at share
+      ratios 0/0.5/0.8 through the engine with and without the radix
+      tree: the prefix-hit-rate x tokens/s curve, with tokens/s >= 1.5x
+      and peak live blocks (cache-idle tree holds excluded — they evict
+      on demand) reduced >= 2x GATED at the 80% ratio;
+    - **chunked leg** — residents decoding while a long prompt arrives:
+      max step wall (the resident-visible stall) with the chunked
+      budget must undercut the one-shot arm's unbounded stall;
+    - **speculative leg** — a decode-heavy trace swept over gamma with
+      the NGram drafter, greedy accept-prefix verify in one bucketed
+      extend dispatch: best-arm speedup >= 1.0x GATED, accept stats
+      recorded and the measured-winner gamma persisted into the
+      autotune cache (``FLAGS_serve_speculative=-1`` reads it back);
+      the record also lands in BENCH_timeline.jsonl.
+
+    Every arm's outputs are asserted token-exact against
+    ``model.generate`` — a throughput number never describes drifted
+    tokens."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.serving import Request, ServingEngine
+    from paddle_tpu.serving.speculative import store_gamma
+    from paddle_tpu.text.models.gpt import GPTForCausalLM, gpt_tiny
+
+    e = os.environ.get
+    vocab = int(e("BENCH_SERVE_TIERS_VOCAB", 512))
+    hidden = int(e("BENCH_SERVE_TIERS_HIDDEN", 192))
+    layers = int(e("BENCH_SERVE_TIERS_LAYERS", 3))
+    max_pos = 256
+    bs_, nb, mb = 16, 96, 4
+    n_users = 6 if small else 8
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny(
+        vocab_size=vocab, hidden_size=hidden, num_layers=layers,
+        num_heads=6, max_position_embeddings=max_pos))
+    model.eval()
+
+    def check_exact(results, trace):
+        bad = [r.rid for r in trace if not np.array_equal(
+            results[r.rid].output,
+            np.asarray(model.generate(jnp.asarray(r.prompt_ids[None]),
+                                      max_new_tokens=r.max_new_tokens))[0])]
+        if bad:
+            raise RuntimeError(f"tier outputs diverged from "
+                               f"model.generate: {bad}")
+
+    # -- (1) prefix leg: hit-rate x tokens/s curve ---------------------------
+    plen, max_new = 224, 3
+
+    def prefix_trace(ratio, shift):
+        rng = np.random.default_rng(17)
+        sl = int(round(ratio * plen / bs_)) * bs_
+        shared = (rng.integers(0, vocab, sl) + shift) % vocab
+        return [Request(
+            rid=f"u{i}s{shift}",
+            prompt_ids=np.concatenate([
+                shared,
+                (rng.integers(0, vocab, max(1, plen - sl)) + shift)
+                % vocab]).astype(np.int32),
+            max_new_tokens=max_new) for i in range(n_users)]
+
+    def prefix_arm(ratio, on):
+        eng = ServingEngine(model, block_size=bs_, num_blocks=nb,
+                            max_batch=mb, max_seq_len=max_pos,
+                            prefix_cache=on)
+        # two distinct-token warm passes: every bucket/width signature
+        # compiles outside the timed window while the timed trace still
+        # shares only among itself
+        eng.serve(prefix_trace(ratio, 7))
+        eng.serve(prefix_trace(ratio, 29))
+        eng.reset_peaks()
+        trace = prefix_trace(ratio, 0)
+        t0 = time.perf_counter()
+        results = eng.serve(trace)
+        wall = time.perf_counter() - t0
+        check_exact(results, trace)
+        tps = sum(r.max_new_tokens for r in trace) / wall
+        return tps, eng
+
+    curve = []
+    for ratio in (0.0, 0.5, 0.8):
+        tps_off, eng_off = prefix_arm(ratio, False)
+        tps_on, eng_on = prefix_arm(ratio, True)
+        rep = eng_on.prefix_report()
+        curve.append({
+            "share_ratio": ratio,
+            "prefix_hit_rate": rep["hit_rate"],
+            "tokens_per_s_off": round(tps_off, 1),
+            "tokens_per_s_on": round(tps_on, 1),
+            "speedup": round(tps_on / tps_off, 3),
+            "peak_live_blocks_off": eng_off.peak_live_blocks,
+            "peak_live_blocks_on": eng_on.peak_live_blocks,
+            "blocks_reduction": round(
+                eng_off.peak_live_blocks
+                / max(eng_on.peak_live_blocks, 1), 3),
+        })
+    head = curve[-1]                 # the 80%-share production point
+    _emit("serving_prefix_tokens_per_s", head["tokens_per_s_on"],
+          "tokens/s @ 80% share", 0.0, {
+              "curve": curve,
+              "speedup_at_80": head["speedup"],
+              "blocks_reduction_at_80": head["blocks_reduction"],
+              "config": {"prompt_len": plen, "max_new": max_new,
+                         "users": n_users, "hidden": hidden,
+                         "layers": layers, "block_size": bs_},
+              "method": ("shared-system-prompt trace (tools/serve_bench"
+                         ".py --prefix-trace shape) at share ratios "
+                         "0/0.5/0.8, radix-tree arm vs private-KV arm, "
+                         "two distinct-token warm passes, outputs "
+                         "token-exact; peak live blocks exclude "
+                         "evictable cache-idle tree holds")})
+    if head["speedup"] < 1.5:
+        raise RuntimeError(
+            f"prefix-cache tokens/s {head['speedup']}x < 1.5x at 80% "
+            f"share: {curve}")
+    if head["blocks_reduction"] < 2.0:
+        raise RuntimeError(
+            f"prefix-cache peak live blocks reduced only "
+            f"{head['blocks_reduction']}x < 2x: {curve}")
+
+    # -- (2) chunked-prefill leg: bounded stall ------------------------------
+    rng = np.random.default_rng(5)
+
+    def chunk_arm(chunk):
+        eng = ServingEngine(model, block_size=bs_, num_blocks=nb,
+                            max_batch=mb, max_seq_len=max_pos,
+                            chunked_prefill=chunk)
+        mk = lambda rid, n, new: Request(  # noqa: E731
+            rid=rid, prompt_ids=rng.integers(0, vocab, n).astype(np.int32),
+            max_new_tokens=new)
+        warm = [mk(f"w{i}", 16, 24) for i in range(3)] + \
+            [mk("wl", 224, 2)]
+        eng.serve(warm)
+        residents = [mk(f"d{i}", 16, 24) for i in range(3)]
+        long_req = mk("long", 224, 2)
+        for r in residents:
+            eng.submit(r)
+        steps_ms, results = [], {}
+        for it in range(200):
+            t0 = time.perf_counter()
+            done = eng.step()
+            steps_ms.append((time.perf_counter() - t0) * 1e3)
+            for s in done:
+                results[s.rid] = s
+            if it == 5:
+                eng.submit(long_req)
+            if not eng.sched.n_pending:
+                break
+        check_exact(results, residents + [long_req])
+        tail = steps_ms[6:]
+        return (max(tail),
+                sorted(tail)[int(0.99 * (len(tail) - 1))])
+
+    stall_off, p99_off = chunk_arm(0)
+    stall_on, p99_on = chunk_arm(32)
+    _emit("serving_chunked_prefill_stall_ms", stall_on, "ms max step "
+          "wall during long-prompt arrival", 0.0, {
+              "unchunked_stall_ms": round(stall_off, 2),
+              "chunked_stall_ms": round(stall_on, 2),
+              "p99_step_ms_unchunked": round(p99_off, 2),
+              "p99_step_ms_chunked": round(p99_on, 2),
+              "stall_reduction": round(stall_off / stall_on, 2),
+              "chunk_tokens": 32, "long_prompt": 224,
+              "method": ("3 short residents decoding, a 224-token "
+                         "prompt arrives at iteration 5; max/p99 "
+                         "engine-step wall over the remaining "
+                         "iterations = the resident-visible stall; "
+                         "chunked budget 32 tokens/iteration vs the "
+                         "one-shot prefill")})
+    if stall_on >= stall_off:
+        raise RuntimeError(
+            f"chunked prefill did not bound the long-prompt stall: "
+            f"chunked {stall_on:.1f}ms >= one-shot {stall_off:.1f}ms")
+
+    # -- (3) speculative leg: gamma sweep ------------------------------------
+    def spec_trace():
+        r = np.random.default_rng(9)
+        return [Request(rid=f"s{i}",
+                        prompt_ids=r.integers(
+                            0, vocab, int(r.integers(8, 17))).astype(
+                                np.int32),
+                        max_new_tokens=24) for i in range(n_users)]
+
+    def spec_arm(gamma):
+        eng = ServingEngine(model, block_size=bs_, num_blocks=nb,
+                            max_batch=mb, max_seq_len=max_pos,
+                            speculative=gamma)
+        tr = spec_trace()
+        eng.serve(tr)        # identical warm: same widths, no tree
+        t0 = time.perf_counter()
+        results = eng.serve(tr)
+        wall = time.perf_counter() - t0
+        check_exact(results, tr)
+        return sum(r.max_new_tokens for r in tr) / wall, eng
+
+    tps_base, _ = spec_arm(0)
+    arms = []
+    for g in (2, 4, 6):
+        tps_g, eng_g = spec_arm(g)
+        r = eng_g.spec_report()
+        arms.append({"gamma": g, "tokens_per_s": round(tps_g, 1),
+                     "speedup": round(tps_g / tps_base, 3),
+                     "accept_rate": r["accept_rate"],
+                     "mean_accept_len": r["mean_accept_len"],
+                     "tokens_per_verify": r["tokens_per_verify"]})
+    best = max(arms, key=lambda a: a["tokens_per_s"])
+    t_desc = f"gpt_l{layers}_h{hidden}_v{vocab}"
+    store_gamma(t_desc, "ngram", best["gamma"],
+                measured_ms=1e3 / max(best["tokens_per_s"], 1e-9))
+    _emit("serving_speculative_speedup", best["speedup"],
+          "x vs plain decode", 0.0, {
+              "baseline_tokens_per_s": round(tps_base, 1),
+              "arms": arms, "best_gamma": best["gamma"],
+              "spec_accept_rate": best["accept_rate"],
+              "drafter": "ngram",
+              "method": ("decode-heavy trace (short prompts, 24 new "
+                         "tokens), NGram prompt-lookup drafter, greedy "
+                         "accept-prefix verify in one bucketed "
+                         "decode-gamma extend dispatch; gamma swept "
+                         "{2,4,6}, measured winner persisted to the "
+                         "autotune cache; outputs token-exact")})
+    if best["speedup"] < 1.0:
+        raise RuntimeError(
+            f"speculative speedup {best['speedup']}x < 1.0x: {arms}")
+    out_path = os.environ.get("BENCH_TRACE_OUT", "BENCH_timeline.jsonl")
+    try:
+        with open(out_path, "a") as f:
+            f.write(json.dumps({
+                "kind": "spec_decode",
+                "spec_accept_rate": best["accept_rate"],
+                "mean_accept_len": best["mean_accept_len"],
+                "speedup": best["speedup"],
+                "gamma": best["gamma"],
+                "drafter": "ngram",
+                "prefix_curve": curve,
+                "chunked_stall_ms": round(stall_on, 2),
+                "unchunked_stall_ms": round(stall_off, 2),
+            }) + "\n")
+    except OSError:
+        pass
 
 
 def bench_gpt_13b():

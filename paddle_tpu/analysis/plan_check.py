@@ -528,6 +528,37 @@ def _rule_barrier_chain(ctx: PlanContext):
 
 
 @register_plan_rule(
+    "D005", "cow-write-isolation", ERROR,
+    "a sub-program writes or donates a buffer the plan declares "
+    "copy-on-write-shared (flags['cow_shared_buffers']) — shared prefix "
+    "pages are immutable; every write must target the private tail")
+def _rule_cow_write_isolation(ctx: PlanContext):
+    rule = _PLAN_RULES["D005"]
+    declared = ctx.plan.flags.get("cow_shared_buffers")
+    if not declared:
+        return
+    shared = {s.strip() for s in str(declared).split(",") if s.strip()}
+    for node in ctx.plan.nodes:
+        for attr in ("writes", "donates"):
+            for buf in getattr(node, attr):
+                hit = next((s for s in shared if _buf_overlaps(buf, s)),
+                           None)
+                if hit is not None:
+                    yield _diag(
+                        rule,
+                        f"node {node.name!r} {attr} buffer {buf!r}, "
+                        f"declared copy-on-write-shared ({hit!r}) — a "
+                        "shared block must never be in a donated/"
+                        "written set",
+                        hint="route the write to the private page "
+                             "region; shared prefix pages may only be "
+                             "read (the engine also asserts this per "
+                             "dispatch against the prefix tree's block "
+                             "set)")
+                    break
+
+
+@register_plan_rule(
     "D004", "plan-capacity-exceeded", ERROR,
     "the composed tiers' static HBM plan (tools/hbm_budget.py) does not "
     "fit the chip budget at any candidate batch")

@@ -210,6 +210,31 @@ define_flag("health_sentinel", "off",
             "spike/explosion thresholds, returning the stats vector for "
             "the host-side verdict (fault/guardian.py drives recovery).",
             choices=("off", "on"))
+define_flag("serve_prefix_cache", False,
+            "Radix prefix-sharing KV cache (serving/prefix_tree.py): "
+            "requests whose prompts share a full-block prefix attach to "
+            "the same immutable pages copy-on-write (refcounted "
+            "BlockAllocator; only the partial tail block is private), "
+            "eviction is LRU over refcount-0 trie leaves with a one-copy "
+            "host spill tier. Off (default) keeps the engine "
+            "byte-identical to the private-KV path.")
+define_flag("serve_chunked_prefill", 0,
+            "Chunked-prefill token budget for the serving engine: 0 "
+            "(default) prefills every prompt in one bucketed dispatch "
+            "(byte-identical to the pre-chunking engine); N > 0 splits "
+            "prompts longer than N tokens into N-token chunks "
+            "interleaved with the decode iterations so a long prompt "
+            "no longer stalls resident decodes (N is rounded down to a "
+            "multiple of the engine block size).")
+define_flag("serve_speculative", 0,
+            "Speculative-decoding draft depth (gamma) for the serving "
+            "engine: 0 (default) decodes one token per iteration "
+            "(byte-identical); N > 0 proposes N tokens per iteration "
+            "from the drafter (NGramDrafter by default, or a "
+            "ModelDrafter over a mirrored paged pool) and verifies them "
+            "in ONE bucketed decode-gamma dispatch with the greedy "
+            "accept-prefix rule; -1 consults the persistent autotune "
+            "cache's accepted-length-derived gamma (falls back to 4).")
 define_flag("cp_nested_ring", False,
             "Run the manual ring-attention CP path even when nested "
             "inside an enclosing manual shard_map (the pipeline "

@@ -40,7 +40,11 @@ from . import metrics
 __all__ = ["RequestTimeline", "REQUEST_PHASES", "current", "reset_default",
            "percentile"]
 
-REQUEST_PHASES = ("queue", "prefill", "decode", "detokenize")
+#: ``chunk_prefill`` replaces ``prefill`` on the extend path (prefix-hit
+#: suffix prefill and chunked prefill); ``draft``/``verify`` replace
+#: ``decode`` under speculative decoding (ISSUE 13).
+REQUEST_PHASES = ("queue", "prefill", "chunk_prefill", "decode",
+                  "draft", "verify", "detokenize")
 
 
 def percentile(values: List[float], q: float) -> Optional[float]:

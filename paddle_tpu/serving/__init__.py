@@ -28,14 +28,19 @@ from .buckets import BucketSet, pow2_buckets  # noqa: F401
 from .engine import ServingEngine  # noqa: F401
 from .paged_cache import (BlockAllocator, NULL_BLOCK,  # noqa: F401
                           OutOfBlocksError, PagedKVCache, SpillError)
+from .prefix_tree import PrefixCache, PrefixNode  # noqa: F401
 from .resilience import (Rejected, RequestJournal,  # noqa: F401
                          ShedPolicy)
 from .scheduler import (FCFSScheduler, Request, Sequence,  # noqa: F401
                         Status, TERMINAL_STATUSES)
+from .speculative import (ModelDrafter, NGramDrafter,  # noqa: F401
+                          pick_gamma, tune_gamma)
 
 __all__ = [
     "ServingEngine", "Request", "Sequence", "Status", "FCFSScheduler",
     "PagedKVCache", "BlockAllocator", "OutOfBlocksError", "SpillError",
     "NULL_BLOCK", "BucketSet", "pow2_buckets",
     "Rejected", "RequestJournal", "ShedPolicy", "TERMINAL_STATUSES",
+    "PrefixCache", "PrefixNode", "NGramDrafter", "ModelDrafter",
+    "pick_gamma", "tune_gamma",
 ]

@@ -89,20 +89,37 @@ def arm_serving_faults(workdir, plan_json):
 
 def run(workdir, cfg, plan_json=""):
     from paddle_tpu.serving import RequestJournal, ServingEngine
+    from paddle_tpu.serving.resilience import prompt_hash
 
     trace = load_trace(os.path.join(workdir, "trace.jsonl"))
     journal = RequestJournal(os.path.join(workdir, "journal.jsonl"))
     pending_rids = set(journal.pending_rids([r.rid for r in trace]))
     if not pending_rids:
         return 0  # a previous incarnation acknowledged everything
+    # replay integrity: the journaled prompt content hashes must match
+    # what the trace hands a relaunched incarnation — a drifted trace
+    # would otherwise silently serve different prompts under old rids
+    shas = journal.prompt_hashes()
+    for r in trace:
+        if r.rid in shas and shas[r.rid] != prompt_hash(r.prompt_ids):
+            raise RuntimeError(
+                f"replay trace prompt for {r.rid!r} does not match the "
+                f"journaled submission hash {shas[r.rid]}")
     arm_serving_faults(workdir, plan_json)
 
     model = build_model(cfg)
+    prefix_on = bool(cfg.get("prefix_cache", 0))
     engine = ServingEngine(
         model, block_size=cfg["block_size"], num_blocks=cfg["num_blocks"],
         max_batch=cfg["max_batch"], max_seq_len=cfg["max_pos"],
-        journal=journal)
+        journal=journal, prefix_cache=prefix_on)
     pending = [r for r in trace if r.rid in pending_rids]
+    if prefix_on:
+        # group shared prefixes adjacently (by prompt, so the journal
+        # hash groups identical prompts too): replayed sharers re-attach
+        # to the pages the first of them re-prefills instead of each
+        # re-prefilling cold
+        pending.sort(key=lambda r: tuple(int(t) for t in r.prompt_ids))
     engine.serve(pending)
     return 0
 

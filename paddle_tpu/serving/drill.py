@@ -41,11 +41,18 @@ WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 def quick_serve_config() -> Dict[str, Any]:
     """The tier-1-safe drill: tiny GPT, a trace that forces preemption
     pressure (so the mid-spill seam is reached), two kills — one
-    mid-decode, one mid-spill — well under two minutes on a laptop CPU."""
+    mid-decode, one mid-spill — well under two minutes on a laptop CPU.
+
+    ``prefix_cache=1`` arms the radix tree in the worker and
+    ``shared_prefix=N`` gives every trace prompt an N-token common
+    prefix, so the relaunch-replay path exercises tree re-attachment
+    (ISSUE 13 satellite: token-exactness must survive kills with the
+    prefix cache on)."""
     return dict(
         requests=6, prompt_lo=8, prompt_hi=14, max_new=8, trace_seed=3,
         model_seed=7, vocab=128, hidden=48, layers=2, heads=4, max_pos=32,
         block_size=4, num_blocks=10, max_batch=4,
+        prefix_cache=0, shared_prefix=0,
         # (kind, counter): decode iteration 4 and the very first spill —
         # both guaranteed to be reached before anything completes
         events=(("mid_decode", 4), ("mid_spill", 1)))
@@ -54,12 +61,13 @@ def quick_serve_config() -> Dict[str, Any]:
 def _write_trace(path: str, cfg: Dict[str, Any]) -> list:
     import numpy as np
     rng = np.random.default_rng(cfg["trace_seed"])
+    shared = rng.integers(0, cfg["vocab"],
+                          int(cfg.get("shared_prefix", 0))).tolist()
     trace = []
     for i in range(cfg["requests"]):
         plen = int(rng.integers(cfg["prompt_lo"], cfg["prompt_hi"] + 1))
-        trace.append({"rid": f"r{i}",
-                      "prompt": rng.integers(0, cfg["vocab"],
-                                             plen).tolist(),
+        prompt = shared + rng.integers(0, cfg["vocab"], plen).tolist()
+        trace.append({"rid": f"r{i}", "prompt": prompt,
                       "max_new_tokens": int(cfg["max_new"])})
     with open(path, "w") as f:
         for rec in trace:

@@ -18,17 +18,41 @@ Composition of the two load-bearing serving ideas on our machinery:
   bucketing works, and fires (through the analysis channel) the moment
   an unregistered signature slips through.
 
+Three throughput tiers compose on top (ISSUE 13; each default-off and
+byte-identical when off):
+
+- **radix prefix sharing** (``FLAGS_serve_prefix_cache``,
+  :mod:`.prefix_tree`): prompts sharing a full-block prefix attach
+  copy-on-write to the same pages via the refcounted allocator; only
+  the suffix is prefilled (through the ``extend`` executable), eviction
+  is LRU-over-refcount-0 trie leaves with a one-copy host spill tier;
+- **chunked prefill** (``FLAGS_serve_chunked_prefill``): long prompts
+  prefill in fixed-token chunks interleaved with decode iterations —
+  the per-iteration prefill token budget — so a 2k-token prompt no
+  longer freezes resident decodes; block tables grow incrementally;
+- **speculative decoding** (``FLAGS_serve_speculative``,
+  :mod:`.speculative`): a drafter proposes gamma tokens which the
+  target verifies in ONE bucketed decode-gamma ``extend`` dispatch
+  (greedy accept-prefix rule; the target's own token commits at the
+  first mismatch), with accepted-length histograms feeding the
+  autotune cache's choice of gamma.
+
 The prefill step runs the model's flash-attention forward on one
 bucket-padded prompt and scatters the per-layer K/V into the sequence's
 pages; the decode step is a batched single-query pass that gathers each
 sequence's pages (``ops.flash_attention.single_query_attention`` masks
 the padded tail by context length) and writes the new token's KV in the
-same program. Both executables take the page pool **donated** — the pool
-is updated in place, never copied — and the whole dispatch sequence is
-declared as a :class:`~paddle_tpu.analysis.plan_check.StepPlan` so the
-donation-lifetime rules (D001/D002) and the sharding-flow rules verify
-the serving path like every training tier (``lint_graph --model
-serving``).
+same program; the ``extend`` step is the multi-token generalization
+(offset-causal over gathered pages) shared by chunked prefill, suffix
+prefill after a prefix hit, and speculative verification. Executables
+take the page pool **donated** — the pool is updated in place, never
+copied — and the whole dispatch sequence is declared as a
+:class:`~paddle_tpu.analysis.plan_check.StepPlan` so the
+donation-lifetime rules (D001/D002) and the COW write-isolation rule
+(D005: a copy-on-write shared buffer is never written or donated)
+verify the serving path like every training tier (``lint_graph --model
+serving``). At runtime the same isolation is asserted per dispatch:
+no scatter ever targets a device block the prefix tree holds.
 
 Works with any ``GPTForCausalLM``-shaped model (``.gpt.wte/wpe/h/ln_f``,
 ``.logits``); decoding is greedy (argmax), matching ``model.generate``'s
@@ -37,6 +61,7 @@ default.
 
 from __future__ import annotations
 
+import math
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence as Seq, Union
@@ -45,6 +70,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import flags as _flags
 from ..fault.injection import fire as _fault_fire
 from ..observability import metrics, request_timeline
 from ..observability.request_timeline import percentile
@@ -53,14 +79,46 @@ from ..ops.flash_attention import flash_attention, single_query_attention
 from .buckets import BucketSet, pow2_buckets, pad_axis
 from .paged_cache import (NULL_BLOCK, OutOfBlocksError, PagedKVCache,
                           SpillError)
+from .prefix_tree import PrefixCache
 from .resilience import Rejected, RequestJournal, ShedPolicy
 from .scheduler import FCFSScheduler, Request, Sequence, Status
+from .speculative import (DEFAULT_GAMMA, ModelDrafter, NGramDrafter,
+                          pick_gamma)
 
 __all__ = ["ServingEngine"]
 
 
 def _ceil_div(a: int, b: int) -> int:
     return -(-a // b)
+
+
+def _multi_query_attention(q, k, v, pos):
+    """Offset-causal attention for the ``extend`` step: ``q`` is
+    ``[B, L, H, D]`` (L query tokens at absolute positions ``pos``
+    [B, L]); ``k``/``v`` are ``[B, Sk, KH, D]`` gathered pages. Query
+    ``(b, i)`` attends keys ``j <= pos[b, i]`` — its own KV was
+    scattered before the gather, so self-attention is included exactly
+    like the decode step's ``lengths = pos + 1`` mask. Same GQA head
+    reshape, f32 score accumulation, and masked-row-safe softmax as
+    :func:`~paddle_tpu.ops.flash_attention.single_query_attention`
+    (numeric agreement with the decode path is what keeps chunked /
+    speculative outputs token-exact against ``model.generate``)."""
+    b, L, h, d = q.shape
+    sk, kh = k.shape[1], k.shape[2]
+    g = h // kh
+    scale = 1.0 / math.sqrt(d)
+    qg = q.reshape(b, L, kh, g, d)
+    scores = jnp.einsum("blkgd,bskd->blkgs", qg, k,
+                        preferred_element_type=jnp.float32) * scale
+    valid = jnp.arange(sk)[None, None, :] <= pos[:, :, None]   # [B, L, Sk]
+    scores = jnp.where(valid[:, :, None, None, :], scores, -jnp.inf)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    e = jnp.where(jnp.isfinite(scores),
+                  jnp.exp(scores - jnp.where(jnp.isfinite(m), m, 0.0)), 0.0)
+    probs = (e / jnp.maximum(jnp.sum(e, axis=-1, keepdims=True),
+                             1e-30)).astype(q.dtype)
+    out = jnp.einsum("blkgs,bskd->blkgd", probs, v)
+    return out.reshape(b, L, h, d)
 
 
 class ServingEngine:
@@ -75,7 +133,11 @@ class ServingEngine:
                  max_spilled_bytes: Optional[int] = None,
                  shed_policy: Optional[ShedPolicy] = None,
                  journal: Optional[RequestJournal] = None,
-                 validate_capacity: bool = True):
+                 validate_capacity: bool = True,
+                 prefix_cache: Optional[bool] = None,
+                 chunked_prefill: Optional[int] = None,
+                 speculative: Optional[int] = None,
+                 drafter: Optional[Any] = None):
         """Resilience knobs (all default-off, preserving PR-8 behavior):
         ``max_waiting``/``max_spilled_bytes`` bound admission (over-budget
         submissions return a typed :class:`Rejected`), ``shed_policy``
@@ -84,7 +146,16 @@ class ServingEngine:
         ``validate_capacity=False`` lets a pool smaller than one
         max-length sequence serve anyway — a request that outgrows it
         FAILS (F003) instead of the constructor refusing, which is how
-        the drill proves pool exhaustion never crashes the loop."""
+        the drill proves pool exhaustion never crashes the loop.
+
+        Throughput knobs (``None`` reads the matching ``FLAGS_serve_*``
+        flag; every one default-off and byte-identical off):
+        ``prefix_cache`` arms the radix prefix-sharing tree;
+        ``chunked_prefill`` is the per-iteration prefill token budget
+        (0 = one-shot prefill); ``speculative`` is the draft depth gamma
+        (0 = off, -1 = the autotune cache's accepted-length-derived
+        choice) with ``drafter`` an :class:`NGramDrafter` (default) or
+        :class:`ModelDrafter`."""
         model.eval()
         cfg = model.cfg
         self.model = model
@@ -115,15 +186,61 @@ class ServingEngine:
             decode_buckets if decode_buckets is not None
             else pow2_buckets(1, max_batch))
 
+        # -- throughput tiers (ISSUE 13) -------------------------------------
+        self.prefix_on = bool(_flags.flag("serve_prefix_cache")) \
+            if prefix_cache is None else bool(prefix_cache)
+        chunk = int(_flags.flag("serve_chunked_prefill")) \
+            if chunked_prefill is None else int(chunked_prefill)
+        # the chunk budget is block-granular (chunk KV scatters whole
+        # blocks); a sub-block budget rounds up to one block
+        self.chunk_tokens = 0 if chunk <= 0 else max(
+            self.block_size, (chunk // self.block_size) * self.block_size)
+        spec = int(_flags.flag("serve_speculative")) \
+            if speculative is None else int(speculative)
+        self.drafter = None
+        self.spec_gamma = 0
+        self._draft_cache: Optional[PagedKVCache] = None
+        if spec != 0:
+            self.drafter = drafter if drafter is not None else NGramDrafter()
+            t_desc = (f"gpt_l{cfg.num_layers}_h{cfg.hidden_size}"
+                      f"_v{cfg.vocab_size}")
+            d_desc = self.drafter.kind
+            if isinstance(self.drafter, ModelDrafter):
+                dcfg = self.drafter.model.cfg
+                d_desc = (f"gpt_l{dcfg.num_layers}_h{dcfg.hidden_size}"
+                          f"_v{dcfg.vocab_size}")
+            self.spec_gamma = spec if spec > 0 else pick_gamma(
+                t_desc, d_desc, default=DEFAULT_GAMMA)
+            self._spec_desc = (t_desc, d_desc)
+        self._accept_lens: List[int] = []
+        self.spec_stats = {"iterations": 0, "proposed": 0, "accepted": 0}
+
         # -- device state ----------------------------------------------------
         act_dtype = model.gpt.wte.weight.dtype
         head_dim = cfg.hidden_size // cfg.num_heads
         self.cache = PagedKVCache(cfg.num_layers, num_blocks,
                                   self.block_size, cfg.kv_heads, head_dim,
                                   dtype=act_dtype)
+        if isinstance(self.drafter, ModelDrafter):
+            dcfg = self.drafter.model.cfg
+            if int(dcfg.vocab_size) != int(cfg.vocab_size):
+                raise ValueError(
+                    f"drafter vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}")
+            self._draft_cache = PagedKVCache(
+                dcfg.num_layers, num_blocks, self.block_size,
+                dcfg.kv_heads, dcfg.hidden_size // dcfg.num_heads,
+                dtype=self.drafter.model.gpt.wte.weight.dtype)
+        self.prefix = PrefixCache(self.cache, mirror=self._draft_cache) \
+            if self.prefix_on else None
         self.sched = FCFSScheduler(max_batch, max_waiting=max_waiting)
         self._seqs: Dict[str, Sequence] = {}
         self._t0 = time.perf_counter()
+        self.peak_blocks_used = 0
+        #: peak blocks referenced by live sequences (tree-idle cache
+        #: holds excluded — they evict on demand); the fair
+        #: pool-pressure comparison across prefix-cache arms
+        self.peak_live_blocks = 0
 
         # -- resilience state ------------------------------------------------
         self.max_spilled_bytes = max_spilled_bytes
@@ -148,11 +265,44 @@ class ServingEngine:
             threshold=len(self.prefill_buckets))
         self._sent_decode = RecompileSentinel(
             threshold=len(self.decode_buckets))
+        self._chunk_raw = None
+        self._chunk_fn = None
+        self._sent_chunk = None
+        if self.prefix_on or self.chunk_tokens:
+            self._chunk_raw = self._make_extend(self.model,
+                                                last_only=True)
+            self._chunk_fn = jax.jit(self._chunk_raw,
+                                     donate_argnums=(1, 2))
+            self._sent_chunk = RecompileSentinel(
+                threshold=len(self.prefill_buckets))
+        self._verify_raw = None
+        self._verify_fn = None
+        self._sent_verify = None
+        if self.spec_gamma:
+            self._verify_raw = self._make_extend(self.model,
+                                                 last_only=False)
+            self._verify_fn = jax.jit(self._verify_raw,
+                                      donate_argnums=(1, 2))
+            self._sent_verify = RecompileSentinel(
+                threshold=len(self.decode_buckets))
+        self._draft_decode_fn = None
+        self._draft_extend_fn = None
+        self._sent_draft = None
+        if self._draft_cache is not None:
+            self._draft_decode_fn = jax.jit(
+                self._make_decode(self.drafter.model),
+                donate_argnums=(1, 2))
+            self._draft_extend_fn = jax.jit(
+                self._make_extend(self.drafter.model, last_only=True),
+                donate_argnums=(1, 2))
+            self._sent_draft = RecompileSentinel(
+                threshold=len(self.decode_buckets) +
+                len(self.prefill_buckets))
         self.plan = self._build_plan()
         self._linted = False
 
     # ------------------------------------------------------------------
-    # The two bucketed executables
+    # The bucketed executables
     # ------------------------------------------------------------------
 
     def _make_prefill(self):
@@ -186,8 +336,8 @@ class ServingEngine:
 
         return prefill
 
-    def _make_decode(self):
-        m = self.model
+    def _make_decode(self, model=None):
+        m = model if model is not None else self.model
         bs = self.block_size
 
         def decode(tokens, k_pages, v_pages, tables, ctx_lens):
@@ -223,6 +373,61 @@ class ServingEngine:
 
         return decode
 
+    def _make_extend(self, model, last_only: bool = False):
+        """The multi-token paged step: chunk prefill, prefix-hit suffix
+        prefill, and speculative verify are all this one program at
+        different (B, L) buckets. ``last_only=True`` (the chunk/prefill
+        form) projects logits for only each row's final real token —
+        the verify form needs the argmax at EVERY position for the
+        accept-prefix rule, the chunk form only the next token."""
+        m = model
+        bs = self.block_size
+
+        def extend(tokens, k_pages, v_pages, tables, ctx_lens, n_real):
+            """tokens [B, L]; tables [B, M] null-padded; ctx_lens [B]
+            tokens already cached per row; n_real [B] real tokens in
+            this dispatch (padded slots scatter into the null block).
+            Writes tokens[b, i]'s KV at position ctx_lens[b] + i and
+            returns the greedy argmax — [B, L] (every query) or [B]
+            (each row's last real query) under ``last_only``."""
+            b, L = tokens.shape
+            mx = tables.shape[1] * bs
+            pos = ctx_lens[:, None] + jnp.arange(L)[None, :]       # [B, L]
+            real = jnp.arange(L)[None, :] < n_real[:, None]        # [B, L]
+            pos_q = jnp.where(real, pos, 0)
+            x = m.gpt.wte(tokens) + m.gpt.wpe(pos_q)
+            bi = jnp.take_along_axis(
+                tables, jnp.clip(pos // bs, 0, tables.shape[1] - 1),
+                axis=1)
+            bi = jnp.where(real, bi, NULL_BLOCK)
+            si = pos % bs
+            for li, blk in enumerate(m.gpt.h):
+                xn = blk.ln_1(x)
+                q, k, v = blk.attn._project_qkv(xn)
+                k_pages = k_pages.at[li, bi, si].set(
+                    k.astype(k_pages.dtype))
+                v_pages = v_pages.at[li, bi, si].set(
+                    v.astype(v_pages.dtype))
+                keys = k_pages[li][tables].reshape(b, mx, *k.shape[2:])
+                vals = v_pages[li][tables].reshape(b, mx, *v.shape[2:])
+                o = _multi_query_attention(q, keys, vals, pos_q)
+                x = x + blk.attn.out_proj(o.reshape(b, L, -1))
+                x = x + blk.mlp(blk.ln_2(x))
+            hidden = m.gpt.ln_f(x)
+            if last_only:
+                idx = jnp.maximum(n_real - 1, 0)[:, None, None]
+                last = jnp.take_along_axis(
+                    hidden, jnp.broadcast_to(
+                        idx, (b, 1, hidden.shape[-1])), axis=1)
+                logits = m.logits(last)[:, 0]
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                logits = m.logits(hidden)
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return toks, k_pages, v_pages
+
+        return extend
+
     # ------------------------------------------------------------------
     # Declared plan + static analysis
     # ------------------------------------------------------------------
@@ -233,6 +438,32 @@ class ServingEngine:
             PlanNode("serve.prefill", reads=("weights", "prompt_ids"),
                      donates=("kv_pages",),
                      writes=("kv_pages", "next_tokens")),
+        ]
+        if self.prefix_on or self.chunk_tokens:
+            # the extend step READS the copy-on-write shared pages (the
+            # prefix tree's immutable blocks) and writes only private
+            # pages — rule D005 rejects any plan that writes/donates a
+            # buffer listed in flags["cow_shared_buffers"]
+            nodes.append(PlanNode(
+                "serve.chunk_prefill",
+                reads=("weights", "chunk_ids", "block_tables",
+                       "kv_pages_shared"),
+                donates=("kv_pages",),
+                writes=("kv_pages", "next_tokens")))
+        if self.spec_gamma:
+            nodes.append(PlanNode(
+                "serve.draft",
+                reads=("draft_weights", "block_tables", "ctx_lens",
+                       "draft_kv_pages_shared"),
+                donates=("draft_kv_pages",),
+                writes=("draft_kv_pages", "draft_tokens")))
+            nodes.append(PlanNode(
+                "serve.verify",
+                reads=("weights", "draft_tokens", "block_tables",
+                       "ctx_lens", "kv_pages_shared"),
+                donates=("kv_pages",),
+                writes=("kv_pages", "next_tokens")))
+        nodes += [
             PlanNode("serve.decode",
                      reads=("weights", "block_tables", "ctx_lens"),
                      donates=("kv_pages",),
@@ -242,26 +473,34 @@ class ServingEngine:
             PlanNode("serve.restore", reads=("host_kv",),
                      donates=("kv_pages",), writes=("kv_pages",)),
         ]
-        return StepPlan(
-            flags={"block_size": self.block_size,
-                   "num_blocks": self.cache.num_blocks,
-                   "max_batch": self.sched.max_batch,
-                   "prefill_buckets": str(self.prefill_buckets.sizes),
-                   "decode_buckets": str(self.decode_buckets.sizes),
-                   # resilience knobs change scheduling, not dispatch —
-                   # declared so the verified plan names the whole config
-                   "max_waiting": str(self.sched.max_waiting),
-                   "max_spilled_bytes": str(self.max_spilled_bytes),
-                   "shed_policy": repr(self.shed_policy)},
-            mesh_axes={}, params={}, nodes=nodes)
+        flags = {"block_size": self.block_size,
+                 "num_blocks": self.cache.num_blocks,
+                 "max_batch": self.sched.max_batch,
+                 "prefill_buckets": str(self.prefill_buckets.sizes),
+                 "decode_buckets": str(self.decode_buckets.sizes),
+                 # resilience knobs change scheduling, not dispatch —
+                 # declared so the verified plan names the whole config
+                 "max_waiting": str(self.sched.max_waiting),
+                 "max_spilled_bytes": str(self.max_spilled_bytes),
+                 "shed_policy": repr(self.shed_policy),
+                 "serve_prefix_cache": self.prefix_on,
+                 "serve_chunked_prefill": self.chunk_tokens,
+                 "serve_speculative": self.spec_gamma}
+        if self.prefix_on:
+            flags["cow_shared_buffers"] = \
+                "kv_pages_shared,draft_kv_pages_shared"
+        return StepPlan(flags=flags, mesh_axes={}, params={}, nodes=nodes)
 
     def trace_steps(self):
-        """Closed jaxprs of the two executables at their smallest buckets
-        — the ``lint_graph --model serving`` / plan_check inputs. Returns
-        ``{name: (closed_jaxpr, donate_argnums)}``."""
+        """Closed jaxprs of the engine's executables at their smallest
+        buckets — the ``lint_graph --model serving`` / plan_check
+        inputs. Returns ``{name: (closed_jaxpr, donate_argnums)}``;
+        ``extend`` (chunk/suffix prefill), ``verify`` (decode-gamma) and
+        the drafter pair appear only when the matching tier is armed."""
         s0 = self.prefill_buckets.sizes[0]
         b0 = self.decode_buckets.sizes[0]
         c = self.cache
+        m_blocks = self.max_blocks_per_seq
         pages = jax.ShapeDtypeStruct(c.k.shape, c.k.dtype)
         i32 = jnp.int32
         pre = jax.make_jaxpr(self._prefill_raw)(
@@ -270,9 +509,31 @@ class ServingEngine:
             jax.ShapeDtypeStruct((), i32))
         dec = jax.make_jaxpr(self._decode_raw)(
             jax.ShapeDtypeStruct((b0,), i32), pages, pages,
-            jax.ShapeDtypeStruct((b0, self.max_blocks_per_seq), i32),
+            jax.ShapeDtypeStruct((b0, m_blocks), i32),
             jax.ShapeDtypeStruct((b0,), i32))
-        return {"prefill": (pre, (1, 2)), "decode": (dec, (1, 2))}
+        out = {"prefill": (pre, (1, 2)), "decode": (dec, (1, 2))}
+        if self._chunk_raw is not None:
+            out["extend"] = (jax.make_jaxpr(self._chunk_raw)(
+                jax.ShapeDtypeStruct((1, s0), i32), pages, pages,
+                jax.ShapeDtypeStruct((1, m_blocks), i32),
+                jax.ShapeDtypeStruct((1,), i32),
+                jax.ShapeDtypeStruct((1,), i32)), (1, 2))
+        if self._verify_raw is not None:
+            L = self.spec_gamma + 1
+            out["verify"] = (jax.make_jaxpr(self._verify_raw)(
+                jax.ShapeDtypeStruct((b0, L), i32), pages, pages,
+                jax.ShapeDtypeStruct((b0, m_blocks), i32),
+                jax.ShapeDtypeStruct((b0,), i32),
+                jax.ShapeDtypeStruct((b0,), i32)), (1, 2))
+        if self._draft_cache is not None:
+            dpages = jax.ShapeDtypeStruct(self._draft_cache.k.shape,
+                                          self._draft_cache.k.dtype)
+            out["draft"] = (jax.make_jaxpr(
+                self._make_decode(self.drafter.model))(
+                    jax.ShapeDtypeStruct((b0,), i32), dpages, dpages,
+                    jax.ShapeDtypeStruct((b0, m_blocks), i32),
+                    jax.ShapeDtypeStruct((b0,), i32)), (1, 2))
+        return out
 
     def compile_decode(self):
         """AOT lower+compile the decode executable at its smallest
@@ -292,11 +553,33 @@ class ServingEngine:
             jax.ShapeDtypeStruct((b0,), i32)).compile()
         return compiled, 2
 
+    def compile_extend(self, verify: bool = False):
+        """AOT lower+compile the extend executable (chunk signature, or
+        the decode-gamma verify signature) for the X pass — same aliasing
+        and zero-collective contract as :meth:`compile_decode`."""
+        fn = self._verify_fn if verify else self._chunk_fn
+        if fn is None:
+            raise ValueError("extend executable not armed (enable "
+                             "prefix_cache/chunked_prefill/speculative)")
+        c = self.cache
+        pages = jax.ShapeDtypeStruct(c.k.shape, c.k.dtype)
+        i32 = jnp.int32
+        if verify:
+            b, L = self.decode_buckets.sizes[0], self.spec_gamma + 1
+        else:
+            b, L = 1, self.prefill_buckets.sizes[0]
+        compiled = fn.lower(
+            jax.ShapeDtypeStruct((b, L), i32), pages, pages,
+            jax.ShapeDtypeStruct((b, self.max_blocks_per_seq), i32),
+            jax.ShapeDtypeStruct((b,), i32),
+            jax.ShapeDtypeStruct((b,), i32)).compile()
+        return compiled, 2
+
     def _maybe_lint(self) -> None:
-        """FLAGS_static_analysis hook: on first dispatch, lint both step
-        graphs, verify the declared plan (one trace feeds both), and —
-        final stage — verify the compiled decode module's optimized HLO
-        against the plan (X-rules, analysis/hlo_check.py)."""
+        """FLAGS_static_analysis hook: on first dispatch, lint every
+        armed step graph, verify the declared plan (one trace feeds
+        them), and — final stage — verify the compiled decode module's
+        optimized HLO against the plan (X-rules, analysis/hlo_check)."""
         if self._linted:
             return
         self._linted = True
@@ -321,6 +604,72 @@ class ServingEngine:
                                          where="serving.decode.hlo")
         if diags:
             jaxpr_lint.emit(diags, where="serving")
+
+    # ------------------------------------------------------------------
+    # Allocation, COW isolation, shared-block accounting
+    # ------------------------------------------------------------------
+
+    def _alloc(self, n: int) -> Optional[List[int]]:
+        """Evict-aware allocation: on a shortfall the prefix tree spills
+        LRU refcount-0 leaves to the host tier until the grant fits (or
+        nothing is evictable). The flag-off path is exactly
+        ``allocator.alloc``."""
+        got = self.cache.allocator.alloc(n)
+        if got is None and self.prefix is not None:
+            # evict with headroom: the per-token alloc(1) pattern would
+            # otherwise pay a tree scan per block under pressure
+            deficit = max(n - self.cache.allocator.n_free, 4)
+            if self.prefix.evict(deficit) > 0:
+                got = self.cache.allocator.alloc(n)
+        if got is not None:
+            self.peak_blocks_used = max(self.peak_blocks_used,
+                                        self.cache.allocator.n_used)
+        return got
+
+    def _assert_cow(self, write_ids) -> None:
+        """The runtime half of rule D005: no dispatch may scatter into a
+        device block the prefix tree holds — shared pages are immutable;
+        only the private tail is ever written."""
+        if self.prefix is None:
+            return
+        bad = self.prefix.device_block_ids().intersection(
+            int(i) for i in write_ids)
+        if bad:
+            raise AssertionError(
+                f"COW write-isolation violated: dispatch would write "
+                f"shared prefix blocks {sorted(bad)}")
+
+    def _write_span_ids(self, seq: Sequence, start: int, n: int
+                        ) -> List[int]:
+        """Block ids covering token positions [start, start+n)."""
+        if n <= 0:
+            return []
+        lo, hi = start // self.block_size, (start + n - 1) // self.block_size
+        return seq.block_ids[lo:hi + 1]
+
+    def _private_blocks(self, seq: Sequence) -> int:
+        """The prefix-sharing cost model (satellite 2): blocks a
+        preemption/shed of this sequence would actually free — its
+        refcount-1 private tail, not the shared tree pages."""
+        return len(seq.block_ids) - seq.n_shared_blocks
+
+    def _cost_fn(self):
+        """Victim-selection cost hook: armed only with the prefix cache
+        (the flag-off scheduler order stays bitwise-identical)."""
+        return self._private_blocks if self.prefix is not None else None
+
+    def _free_seq_blocks(self, seq: Sequence) -> None:
+        """One exit for a sequence's device-block ownership: release the
+        tree attachments (the tree's own cache ref keeps shared pages
+        resident) and free the private tail."""
+        if seq.prefix_nodes:
+            self.prefix.release(seq.prefix_nodes)
+            seq.prefix_nodes = []
+        private = seq.block_ids[seq.n_shared_blocks:]
+        if private:
+            self.cache.allocator.free(private)
+        seq.block_ids = []
+        seq.n_shared_blocks = 0
 
     # ------------------------------------------------------------------
     # Request lifecycle
@@ -383,6 +732,18 @@ class ServingEngine:
         metrics.gauge("serving.running",
                       "sequences resident in the decode batch").set(
                           len(self.sched.running))
+        used = self.cache.allocator.n_used
+        self.peak_blocks_used = max(self.peak_blocks_used, used)
+        live = used - (self.prefix.n_idle_device_blocks()
+                       if self.prefix is not None else 0)
+        self.peak_live_blocks = max(self.peak_live_blocks, live)
+
+    def reset_peaks(self) -> None:
+        """Restart the peak-blocks watermarks (bench arms measure the
+        steady state, not the warmup)."""
+        self.peak_blocks_used = 0
+        self.peak_live_blocks = 0
+        self._gauges()
 
     # -- terminal non-success paths (isolation, deadlines, shedding) ---------
 
@@ -393,11 +754,10 @@ class ServingEngine:
         buffers, journal acknowledgment, timeline record, counters. The
         allocator-invariant tests pin the zero-leak property."""
         self.sched.retire(seq, status)
-        if seq.block_ids:
-            self.cache.allocator.free(seq.block_ids)
-            seq.block_ids = []
+        self._free_seq_blocks(seq)
         if seq.host_kv is not None:
             seq.host_kv = None
+            seq.host_draft_kv = None
             self._account_spill(-seq.spilled_bytes)
             seq.spilled_bytes = 0
         seq.error = reason
@@ -457,7 +817,8 @@ class ServingEngine:
 
     def _apply_shed_policy(self) -> None:
         """One policy consult per iteration: set ``mode``, shed at most
-        one request (lowest-priority/youngest, waiting first), and in
+        one request (lowest-priority, then most-private-blocks under the
+        prefix cost model, youngest last; waiting first), and in
         degraded mode compute the shrunken decode-bucket cap."""
         pol = self.shed_policy
         if pol is None:
@@ -475,7 +836,8 @@ class ServingEngine:
                         "iterations spent in shed/degraded mode").inc()
         # degrade mode preserves residents (they get a smaller bucket);
         # pure shed mode may drop running work to free blocks
-        victim = self.sched.shed_candidate(waiting_only=pol.degrade)
+        victim = self.sched.shed_candidate(waiting_only=pol.degrade,
+                                           cost=self._cost_fn())
         if victim is not None:
             self._cancel(victim, Status.SHED, f"load shed: {why}")
         if pol.degrade and len(self.sched.running) > 1:
@@ -485,13 +847,14 @@ class ServingEngine:
 
     def _enforce_degraded_width(self) -> None:
         """Degraded mode shrinks the active decode bucket: preempt the
-        youngest/lowest-priority residents (the normal LIFO spill path)
-        until the batch fits the smaller bucket."""
+        lowest-priority residents (most private blocks first under the
+        prefix cost model — the normal spill path) until the batch fits
+        the smaller bucket."""
         cap = self._degraded_width
         if cap is None:
             return
         while len(self.sched.running) > cap:
-            victim = self.sched.preempt_victim()
+            victim = self.sched.preempt_victim(cost=self._cost_fn())
             if victim is None:
                 break
             try:
@@ -509,9 +872,11 @@ class ServingEngine:
         if seq is None or not self.sched.has_capacity():
             return False
         if seq.status is Status.PREEMPTED:
-            n_need = int(seq.host_kv[0].shape[1])
-        else:
-            n_need = _ceil_div(seq.prompt_len, self.block_size)
+            return self._admit_restore(seq)
+        if self.prefix is not None or self.chunk_tokens:
+            return self._admit_extend(seq)
+        # -- the flag-off path: byte-identical to the PR-8/9 engine ------
+        n_need = _ceil_div(seq.prompt_len, self.block_size)
         ids = self.cache.allocator.alloc(n_need)
         if ids is None:
             if not self.sched.running and self.cache.allocator.n_used == 0:
@@ -525,10 +890,7 @@ class ServingEngine:
             return False
         self.sched.admit(seq)
         try:
-            if seq.status is Status.RUNNING and seq.host_kv is not None:
-                self._restore(seq, ids)
-            else:
-                self._prefill(seq, ids)
+            self._prefill(seq, ids)
         except Exception as e:  # per-sequence device error: isolate it
             if seq.block_ids:
                 # blocks granted this admission that _cancel would miss
@@ -538,6 +900,88 @@ class ServingEngine:
                 extra = []
             if extra:
                 self.cache.allocator.free(extra)
+            self._cancel(seq, Status.FAILED,
+                         f"{type(e).__name__}: {e}", diagnose=True)
+        return True
+
+    def _admit_restore(self, seq: Sequence) -> bool:
+        """Re-admit a preempted sequence: restore its spilled private
+        blocks (the shared prefix never left the device — its refs were
+        kept through preemption)."""
+        n_need = int(seq.host_kv[0].shape[1])
+        ids = self._alloc(n_need)
+        if ids is None:
+            return False
+        self.sched.admit(seq)
+        try:
+            self._restore(seq, ids)
+        except Exception as e:
+            if not set(ids) <= set(seq.block_ids):
+                self.cache.allocator.free(ids)
+            self._cancel(seq, Status.FAILED,
+                         f"{type(e).__name__}: {e}", diagnose=True)
+        return True
+
+    def _admit_extend(self, seq: Sequence) -> bool:
+        """Admission with the prefix tree and/or chunked prefill armed:
+        attach to the longest cached full-block prefix copy-on-write,
+        allocate blocks for the first prefill span (the whole suffix, or
+        one chunk under the chunked budget), and either prefill inline
+        (one-shot path) or leave the sequence in the chunk pipeline."""
+        prompt = seq.request.prompt_ids
+        chain: List[Any] = []
+        shared_ids: List[int] = []
+        if self.prefix is not None and not seq.prefix_nodes:
+            chain = self.prefix.match(prompt)
+            if chain:
+                shared_ids = self.prefix.attach(seq.rid, chain, self._alloc)
+                chain = chain[:len(shared_ids)]
+        cached = len(shared_ids) * self.block_size
+        span = seq.prompt_len - cached
+        if self.chunk_tokens:
+            span = min(span, self.chunk_tokens)
+        n_new = _ceil_div(cached + span, self.block_size) - len(shared_ids)
+        ids = self._alloc(n_new)
+        if ids is None:
+            if chain:
+                self.prefix.release(chain)      # clean retry next round
+            if not self.sched.running and \
+                    self.cache.allocator.n_used == len(
+                        self.prefix.device_block_ids()
+                        if self.prefix is not None else ()):
+                self._cancel(
+                    seq, Status.FAILED,
+                    f"needs {n_new} KV block(s) beyond the shared prefix, "
+                    f"pool has only {self.cache.allocator.n_free}",
+                    diagnose=True)
+                return True
+            return False
+        self.sched.admit(seq)
+        if self.prefix is not None:
+            self.prefix.account(seq.prompt_len, cached)
+        if not self.chunk_tokens and cached == 0:
+            # cold full prompt, no chunk budget: the one-shot flash
+            # prefill path (it inserts the finished blocks into the tree)
+            try:
+                self._prefill(seq, ids)
+            except Exception as e:
+                if not seq.block_ids:
+                    seq.block_ids = list(ids)
+                self._cancel(seq, Status.FAILED,
+                             f"{type(e).__name__}: {e}", diagnose=True)
+            return True
+        seq.add_phase("queue", time.perf_counter() - seq.t_enqueue)
+        seq.prefix_nodes = list(chain)
+        seq.n_shared_blocks = len(shared_ids)
+        seq.block_ids = shared_ids + ids
+        seq.block_log.extend(shared_ids + ids)
+        seq.ctx_len = cached
+        seq.prefill_pos = cached
+        if self.chunk_tokens:
+            return True             # the chunk pipeline takes it from here
+        try:
+            self._chunk_prefill(seq, span)
+        except Exception as e:
             self._cancel(seq, Status.FAILED,
                          f"{type(e).__name__}: {e}", diagnose=True)
         return True
@@ -553,6 +997,7 @@ class ServingEngine:
         args = (jnp.asarray(ids, jnp.int32), self.cache.k, self.cache.v,
                 jnp.asarray(btab), jnp.asarray(seq.prompt_len, jnp.int32))
         self._maybe_lint()
+        self._assert_cow(block_ids)
         self._sent_prefill.observe_tree(
             "serving.prefill", (args[0], args[3], args[4]),
             donate=(1, 2), where="serving.prefill")
@@ -562,23 +1007,152 @@ class ServingEngine:
         seq.block_ids = list(block_ids)
         seq.block_log.extend(block_ids)
         seq.ctx_len = seq.prompt_len
+        seq.prefill_pos = seq.prompt_len
         seq.out_tokens.append(tok)
         seq.t_first_token = time.perf_counter()
         dur = seq.t_first_token - now
         seq.add_phase("prefill", dur)
         metrics.histogram("serving.prefill_ms",
                           "prefill step wall time (ms)").observe(dur * 1e3)
+        self._mirror_draft_prefill(seq)
+        if self.prefix is not None:
+            new_nodes = self.prefix.insert(
+                seq.request.prompt_ids, seq.block_ids, seq.prompt_len,
+                have=len(seq.prefix_nodes))
+            seq.prefix_nodes += new_nodes
+            seq.n_shared_blocks = len(seq.prefix_nodes)
         if seq.is_finished_by(tok):
             self._finish(seq)
+
+    def _chunk_prefill(self, seq: Sequence, span: int) -> None:
+        """Prefill ``span`` prompt tokens through the ``extend``
+        executable starting at ``seq.prefill_pos`` (a block boundary):
+        the prefix-hit suffix path and the chunked-prefill path. The
+        final span commits the first generated token; every completed
+        full block is inserted into the prefix tree as it fills."""
+        now = time.perf_counter()
+        start = seq.prefill_pos
+        L = self.prefill_buckets.fit(span)
+        toks = pad_axis(
+            seq.request.prompt_ids[None, start:start + span], 1, L)
+        table = np.full((1, self.max_blocks_per_seq), NULL_BLOCK, np.int32)
+        table[0, :len(seq.block_ids)] = seq.block_ids
+        args = (jnp.asarray(toks, jnp.int32), self.cache.k, self.cache.v,
+                jnp.asarray(table), jnp.asarray([start], jnp.int32),
+                jnp.asarray([span], jnp.int32))
+        self._maybe_lint()
+        self._assert_cow(self._write_span_ids(seq, start, span))
+        self._sent_chunk.observe_tree(
+            "serving.extend", (args[0], args[3], args[4], args[5]),
+            donate=(1, 2), where="serving.extend")
+        out, k2, v2 = self._chunk_fn(*args)
+        out = np.asarray(out)   # host sync: honest chunk timing
+        self.cache.swap(k2, v2)
+        if self._draft_extend_fn is not None:
+            dargs = (args[0], self._draft_cache.k, self._draft_cache.v,
+                     args[3], args[4], args[5])
+            _, dk, dv = self._draft_extend_fn(*dargs)
+            self._draft_cache.swap(dk, dv)
+            seq.draft_ctx = start + span
+        seq.prefill_pos = start + span
+        seq.ctx_len = seq.prefill_pos
+        if self.prefix is not None:
+            new_nodes = self.prefix.insert(
+                seq.request.prompt_ids, seq.block_ids, seq.prefill_pos,
+                have=len(seq.prefix_nodes))
+            seq.prefix_nodes += new_nodes
+            seq.n_shared_blocks = len(seq.prefix_nodes)
+        dur = time.perf_counter() - now
+        seq.add_phase("chunk_prefill", dur)
+        if self.chunk_tokens:
+            metrics.counter(
+                "serving.chunked_prefill_iterations",
+                "prefill chunks interleaved with decode").inc()
+        metrics.histogram("serving.prefill_ms",
+                          "prefill step wall time (ms)").observe(dur * 1e3)
+        if seq.prefill_pos >= seq.prompt_len:
+            tok = int(out[0])       # last_only: [B] of last-real argmax
+            seq.out_tokens.append(tok)
+            seq.t_first_token = time.perf_counter()
+            if seq.is_finished_by(tok):
+                self._finish(seq)
+
+    def _mirror_draft_prefill(self, seq: Sequence) -> None:
+        """ModelDrafter: materialize the drafter's prompt KV in the
+        mirrored pool (same block table) after a one-shot target
+        prefill."""
+        if self._draft_extend_fn is None or not seq.block_ids:
+            return
+        p = seq.prompt_len
+        L = self.prefill_buckets.fit(p)
+        toks = pad_axis(seq.request.prompt_ids[None, :], 1, L)
+        table = np.full((1, self.max_blocks_per_seq), NULL_BLOCK, np.int32)
+        table[0, :len(seq.block_ids)] = seq.block_ids
+        _, dk, dv = self._draft_extend_fn(
+            jnp.asarray(toks, jnp.int32), self._draft_cache.k,
+            self._draft_cache.v, jnp.asarray(table),
+            jnp.asarray([0], jnp.int32), jnp.asarray([p], jnp.int32))
+        self._draft_cache.swap(dk, dv)
+        seq.draft_ctx = p
+
+    def _chunk_iteration(self) -> None:
+        """The chunked-prefill scheduler slot: at most ``chunk_tokens``
+        prompt tokens prefill per engine iteration (the oldest
+        mid-prefill resident goes first), interleaved with the decode
+        work — a long prompt costs every resident a bounded slice per
+        token instead of one unbounded stall."""
+        if not self.chunk_tokens:
+            return
+        for seq in list(self.sched.running):
+            if seq.status is not Status.RUNNING or \
+                    seq.prefill_pos >= seq.prompt_len:
+                continue
+            span = min(self.chunk_tokens, seq.prompt_len - seq.prefill_pos)
+            needed = _ceil_div(seq.prefill_pos + span, self.block_size)
+            ok = True
+            while len(seq.block_ids) < needed:
+                got = self._alloc(1)
+                if got is not None:
+                    seq.block_ids.extend(got)
+                    seq.block_log.extend(got)
+                    continue
+                victim = self.sched.preempt_victim(exclude=seq,
+                                                   cost=self._cost_fn())
+                if victim is None:
+                    self._cancel(
+                        seq, Status.FAILED,
+                        f"needs block {len(seq.block_ids) + 1} of "
+                        f"{needed} mid-prefill and there is nothing "
+                        "left to preempt — the request outgrew the pool",
+                        diagnose=True)
+                    ok = False
+                    break
+                try:
+                    self._preempt(victim)
+                except SpillError as e:
+                    self._cancel(victim, Status.FAILED,
+                                 f"KV spill failed: {e}", diagnose=True)
+            if ok:
+                try:
+                    self._chunk_prefill(seq, span)
+                except Exception as e:
+                    self._cancel(seq, Status.FAILED,
+                                 f"{type(e).__name__}: {e}", diagnose=True)
+            break                     # one chunk per iteration: the budget
 
     def _restore(self, seq: Sequence, ids: List[int]) -> None:
         now = time.perf_counter()
         seq.add_phase("queue", now - seq.t_enqueue)
         self.cache.restore(seq.host_kv, ids)
+        if self._draft_cache is not None and seq.host_draft_kv is not None:
+            self._draft_cache.restore(seq.host_draft_kv, ids)
+            seq.host_draft_kv = None
         seq.host_kv = None
         self._account_spill(-seq.spilled_bytes)
         seq.spilled_bytes = 0
-        seq.block_ids = list(ids)
+        # the shared prefix never left the device — rebuild the table as
+        # (pinned shared ids) + (freshly restored private ids)
+        seq.block_ids = seq.block_ids[:seq.n_shared_blocks] + list(ids)
         seq.block_log.append(-1)  # spill/restore boundary
         seq.block_log.extend(ids)
         # KV re-materialization substitutes for prefill on resume
@@ -586,10 +1160,20 @@ class ServingEngine:
 
     def _preempt(self, seq: Sequence) -> None:
         self.sched.preempt(seq)
-        n_blocks = len(seq.block_ids)
-        seq.host_kv = self.cache.spill(seq.block_ids)
-        seq.block_ids = []
-        seq.spilled_bytes = n_blocks * self.cache.bytes_per_block
+        shared = seq.n_shared_blocks
+        private = seq.block_ids[shared:]
+        # refcount-aware spill: the shared prefix pages stay pinned on
+        # device (this sequence keeps its refs; other sharers and the
+        # tree hold them anyway) — only the refcount-1 private tail
+        # moves, and it moves exactly once
+        if self._draft_cache is not None and private:
+            seq.host_draft_kv = self._draft_cache.snapshot(private)
+        seq.host_kv = self.cache.spill(private)
+        seq.block_ids = seq.block_ids[:shared]
+        draft_bytes = (self._draft_cache.bytes_per_block * len(private)
+                       if self._draft_cache is not None else 0)
+        seq.spilled_bytes = (len(private) * self.cache.bytes_per_block
+                             + draft_bytes)
         self._account_spill(seq.spilled_bytes)
         # queue time for the preempted span restarts now; t_submit stays
         # the TRUE arrival so latency + deadlines measure end to end
@@ -599,23 +1183,32 @@ class ServingEngine:
 
     # -- the decode iteration ------------------------------------------------
 
+    def _decodable(self) -> List[Sequence]:
+        """Resident sequences with a committed frontier token (a
+        mid-prefill chunked sequence is resident but not yet
+        decodable)."""
+        return [s for s in self.sched.iteration_batch() if s.out_tokens]
+
     def _ensure_decode_blocks(self) -> None:
-        """Every running sequence needs a real block for position
-        ctx_len before the next iteration; preempt (lowest-priority,
-        youngest first) to make room. Pool exhaustion with nothing left
-        to preempt fails *that* sequence (F003) — :class:`OutOfBlocksError`
-        never crosses the engine loop."""
+        """Every decodable sequence needs real blocks through position
+        ctx_len (+ gamma under speculation) before the next iteration;
+        preempt (lowest-priority, most-private-blocks, youngest) to make
+        room. Pool exhaustion with nothing left to preempt fails *that*
+        sequence (F003) — :class:`OutOfBlocksError` never crosses the
+        engine loop."""
+        lookahead = self.spec_gamma if self.spec_gamma else 0
         for seq in list(self.sched.running):
-            if seq.status is not Status.RUNNING:
+            if seq.status is not Status.RUNNING or not seq.out_tokens:
                 continue
-            needed = seq.ctx_len // self.block_size + 1
+            needed = (seq.ctx_len + lookahead) // self.block_size + 1
             while len(seq.block_ids) < needed:
-                got = self.cache.allocator.alloc(1)
+                got = self._alloc(1)
                 if got is not None:
                     seq.block_ids.extend(got)
                     seq.block_log.extend(got)
                     continue
-                victim = self.sched.preempt_victim(exclude=seq)
+                victim = self.sched.preempt_victim(exclude=seq,
+                                                   cost=self._cost_fn())
                 if victim is None:
                     err = OutOfBlocksError(
                         f"sequence {seq.rid!r} needs block "
@@ -632,9 +1225,11 @@ class ServingEngine:
                                  f"KV spill failed: {e}", diagnose=True)
 
     def _decode_iteration(self) -> List[Sequence]:
-        batch = self.sched.iteration_batch()
+        batch = self._decodable()
         if not batch:
             return []
+        if self.spec_gamma:
+            return self._spec_iteration(batch)
         t0 = time.perf_counter()
         width = self.decode_buckets.fit(len(batch))
         m_blocks = self.max_blocks_per_seq
@@ -648,6 +1243,8 @@ class ServingEngine:
         args = (jnp.asarray(tokens), self.cache.k, self.cache.v,
                 jnp.asarray(tables), jnp.asarray(lens))
         self._maybe_lint()
+        for seq in batch:
+            self._assert_cow(self._write_span_ids(seq, seq.ctx_len, 1))
         self._sent_decode.observe_tree(
             "serving.decode", (args[0], args[3], args[4]),
             donate=(1, 2), where="serving.decode")
@@ -675,12 +1272,155 @@ class ServingEngine:
             self._finish(seq)
         return finished
 
+    # -- speculative decoding ------------------------------------------------
+
+    def _draft_proposals(self, batch: List[Sequence], width: int,
+                         tables: np.ndarray) -> List[List[int]]:
+        """Per-sequence proposals (each ≤ gamma tokens). The NGram
+        drafter is pure host work; the ModelDrafter runs sequential
+        decode dispatches over the mirrored pool — each feed writes the
+        fed token's KV at its position, catch-up feeds (committed tokens
+        whose drafter KV a rejection invalidated) first."""
+        gamma = self.spec_gamma
+        if not isinstance(self.drafter, ModelDrafter):
+            return [self.drafter.propose(
+                list(s.request.prompt_ids) + s.out_tokens, gamma)
+                for s in batch]
+        hists = [list(int(t) for t in s.request.prompt_ids) + s.out_tokens
+                 for s in batch]
+        feeds = [h[s.draft_ctx:] for h, s in zip(hists, batch)]
+        # feeds ends with the frontier token t0 (KV absent); catch-up
+        # length is len(feeds)-1; one proposal lands per feed from t0 on
+        steps = max(len(f) - 1 for f in feeds) + gamma
+        proposals: List[List[int]] = [[] for _ in batch]
+        cur = [list(f) for f in feeds]
+        pos0 = [s.draft_ctx for s in batch]
+        for t in range(steps):
+            toks = np.zeros((width,), np.int32)
+            ctxs = np.zeros((width,), np.int32)
+            for i, seq in enumerate(batch):
+                hi = min(t, len(cur[i]) - 1)
+                toks[i] = cur[i][hi] if t < len(cur[i]) else cur[i][-1]
+                ctxs[i] = min(pos0[i] + t, seq.ctx_len + gamma)
+            dargs = (jnp.asarray(toks), jnp.asarray(tables),
+                     jnp.asarray(ctxs))
+            if t == 0:
+                self._sent_draft.observe_tree(
+                    "serving.draft", dargs, donate=(1, 2),
+                    where="serving.draft")
+            out, dk, dv = self._draft_decode_fn(
+                dargs[0], self._draft_cache.k,
+                self._draft_cache.v, dargs[1], dargs[2])
+            self._draft_cache.swap(dk, dv)
+            out = np.asarray(out)
+            for i in range(len(batch)):
+                catchup = len(feeds[i]) - 1
+                if t >= catchup and len(proposals[i]) < gamma:
+                    proposals[i].append(int(out[i]))
+                    cur[i].append(int(out[i]))
+        return proposals
+
+    def _spec_iteration(self, batch: List[Sequence]) -> List[Sequence]:
+        """One speculative iteration: draft gamma proposals per resident
+        sequence, verify the whole batch in ONE decode-gamma ``extend``
+        dispatch, and commit each row's accepted prefix plus the
+        target's own token at the first mismatch (1..gamma+1 tokens) —
+        exactly the target's greedy stream, drafts or no drafts."""
+        gamma = self.spec_gamma
+        L = gamma + 1
+        width = self.decode_buckets.fit(len(batch))
+        m_blocks = self.max_blocks_per_seq
+        tables = np.full((width, m_blocks), NULL_BLOCK, np.int32)
+        for i, seq in enumerate(batch):
+            tables[i, :len(seq.block_ids)] = seq.block_ids
+        t0 = time.perf_counter()
+        proposals = self._draft_proposals(batch, width, tables)
+        t_draft = time.perf_counter() - t0
+        tokens = np.zeros((width, L), np.int32)
+        lens = np.zeros((width,), np.int32)
+        n_real = np.zeros((width,), np.int32)
+        for i, seq in enumerate(batch):
+            fed = [seq.out_tokens[-1]] + proposals[i]
+            tokens[i, :len(fed)] = fed
+            lens[i] = seq.ctx_len
+            n_real[i] = len(fed)
+        args = (jnp.asarray(tokens), self.cache.k, self.cache.v,
+                jnp.asarray(tables), jnp.asarray(lens),
+                jnp.asarray(n_real))
+        self._maybe_lint()
+        for i, seq in enumerate(batch):
+            self._assert_cow(self._write_span_ids(seq, seq.ctx_len,
+                                                  int(n_real[i])))
+        self._sent_verify.observe_tree(
+            "serving.verify", (args[0], args[3], args[4], args[5]),
+            donate=(1, 2), where="serving.verify")
+        out, k2, v2 = self._verify_fn(*args)
+        out = np.asarray(out)
+        self.cache.swap(k2, v2)
+        _fault_fire("serve.mid_decode")
+        dur = time.perf_counter() - t0
+        t_verify = dur - t_draft
+        self._decode_ms.append(dur * 1e3)
+        metrics.histogram("serving.decode_step_ms",
+                          "decode iteration wall time (ms)").observe(
+                              dur * 1e3)
+        self.spec_stats["iterations"] += 1
+        finished: List[Sequence] = []
+        for i, seq in enumerate(batch):
+            seq.add_phase("draft", t_draft)
+            seq.add_phase("verify", t_verify)
+            props = proposals[i]
+            o = out[i]
+            accepted = 0
+            while accepted < len(props) and \
+                    props[accepted] == int(o[accepted]):
+                accepted += 1
+            committed = [int(props[j]) for j in range(accepted)]
+            committed.append(int(o[accepted]))
+            self.spec_stats["proposed"] += len(props)
+            self.spec_stats["accepted"] += accepted
+            self._accept_lens.append(accepted)
+            metrics.histogram(
+                "serving.spec_accept_len",
+                "draft tokens accepted per speculative iteration"
+            ).observe(accepted)
+            ctx0 = seq.ctx_len
+            done = False
+            kept = 0
+            for tok in committed:
+                seq.out_tokens.append(tok)
+                seq.ctx_len += 1
+                kept += 1
+                if seq.is_finished_by(tok):
+                    done = True
+                    break
+            if isinstance(self.drafter, ModelDrafter):
+                # drafter KV is valid through the accepted prefix it
+                # fed (t0 + the accepted proposals it chained); the
+                # fallback token's KV is next round's catch-up feed
+                seq.draft_ctx = min(ctx0 + 1 + min(accepted, gamma - 1)
+                                    if gamma > 1 else ctx0 + 1,
+                                    seq.ctx_len)
+            if done:
+                finished.append(seq)
+        for seq in finished:
+            self._finish(seq)
+        return finished
+
+    def record_spec_tuning(self) -> Optional[int]:
+        """Persist the accepted-length-derived gamma for this target/
+        drafter pair into the kernel autotune cache (consumed by
+        ``FLAGS_serve_speculative=-1``). Returns the stored gamma."""
+        if not self.spec_gamma or not self._accept_lens:
+            return None
+        from .speculative import tune_gamma
+        return tune_gamma(self._spec_desc[0], self._spec_desc[1],
+                          self._accept_lens)
+
     def _finish(self, seq: Sequence) -> None:
         t0 = time.perf_counter()
         self.sched.finish(seq)
-        if seq.block_ids:
-            self.cache.allocator.free(seq.block_ids)
-            seq.block_ids = []
+        self._free_seq_blocks(seq)
         out = seq.full_output()
         seq.output = out
         # Acknowledge BEFORE detokenize/record: once the journal holds the
@@ -710,16 +1450,18 @@ class ServingEngine:
     def step(self) -> List[Sequence]:
         """One scheduler iteration: expire deadlines, consult the shed
         policy, admit whatever fits (prefill / restore at token
-        granularity), top up decode blocks (preempting under pressure),
-        run one decode iteration. Returns every sequence that reached a
-        terminal state this iteration — FINISHED, and also EXPIRED /
-        SHED / FAILED retirements."""
+        granularity), run one prefill chunk under the chunked budget,
+        top up decode blocks (preempting under pressure), run one decode
+        iteration. Returns every sequence that reached a terminal state
+        this iteration — FINISHED, and also EXPIRED / SHED / FAILED
+        retirements."""
         n0 = len(self.sched.finished)
         self._expire_deadlines()
         self._apply_shed_policy()
         self._enforce_degraded_width()
         while self._try_admit():
             pass
+        self._chunk_iteration()
         self._ensure_decode_blocks()
         self._decode_iteration()
         self._gauges()
@@ -767,14 +1509,74 @@ class ServingEngine:
         budget — the '≤ n_buckets compilations, O001 silent' check."""
         n_pre = len(self._sent_prefill._seen.get("serving.prefill", ()))
         n_dec = len(self._sent_decode._seen.get("serving.decode", ()))
+        n_ext = (len(self._sent_chunk._seen.get("serving.extend", ()))
+                 if self._sent_chunk is not None else 0)
+        n_ver = (len(self._sent_verify._seen.get("serving.verify", ()))
+                 if self._sent_verify is not None else 0)
+        ext_budget = (self._sent_chunk.threshold
+                      if self._sent_chunk is not None else 0)
+        ver_budget = (self._sent_verify.threshold
+                      if self._sent_verify is not None else 0)
         return {
             "prefill_signatures": n_pre,
             "decode_signatures": n_dec,
-            "budget": len(self.prefill_buckets) + len(self.decode_buckets),
+            "extend_signatures": n_ext,
+            "verify_signatures": n_ver,
+            "budget": (len(self.prefill_buckets) +
+                       len(self.decode_buckets) + ext_budget +
+                       ver_budget),
             "prefill_buckets": self.prefill_buckets.sizes,
             "decode_buckets": self.decode_buckets.sizes,
             "within_budget": (n_pre <= len(self.prefill_buckets) and
-                              n_dec <= len(self.decode_buckets)),
-            "o001_fired": bool(self._sent_prefill.diagnostics or
-                               self._sent_decode.diagnostics),
+                              n_dec <= len(self.decode_buckets) and
+                              n_ext <= ext_budget and
+                              n_ver <= ver_budget),
+            "o001_fired": bool(
+                self._sent_prefill.diagnostics or
+                self._sent_decode.diagnostics or
+                (self._sent_chunk is not None and
+                 self._sent_chunk.diagnostics) or
+                (self._sent_verify is not None and
+                 self._sent_verify.diagnostics) or
+                (self._sent_draft is not None and
+                 self._sent_draft.diagnostics)),
+        }
+
+    def prefix_report(self) -> Dict[str, Any]:
+        """Prefix-sharing effectiveness: hit rate, live tree size, and
+        the pool-pressure headline (peak blocks in use)."""
+        rep = {
+            "enabled": self.prefix is not None,
+            "peak_blocks_used": self.peak_blocks_used,
+            "peak_live_blocks": self.peak_live_blocks,
+            "blocks_shared_now": self.cache.allocator.n_shared,
+        }
+        if self.prefix is not None:
+            rep.update({
+                "hit_rate": round(self.prefix.hit_rate(), 4),
+                "hit_tokens": self.prefix.hit_tokens,
+                "lookup_tokens": self.prefix.lookup_tokens,
+                "tree_nodes": self.prefix.n_nodes,
+                "device_blocks_held": len(self.prefix.device_block_ids()),
+            })
+        return rep
+
+    def spec_report(self) -> Dict[str, Any]:
+        """Speculative-decoding effectiveness: acceptance and the mean
+        committed tokens per verify dispatch."""
+        it = self.spec_stats["iterations"]
+        prop = self.spec_stats["proposed"]
+        acc = self.spec_stats["accepted"]
+        rows = len(self._accept_lens)   # per-sequence verify samples
+        return {
+            "enabled": bool(self.spec_gamma),
+            "gamma": self.spec_gamma,
+            "drafter": getattr(self.drafter, "kind", None),
+            "iterations": it,
+            "proposed": prop,
+            "accepted": acc,
+            "accept_rate": round(acc / prop, 4) if prop else 0.0,
+            "mean_accept_len": round(acc / rows, 4) if rows else 0.0,
+            "tokens_per_verify": round((acc + rows) / rows, 4)
+            if rows else 0.0,
         }

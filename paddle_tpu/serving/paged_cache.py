@@ -68,6 +68,15 @@ class BlockAllocator:
     fixed request schedule and re-uses freed blocks immediately (hot
     pages stay hot). ``alloc`` is all-or-nothing: a partial grant would
     leave the caller holding blocks it cannot use.
+
+    Every allocated block carries a **refcount** (the prefix-sharing
+    substrate): ``alloc`` grants refcount 1, :meth:`ref` adds an owner
+    (a sequence attaching to a shared prefix page, or the radix tree's
+    own cache hold), and :meth:`free` drops one owner — the block
+    returns to the free list only when its last owner lets go. With no
+    sharing in play every refcount stays at 1 and alloc/free behave
+    exactly as the pre-refcount allocator (the flag-off bitwise
+    contract); over-freeing past zero is still a hard ``double-free``.
     """
 
     def __init__(self, num_blocks: int, reserved: Sequence[int] = (NULL_BLOCK,)):
@@ -78,6 +87,7 @@ class BlockAllocator:
         self._reserved = frozenset(int(r) for r in reserved)
         self._free = sorted(set(range(self.num_blocks)) - self._reserved)
         self._used: set = set()
+        self._refs: Dict[int, int] = {}
 
     @property
     def n_free(self) -> int:
@@ -87,6 +97,14 @@ class BlockAllocator:
     def n_used(self) -> int:
         return len(self._used)
 
+    @property
+    def n_shared(self) -> int:
+        """Blocks currently held by more than one owner."""
+        return sum(1 for r in self._refs.values() if r > 1)
+
+    def refcount(self, i: int) -> int:
+        return self._refs.get(int(i), 0)
+
     def alloc(self, n: int) -> Optional[List[int]]:
         """n lowest free block ids, or None when fewer than n are free."""
         if n < 0:
@@ -95,18 +113,43 @@ class BlockAllocator:
             return None
         got, self._free = self._free[:n], self._free[n:]
         self._used.update(got)
+        for i in got:
+            self._refs[i] = 1
         self._gauges()
         return got
 
+    def ref(self, ids: Sequence[int]) -> None:
+        """Add one owner to each allocated block (prefix-share attach)."""
+        ids = [int(i) for i in ids]
+        for i in ids:
+            if i not in self._used:
+                raise ValueError(f"ref of unallocated block {i}")
+        for i in ids:
+            self._refs[i] += 1
+        self._gauges()
+
     def free(self, ids: Sequence[int]) -> None:
+        """Drop one owner per block; last-owner blocks return to the
+        free list."""
         ids = [int(i) for i in ids]
         for i in ids:
             if i in self._reserved:
                 raise ValueError(f"freeing reserved block {i}")
             if i not in self._used:
                 raise ValueError(f"double-free of block {i}")
-            self._used.discard(i)
-        self._free = sorted(self._free + ids)
+            if ids.count(i) > self._refs[i]:
+                raise ValueError(
+                    f"double-free of block {i} (repeated past its "
+                    f"refcount in one free call)")
+        released = []
+        for i in ids:
+            self._refs[i] -= 1
+            if self._refs[i] == 0:
+                del self._refs[i]
+                self._used.discard(i)
+                released.append(i)
+        if released:
+            self._free = sorted(self._free + released)
         self._gauges()
 
     def _gauges(self) -> None:
@@ -114,6 +157,9 @@ class BlockAllocator:
                       "free KV blocks in the paged pool").set(self.n_free)
         metrics.gauge("serving.kv_blocks_used",
                       "allocated KV blocks in the paged pool").set(self.n_used)
+        metrics.gauge("serving.blocks_shared",
+                      "KV blocks held by more than one owner").set(
+                          self.n_shared)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -202,6 +248,24 @@ class PagedKVCache:
         self.allocator.free(list(block_ids))
         metrics.counter("serving.kv_spills",
                         "sequence KV spills to host memory").inc()
+        return (k_host, v_host)
+
+    def snapshot(self, block_ids: Sequence[int]) -> Tuple:
+        """Gather ``block_ids`` to the host tier WITHOUT freeing them —
+        the prefix tree's eviction spill (the tree drops its device hold
+        separately once the copy is committed) and the drafter pool's
+        mirror spill (whose blocks are never allocator-owned). Same
+        bitwise round-trip contract as :meth:`spill`."""
+        ids = jnp.asarray(list(block_ids), jnp.int32)
+        try:
+            k_host = self._to_host(_gather_blocks(self.k, ids))
+            v_host = self._to_host(_gather_blocks(self.v, ids))
+            if self.host_kind is not None:
+                jax.block_until_ready((k_host, v_host))
+        except (RuntimeError, MemoryError, ValueError) as e:
+            raise SpillError(
+                f"host snapshot of {len(block_ids)} block(s) failed: {e}"
+            ) from e
         return (k_host, v_host)
 
     def restore(self, host_kv: Tuple, block_ids: Sequence[int]) -> None:

@@ -34,12 +34,25 @@ declared StepPlan describes is unchanged, which is why ``lint_graph
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from dataclasses import dataclass
 from typing import Any, Dict, List, Optional, Sequence as Seq, Set
 
-__all__ = ["Rejected", "ShedPolicy", "RequestJournal"]
+__all__ = ["Rejected", "ShedPolicy", "RequestJournal", "prompt_hash"]
+
+
+def prompt_hash(prompt_ids) -> str:
+    """Content hash of a prompt's token stream (sha1 over the int32
+    bytes, truncated). Journaled with every submission so a relaunched
+    engine can (a) verify the replay trace still carries the tokens the
+    journal admitted and (b) group replayed requests by shared prefix —
+    identical-prompt-prefix requests submitted adjacently re-attach to
+    the radix tree's surviving pages instead of re-prefilling cold."""
+    import numpy as np
+    ids = np.asarray(prompt_ids, np.int32).reshape(-1)
+    return hashlib.sha1(ids.tobytes()).hexdigest()[:16]
 
 
 @dataclass(frozen=True)
@@ -158,6 +171,7 @@ class RequestJournal:
     def submitted(self, request) -> None:
         self.append("submitted", rid=request.rid,
                     prompt=[int(t) for t in request.prompt_ids],
+                    prompt_sha=prompt_hash(request.prompt_ids),
                     max_new_tokens=int(request.max_new_tokens),
                     eos_token_id=request.eos_token_id,
                     deadline_s=request.deadline_s,
@@ -202,6 +216,16 @@ class RequestJournal:
                     and e["rid"] not in seen):
                 seen.append(e["rid"])
         return seen
+
+    def prompt_hashes(self) -> Dict[str, str]:
+        """rid -> journaled prompt content hash (first submitted record
+        wins) — the replay-integrity and prefix-regrouping input."""
+        out: Dict[str, str] = {}
+        for e in self._events:
+            if e["event"] == "submitted" and "prompt_sha" in e \
+                    and e["rid"] not in out:
+                out[e["rid"]] = e["prompt_sha"]
+        return out
 
     def done_outputs(self) -> Dict[str, List[int]]:
         """rid -> output tokens of the FIRST done record (duplicates are

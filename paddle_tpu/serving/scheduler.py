@@ -97,6 +97,19 @@ class Sequence:
     host_kv: Any = None                  # spilled KV while PREEMPTED
     spilled_bytes: int = 0               # host bytes held while PREEMPTED
     preemptions: int = 0
+    # -- prefix sharing (FLAGS_serve_prefix_cache) ------------------------
+    # the first n_shared_blocks of block_ids are copy-on-write tree pages
+    # (one allocator ref held per attached sequence); prefix_nodes is the
+    # matching trie chain. Both stay empty on the private-KV path.
+    n_shared_blocks: int = 0
+    prefix_nodes: List[Any] = field(default_factory=list)
+    # -- chunked prefill (FLAGS_serve_chunked_prefill) --------------------
+    # prompt tokens whose KV is committed; the one-shot path jumps this
+    # straight to prompt_len inside _prefill.
+    prefill_pos: int = 0
+    # -- speculative decoding (FLAGS_serve_speculative) -------------------
+    host_draft_kv: Any = None            # drafter-pool mirror of host_kv
+    draft_ctx: int = 0                   # tokens with drafter KV written
     error: Optional[str] = None          # reason for a non-FINISHED ending
     # every block id ever assigned, in grant order (spill boundaries as
     # -1): the determinism regression's witness
@@ -189,33 +202,57 @@ class FCFSScheduler:
         seq.status = Status.RUNNING
         self.running.append(seq)
 
-    def preempt_victim(self, exclude: Optional[Sequence] = None
-                       ) -> Optional[Sequence]:
+    def preempt_victim(self, exclude: Optional[Sequence] = None,
+                       cost=None) -> Optional[Sequence]:
         """Lowest-priority running sequence other than ``exclude``,
         youngest (LIFO) within a priority class — with the default
-        priority 0 everywhere this is exactly the historical LIFO pick."""
+        priority 0 everywhere this is exactly the historical LIFO pick.
+
+        ``cost`` (optional, ``seq -> int``) is the prefix-sharing cost
+        model: the number of **private** (refcount-1) blocks a
+        preemption would actually free. When given, the pick within a
+        priority class is the sequence freeing the MOST private blocks
+        (tie-broken by the original LIFO order) — preempting a cheap
+        prefix-sharer relieves almost nothing while re-queueing its
+        work, so the expensive private-KV hog goes first. ``cost=None``
+        (the flag-off path) is bitwise-identical to the historical
+        behavior."""
         best: Optional[Sequence] = None
+        best_cost = -1
         for seq in reversed(self.running):      # youngest first
             if seq is exclude:
                 continue
             if best is None or seq.request.priority < best.request.priority:
                 best = seq
+                best_cost = cost(seq) if cost is not None else 0
+            elif (cost is not None
+                  and seq.request.priority == best.request.priority
+                  and cost(seq) > best_cost):
+                best = seq
+                best_cost = cost(seq)
         return best
 
-    def shed_candidate(self, waiting_only: bool = False
-                       ) -> Optional[Sequence]:
+    def shed_candidate(self, waiting_only: bool = False,
+                       cost=None) -> Optional[Sequence]:
         """The cheapest work to drop under overload: lowest priority,
         youngest within the class; waiting work first (no or least sunk
         device work), then — unless ``waiting_only`` (degrade mode keeps
-        residents and shrinks their bucket instead) — running."""
+        residents and shrinks their bucket instead) — running. With the
+        prefix-sharing ``cost`` model (private blocks held), the pick
+        within a priority class prefers the sequence whose drop frees
+        the most private blocks — shedding a prefix-sharer frees almost
+        nothing. ``cost=None`` keeps the historical order bitwise."""
         pools = [list(self.waiting)]
         if not waiting_only:
             pools.append(self.running)
         for pool in pools:
             if pool:
-                # max t_submit = youngest
+                if cost is None:
+                    # max t_submit = youngest
+                    return min(pool, key=lambda s: (s.request.priority,
+                                                    -s.t_submit))
                 return min(pool, key=lambda s: (s.request.priority,
-                                                -s.t_submit))
+                                                -cost(s), -s.t_submit))
         return None
 
     def preempt(self, seq: Sequence) -> None:

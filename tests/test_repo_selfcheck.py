@@ -160,7 +160,8 @@ def test_plan_rules_registered():
     meta-test both rely on it)."""
     from paddle_tpu.analysis import plan_check
     ids = {r.rule_id for r in plan_check.all_plan_rules()}
-    assert ids == {"S001", "S002", "S003", "D001", "D002", "D003", "D004"}
+    assert ids == {"S001", "S002", "S003", "D001", "D002", "D003", "D004",
+                   "D005"}
     assert all(r.doc for r in plan_check.all_plan_rules())
 
 

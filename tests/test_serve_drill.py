@@ -104,3 +104,37 @@ def test_drill_components_inprocess(tmp_path):
     assert req.rid == "a" and req.max_new_tokens == 4
     assert req.deadline_s == 1.5 and req.priority == 2
     np.testing.assert_array_equal(req.prompt_ids, [1, 2, 3])
+
+
+def test_prefix_cache_serve_drill_subprocess(tmp_path):
+    """ISSUE 13 satellite: the kill-and-replay drill with the radix
+    prefix cache armed and an 8-token shared prompt prefix — the
+    relaunch replays re-attach to pages the first replayed sharer
+    re-prefills (grouped by the journaled prompt hashes), and
+    exactly-once + token-exactness must hold unchanged."""
+    out = str(tmp_path / "report.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "serve_drill.py"),
+         "--quick", "--prefix-cache",
+         "--workdir", str(tmp_path / "drill"), "--out", out],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    with open(out) as f:
+        report = json.load(f)
+    assert report["ok"] is True and report["token_exact"] is True
+    assert report["config"]["prefix_cache"] == 1
+    assert report["config"]["shared_prefix"] == 8
+    once = report["exactly_once"]
+    assert once["exactly_once"] is True and once["lost"] == []
+    # every incarnation journaled prompt hashes for its submissions
+    sys.path.insert(0, REPO)
+    from paddle_tpu.serving.resilience import RequestJournal, prompt_hash
+    j = RequestJournal(str(tmp_path / "drill" / "journal.jsonl"))
+    shas = j.prompt_hashes()
+    assert len(shas) == report["config"]["requests"]
+    # hashes are content hashes: recompute from the trace and compare
+    with open(tmp_path / "drill" / "trace.jsonl") as f:
+        for line in f:
+            rec = json.loads(line)
+            assert shas[rec["rid"]] == prompt_hash(rec["prompt"])

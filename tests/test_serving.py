@@ -19,10 +19,12 @@ import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.observability import metrics, request_timeline
-from paddle_tpu.serving import (BlockAllocator, BucketSet, NULL_BLOCK,
-                                PagedKVCache, Rejected, Request,
-                                RequestJournal, ServingEngine, ShedPolicy,
-                                SpillError, Status, pow2_buckets)
+from paddle_tpu.serving import (BlockAllocator, BucketSet, ModelDrafter,
+                                NGramDrafter, NULL_BLOCK, PagedKVCache,
+                                PrefixCache, Rejected, Request,
+                                RequestJournal, Sequence, ServingEngine,
+                                ShedPolicy, SpillError, Status,
+                                pick_gamma, pow2_buckets, tune_gamma)
 from paddle_tpu.text.models.gpt import GPTForCausalLM, gpt_tiny
 
 
@@ -647,3 +649,487 @@ class TestServeBenchCLI:
         assert report["p99_ms"] >= report["p50_ms"]
         assert not report["compile_report"]["o001_fired"]
         assert len(timeline.read_text().splitlines()) == 3
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: refcounted allocator + radix prefix tree (satellite 3)
+# ---------------------------------------------------------------------------
+
+def assert_allocator_pristine_shared(engine):
+    """Prefix-cache extension of :func:`assert_allocator_pristine`: after
+    a drain, only the tree's cache holds may remain — evicting the whole
+    tree (drop path) must land the allocator back at a fresh free list
+    with zero refcount residue."""
+    alloc = engine.cache.allocator
+    held = (engine.prefix.device_block_ids()
+            if engine.prefix is not None else frozenset())
+    assert alloc.n_used == len(held), (alloc.n_used, sorted(held))
+    for i in held:
+        assert alloc.refcount(i) == 1       # tree cache ref only
+    if engine.prefix is not None:
+        engine.prefix.evict(alloc.num_blocks, spill=False)
+    assert_allocator_pristine(engine)
+
+
+class TestAllocatorRefcounts:
+    def test_ref_free_lifecycle(self):
+        a = BlockAllocator(8)
+        ids = a.alloc(2)
+        a.ref(ids)                           # second owner
+        assert a.n_shared == 2
+        a.free(ids)                          # first owner lets go
+        assert a.n_used == 2 and a.n_shared == 0
+        assert a.refcount(ids[0]) == 1
+        a.free(ids)                          # last owner: back to free
+        assert a.n_used == 0 and a.n_free == 7
+        with pytest.raises(ValueError, match="double-free"):
+            a.free([ids[0]])
+
+    def test_ref_of_unallocated_rejected(self):
+        a = BlockAllocator(4)
+        with pytest.raises(ValueError, match="unallocated"):
+            a.ref([2])
+
+    def test_flag_off_semantics_unchanged(self):
+        """refcount-1 alloc/free round trips are exactly the historical
+        allocator: min-id order, all-or-nothing, reserved guard."""
+        a = BlockAllocator(8)
+        assert a.alloc(3) == [1, 2, 3]
+        a.free([2])
+        assert a.alloc(2) == [2, 4]
+        with pytest.raises(ValueError, match="reserved"):
+            a.free([NULL_BLOCK])
+
+
+class TestPrefixTree:
+    def _cache(self, num_blocks=16):
+        return PagedKVCache(n_layers=2, num_blocks=num_blocks,
+                            block_size=4, kv_heads=2, head_dim=8)
+
+    def _fill(self, cache, ids, seed=0):
+        from paddle_tpu.serving.paged_cache import _scatter_blocks
+        rng = np.random.default_rng(seed)
+        k = rng.standard_normal(
+            (2, len(ids), 4, 2, 8)).astype(np.float32)
+        v = rng.standard_normal(
+            (2, len(ids), 4, 2, 8)).astype(np.float32)
+        cache.k = _scatter_blocks(cache.k, jnp.asarray(ids, jnp.int32),
+                                  jnp.asarray(k))
+        cache.v = _scatter_blocks(cache.v, jnp.asarray(ids, jnp.int32),
+                                  jnp.asarray(v))
+        return k, v
+
+    def test_match_caps_at_prompt_minus_one(self):
+        """The final prompt token is always recomputed (its logits are
+        the first generated token) — an exactly-block-aligned prompt
+        matches one block fewer than it inserted."""
+        cache = self._cache()
+        tree = PrefixCache(cache)
+        prompt = np.arange(8, dtype=np.int32)     # 2 exact blocks
+        ids = cache.allocator.alloc(2)
+        assert len(tree.insert(prompt, ids, 8)) == 2
+        assert len(tree.match(prompt)) == 1       # (8-1)//4 = 1
+        longer = np.arange(9, dtype=np.int32)
+        assert len(tree.match(longer)) == 2       # (9-1)//4 = 2
+
+    def test_shared_spill_restore_bitwise_both_sharers_alive(self):
+        """Satellite 3 acceptance: a shared block spilled by tree
+        eviction restores BITWISE while both sharing requests still
+        exist (preempted — refs released, re-attach pending)."""
+        cache = self._cache(num_blocks=8)
+        tree = PrefixCache(cache)
+        prompt = np.arange(9, dtype=np.int32)
+        ids = cache.allocator.alloc(2)
+        k0, v0 = self._fill(cache, ids)
+        inserted = tree.insert(prompt, ids, 8)
+        # two live sharers attach (so the pages are genuinely shared),
+        # then both get preempted: seq refs released, requests alive
+        chains = [tree.match(prompt) for _ in range(2)]
+        for c in chains:
+            got = tree.attach("s", c, cache.allocator.alloc)
+            assert got == ids
+        assert cache.allocator.n_shared == 2
+        tree.release(inserted)
+        for c in chains:
+            tree.release(c)
+        # evict under pressure: ONE host copy per node
+        assert tree.evict(2) == 2
+        assert cache.allocator.n_used == 0
+        # both sharers resume: first re-attach restores, second attaches
+        # to the restored block — no second host transfer
+        metrics.reset_all()
+        c1 = tree.match(prompt)
+        a1 = tree.attach("s1", c1, cache.allocator.alloc)
+        c2 = tree.match(prompt)
+        a2 = tree.attach("s2", c2, cache.allocator.alloc)
+        assert a1 == a2
+        # one restore per spilled node (the second sharer re-attaches to
+        # the already-restored pages — no second host transfer)
+        assert metrics.counter("serving.kv_restores").get() == 2
+        k_back, v_back = cache.read_blocks(a1)
+        np.testing.assert_array_equal(k_back, k0)
+        np.testing.assert_array_equal(v_back, v0)
+        tree.assert_consistent()
+
+    def test_never_rematched_eviction_drops_not_spills(self):
+        cache = self._cache(num_blocks=8)
+        tree = PrefixCache(cache)
+        ids = cache.allocator.alloc(2)
+        new = tree.insert(np.arange(9, dtype=np.int32), ids, 8)
+        tree.release(new)
+        assert tree.evict(2) == 2
+        assert tree.n_nodes == 0              # dropped: hits == 0
+        assert tree.match(np.arange(9, dtype=np.int32)) == []
+
+    def test_randomized_trie_workload_invariants(self):
+        """Randomized attach/insert/release/evict churn: the allocator
+        never leaks, never double-frees, reserved ids never drift, and
+        the tree's refcount bookkeeping stays consistent throughout."""
+        rng = np.random.default_rng(42)
+        cache = self._cache(num_blocks=24)
+        tree = PrefixCache(cache)
+        prompts = [rng.integers(0, 8, int(rng.integers(5, 17)))
+                   for _ in range(6)]
+        live = []                             # (chain, private_ids)
+        for step in range(200):
+            op = rng.integers(0, 3)
+            if op == 0 and len(live) < 8:     # admit a random prompt
+                p = prompts[int(rng.integers(0, len(prompts)))]
+                chain = tree.match(p)
+                got = tree.attach("s", chain, cache.allocator.alloc)
+                chain = chain[:len(got)]
+                n_total = -(-p.size // 4)
+                ids = cache.allocator.alloc(n_total - len(got))
+                if ids is None:
+                    if chain:
+                        tree.release(chain)
+                    cache.allocator.alloc(0)
+                    tree.evict(4)
+                    continue
+                new = tree.insert(p, got + ids, p.size,
+                                  have=len(chain))
+                live.append((chain + new, (got + ids)[len(chain) +
+                                                      len(new):]))
+            elif op == 1 and live:            # retire one
+                chain, priv = live.pop(int(rng.integers(0, len(live))))
+                if chain:
+                    tree.release(chain)
+                if priv:
+                    cache.allocator.free(priv)
+            else:                             # pressure: evict
+                tree.evict(int(rng.integers(1, 4)))
+            tree.assert_consistent()
+            # reserved never drifts, used+free partitions the pool
+            assert cache.allocator._reserved == frozenset({NULL_BLOCK})
+            assert (cache.allocator.n_used + cache.allocator.n_free
+                    == cache.allocator.num_blocks - 1)
+        for chain, priv in live:
+            if chain:
+                tree.release(chain)
+            if priv:
+                cache.allocator.free(priv)
+        tree.evict(cache.allocator.num_blocks, spill=False)
+        assert cache.allocator.n_used == 0
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 13: the three throughput tiers through the engine
+# ---------------------------------------------------------------------------
+
+def shared_prefix_requests(n, shared_len=12, suffix=4, max_new=6,
+                           vocab=128, seed=3):
+    rng = np.random.default_rng(seed)
+    sysp = rng.integers(0, vocab, shared_len)
+    return [Request(rid=f"s{i}",
+                    prompt_ids=np.concatenate(
+                        [sysp, rng.integers(0, vocab, suffix)]),
+                    max_new_tokens=max_new) for i in range(n)]
+
+
+class TestPrefixCacheEngine:
+    def test_shared_trace_token_exact_with_hits(self):
+        model = micro_model()
+        reqs = shared_prefix_requests(4)
+        engine = ServingEngine(model, block_size=4, num_blocks=64,
+                               max_batch=4, prefix_cache=True)
+        results = engine.serve(reqs)
+        for r in reqs:
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        rep = engine.prefix_report()
+        assert rep["hit_rate"] > 0.3          # sharers attached
+        assert rep["tree_nodes"] > 0
+        assert_allocator_pristine_shared(engine)
+
+    def test_outputs_equal_flag_off(self):
+        """The cache changes WHERE KV lives, never what comes out."""
+        model = micro_model()
+        reqs = ragged_requests(4, seed=6)
+        on = ServingEngine(model, block_size=4, num_blocks=32,
+                           max_batch=4, prefix_cache=True).serve(reqs)
+        off = ServingEngine(model, block_size=4, num_blocks=32,
+                            max_batch=4).serve(reqs)
+        for r in reqs:
+            np.testing.assert_array_equal(on[r.rid].output,
+                                          off[r.rid].output)
+
+    def test_token_exact_under_preemption_pressure(self):
+        """Acceptance criterion: prefix cache on + pool pressure — the
+        refcount-aware spill keeps shared pages pinned, spills only the
+        private tail, and every output still matches generate."""
+        model = micro_model(max_position_embeddings=32)
+        reqs = shared_prefix_requests(4, shared_len=12, suffix=4,
+                                      max_new=8)
+        metrics.reset_all()
+        engine = ServingEngine(model, block_size=4, num_blocks=14,
+                               max_batch=4, max_seq_len=32,
+                               prefix_cache=True)
+        results = engine.serve(reqs)
+        assert metrics.counter("serving.preemptions").get() > 0
+        for r in reqs:
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        assert_allocator_pristine_shared(engine)
+
+    def test_cow_runtime_assert_fires(self):
+        model = micro_model()
+        engine = ServingEngine(model, block_size=4, num_blocks=64,
+                               max_batch=2, prefix_cache=True)
+        engine.serve(shared_prefix_requests(2))
+        held = engine.prefix.device_block_ids()
+        assert held
+        with pytest.raises(AssertionError, match="COW write-isolation"):
+            engine._assert_cow([next(iter(held))])
+
+
+class TestCostAwarePreemption:
+    """Satellite 2: victim/shed cost accounting counts only private
+    (refcount-1) blocks."""
+
+    def _mk(self, rid, t_submit, priority=0, blocks=0, shared=0):
+        s = Sequence(Request(rid=rid, prompt_ids=np.ones(4, np.int32),
+                             max_new_tokens=2, priority=priority))
+        s.t_submit = t_submit
+        s.block_ids = list(range(10, 10 + blocks))
+        s.n_shared_blocks = shared
+        s.status = Status.RUNNING
+        return s
+
+    def test_victim_prefers_private_kv_hog(self):
+        from paddle_tpu.serving.scheduler import FCFSScheduler
+        sched = FCFSScheduler(4)
+        sharer = self._mk("sharer", 2.0, blocks=6, shared=5)  # 1 private
+        hog = self._mk("hog", 1.0, blocks=6, shared=0)        # 6 private
+        sched.running = [hog, sharer]
+        # historical LIFO picks the youngest (the cheap sharer)...
+        assert sched.preempt_victim() is sharer
+        # ...the cost model picks the hog whose spill actually frees KV
+        cost = lambda s: len(s.block_ids) - s.n_shared_blocks
+        assert sched.preempt_victim(cost=cost) is hog
+
+    def test_priority_still_dominates_cost(self):
+        from paddle_tpu.serving.scheduler import FCFSScheduler
+        sched = FCFSScheduler(4)
+        lo = self._mk("lo", 1.0, priority=0, blocks=1, shared=0)
+        hi = self._mk("hi", 2.0, priority=1, blocks=9, shared=0)
+        sched.running = [lo, hi]
+        cost = lambda s: len(s.block_ids) - s.n_shared_blocks
+        assert sched.preempt_victim(cost=cost) is lo
+
+    def test_shed_candidate_cost_order(self):
+        from paddle_tpu.serving.scheduler import FCFSScheduler
+        sched = FCFSScheduler(4)
+        a = self._mk("a", 1.0, blocks=2, shared=2)   # 0 private
+        b = self._mk("b", 2.0, blocks=4, shared=1)   # 3 private
+        sched.running = [a, b]
+        assert sched.shed_candidate() is b           # youngest (old rule)
+        cost = lambda s: len(s.block_ids) - s.n_shared_blocks
+        assert sched.shed_candidate(cost=cost) is b  # also most private
+        a.n_shared_blocks = 0                        # now a frees 2
+        b.n_shared_blocks = 4                        # b frees 0
+        assert sched.shed_candidate(cost=cost) is a
+
+
+class TestChunkedPrefill:
+    def test_token_exact(self):
+        model = micro_model()
+        reqs = ragged_requests(4, lo=9, hi=14, max_new=5, seed=8)
+        metrics.reset_all()
+        engine = ServingEngine(model, block_size=4, num_blocks=32,
+                               max_batch=4, chunked_prefill=8)
+        results = engine.serve(reqs)
+        for r in reqs:
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        assert metrics.counter(
+            "serving.chunked_prefill_iterations").get() > 0
+        recs = [s for s in results.values()
+                if "chunk_prefill" in s.phase_s]
+        assert recs, "chunk phase expected on the timeline"
+
+    def test_long_prompt_interleaves_with_decode(self):
+        """The point of the budget: a resident keeps committing tokens
+        WHILE the long prompt's chunks prefill."""
+        model = micro_model()
+        engine = ServingEngine(model, block_size=4, num_blocks=64,
+                               max_batch=4, chunked_prefill=4)
+        rng = np.random.default_rng(4)
+        resident = Request(rid="res", prompt_ids=rng.integers(0, 128, 5),
+                           max_new_tokens=20)
+        long_req = Request(rid="long",
+                           prompt_ids=rng.integers(0, 128, 24),
+                           max_new_tokens=2)
+        engine.submit(resident)
+        while not engine._seqs["res"].out_tokens:
+            engine.step()
+        engine.submit(long_req)
+        interleaved = False
+        n0 = engine._seqs["res"].n_generated
+        for _ in range(100):
+            engine.step()
+            seq = engine._seqs["long"]
+            if (0 < seq.prefill_pos < seq.prompt_len
+                    and engine._seqs["res"].n_generated > n0):
+                interleaved = True
+            if not engine.sched.n_pending:
+                break
+        assert interleaved, \
+            "resident decode must progress mid-prefill of the long prompt"
+        np.testing.assert_array_equal(
+            engine._seqs["res"].output, ref_generate(model, resident))
+        np.testing.assert_array_equal(
+            engine._seqs["long"].output, ref_generate(model, long_req))
+
+
+class TestSpeculative:
+    def test_ngram_propose(self):
+        d = NGramDrafter(repeat_fallback=False)
+        assert d.propose([1, 2, 3, 1, 2], 3) == [3, 1, 2]
+        assert d.propose([5, 6, 7], 2) == []          # no repeat
+        d2 = NGramDrafter()
+        assert d2.propose([5, 6, 7], 2) == [7, 7]     # fallback
+
+    def test_ngram_token_exact(self):
+        model = micro_model()
+        reqs = ragged_requests(4, max_new=8, seed=9)
+        engine = ServingEngine(model, block_size=4, num_blocks=32,
+                               max_batch=4, speculative=3)
+        results = engine.serve(reqs)
+        for r in reqs:
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        rep = engine.spec_report()
+        assert rep["iterations"] > 0
+        assert rep["gamma"] == 3
+        h = metrics.histogram("serving.spec_accept_len").labels()
+        assert h.get()["count"] > 0
+
+    def test_model_drafter_token_exact(self):
+        """A drafter LM over the mirrored paged pool: own page dims,
+        same block ids/tables, spills and restores with its sequence."""
+        model = micro_model(max_position_embeddings=32)
+        paddle.seed(11)
+        from paddle_tpu.text.models.gpt import gpt_tiny as _tiny
+        dm = GPTForCausalLM(_tiny(vocab_size=128, hidden_size=32,
+                                  num_layers=1, num_heads=2,
+                                  max_position_embeddings=32))
+        reqs = ragged_requests(4, lo=8, hi=14, max_new=8, seed=1)
+        metrics.reset_all()
+        engine = ServingEngine(model, block_size=4, num_blocks=10,
+                               max_batch=4, max_seq_len=32,
+                               speculative=2, drafter=ModelDrafter(dm))
+        results = engine.serve(reqs)     # pool pressure: spills too
+        assert metrics.counter("serving.preemptions").get() > 0
+        for r in reqs:
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        assert engine.cache.allocator.n_used == 0
+
+    def test_gamma_autotune_round_trip(self, tmp_path):
+        from paddle_tpu.core.flags import set_flags
+        from paddle_tpu.ops._pallas import autotune as at
+        set_flags({"kernel_autotune_cache_path":
+                   str(tmp_path / "tune.json")})
+        old = at._cache
+        at._cache = None
+        try:
+            assert pick_gamma("t", "d", default=5) == 5
+            assert tune_gamma("t", "d", [2, 3, 3, 4]) == 3  # ceil(mean 3)
+            assert pick_gamma("t", "d", default=5) == 3
+            from paddle_tpu.serving.speculative import store_gamma
+            store_gamma("t", "d", 6)
+            assert pick_gamma("t", "d") == 6
+        finally:
+            at._cache = old
+            set_flags({"kernel_autotune_cache_path": ""})
+
+    def test_all_three_tiers_composed(self):
+        model = micro_model(max_position_embeddings=32)
+        reqs = shared_prefix_requests(4, shared_len=8, suffix=6,
+                                      max_new=8, seed=2)
+        engine = ServingEngine(model, block_size=4, num_blocks=12,
+                               max_batch=4, max_seq_len=32,
+                               prefix_cache=True, chunked_prefill=8,
+                               speculative=2)
+        results = engine.serve(reqs)
+        for r in reqs:
+            np.testing.assert_array_equal(results[r.rid].output,
+                                          ref_generate(model, r))
+        rep = engine.compile_report()
+        assert rep["within_budget"] and not rep["o001_fired"], rep
+        assert_allocator_pristine_shared(engine)
+
+
+class TestCowPlanRule:
+    def test_d005_fires_on_shared_write(self):
+        from paddle_tpu.analysis import plan_check
+        from paddle_tpu.analysis.plan_check import PlanNode, StepPlan
+        plan = StepPlan(
+            flags={"cow_shared_buffers": "kv_pages_shared"},
+            nodes=[PlanNode("serve.verify",
+                            donates=("kv_pages_shared",),
+                            writes=("next_tokens",))])
+        assert "D005" in {d.rule for d in plan_check.check_plan(plan)}
+
+    def test_d005_silent_on_engine_plan(self):
+        from paddle_tpu.analysis import plan_check
+        engine = ServingEngine(micro_model(), block_size=4,
+                               num_blocks=32, max_batch=2,
+                               prefix_cache=True, chunked_prefill=8,
+                               speculative=2)
+        diags = plan_check.check_plan(engine.plan)
+        assert [d for d in diags if d.rule == "D005"] == []
+
+
+class TestJournalPromptHash:
+    def test_submitted_carries_content_hash(self, tmp_path):
+        from paddle_tpu.serving.resilience import prompt_hash
+        path = str(tmp_path / "j.jsonl")
+        j = RequestJournal(path)
+        req = Request(rid="a", prompt_ids=np.asarray([3, 1, 4], np.int32),
+                      max_new_tokens=2)
+        j.submitted(req)
+        j.close()
+        j2 = RequestJournal(path)
+        shas = j2.prompt_hashes()
+        assert shas == {"a": prompt_hash([3, 1, 4])}
+        assert shas["a"] != prompt_hash([3, 1, 5])
+
+    def test_worker_rejects_drifted_replay_trace(self, tmp_path):
+        """A relaunch whose trace no longer matches the journaled
+        prompt hashes must refuse to serve wrong tokens under old
+        rids."""
+        import json as _json
+        from paddle_tpu.serving import _drill_worker as worker
+        trace = [{"rid": "r0", "prompt": [1, 2, 3], "max_new_tokens": 2}]
+        with open(tmp_path / "trace.jsonl", "w") as f:
+            f.write(_json.dumps(trace[0]) + "\n")
+        j = RequestJournal(str(tmp_path / "journal.jsonl"))
+        j.submitted(Request(rid="r0",
+                            prompt_ids=np.asarray([9, 9, 9], np.int32),
+                            max_new_tokens=2))
+        j.close()
+        with pytest.raises(RuntimeError, match="journaled submission"):
+            worker.run(str(tmp_path), dict(
+                model_seed=7, vocab=128, hidden=32, layers=1, heads=2,
+                max_pos=32, block_size=4, num_blocks=8, max_batch=2))

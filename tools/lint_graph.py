@@ -218,22 +218,34 @@ def lint_fault():
 
 
 def lint_serving():
-    """The serving engine's two bucketed executables (paddle_tpu/serving/):
-    prefill (flash forward + paged KV scatter) and decode (paged gather +
-    single-query attention + in-program KV write) traced at their
-    smallest buckets through the jaxpr linter, plus the declared
-    dispatch plan (prefill/decode/spill/restore donation sequence)
-    verified by plan_check — the same S/D gate the training tiers get."""
+    """The serving engine's bucketed executables (paddle_tpu/serving/):
+    prefill (flash forward + paged KV scatter), decode (paged gather +
+    single-query attention + in-program KV write), and — with the three
+    ISSUE-13 throughput tiers armed — extend (chunked/suffix prefill),
+    verify (speculative decode-gamma), and the ModelDrafter's draft
+    step, each traced at its smallest buckets through the jaxpr linter;
+    plus the declared dispatch plan (prefill/chunk/draft/verify/decode/
+    spill/restore donation sequence with the COW-shared page discipline,
+    rule D005) verified by plan_check and the compiled decode + verify
+    modules through the X pass."""
     import paddle_tpu as paddle
     from paddle_tpu.analysis import lint_jaxpr, plan_check
-    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving import ModelDrafter, ServingEngine
     from paddle_tpu.text.models.gpt import GPTForCausalLM, gpt_tiny
 
     paddle.seed(0)
     cfg = gpt_tiny(vocab_size=128, hidden_size=48, num_layers=2,
                    num_heads=4, max_position_embeddings=64)
     model = GPTForCausalLM(cfg)
-    eng = ServingEngine(model, block_size=4, num_blocks=32, max_batch=4)
+    paddle.seed(1)
+    drafter = GPTForCausalLM(gpt_tiny(
+        vocab_size=128, hidden_size=32, num_layers=1, num_heads=2,
+        max_position_embeddings=64))
+    # all three tiers armed: the full plan (incl. D005's cow_shared
+    # declaration) and every executable family get verified
+    eng = ServingEngine(model, block_size=4, num_blocks=32, max_batch=4,
+                        prefix_cache=True, chunked_prefill=8,
+                        speculative=2, drafter=ModelDrafter(drafter))
     diags, n_eqns = [], 0
     traced = eng.trace_steps()
     for name, (closed, donate) in traced.items():
@@ -246,20 +258,23 @@ def lint_serving():
     pd = plan_check.check_plan(eng.plan, traced["decode"][0],
                                donate_argnums=traced["decode"][1],
                                where="serving")
-    print(f"  serving plan ({len(eng.plan.nodes)} nodes): "
+    print(f"  serving plan ({len(eng.plan.nodes)} nodes, cow_shared="
+          f"{eng.plan.flags.get('cow_shared_buffers')!r}): "
           f"{len(pd)} diagnostic(s)")
     diags += pd
-    # compiled-HLO pass (X-rules): the single-partition decode module
-    # must build with zero collectives and both page-pool donations
-    # realized as aliases
+    # compiled-HLO pass (X-rules): the single-partition decode and
+    # verify modules must build with zero collectives and both
+    # page-pool donations realized as aliases
     from paddle_tpu.analysis import hlo_check
-    compiled, donated = eng.compile_decode()
-    facts = hlo_check.collect_hlo_facts(compiled)
-    xd = hlo_check.check_hlo(eng.plan, facts, donated_leaves=donated,
-                             where="serving.decode.hlo")
-    print(f"  serving.decode compiled HLO: {facts.to_json()}, "
-          f"{len(xd)} diagnostic(s)")
-    diags += xd
+    for label, (compiled, donated) in (
+            ("decode", eng.compile_decode()),
+            ("verify", eng.compile_extend(verify=True))):
+        facts = hlo_check.collect_hlo_facts(compiled)
+        xd = hlo_check.check_hlo(eng.plan, facts, donated_leaves=donated,
+                                 where=f"serving.{label}.hlo")
+        print(f"  serving.{label} compiled HLO: {facts.to_json()}, "
+              f"{len(xd)} diagnostic(s)")
+        diags += xd
     return diags, n_eqns
 
 

@@ -6,6 +6,10 @@ tokens/s + tail latency.
     python tools/serve_bench.py --requests 16 --max-batch 8 --json
     python tools/serve_bench.py --trace trace.jsonl --arrivals
     python tools/serve_bench.py --sequential          # max_batch=1 baseline
+    # shared-system-prompt workload x 8 users, radix tree armed:
+    python tools/serve_bench.py --prefix-trace 8 --share-ratio 0.8 \
+        --prompt-len 64 --prefix-cache
+    python tools/serve_bench.py --chunked-prefill 32 --speculative 4
 
 Trace file: one JSON object per line —
     {"rid": "r0", "prompt": [1, 5, 9], "max_new_tokens": 8,
@@ -55,6 +59,25 @@ def synth_trace(n, seed, vocab, lo, hi, max_new):
     return out
 
 
+def prefix_trace(n_users, seed, vocab, share_ratio, prompt_len, max_new):
+    """The production-shaped workload: one shared system prompt of
+    ``share_ratio * prompt_len`` tokens, ``n_users`` requests that each
+    append a private suffix — the trace every prefix-hit-rate x
+    tokens/s curve in BENCH_SERVE replays. ``share_ratio=0`` degrades
+    to fully private prompts of the same length."""
+    rng = np.random.default_rng(seed)
+    shared_len = int(round(share_ratio * prompt_len))
+    shared = rng.integers(0, vocab, shared_len).tolist()
+    out = []
+    for i in range(n_users):
+        suffix = rng.integers(0, vocab,
+                              max(1, prompt_len - shared_len)).tolist()
+        out.append({"rid": f"u{i}", "prompt": shared + suffix,
+                    "max_new_tokens": int(max_new),
+                    "arrival_s": round(i * 0.01, 4)})
+    return out
+
+
 def load_trace(path, seed, vocab):
     rng = np.random.default_rng(seed)
     out = []
@@ -91,12 +114,29 @@ def main(argv=None):
                         "(per-record deadline_s fields win)")
     p.add_argument("--fail-on-slo", type=float, default=None, metavar="PCT",
                    help="exit nonzero when SLO attainment < PCT")
+    # synthetic prefix-sharing workload (ISSUE 13)
+    p.add_argument("--prefix-trace", type=int, default=None, metavar="N",
+                   help="generate a shared-system-prompt trace for N "
+                        "users instead of the ragged trace (see "
+                        "--share-ratio / --prompt-len)")
+    p.add_argument("--share-ratio", type=float, default=0.8,
+                   help="fraction of each --prefix-trace prompt that is "
+                        "the common system prefix")
+    p.add_argument("--prompt-len", type=int, default=64,
+                   help="total prompt length per --prefix-trace user")
     # engine knobs
     p.add_argument("--block-size", type=int, default=4)
     p.add_argument("--num-blocks", type=int, default=64)
     p.add_argument("--max-batch", type=int, default=8)
     p.add_argument("--max-waiting", type=int, default=None,
                    help="bounded admission: reject past this queue depth")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="arm the radix prefix-sharing KV cache")
+    p.add_argument("--chunked-prefill", type=int, default=0, metavar="T",
+                   help="chunked-prefill token budget (0 = one-shot)")
+    p.add_argument("--speculative", type=int, default=0, metavar="G",
+                   help="speculative draft depth gamma (0 = off, "
+                        "-1 = autotuned)")
     # model knobs (tiny CPU-mesh GPT by default)
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--hidden", type=int, default=64)
@@ -116,9 +156,15 @@ def main(argv=None):
 
     say = (lambda *a: print(*a, file=sys.stderr)) if args.json else print
 
-    trace = load_trace(args.trace, args.seed, args.vocab) if args.trace \
-        else synth_trace(args.requests, args.seed, args.vocab,
-                         args.prompt_lo, args.prompt_hi, args.max_new)
+    if args.trace:
+        trace = load_trace(args.trace, args.seed, args.vocab)
+    elif args.prefix_trace:
+        trace = prefix_trace(args.prefix_trace, args.seed, args.vocab,
+                             args.share_ratio, args.prompt_len,
+                             args.max_new)
+    else:
+        trace = synth_trace(args.requests, args.seed, args.vocab,
+                            args.prompt_lo, args.prompt_hi, args.max_new)
     default_deadline = (args.deadline_ms / 1e3
                         if args.deadline_ms is not None else None)
     requests = [Request(rid=r["rid"],
@@ -140,11 +186,18 @@ def main(argv=None):
     eng = ServingEngine(model, block_size=args.block_size,
                         num_blocks=args.num_blocks,
                         max_batch=1 if args.sequential else args.max_batch,
-                        max_waiting=args.max_waiting)
+                        max_waiting=args.max_waiting,
+                        prefix_cache=args.prefix_cache,
+                        chunked_prefill=args.chunked_prefill,
+                        speculative=args.speculative)
+    tiers = [t for t, on in (("prefix", args.prefix_cache),
+                             ("chunked", args.chunked_prefill),
+                             ("spec", args.speculative)) if on]
     say(f"replaying {len(requests)} request(s) through "
         f"{'sequential' if args.sequential else 'continuous-batching'} "
         f"engine (blocks {args.num_blocks}x{args.block_size}, "
-        f"max_batch {eng.sched.max_batch})")
+        f"max_batch {eng.sched.max_batch}"
+        f"{', tiers: ' + '+'.join(tiers) if tiers else ''})")
     t0 = time.perf_counter()
     eng.serve(requests, respect_arrivals=args.arrivals)
     wall_s = time.perf_counter() - t0
@@ -169,6 +222,10 @@ def main(argv=None):
         "compile_report": eng.compile_report(),
         "mode": "sequential" if args.sequential else "continuous",
     }
+    if args.prefix_cache:
+        report["prefix_report"] = eng.prefix_report()
+    if args.speculative:
+        report["spec_report"] = eng.spec_report()
     if args.timeline:
         n = rt.export_jsonl(args.timeline)
         say(f"wrote {n} request record(s) to {args.timeline}")
@@ -183,14 +240,27 @@ def main(argv=None):
         print(f"preemptions       {report['preemptions']} "
               f"(spills {report['kv_spills']})")
         cr = report["compile_report"]
+        ext = (f", extend {cr['extend_signatures']}"
+               if cr.get("extend_signatures") else "")
         print(f"compiles          prefill {cr['prefill_signatures']}/"
               f"{len(cr['prefill_buckets'])} buckets, decode "
               f"{cr['decode_signatures']}/{len(cr['decode_buckets'])} "
-              f"buckets, O001 fired: {cr['o001_fired']}")
+              f"buckets{ext}, O001 fired: {cr['o001_fired']}")
         if report["slo_attainment_pct"] is not None:
             print(f"slo attainment    {report['slo_attainment_pct']}% "
                   f"(shed rate {report['shed_rate']}, "
                   f"outcomes {report['outcomes']})")
+        if "prefix_report" in report:
+            pr = report["prefix_report"]
+            print(f"prefix cache      hit rate {pr['hit_rate']}, "
+                  f"{pr['tree_nodes']} tree nodes, peak blocks "
+                  f"{pr['peak_blocks_used']}")
+        if "spec_report" in report:
+            sr = report["spec_report"]
+            print(f"speculative       gamma {sr['gamma']} "
+                  f"({sr['drafter']}), accept rate "
+                  f"{sr['accept_rate']}, {sr['tokens_per_verify']} "
+                  f"tokens/verify")
     if report["compile_report"]["o001_fired"]:
         return 1
     if (args.fail_on_slo is not None

@@ -48,6 +48,11 @@ def main(argv=None):
                    help="decode iteration of the mid-decode SIGKILL")
     p.add_argument("--spill-kill", type=int, default=None,
                    help="spill ordinal of the mid-spill SIGKILL")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="arm FLAGS_serve_prefix_cache in the worker and "
+                        "give the trace an 8-token shared prefix — the "
+                        "relaunch replay must re-attach to surviving "
+                        "shared pages and stay token-exact")
     p.add_argument("--json", action="store_true",
                    help="machine-readable report on stdout")
     p.add_argument("--out", default=None, help="also write the report here")
@@ -62,6 +67,9 @@ def main(argv=None):
                      ("max_batch", args.max_batch)):
         if val is not None:
             over[key] = val
+    if args.prefix_cache:
+        over["prefix_cache"] = 1
+        over["shared_prefix"] = 8
     events = list(drill.quick_serve_config()["events"])
     if args.decode_kill is not None:
         events[0] = ("mid_decode", args.decode_kill)
