@@ -49,6 +49,9 @@ from .plan_check import (StepPlan, PlanNode, GatherPlan,  # noqa: F401
 from .hlo_check import (HloFacts, collect_hlo_facts, check_hlo,  # noqa: F401
                         all_hlo_rules)
 from ._hlo_utils import aot_compile, cost_dict  # noqa: F401
+from .concurrency_check import (all_thread_rules, make_lock,  # noqa: F401
+                                TrackedLock, check_runtime_order)
+from . import concurrency_check  # noqa: F401
 from . import comm_check  # noqa: F401
 from . import plan_check  # noqa: F401
 from . import hlo_check  # noqa: F401
@@ -72,4 +75,6 @@ __all__ = [
     "plan_check",
     "HloFacts", "collect_hlo_facts", "check_hlo", "all_hlo_rules",
     "aot_compile", "cost_dict", "hlo_check", "hlo_utils",
+    "all_thread_rules", "make_lock", "TrackedLock",
+    "check_runtime_order", "concurrency_check",
 ]

@@ -1,0 +1,1210 @@
+"""Host-side concurrency verifier: the T-rule family + a runtime lock arm.
+
+Every prior analyzer in this package verifies what the *device* runs —
+the traced jaxpr (J rules), the declared dispatch plan (S/D rules), the
+compiled HLO (X rules). But the production guarantees the host runtime
+provides (exactly-once RequestJournal acks, async checkpoint commit,
+hang-watchdog escalation, refcounted COW block sharing, fsync-before-
+effect journaling) are enforced by plain ``threading.Lock``/``Thread``/
+``Timer`` sites in host Python, where a missed lock is invisible to
+every graph-level pass. This module is the lockdep/TSan-style analyzer
+for that layer — pure ``ast`` like :mod:`.repo_lint`, no imports of the
+scanned modules, fast enough for tier-1.
+
+Static rules (``check_tree`` / ``lint_graph --threads``):
+
+  T001  unguarded-shared-mutation — an instance attribute written both
+        under a class's ``with self._lock:`` region and outside it, or
+        written from a ``threading.Thread``/``Timer`` target method
+        while read/written elsewhere without the lock        [error]
+  T002  lock-order-inversion — a cycle in the static lock acquisition
+        graph (nested ``with``-lock scopes, including one level of
+        intra-class call resolution), or a non-reentrant lock
+        re-acquired under itself                             [error]
+  T003  blocking-call-under-lock — fsync / ``block_until_ready`` /
+        subprocess / ``sleep`` / socket ops / thread ``join`` inside a
+        held-lock region                                     [warning]
+  T004  thread-lifecycle — a non-daemon thread never joined, a
+        ``Timer`` with no cancel path, or a thread handle published to
+        ``self`` only *after* ``start()`` (the canceller can race the
+        publish)                                             [warning]
+  T005  journal-protocol-violation — in a registered fsync-before-
+        effect protocol point (RequestJournal acks, Guardian decisions,
+        injection fired-events), a state-mutating effect statement
+        preceding the journaled fsync write                  [error]
+
+Suppress a finding on a specific line with ``# repo-lint: allow T001``
+(the shared :data:`~.repo_lint.ALLOW_MARK` convention).
+
+Runtime arm (``FLAGS_lockcheck``): :func:`make_lock` hands out
+:class:`TrackedLock` wrappers that record the real per-thread
+acquisition order into a process-global graph;
+:func:`check_runtime_order` unions those witnessed edges with the
+static acquisition graph and cycle-checks the result — the lockdep
+cross-check ``tools/race_drill.py`` runs under every drill schedule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, \
+    Set, Tuple
+
+from .jaxpr_lint import Diagnostic, ERROR, WARNING
+from .repo_lint import ALLOW_MARK, DEFAULT_SUBTREES
+
+__all__ = [
+    "check_source", "check_file", "check_tree", "all_thread_rules",
+    "acquisition_graph", "find_lock_cycles",
+    "TrackedLock", "make_lock", "runtime_edges", "reset_runtime",
+    "check_runtime_order", "JOURNAL_PROTOCOL_POINTS", "ProtocolPoint",
+]
+
+
+# ---------------------------------------------------------------------------
+# Rule registry (the RULES.md meta-test enumerates this)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _ThreadRule:
+    rule_id: str
+    name: str
+    severity: str
+    doc: str
+
+
+_THREAD_RULES = (
+    _ThreadRule("T001", "unguarded-shared-mutation", ERROR,
+                "attribute written both under a class lock and outside "
+                "it, or mutated from a Thread/Timer target without the "
+                "lock while accessed elsewhere"),
+    _ThreadRule("T002", "lock-order-inversion", ERROR,
+                "cycle in the static/runtime lock acquisition graph, or "
+                "a non-reentrant lock re-acquired under itself — a "
+                "potential deadlock"),
+    _ThreadRule("T003", "blocking-call-under-lock", WARNING,
+                "fsync/block_until_ready/subprocess/sleep/socket/join "
+                "inside a held-lock region serializes every other "
+                "holder behind a slow syscall"),
+    _ThreadRule("T004", "thread-lifecycle", WARNING,
+                "non-daemon thread never joined, Timer without a cancel "
+                "path, or a handle published after start()"),
+    _ThreadRule("T005", "journal-protocol-violation", ERROR,
+                "a state-mutating effect precedes the journaled fsync "
+                "write in a registered fsync-before-effect protocol "
+                "point"),
+)
+
+
+def all_thread_rules() -> Tuple[_ThreadRule, ...]:
+    return _THREAD_RULES
+
+
+# ---------------------------------------------------------------------------
+# T005 protocol registry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ProtocolPoint:
+    """One fsync-before-effect protocol function.
+
+    ``path`` is a relpath suffix, ``func`` the function name. ``journal``
+    are dotted-name suffixes of the journaled fsync write call;
+    ``effects`` are dotted-name suffixes of the externally visible
+    effects that must come after it (matched against both call names and
+    store targets)."""
+
+    path: str
+    func: str
+    journal: Tuple[str, ...]
+    effects: Tuple[str, ...]
+    doc: str = ""
+
+
+#: The repo's registered protocol points: RequestJournal acks (the
+#: response must never leave before its ack is durable), Guardian
+#: decisions (the recovery journal replays across relaunches), and the
+#: injector's fired-event journal (a relaunch must not replay a fault).
+JOURNAL_PROTOCOL_POINTS: Tuple[ProtocolPoint, ...] = (
+    ProtocolPoint("serving/engine.py", "submit",
+                  ("journal.submitted",), ("sched.submit",),
+                  "admission journaled before any scheduler/device work"),
+    ProtocolPoint("serving/engine.py", "_reject",
+                  ("journal.terminal",), ("request_timeline.current",),
+                  "rejection acked before the response record"),
+    ProtocolPoint("serving/engine.py", "_cancel",
+                  ("journal.terminal",), ("request_timeline.current",),
+                  "terminal outcome acked before the response record"),
+    ProtocolPoint("serving/engine.py", "_finish",
+                  ("journal.done",),
+                  ("self.detokenizer", "request_timeline.current"),
+                  "done tokens acked before detokenize/response record"),
+    ProtocolPoint("fault/guardian.py", "on_anomaly",
+                  ("self.record",),
+                  ("self._pending.clear", "self.recoveries"),
+                  "anomaly+decision journaled before recovery "
+                  "bookkeeping mutates"),
+    ProtocolPoint("fault/injection.py", "poll_event",
+                  ("self._mark_fired",), ("self._die",),
+                  "fired-event journaled before the SIGKILL"),
+    ProtocolPoint("fault/injection.py", "poll_step_begin",
+                  ("self._mark_fired",), ("os.kill",),
+                  "fired-event journaled before the SIGTERM"),
+    ProtocolPoint("fault/injection.py", "_on_ckpt_write",
+                  ("self._mark_fired",), ("self._die",),
+                  "fired-event journaled before the mid-write kill"),
+)
+
+
+# ---------------------------------------------------------------------------
+# AST fact collection
+# ---------------------------------------------------------------------------
+
+_THREAD_CTORS = ("Thread", "Timer")
+_REENTRANT_CTORS = ("RLock", "Condition")
+
+# Container verbs that mutate their receiver: ``self.x.append(...)`` is
+# a write to ``x`` for T001 purposes.
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "add", "update", "pop", "popleft",
+    "popitem", "clear", "remove", "discard", "insert", "setdefault",
+})
+
+# T003 blocklist: (match kind, pattern). "dotted" = full dotted name,
+# "attr" = last segment, "prefix" = dotted startswith.
+_BLOCKING = (
+    ("attr", "fsync"),
+    ("attr", "block_until_ready"),
+    ("prefix", "subprocess."),
+    ("dotted", "time.sleep"),
+    ("attr", "sleep"),
+    ("attr", "sendall"),
+    ("attr", "accept"),
+    ("prefix", "socket."),
+)
+
+
+def _dotted(node: ast.AST) -> str:
+    """'self.journal.terminal' for an Attribute/Name chain, '' otherwise
+    (calls in the chain break it — ``a().b`` is not a stable name)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    if isinstance(node, ast.Call):
+        inner = _dotted(node.func)
+        if inner and parts:
+            # keep enough shape for patterns like request_timeline.current
+            return inner + "()." + ".".join(reversed(parts))
+        return inner
+    return ""
+
+
+def _is_lock_ctor(call: ast.Call) -> Optional[str]:
+    """'plain' / 'reentrant' when ``call`` constructs a lock, else None.
+    Recognizes threading.Lock/RLock/Condition, the bare names, and any
+    factory whose name contains 'lock' (:func:`make_lock` and module-
+    local shims around it)."""
+    name = _dotted(call.func)
+    last = name.rsplit(".", 1)[-1]
+    if last in _REENTRANT_CTORS:
+        return "reentrant"
+    for kw in call.keywords:
+        if kw.arg == "reentrant" and isinstance(kw.value, ast.Constant) \
+                and kw.value.value:
+            return "reentrant"
+    if last == "Lock":
+        return "plain"
+    if "lock" in last.lower():
+        return "plain"
+    return None
+
+
+def _thread_ctor_kind(call: ast.Call) -> Optional[str]:
+    name = _dotted(call.func).rsplit(".", 1)[-1]
+    return name if name in _THREAD_CTORS else None
+
+
+def _callback_of(call: ast.Call, kind: str) -> Optional[ast.AST]:
+    """The target/function expression of a Thread/Timer constructor."""
+    for kw in call.keywords:
+        if kw.arg in ("target", "function"):
+            return kw.value
+    if kind == "Timer" and len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+@dataclass
+class _Access:
+    attr: str
+    lineno: int
+    held: FrozenSet[str]      # lock keys held at the access
+    method: str
+
+
+@dataclass
+class _CallSite:
+    dotted: str
+    lineno: int
+    held: FrozenSet[str]
+    method: str
+    n_posargs: int
+
+
+@dataclass
+class _Acquire:
+    lock: str                 # lock key
+    lineno: int
+    held_before: FrozenSet[str]
+    method: str
+
+
+@dataclass
+class _ThreadMake:
+    kind: str                 # Thread | Timer
+    lineno: int
+    method: str
+    target_attr: Optional[str]    # self.<m> target method name
+    daemon: Optional[bool]        # constructor kwarg, None when absent
+    bound_local: Optional[str]    # local var the handle is bound to
+    bound_attr: Optional[str]     # self attr the handle is bound to
+    started_inline: bool          # Thread(...).start() — never bindable
+
+
+@dataclass
+class _ClassFacts:
+    name: str
+    lineno: int
+    locks: Dict[str, str] = field(default_factory=dict)  # attr -> kind
+    writes: List[_Access] = field(default_factory=list)
+    reads: List[_Access] = field(default_factory=list)
+    calls: List[_CallSite] = field(default_factory=list)
+    acquires: List[_Acquire] = field(default_factory=list)
+    threads: List[_ThreadMake] = field(default_factory=list)
+    self_calls: Dict[str, Set[str]] = field(default_factory=dict)
+    methods: Set[str] = field(default_factory=set)
+    # method -> attr names on which .cancel()/.join()/.daemon= happen
+    cancels: Set[str] = field(default_factory=set)
+    joins: Set[str] = field(default_factory=set)
+    daemon_sets: Set[str] = field(default_factory=set)
+    # property names whose getter/setter bodies take a class lock —
+    # stores/loads through them are lock-guarded by construction
+    locked_props: Set[str] = field(default_factory=set)
+
+
+@dataclass
+class _ModuleFacts:
+    relpath: str
+    locks: Dict[str, str] = field(default_factory=dict)  # name -> kind
+    classes: List[_ClassFacts] = field(default_factory=list)
+    acquires: List[_Acquire] = field(default_factory=list)
+    calls: List[_CallSite] = field(default_factory=list)
+    threads: List[_ThreadMake] = field(default_factory=list)
+    funcs: List[ast.AST] = field(default_factory=list)
+
+
+class _FuncWalker:
+    """Walks one function body tracking the held-lock set through nested
+    ``with`` scopes, recording accesses/calls/acquisitions into the
+    surrounding class (or module) facts."""
+
+    def __init__(self, mod: _ModuleFacts, cls: Optional[_ClassFacts],
+                 method: str):
+        self.mod = mod
+        self.cls = cls
+        self.method = method
+
+    # -- lock expression -> key ---------------------------------------------
+
+    def _lock_key(self, expr: ast.AST) -> Optional[Tuple[str, str]]:
+        """(key, kind) for a lock expression, None when not a known lock.
+        Keys: ``Class.attr`` for self locks, ``module:name`` for
+        module-level locks."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls is not None:
+            kind = self.cls.locks.get(expr.attr)
+            if kind is not None:
+                return f"{self.cls.name}.{expr.attr}", kind
+        if isinstance(expr, ast.Name):
+            kind = self.mod.locks.get(expr.id)
+            if kind is not None:
+                mod = os.path.basename(self.mod.relpath)
+                return f"{mod}:{expr.id}", kind
+        return None
+
+    # -- traversal -----------------------------------------------------------
+
+    def walk(self, body: Sequence[ast.stmt],
+             held: FrozenSet[str] = frozenset()) -> None:
+        for stmt in body:
+            self._stmt(stmt, held)
+
+    def _stmt(self, stmt: ast.stmt, held: FrozenSet[str]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs run later, in an unknown lock context
+        if isinstance(stmt, ast.With):
+            inner = set(held)
+            for item in stmt.items:
+                got = self._lock_key(item.context_expr)
+                if got is not None:
+                    key, _kind = got
+                    self._record_acquire(key, item.context_expr.lineno,
+                                         frozenset(inner))
+                    inner.add(key)
+                else:
+                    self._expr(item.context_expr, held)
+            self.walk(stmt.body, frozenset(inner))
+            return
+        if isinstance(stmt, (ast.If, ast.While)):
+            self._expr(stmt.test, held)
+            self.walk(stmt.body, held)
+            self.walk(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.For):
+            self._expr(stmt.iter, held)
+            self._store_target(stmt.target, held)
+            self.walk(stmt.body, held)
+            self.walk(stmt.orelse, held)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body, held)
+            for h in stmt.handlers:
+                self.walk(h.body, held)
+            self.walk(stmt.orelse, held)
+            self.walk(stmt.finalbody, held)
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = stmt.targets if isinstance(stmt, ast.Assign) \
+                else [stmt.target]
+            value = stmt.value
+            if value is not None:
+                self._expr(value, held)
+                # AugAssign reads its target too
+                if isinstance(stmt, ast.AugAssign):
+                    self._load_target(stmt.target, held)
+            for t in targets:
+                self._store_target(t, held)
+            if isinstance(value, ast.Call):
+                self._maybe_thread_binding(targets, value, held)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr(stmt.value, held)
+            if isinstance(stmt.value, ast.Call):
+                self._maybe_inline_thread(stmt.value)
+            return
+        if isinstance(stmt, ast.Return) and stmt.value is not None:
+            self._expr(stmt.value, held)
+            return
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._expr(child, held)
+
+    # -- pieces --------------------------------------------------------------
+
+    def _record_acquire(self, key: str, lineno: int,
+                        held_before: FrozenSet[str]) -> None:
+        acq = _Acquire(key, lineno, held_before, self.method)
+        (self.cls.acquires if self.cls is not None
+         else self.mod.acquires).append(acq)
+
+    def _store_target(self, t: ast.expr, held: FrozenSet[str]) -> None:
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and t.value.id == "self" \
+                and self.cls is not None:
+            self.cls.writes.append(
+                _Access(t.attr, t.lineno, held, self.method))
+        elif isinstance(t, ast.Subscript):
+            # self.d[k] = v mutates self.d
+            base = t.value
+            if isinstance(base, ast.Attribute) and \
+                    isinstance(base.value, ast.Name) and \
+                    base.value.id == "self" and self.cls is not None:
+                self.cls.writes.append(
+                    _Access(base.attr, t.lineno, held, self.method))
+            self._expr(t.slice, held)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for el in t.elts:
+                self._store_target(el, held)
+
+    def _load_target(self, t: ast.expr, held: FrozenSet[str]) -> None:
+        if isinstance(t, ast.Attribute) and \
+                isinstance(t.value, ast.Name) and t.value.id == "self" \
+                and self.cls is not None:
+            self.cls.reads.append(
+                _Access(t.attr, t.lineno, held, self.method))
+
+    def _expr(self, e: ast.expr, held: FrozenSet[str]) -> None:
+        for node in ast.walk(e):
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load) and \
+                    isinstance(node.value, ast.Name) and \
+                    node.value.id == "self" and self.cls is not None:
+                self.cls.reads.append(
+                    _Access(node.attr, node.lineno, held, self.method))
+            elif isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                site = _CallSite(dotted, node.lineno, held, self.method,
+                                 len(node.args))
+                (self.cls.calls if self.cls is not None
+                 else self.mod.calls).append(site)
+                if self.cls is not None:
+                    if dotted.startswith("self.") and dotted.count(".") == 1:
+                        self.cls.self_calls.setdefault(
+                            self.method, set()).add(dotted[5:])
+                    # lifecycle verbs on self attrs / locals
+                    if isinstance(node.func, ast.Attribute):
+                        owner = node.func.value
+                        verb = node.func.attr
+                        name = None
+                        if isinstance(owner, ast.Attribute) and \
+                                isinstance(owner.value, ast.Name) and \
+                                owner.value.id == "self":
+                            name = owner.attr
+                        elif isinstance(owner, ast.Name):
+                            name = owner.id
+                        if name is not None:
+                            if verb == "cancel":
+                                self.cls.cancels.add(name)
+                            elif verb == "join":
+                                self.cls.joins.add(name)
+                        # self.x.append(...) mutates self.x
+                        if verb in _MUTATORS and \
+                                isinstance(owner, ast.Attribute) and \
+                                isinstance(owner.value, ast.Name) and \
+                                owner.value.id == "self":
+                            self.cls.writes.append(_Access(
+                                owner.attr, node.lineno, held,
+                                self.method))
+
+    def _maybe_thread_binding(self, targets: Sequence[ast.expr],
+                              call: ast.Call,
+                              held: FrozenSet[str]) -> None:
+        kind = _thread_ctor_kind(call)
+        if kind is None:
+            return
+        tm = self._thread_make(call, kind)
+        for t in targets:
+            if isinstance(t, ast.Name):
+                tm.bound_local = t.id
+            elif isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                tm.bound_attr = t.attr
+
+    def _maybe_inline_thread(self, call: ast.Call) -> None:
+        """``threading.Thread(...).start()`` — the handle is gone."""
+        if not isinstance(call.func, ast.Attribute) or \
+                call.func.attr != "start":
+            return
+        inner = call.func.value
+        if isinstance(inner, ast.Call):
+            kind = _thread_ctor_kind(inner)
+            if kind is not None:
+                tm = self._thread_make(inner, kind)
+                tm.started_inline = True
+
+    def _thread_make(self, call: ast.Call, kind: str) -> _ThreadMake:
+        target = _callback_of(call, kind)
+        target_attr = None
+        if isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            target_attr = target.attr
+        daemon = None
+        for kw in call.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        tm = _ThreadMake(kind, call.lineno, self.method, target_attr,
+                         daemon, None, None, False)
+        (self.cls.threads if self.cls is not None
+         else self.mod.threads).append(tm)
+        return tm
+
+
+def _collect(tree: ast.Module, relpath: str) -> _ModuleFacts:
+    mod = _ModuleFacts(relpath)
+    # module-level locks first (any nesting order)
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            kind = _is_lock_ctor(stmt.value)
+            if kind is not None:
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name):
+                        mod.locks[t.id] = kind
+    for stmt in tree.body:
+        if isinstance(stmt, ast.ClassDef):
+            cls = _ClassFacts(stmt.name, stmt.lineno)
+            mod.classes.append(cls)
+            methods = [n for n in stmt.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))]
+            cls.methods = {m.name for m in methods}
+            # two passes: lock attrs must be known before region tracking
+            for m in methods:
+                for node in ast.walk(m):
+                    if isinstance(node, ast.Assign) and \
+                            isinstance(node.value, ast.Call):
+                        kind = _is_lock_ctor(node.value)
+                        if kind is None:
+                            continue
+                        for t in node.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                cls.locks[t.attr] = kind
+                    if isinstance(node, ast.Assign):
+                        for t in node.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    t.attr == "daemon":
+                                owner = t.value
+                                if isinstance(owner, ast.Attribute) and \
+                                        isinstance(owner.value, ast.Name) \
+                                        and owner.value.id == "self":
+                                    cls.daemon_sets.add(owner.attr)
+                                elif isinstance(owner, ast.Name):
+                                    cls.daemon_sets.add(owner.id)
+            for m in methods:
+                deco = {d.attr if isinstance(d, ast.Attribute)
+                        else getattr(d, "id", None)
+                        for d in m.decorator_list}
+                if deco & {"property", "setter", "getter"}:
+                    for node in ast.walk(m):
+                        if isinstance(node, ast.With) and any(
+                                isinstance(i.context_expr, ast.Attribute)
+                                and isinstance(i.context_expr.value,
+                                               ast.Name)
+                                and i.context_expr.value.id == "self"
+                                and i.context_expr.attr in cls.locks
+                                for i in node.items):
+                            cls.locked_props.add(m.name)
+                            break
+            for m in methods:
+                _FuncWalker(mod, cls, m.name).walk(m.body)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            mod.funcs.append(stmt)
+            _FuncWalker(mod, None, stmt.name).walk(stmt.body)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# The rules
+# ---------------------------------------------------------------------------
+
+def _allowed(lines: List[str], lineno: int, rule: str) -> bool:
+    if 0 < lineno <= len(lines):
+        line = lines[lineno - 1]
+        if ALLOW_MARK in line and rule in line.split(ALLOW_MARK, 1)[1]:
+            return True
+    return False
+
+
+def _thread_context(cls: _ClassFacts) -> Set[str]:
+    """Methods that (may) run on a spawned thread: Thread/Timer targets
+    plus everything reachable from them through self-calls."""
+    ctx = {t.target_attr for t in cls.threads if t.target_attr}
+    changed = True
+    while changed:
+        changed = False
+        for m in list(ctx):
+            for callee in cls.self_calls.get(m, ()):
+                if callee in cls.methods and callee not in ctx:
+                    ctx.add(callee)
+                    changed = True
+    return ctx
+
+
+_CTOR_METHODS = ("__init__", "__new__", "__post_init__")
+
+
+def _t001(mod: _ModuleFacts, lines: List[str],
+          diags: List[Diagnostic]) -> None:
+    for cls in mod.classes:
+        lock_attrs = set(cls.locks)
+        tctx = _thread_context(cls)
+        by_attr_w: Dict[str, List[_Access]] = {}
+        by_attr_r: Dict[str, List[_Access]] = {}
+        for w in cls.writes:
+            by_attr_w.setdefault(w.attr, []).append(w)
+        for r in cls.reads:
+            by_attr_r.setdefault(r.attr, []).append(r)
+        for attr, writes in sorted(by_attr_w.items()):
+            if attr in lock_attrs or attr.startswith("__") or \
+                    attr in cls.locked_props:
+                continue
+            eff = [w for w in writes if w.method not in _CTOR_METHODS]
+            locked = [w for w in eff if w.held]
+            unlocked = [w for w in eff if not w.held]
+            reads = [r for r in by_attr_r.get(attr, ())
+                     if r.method not in _CTOR_METHODS]
+            # (a) mixed discipline: locked somewhere, unlocked elsewhere
+            if locked and unlocked and lock_attrs:
+                for w in unlocked:
+                    if _allowed(lines, w.lineno, "T001"):
+                        continue
+                    diags.append(Diagnostic(
+                        rule="T001", name="unguarded-shared-mutation",
+                        severity=ERROR,
+                        message=f"{cls.name}.{attr} is written under "
+                                f"{sorted({k for x in locked for k in x.held})}"
+                                f" (e.g. {locked[0].method}:"
+                                f"{locked[0].lineno}) but written without "
+                                f"the lock in {w.method}()",
+                        source=f"{mod.relpath}:{w.lineno}",
+                        hint="take the lock around this write (or "
+                             "'# repo-lint: allow T001' with a reason "
+                             "if the access is provably pre-publication)"))
+                continue
+            # (b) cross-thread: written on a Thread/Timer target path
+            # without a lock, accessed from non-thread methods
+            if not tctx:
+                continue
+            t_writes = [w for w in eff
+                        if w.method in tctx and not w.held]
+            other = [a for a in eff + reads
+                     if a.method not in tctx and not a.held]
+            if t_writes and other:
+                for w in t_writes:
+                    if _allowed(lines, w.lineno, "T001"):
+                        continue
+                    diags.append(Diagnostic(
+                        rule="T001", name="unguarded-shared-mutation",
+                        severity=ERROR,
+                        message=f"{cls.name}.{attr} is written from the "
+                                f"thread-target path {w.method}() without "
+                                f"a lock while {other[0].method}() "
+                                f"accesses it from the caller's thread",
+                        source=f"{mod.relpath}:{w.lineno}",
+                        hint="guard both sides with one lock (see "
+                             "make_lock for the FLAGS_lockcheck-"
+                             "instrumented variant)"))
+
+
+def acquisition_graph(mods: Iterable[_ModuleFacts]
+                      ) -> Dict[Tuple[str, str], List[str]]:
+    """(held, acquired) -> witness sites, over nested ``with`` scopes
+    plus one level of intra-class call resolution (a call made under a
+    lock to a method that itself acquires)."""
+    edges: Dict[Tuple[str, str], List[str]] = {}
+
+    def add(a: str, b: str, site: str) -> None:
+        edges.setdefault((a, b), []).append(site)
+
+    for mod in mods:
+        scopes = [(None, mod.acquires, mod.calls)]
+        for cls in mod.classes:
+            scopes.append((cls, cls.acquires, cls.calls))
+        for cls, acquires, calls in scopes:
+            for acq in acquires:
+                for held in acq.held_before:
+                    add(held, acq.lock, f"{mod.relpath}:{acq.lineno}")
+            if cls is None:
+                continue
+            # per-method may-acquire sets (fixpoint over self-calls)
+            may: Dict[str, Set[str]] = {m: set() for m in cls.methods}
+            for acq in acquires:
+                may.setdefault(acq.method, set()).add(acq.lock)
+            changed = True
+            while changed:
+                changed = False
+                for m, callees in cls.self_calls.items():
+                    for c in callees:
+                        extra = may.get(c, set()) - may.setdefault(m, set())
+                        if extra:
+                            may[m] |= extra
+                            changed = True
+            for site in calls:
+                if not site.held or not site.dotted.startswith("self."):
+                    continue
+                callee = site.dotted[5:]
+                if "." in callee or callee not in cls.methods:
+                    continue
+                for lock in may.get(callee, ()):
+                    for held in site.held:
+                        add(held, lock, f"{mod.relpath}:{site.lineno}")
+    return edges
+
+
+def find_lock_cycles(edges: Dict[Tuple[str, str], List[str]]
+                     ) -> List[List[str]]:
+    """Simple cycles in the acquisition graph (self-loops included),
+    deduplicated by node set."""
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    cycles: List[List[str]] = []
+    seen_sets: Set[FrozenSet[str]] = set()
+
+    for start in sorted(adj):
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start:
+                    key = frozenset(path)
+                    if key not in seen_sets:
+                        seen_sets.add(key)
+                        cycles.append(path + [start])
+                elif nxt not in path and len(path) < 6:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+def _t002(mod: _ModuleFacts, lines: List[str],
+          diags: List[Diagnostic]) -> None:
+    # non-reentrant self-nesting is a guaranteed deadlock, per module
+    kinds: Dict[str, str] = {}
+    for name, kind in mod.locks.items():
+        kinds[f"{os.path.basename(mod.relpath)}:{name}"] = kind
+    for cls in mod.classes:
+        for attr, kind in cls.locks.items():
+            kinds[f"{cls.name}.{attr}"] = kind
+    scopes = [mod.acquires] + [c.acquires for c in mod.classes]
+    for acquires in scopes:
+        for acq in acquires:
+            if acq.lock in acq.held_before and \
+                    kinds.get(acq.lock) == "plain":
+                if _allowed(lines, acq.lineno, "T002"):
+                    continue
+                diags.append(Diagnostic(
+                    rule="T002", name="lock-order-inversion",
+                    severity=ERROR,
+                    message=f"non-reentrant lock {acq.lock} re-acquired "
+                            f"while already held in {acq.method}() — "
+                            "self-deadlock",
+                    source=f"{mod.relpath}:{acq.lineno}",
+                    hint="use threading.RLock, or split the inner "
+                         "region out of the locked scope"))
+    edges = acquisition_graph([mod])
+    for cycle in find_lock_cycles(edges):
+        if len(cycle) < 3:      # self-loop handled (re-entrancy) above
+            continue
+        sites = []
+        for a, b in zip(cycle, cycle[1:]):
+            sites.extend(edges.get((a, b), ())[:1])
+        lineno = int(sites[0].rsplit(":", 1)[1]) if sites else 1
+        if _allowed(lines, lineno, "T002"):
+            continue
+        diags.append(Diagnostic(
+            rule="T002", name="lock-order-inversion", severity=ERROR,
+            message="lock acquisition cycle "
+                    + " -> ".join(cycle)
+                    + f" (witnessed at {', '.join(sites)})",
+            source=f"{mod.relpath}:{lineno}",
+            hint="pick one global order for these locks and acquire "
+                 "them in it everywhere"))
+
+
+def _t003(mod: _ModuleFacts, lines: List[str],
+          diags: List[Diagnostic]) -> None:
+    scopes = [mod.calls] + [c.calls for c in mod.classes]
+    for calls in scopes:
+        for site in calls:
+            if not site.held:
+                continue
+            dotted = site.dotted
+            last = dotted.rsplit(".", 1)[-1]
+            hit = None
+            for kind, pat in _BLOCKING:
+                if kind == "dotted" and dotted == pat:
+                    hit = pat
+                elif kind == "attr" and last == pat:
+                    hit = pat
+                elif kind == "prefix" and dotted.startswith(pat):
+                    hit = pat
+                if hit:
+                    break
+            # str.join false-positive guard: thread joins pass no
+            # positional args, ``sep.join(parts)`` always passes one
+            if hit is None and last == "join" and site.n_posargs == 0:
+                hit = "join"
+            if hit is None:
+                continue
+            if _allowed(lines, site.lineno, "T003"):
+                continue
+            diags.append(Diagnostic(
+                rule="T003", name="blocking-call-under-lock",
+                severity=WARNING,
+                message=f"{dotted}() blocks while holding "
+                        f"{sorted(site.held)} in {site.method}() — every "
+                        "other acquirer stalls behind the syscall",
+                source=f"{mod.relpath}:{site.lineno}",
+                hint="move the blocking call out of the locked region "
+                     "(copy state under the lock, do I/O outside), or "
+                     "'# repo-lint: allow T003' when serialization is "
+                     "the point"))
+
+
+def _t004(mod: _ModuleFacts, lines: List[str],
+          diags: List[Diagnostic]) -> None:
+    for cls in mod.classes:
+        for tm in cls.threads:
+            if _allowed(lines, tm.lineno, "T004"):
+                continue
+            handle = tm.bound_attr or tm.bound_local
+            if tm.kind == "Timer":
+                cancellable = handle is not None and handle in cls.cancels
+                if not cancellable:
+                    diags.append(Diagnostic(
+                        rule="T004", name="thread-lifecycle",
+                        severity=WARNING,
+                        message=f"Timer in {cls.name}.{tm.method}() has "
+                                "no cancel path"
+                                + ("" if handle else
+                                   " (the handle is never bound)"),
+                        source=f"{mod.relpath}:{tm.lineno}",
+                        hint="bind the timer and cancel it on every "
+                             "exit path (see HangWatchdog.guard)"))
+                continue
+            daemon = tm.daemon
+            if daemon is None and handle is not None and \
+                    handle in cls.daemon_sets:
+                daemon = True
+            joined = handle is not None and handle in cls.joins
+            if not daemon and not joined:
+                diags.append(Diagnostic(
+                    rule="T004", name="thread-lifecycle",
+                    severity=WARNING,
+                    message=f"non-daemon Thread in {cls.name}."
+                            f"{tm.method}() is never joined — process "
+                            "exit blocks on it",
+                    source=f"{mod.relpath}:{tm.lineno}",
+                    hint="pass daemon=True or join the handle on the "
+                         "shutdown path"))
+        # publish-after-start: the canceller can observe a started
+        # thread before (or instead of) the published handle
+        _t004_publish_order(mod, cls, lines, diags)
+
+
+def _t004_publish_order(mod: _ModuleFacts, cls: _ClassFacts,
+                        lines: List[str],
+                        diags: List[Diagnostic]) -> None:
+    for tm in cls.threads:
+        if tm.bound_local is None:
+            continue
+        start_line = None
+        for site in cls.calls:
+            if site.method == tm.method and \
+                    site.dotted == f"{tm.bound_local}.start" and \
+                    site.lineno >= tm.lineno:
+                start_line = site.lineno
+                break
+        if start_line is None:
+            continue
+        for w in cls.writes:
+            if w.method == tm.method and w.lineno > start_line:
+                # only flag handle-looking publishes of this local
+                src = lines[w.lineno - 1] if w.lineno <= len(lines) else ""
+                if f"= {tm.bound_local}" not in src.replace("  ", " "):
+                    continue
+                if _allowed(lines, w.lineno, "T004"):
+                    continue
+                diags.append(Diagnostic(
+                    rule="T004", name="thread-lifecycle",
+                    severity=WARNING,
+                    message=f"{cls.name}.{w.attr} is published after "
+                            f"{tm.bound_local}.start() in {w.method}() — "
+                            "a concurrent canceller/joiner can miss the "
+                            "running thread",
+                    source=f"{mod.relpath}:{w.lineno}",
+                    hint="publish the handle (under the lock) before "
+                         "start()"))
+                break
+
+
+def _match_suffix(dotted: str, pattern: str) -> bool:
+    """Suffix match on '.' boundaries: 'self.journal.terminal' matches
+    'journal.terminal' but 'xjournal.terminal' does not."""
+    if not dotted:
+        return False
+    d = dotted.replace("().", ".")
+    return d == pattern or d.endswith("." + pattern) or \
+        (pattern.startswith("self.") and d == pattern)
+
+
+def _t005(mod: _ModuleFacts, tree: ast.Module, lines: List[str],
+          diags: List[Diagnostic]) -> None:
+    rel = mod.relpath.replace(os.sep, "/")
+    points = [p for p in JOURNAL_PROTOCOL_POINTS if rel.endswith(p.path)]
+    if not points:
+        return
+    funcs: Dict[str, ast.FunctionDef] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            funcs.setdefault(node.name, node)
+    for pt in points:
+        fn = funcs.get(pt.func)
+        if fn is None:
+            continue
+        journal_line = None
+        effect_sites: List[Tuple[int, str]] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func)
+                if any(_match_suffix(dotted, p) for p in pt.journal):
+                    if journal_line is None or node.lineno < journal_line:
+                        journal_line = node.lineno
+                elif any(_match_suffix(dotted, p) for p in pt.effects):
+                    effect_sites.append((node.lineno, dotted))
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    dotted = _dotted(t)
+                    if any(_match_suffix(dotted, p) for p in pt.effects):
+                        effect_sites.append((t.lineno, dotted))
+        if journal_line is None:
+            diags.append(Diagnostic(
+                rule="T005", name="journal-protocol-violation",
+                severity=ERROR,
+                message=f"protocol point {pt.func}() lost its journal "
+                        f"write ({' / '.join(pt.journal)}) — {pt.doc}",
+                source=f"{mod.relpath}:{fn.lineno}",
+                hint="the fsynced journal call must exist and precede "
+                     "every registered effect"))
+            continue
+        for lineno, dotted in sorted(effect_sites):
+            if lineno >= journal_line:
+                continue
+            if _allowed(lines, lineno, "T005"):
+                continue
+            diags.append(Diagnostic(
+                rule="T005", name="journal-protocol-violation",
+                severity=ERROR,
+                message=f"effect {dotted} at line {lineno} precedes the "
+                        f"journaled fsync write (line {journal_line}) in "
+                        f"protocol point {pt.func}() — {pt.doc}",
+                source=f"{mod.relpath}:{lineno}",
+                hint="journal first: a process death between the effect "
+                     "and the journal replays or loses the event"))
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def check_source(src: str, relpath: str) -> List[Diagnostic]:
+    """Run the T rules over one source string (``relpath`` scopes the
+    T005 protocol registry and labels findings)."""
+    try:
+        tree = ast.parse(src, filename=relpath)
+    except SyntaxError as e:
+        return [Diagnostic(rule="R000", name="unparsable", severity=ERROR,
+                           message=f"cannot parse: {e}", source=relpath)]
+    lines = src.splitlines()
+    mod = _collect(tree, relpath)
+    diags: List[Diagnostic] = []
+    _t001(mod, lines, diags)
+    _t002(mod, lines, diags)
+    _t003(mod, lines, diags)
+    _t004(mod, lines, diags)
+    _t005(mod, tree, lines, diags)
+    return diags
+
+
+def check_file(path: str, relpath: Optional[str] = None) -> List[Diagnostic]:
+    relpath = relpath or path
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+    except OSError as e:
+        return [Diagnostic(rule="R000", name="unparsable", severity=ERROR,
+                           message=f"cannot read: {e}", source=relpath)]
+    return check_source(src, relpath)
+
+
+def collect_module_facts(root: str,
+                         subtrees: Optional[Sequence[str]] = None
+                         ) -> List[_ModuleFacts]:
+    """Parsed per-module concurrency facts for the whole tree (the
+    cross-module acquisition graph input)."""
+    out: List[_ModuleFacts] = []
+    for sub in (subtrees if subtrees is not None else DEFAULT_SUBTREES):
+        base = os.path.join(root, sub)
+        paths: List[str] = []
+        if os.path.isfile(base):
+            paths = [base]
+        else:
+            for dirpath, dirnames, filenames in os.walk(base):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                paths += [os.path.join(dirpath, fn)
+                          for fn in sorted(filenames)
+                          if fn.endswith(".py")]
+        for full in paths:
+            rel = os.path.relpath(full, root)
+            try:
+                with open(full, "r", encoding="utf-8") as f:
+                    src = f.read()
+                tree = ast.parse(src, filename=full)
+            except (OSError, SyntaxError):
+                continue
+            out.append(_collect(tree, rel))
+    return out
+
+
+def check_tree(root: str, subtrees: Optional[Sequence[str]] = None
+               ) -> List[Diagnostic]:
+    """The T rules over the project sources (same default coverage as
+    :func:`.repo_lint.lint_tree`)."""
+    diags: List[Diagnostic] = []
+    for sub in (subtrees if subtrees is not None else DEFAULT_SUBTREES):
+        base = os.path.join(root, sub)
+        if os.path.isfile(base):
+            diags += check_file(base, os.path.relpath(base, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                full = os.path.join(dirpath, fn)
+                diags += check_file(full, os.path.relpath(full, root))
+    return diags
+
+
+# ---------------------------------------------------------------------------
+# Runtime arm: FLAGS_lockcheck instrumented locks
+# ---------------------------------------------------------------------------
+
+class _RuntimeGraph:
+    """Process-global record of real lock acquisition order: one edge
+    per (held -> acquired) pair actually witnessed on some thread."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tls = threading.local()
+        self._edges: Dict[Tuple[str, str], int] = {}
+
+    def _stack(self) -> List[str]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def note_acquire(self, name: str) -> None:
+        st = self._stack()
+        if st:
+            with self._mu:
+                for held in st:
+                    key = (held, name)
+                    self._edges[key] = self._edges.get(key, 0) + 1
+        st.append(name)
+
+    def note_release(self, name: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] == name:
+                del st[i]
+                return
+
+    def edges(self) -> Dict[Tuple[str, str], int]:
+        with self._mu:
+            return dict(self._edges)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+
+
+_runtime = _RuntimeGraph()
+
+
+class TrackedLock:
+    """A ``threading.Lock``/``RLock`` wrapper feeding the runtime
+    acquisition-order graph. Context-manager compatible; ``name`` should
+    be the static graph's short key (``Class.attr``) so
+    :func:`check_runtime_order` can union the two."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._lock.acquire(blocking, timeout)
+        if ok:
+            _runtime.note_acquire(self.name)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+        _runtime.note_release(self.name)
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"TrackedLock({self.name!r})"
+
+
+def make_lock(name: str, reentrant: bool = False):
+    """A lock for ``name`` (the ``Class.attr`` short key): a plain
+    ``threading.Lock``/``RLock`` normally, a :class:`TrackedLock` under
+    ``FLAGS_lockcheck`` — the zero-cost-when-off instrumentation seam
+    the concurrency-critical classes construct their locks through."""
+    try:
+        from ..core.flags import flag
+        tracked = bool(flag("lockcheck"))
+    except Exception:
+        tracked = False
+    if tracked:
+        return TrackedLock(name, reentrant=reentrant)
+    return threading.RLock() if reentrant else threading.Lock()
+
+
+def runtime_edges() -> Dict[Tuple[str, str], int]:
+    return _runtime.edges()
+
+
+def reset_runtime() -> None:
+    _runtime.reset()
+
+
+def check_runtime_order(static_edges: Optional[Dict[Tuple[str, str],
+                                                    List[str]]] = None,
+                        where: str = "lockcheck.runtime"
+                        ) -> List[Diagnostic]:
+    """Union the witnessed runtime acquisition order with the static
+    graph (keyed by the short ``Class.attr`` names) and cycle-check: a
+    runtime order contradicting the static order — or any cycle in the
+    union — is a T002 a single execution could never demonstrate as a
+    deadlock but two interleaved ones can hit."""
+    union: Dict[Tuple[str, str], List[str]] = {}
+    for (a, b), n in runtime_edges().items():
+        union.setdefault((a, b), []).append(f"runtime x{n}")
+    for (a, b), sites in (static_edges or {}).items():
+        sa = a.split(":", 1)[-1]
+        sb = b.split(":", 1)[-1]
+        union.setdefault((sa, sb), []).extend(sites)
+    diags: List[Diagnostic] = []
+    for cycle in find_lock_cycles(union):
+        if len(cycle) < 3:
+            continue
+        sites = []
+        for a, b in zip(cycle, cycle[1:]):
+            sites.extend(union.get((a, b), ())[:1])
+        diags.append(Diagnostic(
+            rule="T002", name="lock-order-inversion", severity=ERROR,
+            message="runtime-witnessed lock order closes a cycle: "
+                    + " -> ".join(cycle)
+                    + f" ({', '.join(sites)})",
+            where=where,
+            hint="two threads taking these locks in opposite orders "
+                 "deadlock; fix the acquisition order"))
+    return diags

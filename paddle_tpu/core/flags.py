@@ -141,6 +141,11 @@ define_flag("allocator_strategy", "xla",
             "Parity stub: memory is managed by XLA/PJRT on TPU.")
 define_flag("embedding_deterministic", False,
             "Use deterministic (slower) embedding gradient scatter.")
+define_flag("lockcheck", False,
+            "Hand out instrumented locks (analysis.concurrency_check."
+            "TrackedLock) that record real per-thread acquisition order "
+            "for the T002 runtime cross-check. Off: plain threading "
+            "locks, zero overhead.")
 define_flag("flash_attn_version", 2, "Pallas flash-attention kernel version.")
 define_flag("use_pallas_kernels", True,
             "Use Pallas TPU kernels where available (else jnp reference).")

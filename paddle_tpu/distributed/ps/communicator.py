@@ -97,8 +97,10 @@ class AsyncCommunicator:
                 if remaining <= 0:
                     raise TimeoutError("AsyncCommunicator.flush timed out")
                 self._idle.wait(remaining)
-        if self._error is not None:
+            # _error is written by the send thread — read it under the
+            # same condition lock that ordered the inflight drain
             err, self._error = self._error, None
+        if err is not None:
             raise RuntimeError(
                 "AsyncCommunicator: a background push failed (that batch's "
                 "gradients were dropped)") from err
@@ -113,7 +115,8 @@ class AsyncCommunicator:
             try:
                 self._push_merged(batch)
             except Exception as e:  # keep the send thread alive; surface
-                self._error = e     # the failure at the next flush()
+                with self._idle:    # the failure at the next flush()
+                    self._error = e
             finally:
                 with self._idle:
                     self._inflight -= len(batch)

@@ -70,6 +70,10 @@ class ParameterServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.tables: Dict[str, object] = {}
+        # handler threads race create_* ops: without the lock two
+        # workers' idempotent creates can both construct a table and one
+        # worker's pushes land in the copy that loses the dict slot
+        self._tables_mu = threading.Lock()
         self.barrier = _Barrier()
         self._stop = threading.Event()
         ps = self
@@ -102,17 +106,19 @@ class ParameterServer:
         if op == "ping":
             return "pong"
         if op == "create_sparse":
-            if a["name"] not in self.tables:  # idempotent across workers
-                self.tables[a["name"]] = SparseTable(
-                    a["dim"], a.get("rule", "sgd"), a.get("lr", 0.01),
-                    a.get("init", "uniform"), a.get("init_range", 0.0),
-                    a.get("seed", 0))
+            with self._tables_mu:  # idempotent across racing workers
+                if a["name"] not in self.tables:
+                    self.tables[a["name"]] = SparseTable(
+                        a["dim"], a.get("rule", "sgd"), a.get("lr", 0.01),
+                        a.get("init", "uniform"), a.get("init_range", 0.0),
+                        a.get("seed", 0))
             return "ok"
         if op == "create_dense":
-            if a["name"] not in self.tables:
-                self.tables[a["name"]] = DenseTable(
-                    a["shape"], a.get("rule", "sgd"), a.get("lr", 0.01),
-                    a.get("init", "zeros"), a.get("seed", 0))
+            with self._tables_mu:
+                if a["name"] not in self.tables:
+                    self.tables[a["name"]] = DenseTable(
+                        a["shape"], a.get("rule", "sgd"), a.get("lr", 0.01),
+                        a.get("init", "zeros"), a.get("seed", 0))
             return "ok"
         if op == "pull_sparse":
             return self.tables[a["name"]].pull(a["ids"])

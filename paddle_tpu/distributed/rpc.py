@@ -90,7 +90,10 @@ class _Agent:
         with self._sock_mu:
             s = self._socks.get(to)
             if s is None:
-                s = socket.create_connection((w.ip, w.port), timeout=120)
+                # first-contact dial under _sock_mu is the dedup: two
+                # racing callers must not open two sockets to one peer
+                s = socket.create_connection(  # repo-lint: allow T003
+                    (w.ip, w.port), timeout=120)
                 s.settimeout(600)
                 self._socks[to] = s
             lock = self._peer_locks.setdefault(to, threading.Lock())

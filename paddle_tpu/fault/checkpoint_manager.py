@@ -75,11 +75,30 @@ class CheckpointManager:
         self.max_retries = int(max_retries)
         self.backoff_s = float(backoff_s)
         self.timeout_s = float(timeout_s)
+        from ..analysis.concurrency_check import make_lock
         self.on_commit = on_commit     # (step, capture_to_commit_ms)
-        self.degraded = False          # True after an async write gave up
+        # _lock orders the writer thread's degrade/diagnose against the
+        # training loop's save()/wait(): `degraded` and `diagnostics`
+        # are mutated from the writer thread and read from the caller's,
+        # and the thread handle is published+started atomically so a
+        # concurrent wait() can never observe a published-but-unstarted
+        # thread (join() on one raises) or clear an in-flight handle.
+        self._degraded = False         # True after an async write gave up
         self.diagnostics: List[Any] = []
         self._thread: Optional[threading.Thread] = None
-        self._lock = threading.Lock()
+        self._lock = make_lock("CheckpointManager._lock")
+
+    @property
+    def degraded(self) -> bool:
+        """True after an async write gave up (reads/writes cross the
+        writer thread — coherent under ``_lock``)."""
+        with self._lock:
+            return self._degraded
+
+    @degraded.setter
+    def degraded(self, value: bool) -> None:
+        with self._lock:
+            self._degraded = bool(value)
 
     # -- paths ---------------------------------------------------------------
 
@@ -133,8 +152,10 @@ class CheckpointManager:
             target=self._write_with_retry, args=(step, host_tree, meta, t0),
             name=f"ckpt-save-{step}", daemon=True)
         with self._lock:
+            # publish AND start under the lock: wait() must never see a
+            # handle it cannot join yet
             self._thread = th
-        th.start()
+            th.start()
 
     def _capture(self, state):
         """Fetch every array leaf to host. ``np.asarray`` on a
@@ -267,7 +288,8 @@ class CheckpointManager:
         d = Diagnostic(rule="F001", name="checkpoint-save-degraded",
                        severity="warning", message=message, hint=hint,
                        where="fault.CheckpointManager")
-        self.diagnostics.append(d)
+        with self._lock:   # appended from the writer thread too
+            self.diagnostics.append(d)
         # Operational finding: route through the shared channel but force
         # warn mode — a storage failure must be visible even with
         # FLAGS_static_analysis=off (it is not a static-analysis result).
@@ -315,7 +337,9 @@ class CheckpointManager:
         """Block until the in-flight background write (if any) committed."""
         with self._lock:
             th = self._thread
-        if th is not None and th.is_alive():
+        if th is not None:
+            # published threads are always started (save() holds _lock
+            # across publish+start); joining a finished thread is a no-op
             th.join()
         with self._lock:
             if self._thread is th:

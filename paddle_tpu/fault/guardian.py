@@ -125,7 +125,9 @@ class Guardian:
             with open(self.journal_path, "a") as f:
                 f.write(json.dumps(rec) + "\n")
                 f.flush()
-                os.fsync(f.fileno())
+                # the lock IS the record order: a racing recorder must
+                # not land between this append and its fsync
+                os.fsync(f.fileno())  # repo-lint: allow T003
 
     def events(self) -> List[Dict[str, Any]]:
         return list(self._events)
@@ -210,7 +212,6 @@ class Guardian:
         """Journal the anomaly + decision (fsync, BEFORE the caller acts
         on it), void unpromoted snapshots, count the recovery."""
         dec = self.decide(kind, step, pos=pos)
-        self._pending.clear()  # in the suspicion window — never promote
         latency = (int(step) - int(inject_step)
                    if inject_step is not None else None)
         self.record({"event": "anomaly", "kind": kind, "step": int(step),
@@ -219,6 +220,10 @@ class Guardian:
         self.record({"event": "decision", "kind": kind, "step": int(step),
                      "action": dec.action, "rewind_to": dec.rewind_to,
                      "skip_pos": dec.skip_pos, "reason": dec.reason})
+        # journal-then-effect (rule T005): bookkeeping mutates only after
+        # both records are durable — a death in between must replay the
+        # decision, not lose it
+        self._pending.clear()  # in the suspicion window — never promote
         if dec.action in ("skip_batch", "rewind"):
             self.recoveries += 1
         from ..observability import metrics

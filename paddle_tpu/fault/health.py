@@ -220,10 +220,16 @@ class HangWatchdog:
     def __init__(self, scale: float = 6.0, floor_s: float = 0.5,
                  window: int = 16,
                  on_hang: Optional[Callable[[Dict[str, Any]], None]] = None):
+        from ..analysis.concurrency_check import make_lock
         self.scale = float(scale)
         self.floor_s = float(floor_s)
         self.on_hang = on_hang
         self._times: deque = deque(maxlen=int(window))
+        # _mu orders the guard's disarm against the timer thread's _fire:
+        # whichever takes it first wins, and a disarmed timer is a no-op
+        # — a step completing at the deadline can never be killed after
+        # timer.cancel() won the race.
+        self._mu = make_lock("HangWatchdog._mu")
         self.fired = False
 
     def observe(self, dt_s: float) -> None:
@@ -243,8 +249,12 @@ class HangWatchdog:
         region's duration out of the median (compile steps)."""
         dl = self.deadline_s() if armed else None
         timer = None
+        # per-guard disarm token: cancel() only stops a timer that has
+        # not begun firing — the token makes an already-running _fire a
+        # no-op once the guarded region completed
+        token = {"disarmed": False}
         if dl is not None:
-            timer = threading.Timer(dl, self._fire, args=(step, dl))
+            timer = threading.Timer(dl, self._fire, args=(step, dl, token))
             timer.daemon = True
             timer.start()
         t0 = time.perf_counter()
@@ -252,12 +262,19 @@ class HangWatchdog:
             yield
         finally:
             if timer is not None:
-                timer.cancel()
-            if record and not self.fired:
+                with self._mu:
+                    token["disarmed"] = True
+                    timer.cancel()
+            with self._mu:
+                fired = self.fired
+            if record and not fired:
                 self.observe(time.perf_counter() - t0)
 
-    def _fire(self, step, deadline_s) -> None:
-        self.fired = True
+    def _fire(self, step, deadline_s, token) -> None:
+        with self._mu:
+            if token["disarmed"]:
+                return  # the step completed first; cancel won
+            self.fired = True
         from ..observability import metrics
         metrics.counter(
             "fault.hangs", "steps classified hung by the watchdog").inc()

@@ -47,6 +47,17 @@ def _label_key(labels: Dict[str, Any]) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _new_lock(name: str):
+    """Registry lock factory: the ``FLAGS_lockcheck`` instrumentation
+    seam (``analysis.concurrency_check.make_lock``), resolved lazily so
+    metrics stays importable before the analysis package."""
+    try:
+        from ..analysis.concurrency_check import make_lock
+    except Exception:
+        return threading.Lock()
+    return make_lock(name)
+
+
 class _Child:
     """One (metric name, label set) time series."""
 
@@ -55,7 +66,7 @@ class _Child:
     def __init__(self, name: str, labels: _LabelKey):
         self.name = name
         self.labels = labels
-        self._mu = threading.Lock()
+        self._mu = _new_lock("_Child._mu")
 
     def label_str(self) -> str:
         if not self.labels:
@@ -184,7 +195,7 @@ class Family:
         self.kind = kind
         self.help = help
         self._buckets = tuple(buckets) if buckets is not None else None
-        self._mu = threading.Lock()
+        self._mu = _new_lock("Family._mu")
         self._children: Dict[_LabelKey, _Child] = {}
 
     def labels(self, **labels: Any) -> Any:
@@ -230,7 +241,7 @@ _KIND_NAMES = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
 
 class Registry:
     def __init__(self):
-        self._mu = threading.Lock()
+        self._mu = _new_lock("Registry._mu")
         self._families: Dict[str, Family] = {}
 
     def _family(self, name: str, kind: type, help: str,

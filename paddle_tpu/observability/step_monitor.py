@@ -192,7 +192,8 @@ class RecompileSentinel:
             hint="pad/bucket inputs to a fixed shape set, or mark the "
                  "varying operand static — every new signature pays a "
                  "full XLA compile")
-        self.diagnostics.append(d)
+        with self._mu:   # reset() swaps the list under the same lock
+            self.diagnostics.append(d)
         metrics.counter("telemetry.recompile_churn",
                         "recompile-sentinel firings").inc()
         try:
@@ -420,7 +421,8 @@ class StepTimeline:
             hint="the tools/hbm_budget.py accounting is missing a row "
                  "(new activation, fragmentation, an un-donated buffer) — "
                  "update the plan or find the leak")
-        self.diagnostics.append(d)
+        with self._mu:   # reset() swaps the list under the same lock
+            self.diagnostics.append(d)
         try:
             jaxpr_lint.emit([d], where=d.where)
         except jaxpr_lint.GraphLintError:

@@ -100,24 +100,30 @@ class StatsReporter:
         self.interval = interval
         self.logger = logger or get_logger("paddle_tpu.monitor")
         self._stop = threading.Event()
+        # _mu orders concurrent start()/stop(): without it two racing
+        # start() calls both observe "not alive" and spawn two reporter
+        # loops, and stop() can join a handle start() is replacing
+        self._mu = threading.Lock()
         self._thread = None
 
     def start(self):
-        if self._thread is not None and self._thread.is_alive():
-            return self  # idempotent
-        self._stop.clear()  # restartable after stop()
+        with self._mu:
+            if self._thread is not None and self._thread.is_alive():
+                return self  # idempotent
+            self._stop.clear()  # restartable after stop()
 
-        def loop():
-            while not self._stop.wait(self.interval):
-                snap = stats_snapshot()
-                if snap:
-                    self.logger.info("stats %s", snap)
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+            def loop():
+                while not self._stop.wait(self.interval):
+                    snap = stats_snapshot()
+                    if snap:
+                        self.logger.info("stats %s", snap)
+            self._thread = threading.Thread(target=loop, daemon=True)
+            self._thread.start()
         return self
 
     def stop(self):
         self._stop.set()
-        if self._thread:
-            self._thread.join(timeout=2.0)
-            self._thread = None
+        with self._mu:
+            th, self._thread = self._thread, None
+        if th:
+            th.join(timeout=2.0)

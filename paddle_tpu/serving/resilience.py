@@ -137,10 +137,16 @@ class RequestJournal:
     """
 
     def __init__(self, path: str):
+        from ..analysis.concurrency_check import make_lock
         self.path = path
         parent = os.path.dirname(path)
         if parent:
             os.makedirs(parent, exist_ok=True)
+        # append = write + flush + fsync + in-memory mirror as ONE unit:
+        # concurrent ackers (a multi-threaded engine, the churn tests)
+        # must never interleave half-lines or reorder an ack against its
+        # fsync
+        self._mu = make_lock("RequestJournal._mu")
         self._events: List[Dict[str, Any]] = []
         if os.path.exists(path):
             with open(path) as f:
@@ -160,10 +166,13 @@ class RequestJournal:
 
     def append(self, event: str, **payload: Any) -> None:
         rec = {"event": event, **payload}
-        self._f.write(json.dumps(rec) + "\n")
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        self._events.append(rec)
+        with self._mu:
+            self._f.write(json.dumps(rec) + "\n")
+            self._f.flush()
+            # serializing the fsync IS the exactly-once contract: the
+            # ack must be durable before append returns
+            os.fsync(self._f.fileno())  # repo-lint: allow T003
+            self._events.append(rec)
 
     def launch(self) -> None:
         self.append("launch")
@@ -191,7 +200,8 @@ class RequestJournal:
     # -- read side -----------------------------------------------------------
 
     def events(self) -> List[Dict[str, Any]]:
-        return list(self._events)
+        with self._mu:
+            return list(self._events)
 
     @property
     def n_launches(self) -> int:

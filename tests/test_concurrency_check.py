@@ -1,0 +1,565 @@
+"""Host-side concurrency verifier (analysis/concurrency_check.py): a
+seeded positive AND a clean negative per T rule over synthetic AST
+fixtures, the allow-suppression contract, the lock-guarded-property
+exemption, the protocol-point registry, and the FLAGS_lockcheck runtime
+arm (tracked locks, witnessed edges, cycle detection)."""
+
+import os
+import sys
+import threading
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from paddle_tpu.analysis import concurrency_check as cc  # noqa: E402
+
+
+def rules_of(diags):
+    return [d.rule for d in diags]
+
+
+# ---------------------------------------------------------------------------
+# T001 unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+
+T001_POS = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+    def inc(self):
+        with self._lock:
+            self.n += 1
+    def reset(self):
+        self.n = 0
+"""
+
+T001_NEG = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+    def inc(self):
+        with self._lock:
+            self.n += 1
+    def reset(self):
+        with self._lock:
+            self.n = 0
+"""
+
+
+def test_t001_mixed_discipline_fires_and_clean_is_silent():
+    pos = cc.check_source(T001_POS, "fix/a.py")
+    assert "T001" in rules_of(pos)
+    assert "reset" in pos[0].message
+    neg = cc.check_source(T001_NEG, "fix/a.py")
+    assert "T001" not in rules_of(neg)
+
+
+def test_t001_thread_target_write_without_lock_fires():
+    src = """
+import threading
+class W:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.flag = False
+    def start(self):
+        threading.Timer(1.0, self._fire).start()
+    def poll(self):
+        return self.flag
+    def _fire(self):
+        self.flag = True
+"""
+    diags = cc.check_source(src, "fix/w.py")
+    assert "T001" in rules_of(diags)
+    assert "_fire" in diags[0].message
+    # guarding both sides silences it
+    fixed = src.replace("        self.flag = True",
+                        "        with self._mu:\n"
+                        "            self.flag = True")
+    fixed = fixed.replace("        return self.flag",
+                          "        with self._mu:\n"
+                          "            return self.flag")
+    assert "T001" not in rules_of(cc.check_source(fixed, "fix/w.py"))
+
+
+def test_t001_container_mutators_count_as_writes():
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+    def add(self, x):
+        with self._lock:
+            self.items.append(x)
+    def drop(self):
+        self.items.clear()
+"""
+    assert "T001" in rules_of(cc.check_source(src, "fix/c.py"))
+
+
+def test_t001_init_writes_are_exempt():
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0          # pre-publication: no lock needed
+    def inc(self):
+        with self._lock:
+            self.n += 1
+"""
+    assert rules_of(cc.check_source(src, "fix/c.py")) == []
+
+
+def test_t001_locked_property_is_exempt():
+    """A property whose getter/setter takes the class lock IS the guard:
+    stores through it are lock-guarded by construction (the
+    CheckpointManager.degraded pattern)."""
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._d = False
+    @property
+    def degraded(self):
+        with self._lock:
+            return self._d
+    @degraded.setter
+    def degraded(self, v):
+        with self._lock:
+            self._d = v
+    def writer(self):
+        self.degraded = True
+    def reader(self):
+        if self.degraded:
+            pass
+    def spawn(self):
+        threading.Thread(target=self.writer, daemon=True).start()
+"""
+    assert "T001" not in rules_of(cc.check_source(src, "fix/c.py"))
+
+
+def test_t001_allow_suppression():
+    src = T001_POS.replace(
+        "        self.n = 0\n    def inc",
+        "        self.n = 0\n    def inc")  # keep init line
+    src = src.replace("    def reset(self):\n        self.n = 0",
+                      "    def reset(self):\n"
+                      "        self.n = 0  # repo-lint: allow T001")
+    assert "T001" not in rules_of(cc.check_source(src, "fix/a.py"))
+
+
+# ---------------------------------------------------------------------------
+# T002 lock-order inversion
+# ---------------------------------------------------------------------------
+
+T002_POS = """
+import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+
+
+def test_t002_inversion_fires_and_single_order_is_silent():
+    pos = [d for d in cc.check_source(T002_POS, "fix/l.py")
+           if d.rule == "T002"]
+    assert pos and "C._a" in pos[0].message and "C._b" in pos[0].message
+    neg = T002_POS.replace(
+        "        with self._b:\n            with self._a:\n"
+        "                pass",
+        "        with self._a:\n            with self._b:\n"
+        "                pass")
+    assert "T002" not in rules_of(cc.check_source(neg, "fix/l.py"))
+
+
+def test_t002_nonreentrant_self_nesting_fires():
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def outer(self):
+        with self._lock:
+            with self._lock:
+                pass
+"""
+    diags = [d for d in cc.check_source(src, "fix/s.py")
+             if d.rule == "T002"]
+    assert diags and "re-acquired" in diags[0].message
+    # an RLock self-nests legally
+    rsrc = src.replace("threading.Lock()", "threading.RLock()")
+    assert "T002" not in rules_of(cc.check_source(rsrc, "fix/s.py"))
+
+
+def test_t002_through_intra_class_call():
+    """A call made under lock A to a method that acquires lock B adds
+    the A->B edge — the inversion only exists through the call graph."""
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def locked_b(self):
+        with self._b:
+            pass
+    def ab(self):
+        with self._a:
+            self.locked_b()
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+"""
+    assert "T002" in rules_of(cc.check_source(src, "fix/g.py"))
+
+
+def test_t002_module_level_locks():
+    src = """
+import threading
+_reg = threading.Lock()
+_io = threading.Lock()
+def a():
+    with _reg:
+        with _io:
+            pass
+def b():
+    with _io:
+        with _reg:
+            pass
+"""
+    assert "T002" in rules_of(cc.check_source(src, "fix/m.py"))
+
+
+# ---------------------------------------------------------------------------
+# T003 blocking-call-under-lock
+# ---------------------------------------------------------------------------
+
+def test_t003_blocking_calls_fire_and_allow_suppresses():
+    src = """
+import os
+import time
+import subprocess
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.f = None
+    def slow(self):
+        with self._lock:
+            os.fsync(self.f.fileno())
+            time.sleep(0.1)
+            subprocess.run(["true"])
+"""
+    diags = [d for d in cc.check_source(src, "fix/b.py")
+             if d.rule == "T003"]
+    assert len(diags) == 3
+    assert all(d.severity == "warning" for d in diags)
+    allowed = src.replace("os.fsync(self.f.fileno())",
+                          "os.fsync(self.f.fileno())"
+                          "  # repo-lint: allow T003")
+    assert len([d for d in cc.check_source(allowed, "fix/b.py")
+                if d.rule == "T003"]) == 2
+
+
+def test_t003_join_heuristic_spares_str_join():
+    src = """
+import threading
+class C:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t = None
+    def fine(self, parts):
+        with self._lock:
+            return ",".join(parts)
+    def blocks(self):
+        with self._lock:
+            self._t.join()
+"""
+    diags = [d for d in cc.check_source(src, "fix/j.py")
+             if d.rule == "T003"]
+    assert len(diags) == 1 and "blocks" in diags[0].message
+
+
+def test_t003_outside_lock_is_silent():
+    src = """
+import os
+class C:
+    def fast(self, f):
+        os.fsync(f.fileno())
+"""
+    assert "T003" not in rules_of(cc.check_source(src, "fix/n.py"))
+
+
+# ---------------------------------------------------------------------------
+# T004 thread-lifecycle
+# ---------------------------------------------------------------------------
+
+def test_t004_timer_without_cancel_and_publish_after_start():
+    src = """
+import threading
+class C:
+    def arm(self):
+        self._timer = threading.Timer(1.0, self._work)
+        self._timer.start()
+    def spawn(self):
+        t = threading.Thread(target=self._work, daemon=True)
+        t.start()
+        self._t = t
+    def _work(self):
+        pass
+"""
+    diags = [d for d in cc.check_source(src, "fix/t.py")
+             if d.rule == "T004"]
+    msgs = " | ".join(d.message for d in diags)
+    assert "no cancel path" in msgs
+    assert "published after" in msgs
+
+
+def test_t004_clean_lifecycles_are_silent():
+    src = """
+import threading
+class C:
+    def arm(self):
+        self._timer = threading.Timer(1.0, self._work)
+        self._timer.start()
+    def disarm(self):
+        self._timer.cancel()
+    def spawn(self):
+        t = threading.Thread(target=self._work, daemon=True)
+        self._t = t
+        t.start()
+    def stop(self):
+        self._t.join()
+    def _work(self):
+        pass
+"""
+    assert "T004" not in rules_of(cc.check_source(src, "fix/t.py"))
+
+
+def test_t004_nondaemon_never_joined():
+    src = """
+import threading
+class C:
+    def spawn(self):
+        self._t = threading.Thread(target=self._work)
+        self._t.start()
+    def _work(self):
+        pass
+"""
+    diags = [d for d in cc.check_source(src, "fix/d.py")
+             if d.rule == "T004"]
+    assert diags and "never joined" in diags[0].message
+    joined = src + "    def stop(self):\n        self._t.join()\n"
+    assert not [d for d in cc.check_source(joined, "fix/d.py")
+                if d.rule == "T004" and "never joined" in d.message]
+
+
+# ---------------------------------------------------------------------------
+# T005 journal-protocol violation
+# ---------------------------------------------------------------------------
+
+def test_t005_effect_before_journal_fires():
+    src = """
+class Engine:
+    def _finish(self, seq):
+        self.detokenizer(seq)
+        self.journal.done(seq.rid, [])
+"""
+    diags = [d for d in cc.check_source(src, "serving/engine.py")
+             if d.rule == "T005"]
+    assert diags and "detokenizer" in diags[0].message
+
+
+def test_t005_journal_first_is_silent():
+    src = """
+class Engine:
+    def _finish(self, seq):
+        self.journal.done(seq.rid, [])
+        self.detokenizer(seq)
+"""
+    assert "T005" not in rules_of(
+        cc.check_source(src, "serving/engine.py"))
+
+
+def test_t005_missing_journal_write_fires():
+    src = """
+class Engine:
+    def _finish(self, seq):
+        self.detokenizer(seq)
+"""
+    diags = [d for d in cc.check_source(src, "serving/engine.py")
+             if d.rule == "T005"]
+    assert diags and "lost its journal write" in diags[0].message
+
+
+def test_t005_scoped_to_registered_paths():
+    """The same source outside a registered protocol path is silent —
+    the registry, not the function name, defines the contract."""
+    src = """
+class Engine:
+    def _finish(self, seq):
+        self.detokenizer(seq)
+        self.journal.done(seq.rid, [])
+"""
+    assert "T005" not in rules_of(cc.check_source(src, "other/mod.py"))
+
+
+def test_t005_guardian_effect_patterns():
+    src = """
+class Guardian:
+    def on_anomaly(self, kind, step):
+        self._pending.clear()
+        self.record({"event": "anomaly"})
+"""
+    diags = [d for d in cc.check_source(src, "fault/guardian.py")
+             if d.rule == "T005"]
+    assert diags and "_pending.clear" in diags[0].message
+    good = """
+class Guardian:
+    def on_anomaly(self, kind, step):
+        self.record({"event": "anomaly"})
+        self._pending.clear()
+"""
+    assert "T005" not in rules_of(
+        cc.check_source(good, "fault/guardian.py"))
+
+
+# ---------------------------------------------------------------------------
+# Whole-repo sweep + registry
+# ---------------------------------------------------------------------------
+
+def test_repo_is_t_clean():
+    """The tree the CI gate lints (paddle_tpu/ + tools/ + examples/)
+    carries zero T findings — fixed or explicitly allowed."""
+    diags = cc.check_tree(REPO)
+    assert diags == [], [d.format() for d in diags]
+
+
+def test_thread_rules_registered():
+    rules = cc.all_thread_rules()
+    assert [r.rule_id for r in rules] == \
+        ["T001", "T002", "T003", "T004", "T005"]
+    assert all(r.doc for r in rules)
+
+
+def test_protocol_registry_points_exist():
+    """Every registered protocol point names a real function in a real
+    file — the registry cannot silently rot as the code moves."""
+    import ast as _ast
+    for pt in cc.JOURNAL_PROTOCOL_POINTS:
+        path = os.path.join(REPO, "paddle_tpu", pt.path)
+        assert os.path.exists(path), pt
+        with open(path, encoding="utf-8") as f:
+            tree = _ast.parse(f.read())
+        names = {n.name for n in _ast.walk(tree)
+                 if isinstance(n, (_ast.FunctionDef,
+                                   _ast.AsyncFunctionDef))}
+        assert pt.func in names, (pt.path, pt.func)
+
+
+def test_unparsable_file_reports_r000(tmp_path):
+    p = tmp_path / "bad.py"
+    p.write_text("def broken(:\n")
+    diags = cc.check_file(str(p), "bad.py")
+    assert rules_of(diags) == ["R000"]
+
+
+# ---------------------------------------------------------------------------
+# Runtime arm
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def lockcheck_on():
+    from paddle_tpu.core.flags import set_flags
+    cc.reset_runtime()
+    set_flags({"lockcheck": True})
+    yield
+    set_flags({"lockcheck": False})
+    cc.reset_runtime()
+
+
+def test_make_lock_flag_gating(lockcheck_on):
+    from paddle_tpu.core.flags import set_flags
+    assert isinstance(cc.make_lock("X"), cc.TrackedLock)
+    set_flags({"lockcheck": False})
+    assert not isinstance(cc.make_lock("X"), cc.TrackedLock)
+
+
+def test_tracked_lock_records_nesting_order(lockcheck_on):
+    a, b = cc.make_lock("A"), cc.make_lock("B")
+    with a:
+        with b:
+            pass
+    assert cc.runtime_edges() == {("A", "B"): 1}
+    assert not cc.check_runtime_order()  # one order: no cycle
+
+
+def test_runtime_inversion_across_threads_is_caught(lockcheck_on):
+    a, b = cc.make_lock("A"), cc.make_lock("B")
+    with a:
+        with b:
+            pass
+
+    def rev():
+        with b:
+            with a:
+                pass
+    t = threading.Thread(target=rev)
+    t.start()
+    t.join()
+    diags = cc.check_runtime_order()
+    assert [d.rule for d in diags] == ["T002"]
+    assert "A" in diags[0].message and "B" in diags[0].message
+
+
+def test_runtime_reentrant_tracked_lock(lockcheck_on):
+    r = cc.make_lock("R", reentrant=True)
+    with r:
+        with r:
+            pass
+    assert not cc.check_runtime_order()
+
+
+def test_runtime_unions_static_edges(lockcheck_on):
+    """A runtime order B->A plus a static order A->B closes the cycle
+    neither side sees alone."""
+    a, b = cc.make_lock("C._a"), cc.make_lock("C._b")
+    with b:
+        with a:
+            pass
+    static = {("fix/l.py:C._a", "fix/l.py:C._b"): ["fix/l.py:9"]}
+    diags = cc.check_runtime_order(static)
+    assert [d.rule for d in diags] == ["T002"]
+
+
+def test_acquisition_graph_and_cycles_units():
+    edges = {("A", "B"): ["s1"], ("B", "C"): ["s2"], ("C", "A"): ["s3"]}
+    cycles = cc.find_lock_cycles(edges)
+    assert any(len(c) == 4 for c in cycles)
+    assert not cc.find_lock_cycles({("A", "B"): ["s"],
+                                    ("B", "C"): ["s"]})
+
+
+def test_lint_graph_threads_fixtures_all_fire():
+    from tools import lint_graph
+    fired, diags = lint_graph._threads_selftests()
+    assert all(fired.values()), fired
+    assert diags == []

@@ -115,11 +115,13 @@ def test_rules_md_catalog_matches_code():
     catalog documents exists in code — the catalog cannot silently rot."""
     import glob
     import re
-    from paddle_tpu.analysis import hlo_check, jaxpr_lint, plan_check
+    from paddle_tpu.analysis import (concurrency_check, hlo_check,
+                                     jaxpr_lint, plan_check)
 
     code_ids = {r.rule_id for r in jaxpr_lint.all_rules()}
     code_ids |= {r.rule_id for r in plan_check.all_plan_rules()}
     code_ids |= {r.rule_id for r in hlo_check.all_hlo_rules()}
+    code_ids |= {r.rule_id for r in concurrency_check.all_thread_rules()}
     sources = (
         glob.glob(os.path.join(REPO, "paddle_tpu", "analysis", "*.py")) +
         glob.glob(os.path.join(REPO, "paddle_tpu", "observability",
@@ -249,6 +251,49 @@ def test_multislice_flags_registered():
     with _pytest.raises(ValueError):
         flags.set_flags({"multislice": "everything"})
     assert int(flags.flag("multislice_dcn_bucket_mb")) > 0
+
+
+def test_lint_graph_threads_exits_zero(capsys):
+    """`tools/lint_graph.py --threads` — every T rule fires on its
+    seeded-positive fixture, the repo sweep is T-clean, and the static
+    lock graph is acyclic."""
+    from tools import lint_graph
+    rc = lint_graph.run_threads(min_severity="info")
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "0 error(s)" in out
+    for rule in ("T001", "T002", "T003", "T004", "T005"):
+        assert f"{rule}: fires" in out
+
+
+def test_thread_rules_registered():
+    """The T family is registry-enumerable (the meta-test and the
+    --threads self-tests both rely on it) and FLAGS_lockcheck goes
+    through the flag registry."""
+    from paddle_tpu.analysis import concurrency_check
+    from paddle_tpu.core import flags
+    ids = {r.rule_id for r in concurrency_check.all_thread_rules()}
+    assert ids == {"T001", "T002", "T003", "T004", "T005"}
+    assert flags.flag("lockcheck") in (True, False)
+    assert "lockcheck" not in flags.unknown_env_flags()
+
+
+def test_lint_graph_threads_json_reports_t_rows(capsys):
+    """--threads --json: the schema-v2 report carries the T-family
+    rule_index rows CI diffs across PRs (empty when the repo is clean,
+    but selftests/lock_graph always present)."""
+    import json as _json
+    from tools import lint_graph
+    rc = lint_graph.run_threads(json_mode=True)
+    report = _json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert report["schema_version"] == lint_graph.SCHEMA_VERSION
+    assert report["errors"] == 0
+    assert set(report["selftests"]) == \
+        {"T001", "T002", "T003", "T004", "T005"}
+    assert all(report["selftests"].values())
+    assert report["lock_graph"]["cycles"] == []
+    assert isinstance(report["rule_index"], dict)
 
 
 def test_serving_model_in_lint_graph_catalog():

@@ -494,6 +494,15 @@ def _run_impl(models, with_kernels=False, with_repo=False,
                 print("  " + d.format())
         report["repo"] = [d.to_json() for d in diags]
         all_diags += diags
+        from paddle_tpu.analysis import concurrency_check
+        tdiags = concurrency_check.check_tree(REPO)
+        print(f"== repo concurrency lint (T rules): {len(tdiags)} "
+              "diagnostic(s)")
+        for d in tdiags:
+            if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]:
+                print("  " + d.format())
+        report["threads"] = [d.to_json() for d in tdiags]
+        all_diags += tdiags
         unknown = core_flags.unknown_env_flags()
         if unknown:
             print(f"  note: unrecognized FLAGS_* env vars: {unknown}")
@@ -858,6 +867,151 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None,
 
 
 # ---------------------------------------------------------------------------
+# --threads: the host-concurrency verifier (T rules)
+# ---------------------------------------------------------------------------
+
+# Seeded-positive fixtures: one per T rule, each MUST fire — the gate
+# that proves the rule still detects the hazard class it was built for.
+THREADS_FIXTURES = {
+    "T001": ("t001.py", """
+import threading
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+    def inc(self):
+        with self._lock:
+            self.n += 1
+    def reset(self):
+        self.n = 0
+"""),
+    "T002": ("t002.py", """
+import threading
+class TwoLocks:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+    def ab(self):
+        with self._a:
+            with self._b:
+                pass
+    def ba(self):
+        with self._b:
+            with self._a:
+                pass
+"""),
+    "T003": ("t003.py", """
+import os
+import threading
+class Journal:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.f = None
+    def write(self):
+        with self._lock:
+            os.fsync(self.f.fileno())
+"""),
+    "T004": ("t004.py", """
+import threading
+class Spawner:
+    def spawn(self):
+        t = threading.Thread(target=self._work)
+        t.start()
+        self._t = t
+    def arm(self):
+        self._timer = threading.Timer(1.0, self._work)
+        self._timer.start()
+    def _work(self):
+        pass
+"""),
+    "T005": ("serving/engine.py", """
+class Engine:
+    def _finish(self, seq):
+        self.detokenizer(seq)
+        self.journal.done(seq.rid, [])
+"""),
+}
+
+
+def _threads_selftests():
+    """Run every fixture through the analyzer; a rule that does NOT fire
+    on its seeded positive is itself an error."""
+    from paddle_tpu.analysis import concurrency_check
+    from paddle_tpu.analysis.jaxpr_lint import Diagnostic
+    diags, fired = [], {}
+    for rule, (relpath, src) in sorted(THREADS_FIXTURES.items()):
+        got = concurrency_check.check_source(src, relpath)
+        fired[rule] = any(d.rule == rule for d in got)
+        if not fired[rule]:
+            diags.append(Diagnostic(
+                rule=rule, name="selftest-missing", severity="error",
+                message=f"self-test: {rule} did not fire on its seeded "
+                        f"positive fixture {relpath}",
+                where="threads.selftest"))
+    return fired, diags
+
+
+def run_threads(min_severity="info", json_mode=False):
+    """The T-rule pass standalone: the seeded per-rule self-tests (every
+    rule must fire on its positive fixture) + the whole-repo sweep
+    (which must be clean) + the repo-wide static lock acquisition graph
+    cycle check."""
+    if json_mode:
+        import contextlib
+        with contextlib.redirect_stdout(sys.stderr):
+            rc, report = _run_threads_impl(min_severity)
+        print(json.dumps(report, indent=2))
+        return rc
+    rc, _ = _run_threads_impl(min_severity)
+    return rc
+
+
+def _run_threads_impl(min_severity="info"):
+    from paddle_tpu.analysis import concurrency_check
+    all_diags = []
+    report = {"schema_version": SCHEMA_VERSION}
+    fired, st_diags = _threads_selftests()
+    print("== threads self-tests (each rule must fire on its fixture)")
+    for rule, ok in sorted(fired.items()):
+        print(f"  {rule}: {'fires' if ok else 'MISSING'}")
+    report["selftests"] = fired
+    all_diags += st_diags
+    repo_diags = concurrency_check.check_tree(REPO)
+    print(f"== repo concurrency lint (T rules over paddle_tpu/ + tools/ "
+          f"+ examples/): {len(repo_diags)} diagnostic(s)")
+    for d in repo_diags:
+        if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]:
+            print("  " + d.format())
+    report["repo"] = [d.to_json() for d in repo_diags]
+    all_diags += repo_diags
+    # the cross-module static acquisition graph: cycles anywhere in the
+    # tree, including across files one module's T002 pass cannot see
+    mods = concurrency_check.collect_module_facts(REPO)
+    edges = concurrency_check.acquisition_graph(mods)
+    cycles = concurrency_check.find_lock_cycles(edges)
+    cycles = [c for c in cycles if len(c) >= 3]
+    print(f"== static lock graph: {len(edges)} edge(s), "
+          f"{len(cycles)} cycle(s)")
+    report["lock_graph"] = {
+        "edges": len(edges), "cycles": [" -> ".join(c) for c in cycles]}
+    if cycles:
+        from paddle_tpu.analysis.jaxpr_lint import Diagnostic
+        for c in cycles:
+            all_diags.append(Diagnostic(
+                rule="T002", name="lock-order-inversion", severity="error",
+                message="cross-module lock acquisition cycle "
+                        + " -> ".join(c),
+                where="threads.graph"))
+    errors = [d for d in all_diags if d.severity == "error"]
+    report["rule_index"] = _rule_index(all_diags)
+    report["total_diagnostics"] = len(all_diags)
+    report["errors"] = len(errors)
+    print(f"threads total: {len(all_diags)} diagnostic(s), "
+          f"{len(errors)} error(s)")
+    return (1 if errors else 0), report
+
+
+# ---------------------------------------------------------------------------
 # --hlo: the compiled-HLO verifier, standalone
 # ---------------------------------------------------------------------------
 
@@ -991,6 +1145,10 @@ def main(argv=None):
                    help="compiled-HLO verifier (X-rules) over the "
                         "representative composed steps + the X001 "
                         "seeded self-test")
+    p.add_argument("--threads", action="store_true",
+                   help="host-concurrency verifier (T-rules): per-rule "
+                        "seeded self-tests + the repo sweep + the "
+                        "static lock-order graph")
     p.add_argument("--no-dryrun", action="store_true",
                    help="with --matrix: skip the multichip dryrun scenarios")
     p.add_argument("--no-hlo", action="store_true",
@@ -1007,6 +1165,8 @@ def main(argv=None):
                           with_hlo=not a.no_hlo)
     if a.hlo:
         return run_hlo(min_severity=a.min_severity, json_mode=a.json)
+    if a.threads:
+        return run_threads(min_severity=a.min_severity, json_mode=a.json)
     if a.all:
         models = sorted(MODELS)
     else:

@@ -1,0 +1,481 @@
+#!/usr/bin/env python
+"""Seeded, deterministic interleaving drills over the repo's three
+invariant-critical concurrent objects.
+
+The static T rules (``analysis/concurrency_check.py``) prove lock
+*discipline*; this drill proves the *protocols* hold under adversarial
+operation orders. A cooperative scheduler runs N worker threads but
+grants the run token to exactly one at a time, switching at explicit
+yield points in an order drawn from a seeded RNG — every schedule is a
+real multi-thread execution (real locks, real fsyncs) that replays
+bit-for-bit from its seed.
+
+Three drills, each asserting its object's invariants after every
+operation and at the end of every schedule:
+
+- **allocator/prefix-tree** — concurrent sequences match/attach/insert/
+  release against one refcounted ``BlockAllocator`` + ``PrefixCache``
+  under eviction pressure: ``assert_consistent`` (refcounts >=
+  1 + seq_refs, no resident+spilled node), no block leak, no
+  double-free, no reserved-block drift.
+- **request journal** — concurrent submit/ack writers plus a seeded
+  torn-tail crash + replay: ``exactly_once_report`` must come back with
+  zero lost and zero duplicated acks across the relaunch.
+- **checkpoint manager** — async saves racing ``latest_complete``/
+  ``restore`` readers with a seeded snapshot corruption: the reader
+  must always land on a validating snapshot (torn-snapshot skip), the
+  degraded flag must be observed coherently, and a restored state must
+  round-trip bitwise.
+
+``FLAGS_lockcheck`` is armed for the whole run: every instrumented lock
+feeds the runtime acquisition-order graph, and the drill finishes with
+``check_runtime_order`` — a lock-order inversion witnessed under ANY
+schedule fails the drill even though no schedule happened to deadlock.
+
+    python tools/race_drill.py --quick          # 20 seeds, tier-1 speed
+    python tools/race_drill.py --seeds 200      # the long soak
+    python tools/race_drill.py --drill journal --seeds 50
+"""
+
+import argparse
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# The deterministic scheduler
+# ---------------------------------------------------------------------------
+
+class ScheduleViolation(AssertionError):
+    """An invariant broke under some schedule; the message carries the
+    seed so the exact interleaving replays."""
+
+
+class DrillScheduler:
+    """Cooperative single-token scheduler over real threads.
+
+    Workers are callables taking one argument — the scheduler — and must
+    call :meth:`step` between operations (the explicit yield points).
+    Only the token holder runs; the next holder is drawn from the seeded
+    RNG, so the interleaving of *operations* is deterministic while the
+    operations themselves execute on genuinely distinct threads against
+    real locks."""
+
+    def __init__(self, seed: int):
+        self.rng = random.Random(seed)
+        self.seed = seed
+        self._cv = threading.Condition()
+        self._current = None        # worker id holding the token
+        self._runnable = []         # workers waiting at a yield point
+        self._done = set()
+        self._errors = []
+        self._n = 0
+
+    # -- worker side ---------------------------------------------------------
+
+    def step(self):
+        """Yield point: hand the token back and wait to be rescheduled."""
+        me = threading.current_thread()._drill_id
+        with self._cv:
+            self._current = None
+            self._runnable.append(me)
+            self._cv.notify_all()
+            while self._current != me:
+                self._cv.wait(timeout=30.0)
+                if self._current is None and me not in self._runnable:
+                    # scheduler abandoned us (another worker errored)
+                    raise ScheduleViolation("schedule aborted")
+
+    # -- driver side ---------------------------------------------------------
+
+    def run(self, workers):
+        self._n = len(workers)
+        threads = []
+        for i, fn in enumerate(workers):
+            t = threading.Thread(target=self._trampoline, args=(i, fn),
+                                 daemon=True, name=f"drill-w{i}")
+            t._drill_id = i
+            threads.append(t)
+        for t in threads:
+            t.start()
+        while True:
+            with self._cv:
+                while (len(self._runnable) + len(self._done) < self._n
+                        and not self._errors):
+                    self._cv.wait(timeout=30.0)
+                if self._errors:
+                    break
+                if len(self._done) == self._n:
+                    break
+                if not self._runnable:
+                    break
+                nxt = self._runnable.pop(
+                    self.rng.randrange(len(self._runnable)))
+                self._current = nxt
+                self._cv.notify_all()
+                # wait until that worker yields again or finishes
+                while self._current == nxt and nxt not in self._done \
+                        and not self._errors:
+                    self._cv.wait(timeout=30.0)
+        for t in threads:
+            t.join(timeout=30.0)
+        if self._errors:
+            raise self._errors[0]
+
+    def _trampoline(self, i, fn):
+        # park until first scheduled
+        self.step()
+        try:
+            fn(self)
+        except ScheduleViolation:
+            raise
+        except BaseException as e:
+            with self._cv:
+                self._errors.append(ScheduleViolation(
+                    f"seed {self.seed}, worker {i}: "
+                    f"{type(e).__name__}: {e}"))
+                self._cv.notify_all()
+            return
+        with self._cv:
+            self._done.add(i)
+            self._current = None
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# Drill 1: refcounted allocator + prefix tree
+# ---------------------------------------------------------------------------
+
+def _tiny_cache():
+    from paddle_tpu.serving.paged_cache import PagedKVCache
+    return PagedKVCache(n_layers=1, num_blocks=14, block_size=2,
+                        kv_heads=1, head_dim=2)
+
+
+def _check_tree(cache, tree):
+    tree.assert_consistent()
+    alloc = cache.allocator
+    n_total = alloc.num_blocks - len(alloc._reserved)
+    if alloc.n_free + alloc.n_used != n_total:
+        raise ScheduleViolation(
+            f"block leak: free {alloc.n_free} + used {alloc.n_used} "
+            f"!= {n_total}")
+
+
+def drill_prefix(seed: int) -> dict:
+    """Concurrent sequences sharing prompt prefixes: attach/insert/
+    release/evict churn over one allocator + trie."""
+    from paddle_tpu.serving.prefix_tree import PrefixCache
+
+    cache = _tiny_cache()
+    tree = PrefixCache(cache)
+    bs = cache.block_size
+    # three prompts sharing a 2-block prefix, plus a private one
+    base = [7, 3, 9, 1]
+    prompts = [np.asarray(base + [11, t], np.int32)
+               for t in (0, 1, 2)] + [np.asarray([5, 5, 5, 5, 5],
+                                                 np.int32)]
+    stats = {"attached": 0, "inserted": 0, "evicted": 0}
+    mu = threading.Lock()   # op-granular: ops are atomic, order is fuzzed
+
+    def worker(widx):
+        rng = random.Random((seed << 4) + widx)
+
+        def body(sched):
+            for _ in range(6):
+                sched.step()
+                prompt = prompts[rng.randrange(len(prompts))]
+                with mu:
+                    chain = tree.match(prompt)
+                    got = tree.attach(f"w{widx}", chain,
+                                      lambda n: cache.allocator.alloc(n))
+                    chain = chain[:len(got)]
+                    stats["attached"] += len(got)
+                    _check_tree(cache, tree)
+                sched.step()
+                with mu:
+                    # cold-prefill the uncovered full blocks privately,
+                    # then publish them into the trie (the engine's
+                    # insert-after-prefill); blocks the trie refuses
+                    # (a racing duplicate insert won the key) stay
+                    # private and are freed like a retired sequence's
+                    # tail
+                    n_full = max(0, (prompt.size - 1) // bs)
+                    need = n_full - len(chain)
+                    priv = cache.allocator.alloc(need) if need > 0 else []
+                    if priv is not None and need > 0:
+                        new = tree.insert(prompt, list(got) + priv,
+                                          filled_tokens=n_full * bs,
+                                          have=len(chain))
+                        stats["inserted"] += len(new)
+                        # the trie took its own ref on each new node;
+                        # our alloc grant doubles as the attachment
+                        chain = chain + new
+                        consumed = {n.block_id for n in new}
+                        leftover = [b for b in priv if b not in consumed]
+                        if leftover:
+                            cache.allocator.free(leftover)
+                    elif priv is None:
+                        stats["evicted"] += tree.evict(need)
+                    _check_tree(cache, tree)
+                sched.step()
+                with mu:
+                    tree.release(chain)
+                    _check_tree(cache, tree)
+                if rng.random() < 0.3:
+                    sched.step()
+                    with mu:
+                        stats["evicted"] += tree.evict(1)
+                        _check_tree(cache, tree)
+        return body
+
+    sched = DrillScheduler(seed)
+    sched.run([worker(i) for i in range(3)])
+    with mu:
+        # drain the cache tier: every block must come home
+        tree.evict(cache.allocator.num_blocks, spill=False)
+        _check_tree(cache, tree)
+        if cache.allocator.n_used != 0:
+            raise ScheduleViolation(
+                f"seed {seed}: {cache.allocator.n_used} block(s) still "
+                "allocated after full release+evict")
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# Drill 2: exactly-once request journal
+# ---------------------------------------------------------------------------
+
+def drill_journal(seed: int, workdir: str) -> dict:
+    """Concurrent submit/ack writers + a seeded torn-tail crash and
+    replay: every rid acked exactly once across the relaunch."""
+    from paddle_tpu.serving.resilience import RequestJournal
+
+    path = os.path.join(workdir, f"journal_{seed}.jsonl")
+    j = RequestJournal(path)
+    j.launch()
+    rng = random.Random(seed)
+    per = 4
+    rids = [[f"s{seed}w{w}r{i}" for i in range(per)] for w in range(3)]
+    crash_at = rng.randrange(3 * per)
+    acked = {"n": 0, "crashed": False}
+    mu = threading.Lock()
+
+    class _Req:
+        def __init__(self, rid):
+            self.rid = rid
+            self.prompt_ids = np.asarray([1, 2, 3], np.int32)
+            self.max_new_tokens = 4
+            self.eos_token_id = None
+            self.deadline_s = None
+            self.priority = 0
+
+    def worker(widx):
+        def body(sched):
+            for rid in rids[widx]:
+                sched.step()
+                with mu:
+                    if acked["crashed"]:
+                        return  # post-crash work happens in replay
+                    j.submitted(_Req(rid))
+                sched.step()
+                with mu:
+                    if acked["crashed"]:
+                        return
+                    if acked["n"] == crash_at and not acked["crashed"]:
+                        # torn-tail kill: a half-written line after the
+                        # last durable ack
+                        j._f.write('{"event": "done", "rid": "torn')
+                        j._f.flush()
+                        j.close()
+                        acked["crashed"] = True
+                        return
+                    j.done(rid, [1, 2])
+                    acked["n"] += 1
+        return body
+
+    sched = DrillScheduler(seed)
+    sched.run([worker(i) for i in range(3)])
+    if not acked["crashed"]:
+        j.close()
+    # relaunch: reopen, replay exactly the pending set
+    j2 = RequestJournal(path)
+    j2.launch()
+    pending = j2.pending_rids()
+    for rid in pending:
+        j2.done(rid, [9])
+    expected = sorted(j2.submitted_rids())
+    report = j2.exactly_once_report(expected)
+    j2.close()
+    if not report["exactly_once"]:
+        raise ScheduleViolation(
+            f"seed {seed}: journal not exactly-once: {report}")
+    return {"submitted": len(expected), "replayed": len(pending),
+            "crashed": acked["crashed"], "launches": report["launches"]}
+
+
+# ---------------------------------------------------------------------------
+# Drill 3: checkpoint manager async save vs reader
+# ---------------------------------------------------------------------------
+
+def drill_checkpoint(seed: int, workdir: str) -> dict:
+    """Async saves racing latest_complete/restore with one seeded
+    snapshot corruption: the reader always lands on a validating
+    snapshot and restored state round-trips bitwise."""
+    from paddle_tpu.fault.checkpoint_manager import CheckpointManager
+    from paddle_tpu.distributed import checkpoint as dckpt
+
+    d = os.path.join(workdir, f"ckpt_{seed}")
+    shutil.rmtree(d, ignore_errors=True)
+    mgr = CheckpointManager(d, keep=3, async_save=True)
+    rng = random.Random(seed)
+    states = {s: {"w": np.full((4, 4), s, np.float32),
+                  "b": np.arange(4, dtype=np.int64) + s}
+              for s in range(1, 5)}
+    corrupt_after = rng.randrange(2, 5)
+    stats = {"saves": 0, "reads": 0, "skips": 0}
+
+    def writer(sched):
+        for s in sorted(states):
+            sched.step()
+            mgr.save(s, states[s])
+            stats["saves"] += 1
+            if s == corrupt_after:
+                sched.step()
+                mgr.wait()
+                # corrupt the newest committed snapshot: truncate one
+                # array file — crc validation must reject it
+                step = max(mgr.all_steps())
+                for fn in sorted(os.listdir(mgr._final_dir(step))):
+                    if fn.endswith(".npy"):
+                        p = os.path.join(mgr._final_dir(step), fn)
+                        with open(p, "r+b") as f:
+                            f.truncate(max(0, os.path.getsize(p) - 7))
+                        break
+
+    def reader(sched):
+        for _ in range(5):
+            sched.step()
+            latest = mgr.latest_complete()
+            stats["reads"] += 1
+            if latest is None:
+                continue
+            ok, reason = dckpt.validate_snapshot(mgr._final_dir(latest))
+            if not ok:
+                raise ScheduleViolation(
+                    f"seed {seed}: latest_complete returned invalid "
+                    f"snapshot step_{latest}: {reason}")
+            step, state, _meta = mgr.restore(latest)
+            ref = states[step]
+            for k in ref:
+                if state[k].tobytes() != ref[k].tobytes():
+                    raise ScheduleViolation(
+                        f"seed {seed}: restore of step_{step} key {k!r} "
+                        "is not bitwise")
+
+    sched = DrillScheduler(seed)
+    sched.run([writer, reader])
+    mgr.wait()
+    stats["skips"] = sum(1 for dg in mgr.diagnostics
+                         if "torn/corrupt" in dg.message)
+    latest = mgr.latest_complete()
+    if latest is None:
+        raise ScheduleViolation(f"seed {seed}: no valid snapshot survived")
+    if mgr.degraded:
+        raise ScheduleViolation(
+            f"seed {seed}: manager degraded without a storage fault")
+    mgr.close()
+    shutil.rmtree(d, ignore_errors=True)
+    return stats
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+DRILLS = ("prefix", "journal", "checkpoint")
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--seeds", type=int, default=50,
+                   help="number of distinct schedule seeds per drill")
+    p.add_argument("--quick", action="store_true",
+                   help="tier-1 mode: 20 seeds per drill")
+    p.add_argument("--drill", choices=DRILLS, action="append",
+                   help="run only the named drill(s)")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    a = p.parse_args(argv)
+    n_seeds = 20 if a.quick else a.seeds
+    drills = a.drill or list(DRILLS)
+
+    from paddle_tpu.analysis import concurrency_check as cc
+    from paddle_tpu.core.flags import set_flags
+    set_flags({"lockcheck": True})
+    cc.reset_runtime()
+
+    report = {"seeds": n_seeds, "drills": {}, "violations": []}
+    workdir = tempfile.mkdtemp(prefix="race_drill_")
+    try:
+        for name in drills:
+            agg = {}
+            for seed in range(n_seeds):
+                try:
+                    if name == "prefix":
+                        st = drill_prefix(seed)
+                    elif name == "journal":
+                        st = drill_journal(seed, workdir)
+                    else:
+                        st = drill_checkpoint(seed, workdir)
+                except ScheduleViolation as e:
+                    report["violations"].append(f"{name}: {e}")
+                    continue
+                for k, v in st.items():
+                    agg[k] = agg.get(k, 0) + (int(v) if not
+                                              isinstance(v, bool)
+                                              else int(v))
+            report["drills"][name] = agg
+            if not a.json:
+                print(f"== {name}: {n_seeds} schedule(s), {agg}")
+        # lockdep cross-check over everything the schedules witnessed
+        static = cc.acquisition_graph(
+            cc.collect_module_facts(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))))
+        order = cc.check_runtime_order(static)
+        report["runtime_lock_edges"] = len(cc.runtime_edges())
+        report["lock_order_diagnostics"] = [d.to_json() for d in order]
+        if not a.json:
+            print(f"== lockdep: {report['runtime_lock_edges']} witnessed "
+                  f"edge(s), {len(order)} inversion(s)")
+            for d in order:
+                print("  " + d.format())
+        if order:
+            report["violations"] += [d.format() for d in order]
+    finally:
+        set_flags({"lockcheck": False})
+        shutil.rmtree(workdir, ignore_errors=True)
+    ok = not report["violations"]
+    report["ok"] = ok
+    if a.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"race drill: {len(drills)} drill(s) x {n_seeds} seed(s): "
+              + ("OK" if ok else "VIOLATIONS:"))
+        for v in report["violations"]:
+            print("  " + v)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
