@@ -939,6 +939,85 @@ def bench_telemetry_overhead(small: bool):
     })
 
 
+def bench_flight_recorder_overhead(small: bool):
+    """A/B one instrumented ``sharded.TrainStep`` with
+    FLAGS_flight_recorder=off vs =on (recorder armed to a scratch dir,
+    FLAGS_telemetry=metrics both arms) and emit
+    ``flight_recorder_overhead_pct`` — the crash-persistent black box
+    must cost <2% step time on the CPU mesh, measured with interleaved
+    windows exactly like the telemetry A/B."""
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.observability import flight_recorder as _flr
+    from paddle_tpu.observability import step_monitor
+    from paddle_tpu.optimizer import AdamW
+
+    batch = 32 if small else 64
+    hidden = 512 if small else 2048
+    steps = 20 if small else 30
+    windows = 5
+
+    def loss_fn(model, params, b):
+        x, y = b
+        return F.cross_entropy(functional_call(model, params, x), y).mean()
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, hidden)).astype(np.float32)
+    y = rng.integers(0, 10, (batch,)).astype(np.int64)
+
+    step_monitor.reset_default()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(hidden, hidden), nn.Tanh(),
+                        nn.Linear(hidden, hidden), nn.Tanh(),
+                        nn.Linear(hidden, 10))
+    ts = make_sharded_train_step(net, AdamW(1e-3), loss_fn)
+    run_dir = tempfile.mkdtemp(prefix="bench_flr_")
+    box = _flr.arm(run_dir, role="bench", run_id="bench_flight_recorder")
+    prev = _flags.get_flags(["flight_recorder", "telemetry"])
+    best = {"off": None, "on": None}
+    try:
+        _flags.set_flags({"telemetry": "metrics"})
+        float(ts.step((x, y)))  # compile + warm
+        float(ts.step((x, y)))
+        for _ in range(windows):
+            for mode in ("off", "on"):
+                _flags.set_flags({"flight_recorder": mode})
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    loss = ts.step((x, y))
+                float(loss)  # sync the window
+                dt = (time.perf_counter() - t0) / steps
+                best[mode] = dt if best[mode] is None \
+                    else min(best[mode], dt)
+    finally:
+        _flags.set_flags(prev)
+        _flr.disarm()
+    t_off, t_on = best["off"], best["on"]
+    overhead_pct = 100.0 * (t_on / t_off - 1.0)
+    _meta, records, replay = _flr.replay(box.path)
+    _emit("flight_recorder_overhead_pct", overhead_pct, "pct", 0.0, {
+        "overhead_pct": round(overhead_pct, 3),
+        "step_ms_off": round(t_off * 1e3, 3),
+        "step_ms_on": round(t_on * 1e3, 3),
+        "steps_per_window": steps, "windows": windows,
+        "batch": batch, "hidden": hidden,
+        "recorder_records": len(records),
+        "recorder_frames_torn": replay["frames_torn"],
+        "recorder_wrapped": replay["wrapped"],
+        "note": "min-of-windows wall per instrumented sharded.TrainStep "
+                "step, FLAGS_flight_recorder=off vs =on (mmap ring "
+                "armed, FLAGS_telemetry=metrics both arms), identical "
+                "model/batch/seed; replay the ring with "
+                "tools/postmortem.py",
+    })
+
+
 # ---------------------------------------------------------------------------
 # Config 4 (PRIMARY): GPT decoder LM
 # ---------------------------------------------------------------------------
@@ -1365,6 +1444,32 @@ def bench_fault(small: bool):
 
     from paddle_tpu.fault import drill
 
+    def _pm_summary(rep):
+        pm = rep.get("postmortem") or {}
+        pc = pm.get("plan_check") or {}
+        return {
+            "ok": pm.get("ok"), "coherent": pm.get("coherent"),
+            "recorder_files": pm.get("recorder_files"),
+            "last_committed_steps": pm.get("last_committed_steps"),
+            "deaths": [(d["kind"], d["step"])
+                       for d in pm.get("deaths", [])],
+            "plan_matches": pc.get("matches"),
+            "kill_order_ok": pc.get("kill_order_ok"),
+        }
+
+    def _pm_timeline(drill_name, rep):
+        # machine-readable postmortem record per drill run, riding the
+        # shared timeline JSONL like the serving/health records do
+        out_path = os.environ.get("BENCH_TRACE_OUT",
+                                  "BENCH_timeline.jsonl")
+        try:
+            with open(out_path, "a") as f:
+                f.write(json.dumps({"kind": "postmortem",
+                                    "drill": drill_name,
+                                    **_pm_summary(rep)}) + "\n")
+        except OSError:
+            pass
+
     cfg = drill.quick_config()
     if not small:
         cfg.update(total_steps=16, ckpt_every=4)
@@ -1388,6 +1493,7 @@ def bench_fault(small: bool):
            "plan": report["plan"]["events"],
            "fired": report.get("fired_events"),
            "parity_bitwise": parity.get("bitwise_equal"),
+           "postmortem": _pm_summary(report),
            "method": ("subprocess elastic drill on the CPU mesh: "
                       "deterministic FaultPlan kills the trainer mid-step "
                       "and mid-checkpoint-write; ElasticManager "
@@ -1396,6 +1502,12 @@ def bench_fault(small: bool):
                       "and re-executed steps")})
     if not parity.get("bitwise_equal"):
         raise RuntimeError(f"fault drill parity broken: {parity}")
+    _pm_timeline("fault", report)
+    if report.get("postmortem") and not report["postmortem"]["ok"]:
+        raise RuntimeError(
+            f"fault drill postmortem incoherent: "
+            f"{report['postmortem']['coherence']} "
+            f"plan_check={report['postmortem']['plan_check']}")
 
     # -- the training-health leg: the chained --health drill (2 kills +
     # inject_nan + inject_hang over the guarded trainer) measured the
@@ -1428,6 +1540,7 @@ def bench_fault(small: bool):
            "rewound_steps": hg["rewound_steps"],
            "skipped_batches": hg["skipped_batches"],
            "parity_bitwise": hparity.get("bitwise_equal"),
+           "postmortem": _pm_summary(hreport),
            "method": ("tools/fault_drill.py --quick --health machinery: "
                       "guarded trainer (fused sentinel, hang watchdog, "
                       "SDC canary, Guardian rewind-and-skip) under 2 "
@@ -1436,6 +1549,12 @@ def bench_fault(small: bool):
                       "poisoned-batch skip set")})
     if not hparity.get("bitwise_equal"):
         raise RuntimeError(f"health drill parity broken: {hparity}")
+    _pm_timeline("health", hreport)
+    if hreport.get("postmortem") and not hreport["postmortem"]["ok"]:
+        raise RuntimeError(
+            f"health drill postmortem incoherent: "
+            f"{hreport['postmortem']['coherence']} "
+            f"plan_check={hreport['postmortem']['plan_check']}")
     # the health records ride the shared timeline JSONL like the serving
     # request records do
     out_path = os.environ.get("BENCH_TRACE_OUT", "BENCH_timeline.jsonl")
@@ -2233,6 +2352,12 @@ def main():
         except Exception as e:
             print(json.dumps({"metric": "bench_telemetry_overhead_FAILED",
                               "error": str(e)[:500]}), flush=True)
+        try:
+            bench_flight_recorder_overhead(small)
+        except Exception as e:
+            print(json.dumps(
+                {"metric": "bench_flight_recorder_overhead_FAILED",
+                 "error": str(e)[:500]}), flush=True)
     # comm-overlap A/B (FLAGS_comm_overlap off vs tp): emits the
     # comm_overlap metric — measured on >=2-device meshes, static hop
     # plans only on a single chip (ready for the next device round)

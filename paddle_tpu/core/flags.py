@@ -166,6 +166,21 @@ define_flag("telemetry", "metrics",
             "span trees into the in-memory ring for chrome-trace/JSONL "
             "export.",
             choices=("off", "metrics", "trace"))
+define_flag("flight_recorder", "off",
+            "Crash-persistent per-process flight recorder "
+            "(paddle_tpu.observability.flight_recorder): 'off' (default) "
+            "keeps every emit seam a no-op (byte-identical on step "
+            "outputs, the FLAGS_telemetry contract); 'on' appends "
+            "CRC-framed records (step phase commits, metric-snapshot "
+            "deltas, O-rule diagnostics, guardian decisions, watchdog "
+            "arm/fire, serving request outcomes, heartbeats, fired "
+            "faults) into an mmap-backed ring that survives SIGKILL / "
+            "os._exit with no flush — the input to observability.fleet "
+            "and tools/postmortem.py.",
+            choices=("off", "on"))
+define_flag("flight_recorder_mb", 4,
+            "Flight-recorder ring capacity per process incarnation in "
+            "MiB (the ring wraps — oldest records are overwritten).")
 define_flag("static_analysis", "off",
             "Graph/kernel static analysis mode (paddle_tpu.analysis): "
             "'off' skips, 'warn' prints diagnostics to stderr, 'error' "

@@ -51,6 +51,9 @@ class SliceHeartbeatMonitor:
     def beat(self, step: int, now: Optional[float] = None) -> None:
         """Record this slice's liveness + progress (atomic replace, same
         discipline as the elastic pod heartbeat)."""
+        from ...observability import flight_recorder
+        flight_recorder.emit("heartbeat", slice_id=self.slice_id,
+                             step=int(step))
         tmp = self._path(self.slice_id) + ".tmp"
         with open(tmp, "w") as f:
             json.dump({"time": float(now if now is not None

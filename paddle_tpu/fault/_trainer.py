@@ -162,8 +162,14 @@ def train(work_dir: str, total_steps: int = 8, ckpt_every: int = 2,
     from paddle_tpu.core.random import get_rng_state, set_rng_state
     from paddle_tpu.fault.checkpoint_manager import CheckpointManager
     from paddle_tpu.fault.injection import FaultInjector, FaultPlan
+    from paddle_tpu.observability import flight_recorder as flr
 
     os.makedirs(work_dir, exist_ok=True)
+    # the black box: one crash-persistent ring per incarnation, keyed
+    # (role, replica, incarnation) — no-op unless FLAGS_flight_recorder=on
+    box = flr.arm_if_enabled(
+        os.path.join(work_dir, "flr"), role="trainer",
+        replica_id=int(os.environ.get("FAULT_SLICE_ID") or 0))
     log = _Log(os.path.join(work_dir, "train_log.jsonl"))
     plan = FaultPlan.from_json(plan_json)
     ts, batches = build_step(size)
@@ -221,6 +227,8 @@ def train(work_dir: str, total_steps: int = 8, ckpt_every: int = 2,
     if len(plan):
         inj.disarm()
     log.write({"event": "done"})
+    if box is not None:  # inline runs reuse the process: detach the box
+        flr.disarm()
 
 
 def _train_guarded(work_dir: str, total_steps: int, ckpt_every: int,
@@ -242,9 +250,13 @@ def _train_guarded(work_dir: str, total_steps: int, ckpt_every: int,
     from paddle_tpu.fault.checkpoint_manager import CheckpointManager
     from paddle_tpu.fault.guardian import Guardian
     from paddle_tpu.fault.injection import FaultInjector, FaultPlan
+    from paddle_tpu.observability import flight_recorder as flr
     from paddle_tpu.observability import step_monitor
 
     os.makedirs(work_dir, exist_ok=True)
+    box = flr.arm_if_enabled(
+        os.path.join(work_dir, "flr"), role="trainer",
+        replica_id=int(os.environ.get("FAULT_SLICE_ID") or 0))
     log = _Log(os.path.join(work_dir, "train_log.jsonl"))
     plan = FaultPlan.from_json(plan_json)
     ts, batches = build_step(size, health=True)
@@ -455,6 +467,8 @@ def _train_guarded(work_dir: str, total_steps: int, ckpt_every: int,
     if len(plan):
         inj.disarm()
     log.write({"event": "done"})
+    if box is not None:  # inline runs reuse the process: detach the box
+        flr.disarm()
 
 
 def main() -> None:

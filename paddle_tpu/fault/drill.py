@@ -104,7 +104,8 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
               kinds: Sequence[str] = ("mid_step", "mid_ckpt_write"),
               size: str = "quick", max_restarts: Optional[int] = None,
               reference: str = "inline",
-              health: bool = False, canary_every: int = 3
+              health: bool = False, canary_every: int = 3,
+              flight_recorder: bool = True
               ) -> Dict[str, Any]:
     """Run the fault-injected job + the uninterrupted reference, return the
     full report (goodput record, parity verdict, plan, per-run logs).
@@ -140,6 +141,10 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
     os.makedirs(ref_dir, exist_ok=True)
 
     env = _fault_env(fault_dir, total_steps, ckpt_every, plan, size)
+    if flight_recorder:
+        # every incarnation writes a crash-persistent black box; the
+        # postmortem below reconstructs the run from those + journals
+        env["FLAGS_flight_recorder"] = "on"
     if health:
         env.update({"FAULT_HEALTH": "1",
                     "FAULT_CANARY_EVERY": str(canary_every),
@@ -204,6 +209,16 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
         rlog = goodput.parse_train_log(f)
     report["parity"] = _parity(flog, rlog, total_steps)
     report["reference_rc"] = ref_rc
+
+    # -- postmortem: the drill doubles as the flight recorder's proof —
+    # the reconstruction from recorder files + journals alone must match
+    # the injected plan (kinds, steps, kill ordering) and cohere with
+    # the train log
+    if flight_recorder:
+        from ..observability import fleet
+        report["postmortem"] = fleet.postmortem_report(
+            fault_dir, plan=report["plan"]["events"],
+            ckpt_every=ckpt_every)
     return report
 
 
@@ -249,6 +264,16 @@ def report_summary(report: Dict[str, Any]) -> str:
         f"  parity: bitwise_equal={p.get('bitwise_equal')} "
         f"over {p.get('steps')} steps",
     ]
+    pm = report.get("postmortem")
+    if pm:
+        pc = pm.get("plan_check") or {}
+        lines.append(
+            f"  postmortem: ok={pm.get('ok')} "
+            f"coherent={pm.get('coherent')} "
+            f"recorder_files={pm.get('recorder_files')} "
+            f"last_steps={pm.get('last_committed_steps')} "
+            f"deaths={[(d['kind'], d['step']) for d in pm.get('deaths', [])]} "
+            f"kill_order_ok={pc.get('kill_order_ok')}")
     h = report.get("health")
     if h:
         lines.append(

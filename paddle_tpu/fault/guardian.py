@@ -118,6 +118,8 @@ class Guardian:
 
     def record(self, rec: Dict[str, Any]) -> None:
         """Append + fsync one journal record BEFORE its effect applies."""
+        from ..observability import flight_recorder
+        flight_recorder.emit("guardian", **rec)
         with self._mu:
             self._events.append(dict(rec))
             if not self.journal_path:

@@ -257,6 +257,9 @@ class HangWatchdog:
             timer = threading.Timer(dl, self._fire, args=(step, dl, token))
             timer.daemon = True
             timer.start()
+            from ..observability import flight_recorder
+            flight_recorder.emit("watchdog_arm", step=step,
+                                 deadline_s=round(float(dl), 4))
         t0 = time.perf_counter()
         try:
             yield
@@ -275,7 +278,10 @@ class HangWatchdog:
             if token["disarmed"]:
                 return  # the step completed first; cancel won
             self.fired = True
-        from ..observability import metrics
+        from ..observability import flight_recorder, metrics
+        # durable before the escalation callback can os._exit(103)
+        flight_recorder.emit("watchdog_fire", step=step,
+                             deadline_s=round(float(deadline_s), 4))
         metrics.counter(
             "fault.hangs", "steps classified hung by the watchdog").inc()
         info = {"kind": "hang", "step": step,

@@ -91,10 +91,20 @@ def run_scenario(scenario: str, workdir: str, total_steps: int = 10,
         raise ValueError(f"unknown scenario {scenario!r}; "
                          f"expected one of {SCENARIOS}")
 
+    from ..core import flags as _flags
+
     t0 = time.perf_counter()
-    _trainer.train(fdir, total_steps=total_steps, ckpt_every=ckpt_every,
-                   plan_json=plan.to_json(), health=True,
-                   canary_every=canary_every)
+    # the fault run records into the black box (the clean reference does
+    # not — the flag is restored before it runs), so the postmortem
+    # below reconstructs the injected story from recorder + journals
+    prev_flags = _flags.get_flags(["flight_recorder"])
+    _flags.set_flags({"flight_recorder": "on"})
+    try:
+        _trainer.train(fdir, total_steps=total_steps,
+                       ckpt_every=ckpt_every, plan_json=plan.to_json(),
+                       health=True, canary_every=canary_every)
+    finally:
+        _flags.set_flags(prev_flags)
     wall_s = time.perf_counter() - t0
     flog = _read_log(fdir)
     record: Dict[str, Any] = {
@@ -107,9 +117,14 @@ def run_scenario(scenario: str, workdir: str, total_steps: int = 10,
         "skipped_batches": flog["skipped_batches"],
         "detection_latency_steps": flog["detection_latency_steps"],
     }
+    from ..observability import fleet
+    record["postmortem"] = fleet.postmortem_report(
+        fdir, plan=[{"kind": e.kind, "step": e.step}
+                    for e in plan.events], ckpt_every=ckpt_every)
     if scenario == "clean":
         record["ok"] = (not record["anomalies"]
-                        and len(flog["steps"]) == total_steps)
+                        and len(flog["steps"]) == total_steps
+                        and record["postmortem"]["ok"])
         record["false_positives"] = len(record["anomalies"])
         return record
 
@@ -122,7 +137,8 @@ def run_scenario(scenario: str, workdir: str, total_steps: int = 10,
     latency_ok = bool(latencies) and (
         max(latencies) <= (canary_every if scenario == "sdc" else 1))
     record["ok"] = (kinds == [expect_kind] and latency_ok
-                    and record["parity"]["bitwise_equal"])
+                    and record["parity"]["bitwise_equal"]
+                    and record["postmortem"]["ok"])
     return record
 
 
@@ -145,7 +161,11 @@ def _run_hang(workdir: str, total_steps: int, canary_every: int
     env = _fault_env(fdir, total_steps, ckpt_every, plan, "quick")
     env.update({"FAULT_HEALTH": "1",
                 "FAULT_CANARY_EVERY": str(canary_every),
-                "FAULT_HANG_SLEEP_S": "8.0"})
+                "FAULT_HANG_SLEEP_S": "8.0",
+                # the hang postmortem is the flight recorder's hardest
+                # case: the dying record is written from the watchdog's
+                # timer thread while the main thread is stalled
+                "FLAGS_flight_recorder": "on"})
     cfg = LaunchConfig(nproc_per_node=1,
                        log_dir=os.path.join(fdir, "logs"), envs=env)
     t0 = time.perf_counter()
@@ -164,10 +184,15 @@ def _run_hang(workdir: str, total_steps: int, canary_every: int
     _trainer.train(rdir, total_steps=total_steps, ckpt_every=ckpt_every,
                    plan_json="", health=True, canary_every=canary_every)
     record["parity"] = _parity(flog, _read_log(rdir), total_steps)
+    from ..observability import fleet
+    record["postmortem"] = fleet.postmortem_report(
+        fdir, plan=[{"kind": e.kind, "step": e.step}
+                    for e in plan.events], ckpt_every=ckpt_every)
     kinds = [a["kind"] for a in record["anomalies"]]
     record["ok"] = (kinds == ["hang"]
                     and record["goodput_record"]["restarts"] == 1
-                    and record["parity"]["bitwise_equal"])
+                    and record["parity"]["bitwise_equal"]
+                    and record["postmortem"]["ok"])
     return record
 
 
@@ -199,5 +224,8 @@ def report_summary(report: Dict[str, Any]) -> str:
                  f"parity_bitwise={par} "
                  f"rewound={r.get('goodput_record', {}).get('rewound_steps')} "
                  f"skipped={r.get('skipped_batches')}")
+        pm = r.get("postmortem")
+        if pm:
+            extra += f" postmortem_ok={pm.get('ok')}"
         lines.append(f"  {name}: ok={r.get('ok')}{extra}")
     return "\n".join(lines)

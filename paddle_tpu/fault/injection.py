@@ -219,6 +219,13 @@ class FaultInjector:
 
     def _mark_fired(self, ev: FaultEvent) -> None:
         self._fired.add(ev.key)
+        # black box FIRST: the mmap write is durable without a flush, so
+        # even a SIGKILL between here and the fsynced journal below
+        # leaves the recorder a superset of fired.json (the direction
+        # the postmortem coherence check relies on)
+        from ..observability import flight_recorder
+        flight_recorder.emit("fault_fired", key=ev.key, kind=ev.kind,
+                             step=ev.step)
         tmp = self.record_path + ".tmp"
         with open(tmp, "w") as f:
             json.dump(sorted(self._fired), f)

@@ -633,6 +633,10 @@ class TrainStep:
             self._step_count += 1
         else:
             self._step_count = int(index)
+        # the flight recorder's step commits carry this global applied
+        # index (checkpointed, so it spans incarnations), not just the
+        # timeline's process-local step counter
+        tm.note("index", self._step_count)
         key = jax.random.fold_in(self._base_key, self._step_count)
         # Trace-time consumers (sharding constraints, CP attention) resolve
         # the mesh via get_hybrid_mesh(); install THIS step's mesh for the

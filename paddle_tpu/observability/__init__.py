@@ -21,6 +21,12 @@ low-overhead measurement layer that is always there (gated by
   when a jitted callable churns signatures), and HBM watermarks sampled
   from ``device.memory_stats()`` and cross-checked against
   ``tools/hbm_budget.py`` plans (O002).
+- :mod:`.flight_recorder` — the crash-persistent tier
+  (``FLAGS_flight_recorder=off|on``): an mmap-backed ring of CRC-framed
+  records per process incarnation that survives SIGKILL/``os._exit``
+  with no flush; :mod:`.fleet` merges every incarnation's ring with the
+  fsynced journals into one globally-ordered fleet timeline, and
+  ``tools/postmortem.py`` reconstructs + verifies the story.
 
 Wiring: ``framework.sharded.TrainStep``, ``framework.offload``,
 ``distributed.pipeline_schedule``, ``io.dataloader`` and ``hapi`` report
@@ -31,18 +37,23 @@ timeline; ``tools/trace_view.py`` renders the JSONL. See OBSERVABILITY.md.
 
 from . import metrics  # noqa: F401
 from . import trace  # noqa: F401
+from . import flight_recorder  # noqa: F401
 from . import step_monitor  # noqa: F401
 from . import request_timeline  # noqa: F401
+from . import fleet  # noqa: F401
 from .trace import span, telemetry_mode  # noqa: F401
 from .step_monitor import (StepTimeline, RecompileSentinel,  # noqa: F401
                            current, reset_default, instrument_jitted,
                            fingerprint, fingerprint_diff)
 from .request_timeline import RequestTimeline  # noqa: F401
+from .flight_recorder import FlightRecorder  # noqa: F401
 
 __all__ = [
     "metrics", "trace", "step_monitor", "request_timeline",
+    "flight_recorder", "fleet",
     "span", "telemetry_mode",
     "StepTimeline", "RecompileSentinel", "RequestTimeline",
+    "FlightRecorder",
     "current", "reset_default",
     "instrument_jitted", "fingerprint", "fingerprint_diff",
 ]

@@ -1,0 +1,449 @@
+"""Cross-incarnation aggregation: one fleet story from many black boxes.
+
+A drill run (and, at pod scale, a fleet) leaves behind one flight-recorder
+file per process incarnation (:mod:`.flight_recorder`) plus the fsynced
+journals the subsystems already keep — the injector's ``fired.json``, the
+trainer's ``train_log.jsonl``, the guardian's ``health.jsonl``, the
+serving tier's exactly-once ``journal.jsonl``. This module merges them:
+
+- :func:`load_run` — replay every recorder file under a run directory,
+  flatten the records into one globally-ordered event stream (wall-clock
+  ``ts``, seq as the tiebreak), and collect the journals.
+- :func:`postmortem_report` — the reconstruction: per-worker
+  last-committed-step table, who-died-first ordering, the
+  hang/NaN/shed/preemption narrative, the exactly-once cross-check
+  against the request journal, and a **coherence** verdict — a story
+  that contradicts itself (a journaled fired event no recorder saw, a
+  recorder step the train log can't explain, a served output the journal
+  never acknowledged) is reported as incoherent, and
+  ``tools/postmortem.py`` exits nonzero on it.
+
+Correlation anchors: recorder meta carries ``(run_id, role, replica_id,
+incarnation, pid, start_ts)``; the train log's ``start`` events carry the
+same pids in launch order, ``fired.json`` keys match the recorder's
+``fault_fired`` records, and the request journal's ``done``/terminal acks
+match the recorder's ``request`` outcomes — each pair is checked in the
+direction its write ordering guarantees.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from . import flight_recorder
+
+__all__ = ["load_run", "postmortem_report", "format_report",
+           "KILL_KINDS", "DEATH_KINDS"]
+
+#: Fault kinds delivered as SIGKILL (the process dies with no cleanup).
+KILL_KINDS = ("mid_step", "mid_ckpt_write", "mid_decode", "mid_spill")
+#: Everything that ends an incarnation: SIGKILLs, the SIGTERM preemption
+#: exit, and the watchdog's exit-103 hang escalation.
+DEATH_KINDS = KILL_KINDS + ("sigterm", "hang")
+
+_JOURNAL_NAMES = ("fired.json", "train_log.jsonl", "health.jsonl",
+                  "journal.jsonl")
+
+
+def _read_jsonl(path: str) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    break  # torn tail from a mid-write death
+    except OSError:
+        pass
+    return out
+
+
+def _find_journals(run_dir: str) -> Dict[str, List[str]]:
+    found: Dict[str, List[str]] = {n: [] for n in _JOURNAL_NAMES}
+    for dirpath, _dirnames, filenames in os.walk(run_dir):
+        for name in filenames:
+            if name in found:
+                found[name].append(os.path.join(dirpath, name))
+    return {k: sorted(v) for k, v in found.items()}
+
+
+def _worker_key(meta: Dict[str, Any]) -> str:
+    return f"{meta.get('role', '?')}.r{meta.get('replica_id', 0)}"
+
+
+def load_run(run_dir: str) -> Dict[str, Any]:
+    """Replay every recorder file under ``run_dir`` and collect the
+    journals. Returns ``{"workers": [...], "events": [...],
+    "journals": {...}}`` — ``events`` is the globally-ordered fleet
+    timeline (each record annotated with its worker/incarnation)."""
+    workers: List[Dict[str, Any]] = []
+    for path in flight_recorder.recorder_files(run_dir):
+        try:
+            meta, records, replay = flight_recorder.replay(path)
+        except (ValueError, OSError):
+            continue
+        workers.append({"path": path, "meta": meta, "records": records,
+                        "replay": replay})
+    events: List[Dict[str, Any]] = []
+    for w in workers:
+        meta = w["meta"]
+        wk = _worker_key(meta)
+        inc = int(meta.get("incarnation", 0))
+        for r in w["records"]:
+            ev = dict(r)
+            ev["worker"] = wk
+            ev["incarnation"] = inc
+            events.append(ev)
+    events.sort(key=lambda e: (e.get("ts", 0.0), e.get("seq", 0)))
+    return {"workers": workers, "events": events,
+            "journals": _find_journals(run_dir)}
+
+
+# ---------------------------------------------------------------------------
+# Reconstruction
+# ---------------------------------------------------------------------------
+
+def _incarnation_summary(w: Dict[str, Any]) -> Dict[str, Any]:
+    meta, records, replay = w["meta"], w["records"], w["replay"]
+    indices = [r["index"] for r in records
+               if r.get("k") == "step" and r.get("index") is not None]
+    tl_steps = [r["step"] for r in records
+                if r.get("k") == "step" and r.get("step") is not None]
+    deaths = [r for r in records
+              if (r.get("k") == "fault_fired"
+                  and r.get("kind") in KILL_KINDS + ("sigterm",))
+              or r.get("k") == "watchdog_fire"]
+    last = records[-1] if records else None
+    return {
+        "path": w["path"],
+        "worker": _worker_key(meta),
+        "role": meta.get("role"),
+        "replica_id": int(meta.get("replica_id", 0)),
+        "incarnation": int(meta.get("incarnation", 0)),
+        "pid": meta.get("pid"),
+        "start_ts": meta.get("start_ts"),
+        "records": len(records),
+        "frames_torn": replay.get("frames_torn", 0),
+        "wrapped": replay.get("wrapped", False),
+        "contiguous": replay.get("contiguous", True),
+        # index = applied step + 1, so the last COMMITTED trainer step:
+        "last_committed_step": (max(indices) - 1) if indices
+        else (max(tl_steps) if tl_steps else None),
+        "requests_ok": sorted(r["rid"] for r in records
+                              if r.get("k") == "request"
+                              and r.get("outcome") == "ok"),
+        "died": ({"kind": ("hang" if deaths[-1]["k"] == "watchdog_fire"
+                           else deaths[-1]["kind"]),
+                  "step": deaths[-1].get("step"),
+                  "ts": deaths[-1].get("ts")}
+                 if deaths else None),
+        "last_ts": last.get("ts") if last else None,
+        "last_kind": last.get("k") if last else None,
+    }
+
+
+def _death_events(events: Sequence[Dict[str, Any]]
+                  ) -> List[Dict[str, Any]]:
+    out = []
+    for e in events:
+        if e.get("k") == "fault_fired" \
+                and e.get("kind") in KILL_KINDS + ("sigterm",):
+            out.append({"worker": e["worker"],
+                        "incarnation": e["incarnation"],
+                        "kind": e["kind"], "step": e.get("step"),
+                        "ts": e.get("ts")})
+        elif e.get("k") == "watchdog_fire":
+            out.append({"worker": e["worker"],
+                        "incarnation": e["incarnation"],
+                        "kind": "hang", "step": e.get("step"),
+                        "ts": e.get("ts")})
+    return out  # events are already globally ts-ordered
+
+
+def _narrative(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """The human-significant subset of the fleet timeline, in order."""
+    out = []
+    for e in events:
+        k = e.get("k")
+        who = f"{e['worker']}.i{e['incarnation']}"
+        text = None
+        if k == "fault_fired":
+            text = f"fault fired: {e.get('kind')}@{e.get('step')}"
+        elif k == "watchdog_fire":
+            text = (f"hang watchdog fired at step {e.get('step')} "
+                    f"(deadline {e.get('deadline_s')}s) -> exit 103")
+        elif k == "guardian":
+            ev = e.get("event")
+            if ev == "anomaly":
+                text = (f"anomaly {e.get('kind')} at step {e.get('step')}"
+                        + (f" (injected at {e.get('inject_step')})"
+                           if e.get("inject_step") is not None else ""))
+            elif ev == "decision":
+                text = (f"guardian decision: {e.get('action')} "
+                        f"for {e.get('kind')} at step {e.get('step')}"
+                        + (f" -> rewind to {e.get('rewind_to')}"
+                           if e.get("rewind_to") is not None else ""))
+            elif ev == "promote":
+                text = f"last-good promoted to step {e.get('step')}"
+        elif k == "request" and e.get("outcome") not in (None, "ok"):
+            text = (f"request {e.get('rid')} ended "
+                    f"{e.get('outcome')}"
+                    + (f": {e.get('error')}" if e.get("error") else ""))
+        elif k == "phase" and e.get("phase") in ("rewind", "ckpt_restore"):
+            text = f"{e.get('phase')} took {e.get('ms')} ms"
+        elif k == "diag":
+            text = f"diagnostic {e.get('rule')} at {e.get('where')}"
+        if text is not None:
+            out.append({"ts": e.get("ts"), "worker": who, "text": text})
+    return out
+
+
+def _delivery_key(kind: str, step: int,
+                  ckpt_every: Optional[int]) -> Tuple[int, float]:
+    """Where in the step sequence a planned fault actually *delivers* —
+    the who-died-first oracle. ``sigterm`` polls at step begin, the
+    watchdog fires mid-dispatch, ``mid_step`` at step end, and
+    ``mid_ckpt_write`` waits for the next save boundary (after step
+    ``m - 1`` for the smallest multiple ``m`` of ``ckpt_every`` whose
+    preceding step reaches the event step)."""
+    if kind == "sigterm":
+        return (int(step), 0.0)
+    if kind == "inject_hang":
+        return (int(step), 0.5)
+    if kind == "mid_ckpt_write" and ckpt_every:
+        m = -(-(int(step) + 1) // int(ckpt_every)) * int(ckpt_every)
+        return (m - 1, 1.5)
+    return (int(step), 1.0 if kind == "mid_step" else 1.5)
+
+
+def _plan_check(plan: Optional[Sequence[Dict[str, Any]]],
+                fired_journal: List[str],
+                events: Sequence[Dict[str, Any]],
+                deaths: Sequence[Dict[str, Any]],
+                ckpt_every: Optional[int] = None
+                ) -> Optional[Dict[str, Any]]:
+    if plan is None:
+        return None
+    expected = sorted(f"{e['kind']}@{e['step']}" for e in plan)
+    fired_rec = [f"{e.get('kind')}@{e.get('step')}" for e in events
+                 if e.get("k") == "fault_fired"]
+    fired_all = sorted(set(fired_journal) | set(fired_rec))
+    matches = expected == fired_all
+    # who-died-first vs the plan: meaningful when every death rides the
+    # trainer's single step counter (the serving kinds count decode
+    # iterations / spill ordinals instead, so only set equality applies)
+    death_plan = sorted(
+        (e for e in plan if e["kind"] in DEATH_KINDS + ("inject_hang",)),
+        key=lambda e: _delivery_key(e["kind"], int(e["step"]),
+                                    ckpt_every))
+    expected_deaths = [("hang" if e["kind"] == "inject_hang"
+                        else e["kind"], int(e["step"]))
+                       for e in death_plan]
+    observed_deaths = [(d["kind"], int(d["step"])) for d in deaths]
+    deaths_match = sorted(expected_deaths) == sorted(observed_deaths)
+    kill_order_ok: Optional[bool] = None
+    if not any(k in ("mid_decode", "mid_spill")
+               for k, _s in expected_deaths):
+        kill_order_ok = expected_deaths == observed_deaths
+    return {"expected": expected, "fired": fired_all,
+            "fired_recorder": fired_rec, "matches": matches,
+            "expected_deaths": expected_deaths,
+            "observed_deaths": observed_deaths,
+            "deaths_match": deaths_match,
+            "kill_order_ok": kill_order_ok}
+
+
+def postmortem_report(run_dir: str,
+                      plan: Optional[Sequence[Dict[str, Any]]] = None,
+                      expected_rids: Optional[Sequence[str]] = None,
+                      ckpt_every: Optional[int] = None
+                      ) -> Dict[str, Any]:
+    """Reconstruct one run's story from recorder files + journals alone.
+
+    ``plan`` is the injected FaultPlan's event list
+    (``[{"kind", "step"}, ...]``) when the caller knows it — the report
+    then carries ``plan_check``; ``ckpt_every`` (when known) lets the
+    who-died-first oracle model ``mid_ckpt_write``'s save-boundary
+    delivery. ``expected_rids`` scopes the serving exactly-once
+    cross-check to a known trace. ``ok`` is the drill verdict: coherent
+    story, plan matched, deaths in the injected order, exactly-once
+    intact."""
+    run = load_run(run_dir)
+    incs = sorted((_incarnation_summary(w) for w in run["workers"]),
+                  key=lambda s: (s["worker"], s["incarnation"]))
+    events = run["events"]
+    journals = run["journals"]
+
+    last_committed: Dict[str, Optional[int]] = {}
+    for s in incs:
+        cur = last_committed.get(s["worker"])
+        if s["last_committed_step"] is not None:
+            last_committed[s["worker"]] = s["last_committed_step"] \
+                if cur is None else max(cur, s["last_committed_step"])
+        else:
+            last_committed.setdefault(s["worker"], None)
+
+    deaths = _death_events(events)
+    fired_journal: List[str] = []
+    for p in journals["fired.json"]:
+        try:
+            with open(p) as f:
+                fired_journal.extend(json.load(f))
+        except (OSError, ValueError):
+            pass
+
+    coherence: List[str] = []
+
+    # 1. the recorder must cover the fired-event journal (the recorder
+    #    write lands BEFORE the journal fsync, so journal ⊆ recorder)
+    if incs:
+        fired_rec = {f"{e.get('kind')}@{e.get('step')}" for e in events
+                     if e.get("k") == "fault_fired"}
+        for key in fired_journal:
+            if key not in fired_rec:
+                coherence.append(
+                    f"fired.json records {key!r} but no recorder file "
+                    f"holds a fault_fired record for it")
+
+    # 2. every unwrapped recorder file must replay seq-contiguous
+    for s in incs:
+        if not s["wrapped"] and not s["contiguous"]:
+            coherence.append(
+                f"{s['path']}: non-contiguous record seqs in an "
+                f"unwrapped ring (lost frames mid-file)")
+
+    # 3. train-log cross-check: the recorder commits a step at compute
+    #    end, the log line lands after poll_step_end — so the recorder
+    #    may lead the log by at most the one mid-step-killed step
+    log_events: List[Dict[str, Any]] = []
+    for p in journals["train_log.jsonl"]:
+        log_events.extend(_read_jsonl(p))
+    trainer_steps = [s["last_committed_step"] for s in incs
+                     if s["role"] == "trainer"
+                     and s["last_committed_step"] is not None]
+    if log_events and trainer_steps:
+        log_steps = [int(e["step"]) for e in log_events
+                     if "loss" in e and "step" in e]
+        if log_steps:
+            lead = max(trainer_steps) - max(log_steps)
+            if not 0 <= lead <= 1:
+                coherence.append(
+                    f"recorder last committed step {max(trainer_steps)} "
+                    f"vs train-log max {max(log_steps)}: lead {lead} "
+                    f"outside the [0, 1] a mid-step kill can explain")
+    # 3b. incarnation pids must match the log's start order
+    start_pids = [e.get("pid") for e in log_events
+                  if e.get("event") == "start"]
+    rec_pids = [s["pid"] for s in incs if s["role"] == "trainer"]
+    if start_pids and rec_pids and start_pids != rec_pids:
+        coherence.append(
+            f"train-log start pids {start_pids} disagree with recorder "
+            f"incarnation pids {rec_pids}")
+
+    # 4. serving: exactly-once against the request journal, and no
+    #    recorder-served output the journal never acknowledged
+    exactly_once: Optional[Dict[str, Any]] = None
+    if journals["journal.jsonl"]:
+        from ..serving.resilience import RequestJournal
+        j = RequestJournal(journals["journal.jsonl"][0])
+        try:
+            expected = list(expected_rids) if expected_rids is not None \
+                else sorted(j.submitted_rids())
+            exactly_once = j.exactly_once_report(expected)
+            done_rids = set(j.done_outputs())
+            for s in incs:
+                for rid in s["requests_ok"]:
+                    if rid not in done_rids:
+                        coherence.append(
+                            f"recorder {s['path']} served {rid!r} but "
+                            f"the request journal holds no done ack")
+            if not exactly_once["exactly_once"]:
+                coherence.append(
+                    f"request journal is not exactly-once: "
+                    f"lost={exactly_once['lost']} "
+                    f"duplicated={exactly_once['duplicated']}")
+        finally:
+            j.close()
+
+    plan_check = _plan_check(plan, fired_journal, events, deaths,
+                             ckpt_every=ckpt_every)
+
+    report = {
+        "run_dir": os.path.abspath(run_dir),
+        "recorder_files": len(incs),
+        "workers": incs,
+        "last_committed_steps": last_committed,
+        "deaths": deaths,
+        "narrative": _narrative(events),
+        "exactly_once": exactly_once,
+        "plan_check": plan_check,
+        "coherence": coherence,
+        "coherent": not coherence,
+    }
+    report["ok"] = bool(
+        report["coherent"]
+        and (plan_check is None
+             or (plan_check["matches"] and plan_check["deaths_match"]
+                 and plan_check["kill_order_ok"] in (None, True)))
+        and (exactly_once is None or exactly_once["exactly_once"]))
+    return report
+
+
+def _fmt_ts(ts: Optional[float]) -> str:
+    if ts is None:
+        return "-"
+    import datetime
+    return datetime.datetime.fromtimestamp(ts).strftime("%H:%M:%S.%f")[:-3]
+
+
+def format_report(report: Dict[str, Any]) -> str:
+    """Render the reconstruction for a terminal."""
+    lines = [f"postmortem of {report['run_dir']}",
+             f"  recorder files: {report['recorder_files']}  "
+             f"coherent={report['coherent']} ok={report['ok']}"]
+    lines.append("  per-worker incarnations "
+                 "(last committed step / records / end):")
+    for s in report["workers"]:
+        died = s["died"]
+        end = (f"died {died['kind']}@{died['step']}" if died
+               else (s["last_kind"] or "-"))
+        lines.append(
+            f"    {s['worker']}.i{s['incarnation']} pid={s['pid']} "
+            f"last_step={s['last_committed_step']} "
+            f"records={s['records']} torn={s['frames_torn']} {end}")
+    lines.append(f"  last committed steps: "
+                 f"{report['last_committed_steps']}")
+    if report["deaths"]:
+        lines.append("  who died first:")
+        for i, d in enumerate(report["deaths"]):
+            lines.append(
+                f"    {i + 1}. [{_fmt_ts(d['ts'])}] {d['worker']}"
+                f".i{d['incarnation']} {d['kind']}@{d['step']}")
+    pc = report.get("plan_check")
+    if pc is not None:
+        lines.append(f"  plan: matches={pc['matches']} "
+                     f"deaths_match={pc['deaths_match']} "
+                     f"kill_order_ok={pc['kill_order_ok']}")
+        lines.append(f"    expected: {pc['expected']}")
+        lines.append(f"    fired:    {pc['fired']}")
+    eo = report.get("exactly_once")
+    if eo is not None:
+        lines.append(
+            f"  exactly-once: {eo['exactly_once']} "
+            f"({eo['expected']} expected, {eo['acknowledged']} acked, "
+            f"lost={eo['lost']}, duplicated={eo['duplicated']}, "
+            f"launches={eo['launches']})")
+    if report["narrative"]:
+        lines.append("  narrative:")
+        for n in report["narrative"]:
+            lines.append(f"    [{_fmt_ts(n['ts'])}] {n['worker']}: "
+                         f"{n['text']}")
+    for c in report["coherence"]:
+        lines.append(f"  INCOHERENT: {c}")
+    return "\n".join(lines)

@@ -35,7 +35,7 @@ import threading
 from collections import deque
 from typing import Any, Dict, List, Optional
 
-from . import metrics
+from . import flight_recorder, metrics
 
 __all__ = ["RequestTimeline", "REQUEST_PHASES", "current", "reset_default",
            "percentile"]
@@ -113,6 +113,14 @@ class RequestTimeline:
         rec.update(extra)
         with self._mu:
             self._records.append(rec)
+        # the black box keeps the terminal outcome even when the engine
+        # process is SIGKILLed right after — the journal's ack plus this
+        # record is what the postmortem cross-checks for exactly-once
+        flight_recorder.emit(
+            "request", rid=rec["rid"], outcome=rec["outcome"],
+            new_tokens=rec["new_tokens"],
+            total_ms=rec["total_ms"], preemptions=rec["preemptions"],
+            **({"error": rec["error"]} if "error" in rec else {}))
         if outcome == "ok":
             self._completed.inc()
             self._tokens.inc(int(new_tokens))

@@ -38,7 +38,7 @@ import time
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from . import metrics, trace
+from . import flight_recorder, metrics, trace
 from .trace import telemetry_mode
 
 __all__ = ["StepTimeline", "RecompileSentinel", "current", "reset_default",
@@ -196,6 +196,8 @@ class RecompileSentinel:
             self.diagnostics.append(d)
         metrics.counter("telemetry.recompile_churn",
                         "recompile-sentinel firings").inc()
+        flight_recorder.emit("diag", rule=d.rule, where=d.where,
+                             message=d.message)
         try:
             jaxpr_lint.emit([d], where=d.where)
         except jaxpr_lint.GraphLintError:
@@ -319,6 +321,17 @@ class StepTimeline:
             return _NOOP
         return _Phase(self, name, attrs)
 
+    def note(self, key: str, value: Any) -> None:
+        """Annotate the OPEN step record (no-op between steps / off).
+        ``sharded.TrainStep`` notes its applied-step ``index`` here so
+        the flight recorder's step commits carry the trainer's global
+        step, not just the timeline's incarnation-local count."""
+        if not self.enabled:
+            return
+        with self._mu:
+            if self._cur is not None:
+                self._cur[key] = value
+
     def _step_begin(self) -> int:
         with self._mu:
             self._step_idx += 1
@@ -341,9 +354,20 @@ class StepTimeline:
             self._steps.append(cur)
         self._step_counter.inc()
         self._step_hist.observe(cur["total_ms"])
+        # black-box commit: the step's phase totals land in the
+        # crash-persistent ring the moment the record returns, so a
+        # SIGKILL in the very next instruction keeps this step
+        flight_recorder.emit(
+            "step", step=cur["step"], index=cur.get("index"),
+            total_ms=round(cur["total_ms"], 4),
+            phases={k: round(v, 4) for k, v in cur["phases"].items()},
+            **({"hbm_peak_gb": cur["hbm_peak_gb"]}
+               if "hbm_peak_gb" in cur else {}))
+        flight_recorder.maybe_metrics(cur.get("index", cur["step"]))
 
     def _phase_done(self, name: str, dur_ms: float) -> None:
         with self._mu:
+            standalone = self._cur is None
             if self._cur is not None:
                 ph = self._cur["phases"]
                 ph[name] = ph.get(name, 0.0) + dur_ms
@@ -353,6 +377,11 @@ class StepTimeline:
                     "telemetry.phase_ms",
                     "wall time per step phase (ms)").labels(phase=name)
         hist.observe(dur_ms)
+        if standalone:
+            # between-steps phases (ckpt_restore, the guardian's rewind)
+            # are exactly the recovery work a postmortem reconstructs
+            flight_recorder.emit("phase", phase=name,
+                                 ms=round(dur_ms, 4))
 
     # -- dispatch observation (sentinel + compile attribution) ---------------
 
@@ -423,6 +452,8 @@ class StepTimeline:
                  "update the plan or find the leak")
         with self._mu:   # reset() swaps the list under the same lock
             self.diagnostics.append(d)
+        flight_recorder.emit("diag", rule=d.rule, where=d.where,
+                             message=d.message)
         try:
             jaxpr_lint.emit([d], where=d.where)
         except jaxpr_lint.GraphLintError:

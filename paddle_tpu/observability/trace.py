@@ -32,13 +32,19 @@ from typing import Any, Dict, List, Optional
 from ..core.flags import flag
 
 __all__ = ["span", "Span", "telemetry_mode", "tracing_active", "spans",
-           "clear", "export_chrome_trace", "export_jsonl", "RING_CAPACITY"]
+           "open_spans", "clear", "export_chrome_trace", "export_jsonl",
+           "RING_CAPACITY"]
 
 RING_CAPACITY = 65536
 
 _ring: "deque[Dict[str, Any]]" = deque(maxlen=RING_CAPACITY)
 _ring_mu = threading.Lock()
 _tls = threading.local()
+# spans entered but not yet exited, across ALL threads — the export
+# functions emit these as explicit `incomplete` spans so a hang
+# postmortem shows WHERE the process was stuck, not just that it was
+_open_mu = threading.Lock()
+_open: Dict[int, "Span"] = {}
 
 
 def telemetry_mode() -> str:
@@ -63,13 +69,15 @@ def _stack() -> List["Span"]:
 class Span:
     """One open span; records itself into the ring on exit."""
 
-    __slots__ = ("name", "attrs", "begin_ns", "depth", "_ann", "_active")
+    __slots__ = ("name", "attrs", "begin_ns", "depth", "tid", "_ann",
+                 "_active")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
         self.attrs = attrs
         self.begin_ns = 0
         self.depth = 0
+        self.tid = 0
         self._ann = None
         self._active = False
 
@@ -80,6 +88,9 @@ class Span:
         st = _stack()
         self.depth = len(st)
         st.append(self)
+        self.tid = threading.get_ident()
+        with _open_mu:
+            _open[id(self)] = self
         try:  # device-trace correlation (best effort: no-op off-TPU trace)
             import jax
             self._ann = jax.profiler.TraceAnnotation(self.name)
@@ -99,6 +110,8 @@ class Span:
         st = _stack()
         if st and st[-1] is self:
             st.pop()
+        with _open_mu:
+            _open.pop(id(self), None)
         rec = {
             "kind": "span",
             "name": self.name,
@@ -122,24 +135,58 @@ def span(name: str, **attrs: Any) -> Span:
 
 
 def spans() -> List[Dict[str, Any]]:
-    """Snapshot of the ring (oldest first)."""
+    """Snapshot of the ring (oldest first) — completed spans only; see
+    :func:`open_spans` for the in-flight ones."""
     with _ring_mu:
         return list(_ring)
+
+
+def open_spans() -> List[Dict[str, Any]]:
+    """Spans still open right now, as ``incomplete`` records whose end
+    is the call time — a span that never closes is the signature of a
+    hang, and dropping it (the old export behavior) hid exactly the
+    evidence a hang postmortem needs."""
+    now_ns = time.perf_counter_ns()
+    with _open_mu:
+        live = list(_open.values())
+    out = []
+    for s in live:
+        rec = {
+            "kind": "span",
+            "name": s.name,
+            "ts_us": s.begin_ns / 1e3,
+            "dur_us": max(0.0, (now_ns - s.begin_ns) / 1e3),
+            "tid": s.tid,
+            "depth": s.depth,
+            "incomplete": True,
+        }
+        if s.attrs:
+            rec["attrs"] = dict(s.attrs)
+        out.append(rec)
+    out.sort(key=lambda r: r["ts_us"])
+    return out
 
 
 def clear() -> None:
     with _ring_mu:
         _ring.clear()
+    with _open_mu:
+        _open.clear()
 
 
 def export_chrome_trace(path: str) -> int:
-    """Write the ring as chrome-trace JSON; returns the event count."""
+    """Write the ring as chrome-trace JSON; returns the event count.
+    Spans still open at export time are emitted too (end = export time,
+    ``args.incomplete`` set) instead of being silently dropped."""
     events = []
-    for s in spans():
+    for s in spans() + open_spans():
         ev = {"name": s["name"], "ph": "X", "ts": s["ts_us"],
               "dur": s["dur_us"], "pid": 0, "tid": s["tid"]}
-        if s.get("attrs"):
-            ev["args"] = s["attrs"]
+        args = dict(s.get("attrs") or {})
+        if s.get("incomplete"):
+            args["incomplete"] = True
+        if args:
+            ev["args"] = args
         events.append(ev)
     with open(path, "w") as f:
         json.dump({"traceEvents": events}, f)
@@ -147,8 +194,10 @@ def export_chrome_trace(path: str) -> int:
 
 
 def export_jsonl(path: str, append: bool = False) -> int:
-    """Write the ring as JSONL (one span per line); returns the count."""
-    recs = spans()
+    """Write the ring as JSONL (one span per line); returns the count.
+    Open spans land flagged ``"incomplete": true`` with end = export
+    time."""
+    recs = spans() + open_spans()
     with open(path, "a" if append else "w") as f:
         for r in recs:
             f.write(json.dumps(r) + "\n")

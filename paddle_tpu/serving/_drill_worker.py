@@ -88,9 +88,13 @@ def arm_serving_faults(workdir, plan_json):
 
 
 def run(workdir, cfg, plan_json=""):
+    from paddle_tpu.observability import flight_recorder as flr
     from paddle_tpu.serving import RequestJournal, ServingEngine
     from paddle_tpu.serving.resilience import prompt_hash
 
+    # the serving black box: request outcomes + fired faults survive the
+    # SIGKILLs this worker exists to absorb (no-op unless the flag is on)
+    flr.arm_if_enabled(os.path.join(workdir, "flr"), role="server")
     trace = load_trace(os.path.join(workdir, "trace.jsonl"))
     journal = RequestJournal(os.path.join(workdir, "journal.jsonl"))
     pending_rids = set(journal.pending_rids([r.rid for r in trace]))

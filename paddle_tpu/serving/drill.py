@@ -104,6 +104,7 @@ def run_serve_drill(workdir: str, **overrides: Any) -> Dict[str, Any]:
 
     env = dict(os.environ)
     env.update({
+        "FLAGS_flight_recorder": "on",  # arm the worker's black box
         "SERVE_WORK_DIR": workdir,
         "SERVE_PLAN": plan.to_json(),
         "SERVE_CFG": json.dumps({k: v for k, v in cfg.items()
@@ -152,10 +153,18 @@ def run_serve_drill(workdir: str, **overrides: Any) -> Dict[str, Any]:
     report["served"] = len(outs)
     report["token_exact"] = not mismatched
     report["mismatched_rids"] = mismatched
+
+    # postmortem reconstruction from the worker's black boxes + the
+    # journals: fired kinds/counters must match the plan and every
+    # recorder-served output must carry a journaled ack
+    from ..observability import fleet
+    report["postmortem"] = fleet.postmortem_report(
+        workdir, plan=report["plan"]["events"], expected_rids=expected)
     report["ok"] = bool(
         once["exactly_once"] and not mismatched
         and len(fired) == len(plan)
-        and report["restarts"] == len(plan))
+        and report["restarts"] == len(plan)
+        and report["postmortem"]["ok"])
     return report
 
 
@@ -173,4 +182,11 @@ def report_summary(report: Dict[str, Any]) -> str:
         f"  outputs: {report.get('served')} served, "
         f"token_exact={report.get('token_exact')}",
     ]
+    pm = report.get("postmortem")
+    if pm:
+        lines.append(
+            f"  postmortem: ok={pm.get('ok')} "
+            f"coherent={pm.get('coherent')} "
+            f"recorder_files={pm.get('recorder_files')} "
+            f"deaths={[(d['kind'], d['step']) for d in pm.get('deaths', [])]}")
     return "\n".join(lines)

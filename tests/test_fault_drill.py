@@ -44,6 +44,20 @@ def test_quick_drill_subprocess(tmp_path):
     assert g["lost_steps"] >= 1          # a SIGKILL always loses work
     assert g["ckpt_save"]["count"] >= 1
     assert g["ckpt_restore"]["count"] == 2
+
+    # flight-recorder postmortem (ISSUE 15): the run's story is
+    # reconstructed from the black boxes + journals alone and must match
+    # the injected plan — kinds, steps, and who-died-first ordering
+    pm = report["postmortem"]
+    assert pm["ok"], pm
+    assert pm["coherent"], pm["coherence"]
+    assert pm["recorder_files"] == 3     # one per incarnation (2 kills)
+    assert pm["plan_check"]["matches"]
+    assert pm["plan_check"]["kill_order_ok"] is True
+    planned = {(e["kind"], e["step"]) for e in report["plan"]["events"]}
+    assert {(d["kind"], d["step"]) for d in pm["deaths"]} == planned
+    total = report["config"]["total_steps"]
+    assert pm["last_committed_steps"] == {"trainer.r0": total - 1}
     assert g["ckpt_save"]["mean_ms"] > 0.0
 
 

@@ -1,0 +1,397 @@
+"""Flight recorder subsystem tests (ISSUE 15).
+
+Covers the mmap ring's framing (CRC round-trip, torn-tail skip, wrap
+window), the flag-gated emit seams (off = no-op; bitwise non-intrusive
+on TrainStep outputs, mirroring TestTelemetryOffBitwise), the
+crash-persistence contract (a SIGKILLed recorder-armed trainer replays
+cleanly to exactly the last committed record), the cross-incarnation
+fleet aggregation + coherence checks, and the tools/postmortem.py CLI.
+"""
+
+import json
+import os
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags as core_flags
+from paddle_tpu.observability import fleet, flight_recorder as flr
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+@pytest.fixture(autouse=True)
+def _recorder_off():
+    """Default-off flag, detached process recorder around every test."""
+    prev = core_flags.get_flags(["flight_recorder"])
+    yield
+    core_flags.set_flags(prev)
+    flr.disarm()
+
+
+# ---------------------------------------------------------------------------
+# ring framing
+# ---------------------------------------------------------------------------
+
+class TestRing:
+    def test_roundtrip_and_meta(self, tmp_path):
+        rec = flr.FlightRecorder(
+            str(tmp_path / "trainer.r0.i0.flr"),
+            {"run_id": "t", "role": "trainer", "replica_id": 0,
+             "incarnation": 0})
+        for i in range(5):
+            assert rec.record("step", step=i, phases={"device": 0.5}) == i
+        rec.record("fault_fired", kind="mid_step", step=3)
+        meta, records, report = flr.replay(rec.path)
+        assert meta["role"] == "trainer" and meta["incarnation"] == 0
+        assert meta["pid"] == os.getpid()
+        assert [r["k"] for r in records] == ["step"] * 5 + ["fault_fired"]
+        assert records[3]["phases"] == {"device": 0.5}
+        assert records[-1]["kind"] == "mid_step"
+        assert report["frames_torn"] == 0 and report["contiguous"]
+        assert not report["wrapped"]
+        # wall-clock timestamps are monotone within one file
+        ts = [r["ts"] for r in records]
+        assert ts == sorted(ts)
+
+    def test_torn_tail_is_skipped_crc_verified(self, tmp_path):
+        rec = flr.FlightRecorder(
+            str(tmp_path / "w.r0.i0.flr"),
+            {"role": "w", "replica_id": 0, "incarnation": 0})
+        for i in range(8):
+            rec.record("step", step=i)
+        # corrupt one byte inside the LAST frame's payload — the torn
+        # write a SIGKILL mid-memcpy leaves behind
+        with open(rec.path, "r+b") as f:
+            data = f.read()
+            magic = struct.pack("<I", flr.FRAME_MAGIC)
+            last = data.rfind(magic)
+            f.seek(last + 40)
+            f.write(b"\xff")
+        _meta, records, report = flr.replay(rec.path)
+        assert [r["step"] for r in records] == list(range(7))
+        assert report["frames_torn"] == 1
+        assert report["contiguous"]  # everything BEFORE the tear replays
+
+    def test_wrap_keeps_newest_contiguous_window(self, tmp_path):
+        rec = flr.FlightRecorder(
+            str(tmp_path / "w.r0.i0.flr"),
+            {"role": "w", "replica_id": 0, "incarnation": 0},
+            capacity_bytes=flr.HEADER_SIZE + 2048)
+        for i in range(300):
+            rec.record("step", step=i)
+        _meta, records, report = flr.replay(rec.path)
+        assert report["wrapped"]
+        assert report["seq_max"] == 299  # newest record always survives
+        assert report["contiguous"]      # one unbroken trailing window
+        assert 0 < len(records) < 300
+
+    def test_oversized_record_dropped_not_raised(self, tmp_path):
+        rec = flr.FlightRecorder(
+            str(tmp_path / "w.r0.i0.flr"),
+            {"role": "w", "replica_id": 0, "incarnation": 0},
+            capacity_bytes=flr.HEADER_SIZE + 4096)
+        assert rec.record("blob", data="x" * 100000) is None
+        assert rec.dropped == 1
+        assert rec.record("ok") is not None
+
+    def test_next_incarnation_scans_existing_files(self, tmp_path):
+        d = str(tmp_path)
+        assert flr.next_incarnation(d, "trainer", 0) == 0
+        flr.FlightRecorder(flr.recorder_path(d, "trainer", 0, 0),
+                           {"role": "trainer", "replica_id": 0,
+                            "incarnation": 0})
+        flr.FlightRecorder(flr.recorder_path(d, "trainer", 0, 1),
+                           {"role": "trainer", "replica_id": 0,
+                            "incarnation": 1})
+        assert flr.next_incarnation(d, "trainer", 0) == 2
+        assert flr.next_incarnation(d, "trainer", 1) == 0
+        assert flr.next_incarnation(d, "server", 0) == 0
+        assert len(flr.recorder_files(d)) == 2
+
+
+# ---------------------------------------------------------------------------
+# gated emit seams
+# ---------------------------------------------------------------------------
+
+class TestEmitGating:
+    def test_emit_noop_when_off_or_unarmed(self, tmp_path):
+        assert flr.emit("step", step=1) is None  # nothing armed
+        rec = flr.arm(str(tmp_path), role="t")
+        assert flr.emit("step", step=1) is None  # armed but flag off
+        core_flags.set_flags({"flight_recorder": "on"})
+        assert flr.emit("step", step=1) == 0
+        assert flr.enabled()
+        flr.disarm()
+        assert flr.emit("step", step=2) is None
+        _meta, records, _rep = flr.replay(rec.path)
+        assert len(records) == 1  # exactly the one gated-on emit
+
+    def test_rearm_opens_next_incarnation(self, tmp_path):
+        core_flags.set_flags({"flight_recorder": "on"})
+        a = flr.arm(str(tmp_path), role="t")
+        b = flr.arm(str(tmp_path), role="t")
+        assert a.meta["incarnation"] == 0 and b.meta["incarnation"] == 1
+        assert flr.current() is b
+
+    def test_metrics_delta_records_changed_keys_only(self, tmp_path):
+        from paddle_tpu.observability import metrics
+        core_flags.set_flags({"flight_recorder": "on"})
+        rec = flr.arm(str(tmp_path), role="t")
+        metrics.counter("flrtest.a").labels().inc()
+        rec.metrics_delta(step=1)
+        metrics.counter("flrtest.b").labels().inc(3)
+        rec.metrics_delta(step=2)
+        _meta, records, _rep = flr.replay(rec.path)
+        deltas = [r for r in records if r["k"] == "metrics"]
+        assert len(deltas) == 2
+        assert deltas[0]["delta"]["flrtest.a"] == 1
+        assert "flrtest.a" not in deltas[1]["delta"]  # unchanged since
+        assert deltas[1]["delta"]["flrtest.b"] == 3
+
+
+# ---------------------------------------------------------------------------
+# bitwise off-arm (mirror of TestTelemetryOffBitwise)
+# ---------------------------------------------------------------------------
+
+def _tiny_train_step():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+    def loss_fn(model, params, batch):
+        x, y = batch
+        return F.cross_entropy(functional_call(model, params, x), y).mean()
+
+    return make_sharded_train_step(net, AdamW(1e-3), loss_fn)
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((8, 8)).astype(np.float32),
+            rng.integers(0, 4, (8,)).astype(np.int64))
+
+
+class TestRecorderOffBitwise:
+    def test_on_mode_is_bitwise_nonintrusive_on_trainstep(self, tmp_path):
+        results = {}
+        for mode in ("off", "on"):
+            core_flags.set_flags({"flight_recorder": mode})
+            if mode == "on":
+                flr.arm(str(tmp_path / "flr"), role="test")
+            ts = _tiny_train_step()
+            losses = [np.asarray(ts.step(_batch(seed=s)))
+                      for s in range(3)]
+            results[mode] = (losses, {k: np.asarray(v)
+                                      for k, v in ts.params.items()})
+        for a, b in zip(results["off"][0], results["on"][0]):
+            np.testing.assert_array_equal(a, b)
+        for k in results["off"][1]:
+            np.testing.assert_array_equal(results["off"][1][k],
+                                          results["on"][1][k])
+        # and the armed run DID record the steps it observed
+        _meta, records, _rep = flr.replay(flr.current().path)
+        assert sum(1 for r in records if r["k"] == "step") == 3
+
+
+# ---------------------------------------------------------------------------
+# crash persistence: SIGKILL a recorder-armed trainer mid-step
+# ---------------------------------------------------------------------------
+
+class TestSigkillReplay:
+    def test_sigkilled_trainer_replays_to_last_committed_record(
+            self, tmp_path):
+        """One incarnation of the drill trainer, killed by its own
+        injector at mid_step@2: the recorder file must replay cleanly
+        (CRC verified, contiguous seq, torn tail at most the frame in
+        flight) to exactly the last committed record — step index 3
+        (= step 2's compute) then the fault_fired breadcrumb."""
+        from paddle_tpu.fault.injection import FaultEvent, FaultPlan
+
+        workdir = str(tmp_path / "w")
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+            FLAGS_flight_recorder="on",
+            FAULT_WORK_DIR=workdir,
+            FAULT_TOTAL_STEPS="6",
+            FAULT_CKPT_EVERY="2",
+            FAULT_PLAN=FaultPlan([FaultEvent("mid_step", 2)]).to_json())
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(REPO, "paddle_tpu", "fault", "_trainer.py")],
+            capture_output=True, text=True, timeout=240, env=env, cwd=REPO)
+        assert proc.returncode == -9, proc.stdout + proc.stderr  # SIGKILL
+
+        files = flr.recorder_files(workdir)
+        assert len(files) == 1
+        meta, records, report = flr.replay(files[0])
+        assert meta["role"] == "trainer" and meta["incarnation"] == 0
+        assert report["frames_torn"] == 0 and report["contiguous"]
+        assert not report["wrapped"]
+        # last committed step record is exactly the killed step's compute
+        steps = [r for r in records if r["k"] == "step"]
+        assert [r["index"] for r in steps] == [1, 2, 3]
+        # the final record is the kill's own breadcrumb, written BEFORE
+        # the fsynced journal and the SIGKILL
+        assert records[-1]["k"] == "fault_fired"
+        assert records[-1]["kind"] == "mid_step"
+        assert records[-1]["step"] == 2
+        # and it agrees with the fsynced fired.json journal
+        with open(os.path.join(workdir, "fired.json")) as f:
+            assert json.load(f) == ["mid_step@2"]
+
+        # the postmortem reconstructs the same story from disk alone
+        pm = fleet.postmortem_report(
+            workdir, plan=[{"kind": "mid_step", "step": 2}], ckpt_every=2)
+        assert pm["coherent"], pm["coherence"]
+        assert pm["ok"], pm
+        assert pm["last_committed_steps"] == {"trainer.r0": 2}
+        assert [(d["kind"], d["step"]) for d in pm["deaths"]] == \
+            [("mid_step", 2)]
+
+
+# ---------------------------------------------------------------------------
+# fleet aggregation + coherence
+# ---------------------------------------------------------------------------
+
+def _mk_box(d, role, replica, inc, records):
+    rec = flr.FlightRecorder(
+        flr.recorder_path(str(d), role, replica, inc),
+        {"run_id": "syn", "role": role, "replica_id": replica,
+         "incarnation": inc})
+    for kind, fields in records:
+        rec.record(kind, **fields)
+    rec.close()
+    return rec
+
+
+class TestFleetPostmortem:
+    def test_multi_worker_story_orders_deaths_globally(self, tmp_path):
+        # worker 0 dies first (mid_step@3), worker 1 later (mid_ckpt@5):
+        # the merged timeline must say so regardless of file order
+        _mk_box(tmp_path, "trainer", 0, 0,
+                [("step", {"step": i + 1, "index": i + 1})
+                 for i in range(3)]
+                + [("fault_fired",
+                    {"key": "mid_step@3", "kind": "mid_step", "step": 3})])
+        _mk_box(tmp_path, "trainer", 1, 0,
+                [("step", {"step": i + 1, "index": i + 1})
+                 for i in range(5)]
+                + [("fault_fired", {"key": "mid_ckpt_write@5",
+                                    "kind": "mid_ckpt_write", "step": 5})])
+        with open(tmp_path / "fired.json", "w") as f:
+            json.dump(["mid_ckpt_write@5", "mid_step@3"], f)
+        pm = fleet.postmortem_report(
+            str(tmp_path),
+            plan=[{"kind": "mid_step", "step": 3},
+                  {"kind": "mid_ckpt_write", "step": 5}], ckpt_every=2)
+        assert pm["coherent"], pm["coherence"]
+        assert pm["ok"]
+        assert [(d["worker"], d["kind"]) for d in pm["deaths"]] == \
+            [("trainer.r0", "mid_step"), ("trainer.r1", "mid_ckpt_write")]
+        assert pm["last_committed_steps"] == \
+            {"trainer.r0": 2, "trainer.r1": 4}
+        assert pm["plan_check"]["matches"]
+        assert pm["plan_check"]["kill_order_ok"]
+
+    def test_journaled_fire_without_recorder_record_is_incoherent(
+            self, tmp_path):
+        _mk_box(tmp_path, "trainer", 0, 0, [("step", {"step": 1})])
+        with open(tmp_path / "fired.json", "w") as f:
+            json.dump(["mid_step@3"], f)
+        pm = fleet.postmortem_report(str(tmp_path))
+        assert not pm["coherent"]
+        assert any("fired.json" in c for c in pm["coherence"])
+
+    def test_recorder_step_lead_beyond_one_is_incoherent(self, tmp_path):
+        # recorder claims step 9 committed but the train log stops at 3:
+        # no single mid-step kill explains a 5-step lead
+        _mk_box(tmp_path, "trainer", 0, 0,
+                [("step", {"step": i + 1, "index": i + 1})
+                 for i in range(9)])
+        with open(tmp_path / "train_log.jsonl", "w") as f:
+            for s in range(4):
+                f.write(json.dumps({"step": s, "loss": 1.0, "t": 0.1})
+                        + "\n")
+        pm = fleet.postmortem_report(str(tmp_path))
+        assert not pm["coherent"]
+        assert any("lead" in c for c in pm["coherence"])
+
+    def test_unacked_served_output_is_incoherent(self, tmp_path):
+        _mk_box(tmp_path, "server", 0, 0,
+                [("request", {"rid": "r0", "outcome": "ok",
+                              "new_tokens": 4, "total_ms": 1.0,
+                              "preemptions": 0}),
+                 ("request", {"rid": "rGHOST", "outcome": "ok",
+                              "new_tokens": 4, "total_ms": 1.0,
+                              "preemptions": 0})])
+        with open(tmp_path / "journal.jsonl", "w") as f:
+            f.write(json.dumps({"event": "launch"}) + "\n")
+            f.write(json.dumps({"event": "submitted", "rid": "r0",
+                                "prompt": [1], "max_new_tokens": 4}) + "\n")
+            f.write(json.dumps({"event": "done", "rid": "r0",
+                                "tokens": [1, 2, 3, 4]}) + "\n")
+        pm = fleet.postmortem_report(str(tmp_path))
+        assert not pm["coherent"]
+        assert any("rGHOST" in c for c in pm["coherence"])
+        assert pm["exactly_once"]["exactly_once"]  # journal itself is fine
+
+    def test_hang_death_reconstructed_from_watchdog_fire(self, tmp_path):
+        _mk_box(tmp_path, "trainer", 0, 0,
+                [("step", {"step": 1, "index": 1}),
+                 ("fault_fired", {"key": "inject_hang@1",
+                                  "kind": "inject_hang", "step": 1}),
+                 ("watchdog_fire", {"step": 1, "deadline_s": 0.5})])
+        with open(tmp_path / "fired.json", "w") as f:
+            json.dump(["inject_hang@1"], f)
+        pm = fleet.postmortem_report(
+            str(tmp_path), plan=[{"kind": "inject_hang", "step": 1}])
+        assert pm["ok"], pm
+        assert [(d["kind"], d["step"]) for d in pm["deaths"]] == \
+            [("hang", 1)]
+        assert any("watchdog" in n["text"] for n in pm["narrative"])
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+class TestPostmortemCli:
+    def test_cli_json_and_exit_codes(self, tmp_path, capsys):
+        from tools import postmortem
+        run = tmp_path / "run"
+        run.mkdir()
+        _mk_box(run, "trainer", 0, 0,
+                [("step", {"step": 1, "index": 1}),
+                 ("fault_fired", {"key": "mid_step@0",
+                                  "kind": "mid_step", "step": 0})])
+        plan = tmp_path / "plan.json"
+        plan.write_text(json.dumps(
+            {"events": [{"kind": "mid_step", "step": 0}]}))
+        rc = postmortem.main([str(run), "--plan", str(plan), "--json"])
+        report = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert report["ok"] and report["plan_check"]["matches"]
+        # an empty dir is rc 2 (nothing to reconstruct)
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert postmortem.main([str(empty)]) == 2
+        capsys.readouterr()
+        # a plan the run contradicts is rc 1
+        bad = tmp_path / "badplan.json"
+        bad.write_text(json.dumps(
+            {"events": [{"kind": "mid_ckpt_write", "step": 4}]}))
+        assert postmortem.main([str(run), "--plan", str(bad)]) == 1
+        capsys.readouterr()

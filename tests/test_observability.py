@@ -162,6 +162,37 @@ class TestTrace:
         rec = json.loads(jl.read_text().strip())
         assert rec["kind"] == "span" and rec["name"] == "a"
 
+    def test_open_span_exports_as_incomplete(self, tmp_path):
+        """Regression (ISSUE 15 satellite): a span still open at export
+        time — the signature of a hang — must be emitted flagged
+        ``incomplete`` with end = export time, not silently dropped."""
+        _mode("trace")
+        hung = trace.span("possibly/hung", step=7)
+        hung.__enter__()  # deliberately never exited before export
+        with trace.span("done"):
+            pass
+        jl = tmp_path / "t.jsonl"
+        assert trace.export_jsonl(str(jl)) == 2
+        recs = [json.loads(line) for line in
+                jl.read_text().strip().splitlines()]
+        by = {r["name"]: r for r in recs}
+        assert "incomplete" not in by["done"]
+        inc = by["possibly/hung"]
+        assert inc["incomplete"] is True
+        assert inc["dur_us"] >= 0 and inc["attrs"] == {"step": 7}
+        # chrome export carries the flag through args
+        chrome = tmp_path / "t.json"
+        assert trace.export_chrome_trace(str(chrome)) == 2
+        evs = {e["name"]: e
+               for e in json.loads(chrome.read_text())["traceEvents"]}
+        assert evs["possibly/hung"]["args"]["incomplete"] is True
+        # closing it afterwards records ONE completed span, no longer
+        # double-reported as open
+        hung.__exit__(None, None, None)
+        assert trace.open_spans() == []
+        names = [s["name"] for s in trace.spans()]
+        assert names.count("possibly/hung") == 1
+
 
 # ---------------------------------------------------------------------------
 # StepTimeline

@@ -296,6 +296,46 @@ def test_lint_graph_threads_json_reports_t_rows(capsys):
     assert isinstance(report["rule_index"], dict)
 
 
+def test_repo_lint_clean_over_flight_recorder_tier():
+    """The flight-recorder tier sources (the mmap ring, the fleet
+    aggregator, the postmortem CLI) pass the repo source rules — R002/
+    R003 apply in full; R001 host clocks are fine (not kernel code, and
+    wall-clock timestamps are the cross-incarnation ordering key)."""
+    from paddle_tpu.analysis import repo_lint
+    for rel in (os.path.join("paddle_tpu", "observability",
+                             "flight_recorder.py"),
+                os.path.join("paddle_tpu", "observability", "fleet.py"),
+                os.path.join("tools", "postmortem.py")):
+        diags = repo_lint.lint_file(os.path.join(REPO, rel), rel)
+        errors = [d for d in diags if d.severity == "error"]
+        assert errors == [], [d.format() for d in errors]
+
+
+def test_concurrency_check_clean_over_flight_recorder():
+    """The recorder's mmap writer is exactly the cross-thread code the
+    T rules exist for (the watchdog timer thread, the checkpoint writer
+    thread and the training loop all record into one ring): the module
+    must stay T001/T003/T004-clean under the static analyzer."""
+    from paddle_tpu.analysis import concurrency_check
+    path = os.path.join(REPO, "paddle_tpu", "observability",
+                        "flight_recorder.py")
+    diags = concurrency_check.check_file(
+        path, os.path.join("paddle_tpu", "observability",
+                           "flight_recorder.py"))
+    assert diags == [], [d.format() for d in diags]
+
+
+def test_flight_recorder_flags_registered():
+    """FLAGS_flight_recorder goes through the registry with validated
+    choices, like FLAGS_telemetry."""
+    from paddle_tpu.core import flags
+    assert flags.flag("flight_recorder") in ("off", "on")
+    with pytest.raises(ValueError):
+        flags.set_flags({"flight_recorder": "maybe"})
+    assert int(flags.flag("flight_recorder_mb")) > 0
+    assert "flight_recorder" not in flags.unknown_env_flags()
+
+
 def test_serving_model_in_lint_graph_catalog():
     """`tools/lint_graph.py --model serving` exists; the bucketed
     prefill/decode executables and the declared dispatch plan lint with

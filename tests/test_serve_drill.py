@@ -49,6 +49,17 @@ def test_quick_serve_drill_subprocess(tmp_path):
     assert report["served"] == report["config"]["requests"]
     assert report["mismatched_rids"] == []
 
+    # flight-recorder postmortem (ISSUE 15): the serving black boxes +
+    # journals reconstruct the kills and every served output carries a
+    # journaled ack
+    pm = report["postmortem"]
+    assert pm["ok"], pm
+    assert pm["coherent"], pm["coherence"]
+    assert pm["recorder_files"] == 3     # one per incarnation (2 kills)
+    assert pm["exactly_once"]["exactly_once"] is True
+    planned = {(e["kind"], e["step"]) for e in report["plan"]["events"]}
+    assert {(d["kind"], d["step"]) for d in pm["deaths"]} == planned
+
 
 def test_serve_bench_slo_gate(tmp_path, capsys):
     """The CI SLO gate: serve_bench --deadline-ms/--fail-on-slo exits

@@ -95,6 +95,10 @@ def main(argv=None):
 
     ok = (report.get("rc") == 0 and report.get("done")
           and report.get("parity", {}).get("bitwise_equal"))
+    if ok and "postmortem" in report:
+        # the reconstructed story (recorder files + journals alone) must
+        # match the injected plan and cohere with the train log
+        ok = bool(report["postmortem"].get("ok"))
     if args.health and ok:
         kinds = [a.get("kind")
                  for a in report.get("health", {}).get("anomalies", [])]

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Postmortem reconstruction CLI: one fleet story from the black boxes.
+
+    python tools/postmortem.py RUN_DIR                 # human narrative
+    python tools/postmortem.py RUN_DIR --json          # machine report
+    python tools/postmortem.py RUN_DIR --plan plan.json
+    python tools/postmortem.py RUN_DIR --expected-rids r0,r1,r2
+
+Reads every flight-recorder file (``*.flr``) plus the fsynced journals
+(``fired.json``, ``train_log.jsonl``, ``health.jsonl``,
+``journal.jsonl``) under RUN_DIR and reconstructs:
+
+- the per-worker last-committed-step table (exact: the recorder commits
+  a step's phases at compute end, before any log/checkpoint);
+- who-died-first ordering across workers and incarnations;
+- the hang / NaN / shed / preemption event narrative;
+- the exactly-once cross-check against the serving request journal.
+
+``--plan`` (a FaultPlan JSON file, or the literal JSON) additionally
+verifies the reconstruction against the injected plan: every planned
+fault fired, nothing unplanned fired, deaths in the injected order.
+
+Exit code: 0 for a coherent story (and a matching plan, when given);
+1 when the story contradicts itself or the plan; 2 when RUN_DIR holds
+no recorder files at all.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _load_plan(arg):
+    """--plan accepts a path to a FaultPlan JSON (or a report carrying
+    ``events``) or the literal JSON string."""
+    if arg is None:
+        return None
+    text = arg
+    if os.path.exists(arg):
+        with open(arg) as f:
+            text = f.read()
+    rec = json.loads(text)
+    if isinstance(rec, dict):
+        rec = rec.get("events", rec.get("plan", {}).get("events", []))
+    return [{"kind": e["kind"], "step": int(e["step"])} for e in rec]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("run_dir", help="directory holding *.flr recorder "
+                                   "files and the run's journals")
+    p.add_argument("--plan", default=None,
+                   help="FaultPlan JSON (path or literal) to verify the "
+                        "reconstruction against")
+    p.add_argument("--expected-rids", default=None,
+                   help="comma list scoping the serving exactly-once "
+                        "cross-check")
+    p.add_argument("--json", action="store_true",
+                   help="machine-readable report on stdout")
+    p.add_argument("--out", default=None, help="also write the report here")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.observability import fleet
+
+    rids = [r for r in (args.expected_rids or "").split(",") if r.strip()]
+    report = fleet.postmortem_report(
+        args.run_dir, plan=_load_plan(args.plan),
+        expected_rids=rids or None)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(fleet.format_report(report))
+    if report["recorder_files"] == 0:
+        print(f"postmortem: no recorder files under {args.run_dir} "
+              f"(was FLAGS_flight_recorder=on?)", file=sys.stderr)
+        return 2
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
