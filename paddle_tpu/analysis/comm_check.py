@@ -58,7 +58,31 @@ __all__ = ["CommSpec", "check_comm_spec", "enforce", "record", "recording",
            "spec_for_dcn_allreduce", "spec_for_slice_all_gather",
            "dcn_axes", "register_dcn_axis", "link_class",
            "ICI_GBPS", "DCN_GBPS", "PEAK_TFLOPS",
-           "HOP_LATENCY_FLOOR_BYTES", "DCN_HOP_LATENCY_FLOOR_BYTES"]
+           "HOP_LATENCY_FLOOR_BYTES", "DCN_HOP_LATENCY_FLOOR_BYTES",
+           "ALLGATHER_MATMUL", "MATMUL_REDUCE_SCATTER", "CP_RING",
+           "SLICE_REDUCE_SCATTER", "DCN_ALLREDUCE", "SLICE_ALL_GATHER",
+           "FLAT_ICI_ALLREDUCE", "SPEC_NAMES"]
+
+# Canonical CommSpec names. Each factory below mints exactly one of
+# these; the subsystems that register a spec re-export the subset they
+# own (``distributed.overlap.SP_COMM_SPECS``,
+# ``distributed.multislice.reducer.MULTISLICE_COMM_SPECS``,
+# ``CP_RING`` for the ring-CP attention tier) and the step-pipeline
+# pass contracts consume those exports — so a factory, its registering
+# subsystem, and the G003 trace-ownership check can never drift on a
+# name.
+ALLGATHER_MATMUL = "allgather_matmul"
+MATMUL_REDUCE_SCATTER = "matmul_reduce_scatter"
+CP_RING = "cp_ring"
+SLICE_REDUCE_SCATTER = "slice_reduce_scatter"
+DCN_ALLREDUCE = "dcn_allreduce"
+SLICE_ALL_GATHER = "slice_all_gather"
+# Minted by the flat multislice baseline (``reducer._bucket_specs``),
+# not by a factory here — the A/B arm C004 is meant to fire on.
+FLAT_ICI_ALLREDUCE = "flat_ici_allreduce"
+SPEC_NAMES = (ALLGATHER_MATMUL, MATMUL_REDUCE_SCATTER, CP_RING,
+              SLICE_REDUCE_SCATTER, DCN_ALLREDUCE, SLICE_ALL_GATHER,
+              FLAT_ICI_ALLREDUCE)
 
 # Per-direction, per-link ICI bandwidth (v5e 2D torus) and bf16 peak.
 ICI_GBPS = 45.0
@@ -147,7 +171,7 @@ def spec_for_allgather_matmul(b: int, s_local: int, k: int, m_local: int,
     chunk; each hop hides under one chunk x w_local matmul."""
     chunk_bytes = b * s_local * k * itemsize
     return CommSpec(
-        name="allgather_matmul", axis_size=n, hops=max(n - 1, 0),
+        name=ALLGATHER_MATMUL, axis_size=n, hops=max(n - 1, 0),
         bytes_per_hop=chunk_bytes,
         collective_bytes=max(n - 1, 0) * chunk_bytes,
         flops_per_hop=2 * b * s_local * k * m_local,
@@ -164,7 +188,7 @@ def spec_for_matmul_reduce_scatter(b: int, s_chunk: int, k_local: int,
     half_bytes = b * s_chunk * max(m // 2, 1) * itemsize
     hops = 2 * max(n - 1, 0) if m >= 2 else max(n - 1, 0)
     return CommSpec(
-        name="matmul_reduce_scatter", axis_size=n, hops=hops,
+        name=MATMUL_REDUCE_SCATTER, axis_size=n, hops=hops,
         bytes_per_hop=half_bytes,
         collective_bytes=max(n - 1, 0) * b * s_chunk * m * itemsize,
         flops_per_hop=2 * b * s_chunk * k_local * max(m // 2, 1),
@@ -180,7 +204,7 @@ def spec_for_cp_ring(b: int, s_local: int, heads: int, head_dim: int,
     KV all-gather a non-ring CP would issue — same per-rank volume."""
     kv_bytes = 2 * b * heads * s_local * head_dim * itemsize
     return CommSpec(
-        name="cp_ring", axis_size=n, hops=max(n - 1, 0),
+        name=CP_RING, axis_size=n, hops=max(n - 1, 0),
         bytes_per_hop=kv_bytes,
         collective_bytes=max(n - 1, 0) * kv_bytes,
         flops_per_hop=4 * b * heads * s_local * s_local * head_dim,
@@ -200,7 +224,7 @@ def spec_for_slice_reduce_scatter(bucket_bytes: int, ici_size: int,
     n = max(ici_size, 1)
     shard = -(-bucket_bytes // n)  # ceil: the padded shard
     return CommSpec(
-        name="slice_reduce_scatter", axis_size=n, hops=max(n - 1, 0),
+        name=SLICE_REDUCE_SCATTER, axis_size=n, hops=max(n - 1, 0),
         bytes_per_hop=shard, collective_bytes=max(n - 1, 0) * shard,
         flops_per_hop=0, directions=1, axis=axis, link=link_class(axis),
         reduced_from_bytes=bucket_bytes, ici_size=n,
@@ -217,7 +241,7 @@ def spec_for_dcn_allreduce(shard_bytes: int, dcn_size: int,
     bucket here and C004 fires."""
     n = max(dcn_size, 1)
     return CommSpec(
-        name="dcn_allreduce", axis_size=n, hops=2 * max(n - 1, 0),
+        name=DCN_ALLREDUCE, axis_size=n, hops=2 * max(n - 1, 0),
         bytes_per_hop=-(-shard_bytes // n) if n > 1 else shard_bytes,
         collective_bytes=2 * max(n - 1, 0) * (-(-shard_bytes // n)),
         flops_per_hop=0, directions=1, axis=axis, link=link_class(axis),
@@ -232,7 +256,7 @@ def spec_for_slice_all_gather(bucket_bytes: int, ici_size: int,
     n = max(ici_size, 1)
     shard = -(-bucket_bytes // n)
     return CommSpec(
-        name="slice_all_gather", axis_size=n, hops=max(n - 1, 0),
+        name=SLICE_ALL_GATHER, axis_size=n, hops=max(n - 1, 0),
         bytes_per_hop=shard, collective_bytes=max(n - 1, 0) * shard,
         flops_per_hop=0, directions=1, axis=axis, link=link_class(axis),
         reduced_from_bytes=bucket_bytes, ici_size=n,

@@ -1,0 +1,513 @@
+"""Pass-composition verifier (G-rules): tier composition as architecture.
+
+``framework/step_pipeline.py`` assembles ``sharded.TrainStep`` as an
+ordered list of graph-transform passes (base_grad -> remat ->
+sp_decompose -> zero_gather_ahead -> dp_buckets -> multislice_reduce ->
+offload_stream -> health_sentinel -> telemetry). Each pass declares a
+static :class:`PassContract` — the capability keys it requires/provides,
+the plan nodes and buffer classes it may introduce, the CommSpecs its
+transforms register, and the invariants it preserves — and emits its
+slice of ONE declared ``plan_check.StepPlan``.
+
+This module verifies the *composition itself*, before anything traces:
+
+- **G001** unsatisfied-requires: a pass is ordered before (or without)
+  the pass that provides a capability it requires;
+- **G002** contract-conflict: two passes write/donate the same buffer
+  class without a declared handoff — the composed donation lifetimes
+  are then accidental, not owned;
+- **G003** undeclared-plan-delta: a pass's emitted plan slice (checked
+  by diffing the plan before/after each ``plan_apply``) or the
+  CommSpecs recorded while the composed step traced exceed what its
+  contract declares;
+- **G004** order-sensitivity: an adjacent pass pair with NO declared
+  ordering edge (no require/provide dependency, no ``order_after``, no
+  handoff) whose swap changes the composed-plan hash — the pipeline
+  depends on an ordering nobody declared;
+- **G005** orphan-capability: a capability provided, never consumed by
+  a later pass, and not declared a terminal output.
+
+The S/D rules (``plan_check``) then verify the *composed* plan against
+the traced step; the G rules verify that the plan was composed legally
+in the first place. Wiring: ``TrainStep._maybe_lint`` (ahead of the
+S/D/X rules) and ``tools/lint_graph.py --passes`` / ``--matrix``.
+Rule catalog: ``analysis/RULES.md``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field, fields
+from typing import (Any, Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple)
+
+from .jaxpr_lint import Diagnostic, ERROR, WARNING, _SEV_ORDER, emit
+from .plan_check import PlanNode, StepPlan, _buf_base
+
+__all__ = [
+    "PassContract", "PlanDelta", "PassContext", "contract_hash",
+    "plan_fingerprint", "composed_plan_hash", "snapshot_plan", "diff_plan",
+    "check_passes", "check_traced_comm", "enforce_passes",
+    "register_pass_rule", "all_pass_rules",
+]
+
+
+# ---------------------------------------------------------------------------
+# The contract
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PassContract:
+    """Static declaration of one step-pipeline pass.
+
+    Buffer classes are plan-node buffer base names ("params", "moments");
+    capability keys are free-form strings matched between ``requires``
+    and ``provides``. A contract is pure data — hashing it (see
+    :func:`contract_hash`) is how CI diffs pipeline composition.
+    """
+
+    name: str
+    # capability keys this pass consumes / produces
+    requires: Tuple[str, ...] = ()
+    provides: Tuple[str, ...] = ()
+    # provided capabilities that are legitimate final outputs of the
+    # composition (exempt from G005 even when nothing consumes them)
+    terminal: Tuple[str, ...] = ()
+    # plan-node name prefixes this pass may add / mutate / remove
+    node_prefixes: Tuple[str, ...] = ()
+    node_updates: Tuple[str, ...] = ()
+    node_removals: Tuple[str, ...] = ()
+    # buffer classes the pass's added nodes (or added fields of updated
+    # nodes) may read / write / donate
+    plan_reads: Tuple[str, ...] = ()
+    plan_writes: Tuple[str, ...] = ()
+    plan_donates: Tuple[str, ...] = ()
+    # CommSpec names the pass's transforms may register at trace time
+    comm_specs: Tuple[str, ...] = ()
+    # invariants the pass preserves (documentation; part of the hash)
+    invariants: Tuple[str, ...] = ()
+    # declared buffer-class ownership handoffs: (buffer_class, from_pass)
+    # — this pass takes over that class from the named earlier pass,
+    # silencing G002 for the pair
+    handoffs: Tuple[Tuple[str, str], ...] = ()
+    # explicit ordering edges beyond requires/provides: names of passes
+    # this one must run after when both are active
+    order_after: Tuple[str, ...] = ()
+    # whether this pass may emit/replace the plan's gather-ahead slice
+    declares_gather: bool = False
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, tuple):
+                v = [list(e) if isinstance(e, tuple) else e for e in v]
+            out[f.name] = v
+        return out
+
+
+def contract_hash(contract: PassContract) -> str:
+    """Stable 16-hex digest of one contract (CI diffs these per PR)."""
+    payload = json.dumps(contract.to_json(), sort_keys=True)
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------------
+# Plan fingerprinting + per-pass deltas
+# ---------------------------------------------------------------------------
+
+def plan_fingerprint(plan: StepPlan) -> Dict[str, Any]:
+    """Canonical, order-sensitive digest input of one composed plan:
+    node sequence with full read/write/donate sets, the gather slice,
+    flags, and the mesh. Deliberately EXCLUDES the pass list itself so
+    two orderings hash equal iff their plan slices commute (G004)."""
+    gather = None
+    if plan.gather is not None:
+        gather = {
+            "depth": int(plan.gather.depth),
+            "anchored": [bool(a) for a in plan.gather.anchored],
+            "edges": [list(e) for e in plan.gather.edges],
+            "params": {n: str(s)
+                       for n, s in sorted(plan.gather.params.items())},
+        }
+    return {
+        "flags": {k: (v if isinstance(v, (int, float, str, bool))
+                      else str(v)) for k, v in plan.flags.items()},
+        "mesh_axes": dict(plan.mesh_axes),
+        "fsdp_axis": plan.fsdp_axis,
+        "params": sorted(plan.params),
+        "nodes": [[n.name, list(n.reads), list(n.writes), list(n.donates)]
+                  for n in plan.nodes],
+        "gather": gather,
+    }
+
+
+def composed_plan_hash(plan: StepPlan) -> str:
+    """sha256 over the canonical plan fingerprint — deterministic across
+    process restarts (no ids, no dict-order dependence) and the key the
+    matrix trace cache / CI composition diff use."""
+    payload = json.dumps(plan_fingerprint(plan), sort_keys=True)
+    return hashlib.sha256(payload.encode("utf-8")).hexdigest()
+
+
+def snapshot_plan(plan: StepPlan) -> Dict[str, Any]:
+    """Cheap structural snapshot taken before each pass's plan_apply."""
+    return {
+        "nodes": {n.name: (tuple(n.reads), tuple(n.writes),
+                           tuple(n.donates)) for n in plan.nodes},
+        "order": [n.name for n in plan.nodes],
+        "gather": plan.gather,
+    }
+
+
+@dataclass
+class PlanDelta:
+    """What one pass's ``plan_apply`` actually did to the shared plan."""
+
+    contract: PassContract
+    added: List[PlanNode] = field(default_factory=list)
+    removed: List[str] = field(default_factory=list)
+    # name -> (added_reads, added_writes, added_donates)
+    updated: Dict[str, Tuple[Tuple[str, ...], Tuple[str, ...],
+                             Tuple[str, ...]]] = field(default_factory=dict)
+    gather_changed: bool = False
+
+
+def diff_plan(before: Dict[str, Any], plan: StepPlan,
+              contract: PassContract) -> PlanDelta:
+    """Structural diff of the plan across one pass (G003's evidence)."""
+    delta = PlanDelta(contract=contract)
+    after = {n.name: n for n in plan.nodes}
+    for node in plan.nodes:
+        prev = before["nodes"].get(node.name)
+        if prev is None:
+            delta.added.append(node)
+            continue
+        adds = tuple(
+            tuple(x for x in cur if x not in old)
+            for cur, old in ((node.reads, prev[0]), (node.writes, prev[1]),
+                             (node.donates, prev[2])))
+        if any(adds):
+            delta.updated[node.name] = adds
+    for name in before["order"]:
+        if name not in after:
+            delta.removed.append(name)
+    delta.gather_changed = plan.gather is not before["gather"]
+    return delta
+
+
+# ---------------------------------------------------------------------------
+# Rule registry (G family)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PassContext:
+    """Everything the G rules see: the ordered ACTIVE contracts, the
+    per-pass plan deltas (None when only the static contracts are being
+    checked), and a plan-only rebuild callback order -> composed-plan
+    hash (None disables G004)."""
+
+    contracts: List[PassContract]
+    deltas: Optional[List[PlanDelta]] = None
+    rebuild: Optional[Callable[[Tuple[str, ...]], str]] = None
+    base_hash: Optional[str] = None
+
+
+@dataclass
+class _PassRule:
+    rule_id: str
+    name: str
+    severity: str
+    doc: str
+    fn: Callable[[PassContext], Iterable[Diagnostic]]
+
+
+_PASS_RULES: Dict[str, _PassRule] = {}
+
+
+def register_pass_rule(rule_id: str, name: str, severity: str, doc: str):
+    def wrap(fn):
+        _PASS_RULES[rule_id] = _PassRule(rule_id, name, severity, doc, fn)
+        return fn
+
+    return wrap
+
+
+def all_pass_rules() -> List[_PassRule]:
+    return [_PASS_RULES[k] for k in sorted(_PASS_RULES)]
+
+
+def _diag(rule: _PassRule, message: str, hint: str = "") -> Diagnostic:
+    return Diagnostic(rule=rule.rule_id, name=rule.name,
+                      severity=rule.severity, message=message, hint=hint)
+
+
+def _declared_edge(a: PassContract, b: PassContract) -> bool:
+    """True when the relative order of adjacent passes a (earlier) and b
+    (later) is DECLARED: a provides something b requires, b names a in
+    order_after, or either declares a buffer handoff from the other."""
+    if set(a.provides) & set(b.requires):
+        return True
+    if a.name in b.order_after:
+        return True
+    if any(src == a.name for _, src in b.handoffs):
+        return True
+    if any(src == b.name for _, src in a.handoffs):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# G-rules
+# ---------------------------------------------------------------------------
+
+@register_pass_rule(
+    "G001", "unsatisfied-requires", ERROR,
+    "a pass requires a capability no earlier active pass provides — it "
+    "is ordered before its provider, or the provider is not in the "
+    "composition at all")
+def _rule_unsatisfied_requires(ctx: PassContext):
+    rule = _PASS_RULES["G001"]
+    provided: set = set()
+    for c in ctx.contracts:
+        for cap in c.requires:
+            if cap not in provided:
+                providers = [o.name for o in ctx.contracts
+                             if cap in o.provides]
+                yield _diag(
+                    rule,
+                    f"pass {c.name!r} requires capability {cap!r} which "
+                    "no earlier active pass provides"
+                    + (f" (provider {providers[0]!r} is ordered after it)"
+                       if providers else
+                       " (no active pass provides it)"),
+                    hint="reorder the pipeline so the provider runs "
+                         "first, or activate the providing pass")
+        provided.update(c.provides)
+
+
+@register_pass_rule(
+    "G002", "contract-conflict", ERROR,
+    "two passes declare writes/donates of the same buffer class without "
+    "a declared handoff — the composed donation lifetimes are "
+    "accidental, not owned by exactly one pass")
+def _rule_contract_conflict(ctx: PassContext):
+    rule = _PASS_RULES["G002"]
+    for i, a in enumerate(ctx.contracts):
+        a_classes = {_buf_base(x) for x in a.plan_writes + a.plan_donates}
+        for b in ctx.contracts[i + 1:]:
+            b_classes = {_buf_base(x)
+                         for x in b.plan_writes + b.plan_donates}
+            for cls in sorted(a_classes & b_classes):
+                handed = ((cls, a.name) in b.handoffs
+                          or (cls, b.name) in a.handoffs)
+                if not handed:
+                    yield _diag(
+                        rule,
+                        f"passes {a.name!r} and {b.name!r} both declare "
+                        f"writes/donates of buffer class {cls!r} with no "
+                        "declared handoff between them",
+                        hint="declare the takeover in the later pass's "
+                             "contract: handoffs=((buffer_class, "
+                             "from_pass),)")
+
+
+@register_pass_rule(
+    "G003", "undeclared-plan-delta", ERROR,
+    "a pass's emitted plan slice (added/removed/updated nodes, buffer "
+    "classes, the gather slice) or its traced CommSpecs exceed what its "
+    "contract declares — found by diffing the plan before/after each "
+    "pass")
+def _rule_undeclared_plan_delta(ctx: PassContext):
+    rule = _PASS_RULES["G003"]
+    if ctx.deltas is None:
+        return
+    for delta in ctx.deltas:
+        c = delta.contract
+        for node in delta.added:
+            if not any(node.name.startswith(p) for p in c.node_prefixes):
+                yield _diag(
+                    rule,
+                    f"pass {c.name!r} added plan node {node.name!r} "
+                    f"outside its declared prefixes {list(c.node_prefixes)}",
+                    hint="declare the node prefix in the pass contract")
+                continue
+            for kind, have, declared in (
+                    ("reads", node.reads, c.plan_reads),
+                    ("writes", node.writes, c.plan_writes),
+                    ("donates", node.donates, c.plan_donates)):
+                allowed = {_buf_base(x) for x in declared}
+                extra = sorted({_buf_base(x) for x in have} - allowed)
+                if extra:
+                    yield _diag(
+                        rule,
+                        f"pass {c.name!r} node {node.name!r} {kind} "
+                        f"undeclared buffer class(es) {extra}",
+                        hint=f"declare them in the contract's plan_{kind}")
+        for name in delta.removed:
+            if not any(name.startswith(p) for p in c.node_removals):
+                yield _diag(
+                    rule,
+                    f"pass {c.name!r} removed plan node {name!r} its "
+                    "contract does not declare removable",
+                    hint="declare the node prefix in node_removals")
+        for name, adds in delta.updated.items():
+            if not any(name.startswith(p) for p in c.node_updates):
+                yield _diag(
+                    rule,
+                    f"pass {c.name!r} mutated plan node {name!r} its "
+                    "contract does not declare updatable",
+                    hint="declare the node prefix in node_updates")
+                continue
+            for kind, have, declared in (
+                    ("reads", adds[0], c.plan_reads),
+                    ("writes", adds[1], c.plan_writes),
+                    ("donates", adds[2], c.plan_donates)):
+                allowed = {_buf_base(x) for x in declared}
+                extra = sorted({_buf_base(x) for x in have} - allowed)
+                if extra:
+                    yield _diag(
+                        rule,
+                        f"pass {c.name!r} added {kind} of undeclared "
+                        f"buffer class(es) {extra} to node {name!r}",
+                        hint=f"declare them in the contract's plan_{kind}")
+        if delta.gather_changed and not c.declares_gather:
+            yield _diag(
+                rule,
+                f"pass {c.name!r} replaced the plan's gather-ahead slice "
+                "without declaring it (declares_gather=False)",
+                hint="set declares_gather=True in the pass contract")
+
+
+@register_pass_rule(
+    "G004", "order-sensitivity", ERROR,
+    "an adjacent pass pair with no declared ordering edge whose swap "
+    "changes the composed-plan hash — the pipeline silently depends on "
+    "an ordering nobody declared")
+def _rule_order_sensitivity(ctx: PassContext):
+    rule = _PASS_RULES["G004"]
+    if ctx.rebuild is None or ctx.base_hash is None:
+        return
+    names = [c.name for c in ctx.contracts]
+    for i in range(len(ctx.contracts) - 1):
+        a, b = ctx.contracts[i], ctx.contracts[i + 1]
+        if _declared_edge(a, b):
+            continue
+        swapped = list(names)
+        swapped[i], swapped[i + 1] = swapped[i + 1], swapped[i]
+        try:
+            h = ctx.rebuild(tuple(swapped))
+        except Exception as e:
+            yield _diag(
+                rule,
+                f"swapping adjacent passes {a.name!r} and {b.name!r} "
+                f"(no declared ordering edge) fails to compose: "
+                f"{type(e).__name__}: {e}",
+                hint="declare the ordering edge (order_after / "
+                     "requires+provides / handoff) or make the passes "
+                     "genuinely commutative")
+            continue
+        if h != ctx.base_hash:
+            yield _diag(
+                rule,
+                f"swapping adjacent passes {a.name!r} and {b.name!r} "
+                "changes the composed-plan hash but no ordering edge "
+                "between them is declared",
+                hint="declare order_after (or a require/provide edge or "
+                     "a handoff) on the later pass")
+
+
+@register_pass_rule(
+    "G005", "orphan-capability", WARNING,
+    "a capability is provided, never consumed by any later pass, and "
+    "not declared a terminal output — dead pipeline surface or a "
+    "mis-spelled capability key")
+def _rule_orphan_capability(ctx: PassContext):
+    rule = _PASS_RULES["G005"]
+    for i, c in enumerate(ctx.contracts):
+        later_requires: set = set()
+        for o in ctx.contracts[i + 1:]:
+            later_requires.update(o.requires)
+        for cap in c.provides:
+            if cap in later_requires or cap in c.terminal:
+                continue
+            yield _diag(
+                rule,
+                f"pass {c.name!r} provides capability {cap!r} which no "
+                "later active pass consumes and which is not declared "
+                "terminal",
+                hint="mark it terminal in the contract or drop it")
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+def check_passes(contracts: Sequence[PassContract],
+                 deltas: Optional[Sequence[PlanDelta]] = None,
+                 rebuild: Optional[Callable[[Tuple[str, ...]], str]] = None,
+                 base_hash: Optional[str] = None,
+                 rules: Optional[Sequence[str]] = None,
+                 where: str = "") -> List[Diagnostic]:
+    """Run the G rules over one ordered active-pass composition.
+    Returns diagnostics sorted most-severe first; does not emit."""
+    ctx = PassContext(list(contracts),
+                      deltas=list(deltas) if deltas is not None else None,
+                      rebuild=rebuild, base_hash=base_hash)
+    selected = all_pass_rules() if rules is None else \
+        [_PASS_RULES[r] for r in rules if r in _PASS_RULES]
+    out: List[Diagnostic] = []
+    for rule in selected:
+        try:
+            out.extend(rule.fn(ctx) or ())
+        except Exception as e:  # a broken rule must not kill construction
+            out.append(Diagnostic(
+                rule=rule.rule_id, name=rule.name, severity="info",
+                message=f"rule crashed: {type(e).__name__}: {e}"))
+    for d in out:
+        if where and not d.where:
+            d.where = where
+    out.sort(key=lambda d: -_SEV_ORDER.get(d.severity, 0))
+    return out
+
+
+def check_traced_comm(contracts: Sequence[PassContract],
+                      comm_specs: Sequence[Tuple[str, Any]],
+                      ambient: Iterable[str] = (),
+                      where: str = "") -> List[Diagnostic]:
+    """G003 at trace level: every CommSpec recorded while the composed
+    step traced must be declared by some active pass's contract (or be
+    an ``ambient`` name owned by a model-level tier, e.g. the ring-CP
+    attention that lives inside the loss function, not the pipeline)."""
+    rule = _PASS_RULES["G003"]
+    declared: set = set(ambient)
+    for c in contracts:
+        declared.update(c.comm_specs)
+    out: List[Diagnostic] = []
+    seen: set = set()
+    for rec_where, spec in comm_specs:
+        name = getattr(spec, "name", str(spec))
+        if name in declared or name in seen:
+            continue
+        seen.add(name)
+        out.append(_diag(
+            rule,
+            f"CommSpec {name!r} recorded at {rec_where} is declared by "
+            "no active pass contract — the traced communication exceeds "
+            "the composed contracts",
+            hint="declare the spec name in the owning pass's "
+                 "contract.comm_specs"))
+    for d in out:
+        if where and not d.where:
+            d.where = where
+    return out
+
+
+def enforce_passes(contracts: Sequence[PassContract], **kw) -> List[Diagnostic]:
+    """check_passes + route through ``FLAGS_static_analysis``."""
+    where = kw.pop("where", "pass_check")
+    diags = check_passes(contracts, where=where, **kw)
+    if diags:
+        emit(diags, where=where)
+    return diags

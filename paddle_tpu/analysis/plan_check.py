@@ -46,7 +46,7 @@ __all__ = [
     "ParamInfo", "PlanNode", "GatherPlan", "StepPlan", "JaxprFacts",
     "collect_jaxpr_facts", "check_plan", "check_capacity", "enforce",
     "register_plan_rule", "all_plan_rules", "TIER_FLAGS",
-    "iter_tier_combos",
+    "iter_tier_combos", "normalize_combo",
 ]
 
 
@@ -655,3 +655,35 @@ def iter_tier_combos() -> Iterable[Dict[str, Any]]:
     names = [n for n, _ in TIER_FLAGS]
     for values in itertools.product(*(v for _, v in TIER_FLAGS)):
         yield dict(zip(names, values))
+
+
+_legacy_combo_warned = False
+
+
+def normalize_combo(combo: Dict[str, Any]) -> Dict[str, Any]:
+    """The ONE entry point every combo-dict consumer normalizes through
+    (the matrix runner, the pass pipeline's plan-only builds, tests).
+
+    Historically combos were 5-flag dicts (pre-multislice) and every
+    consumer silently ``.get()``-defaulted the missing keys — a typo'd
+    key or a stale caller then tested a different composition than it
+    named. Now: unknown keys raise, missing keys fill with each tier's
+    first (default) value with a once-per-process warning on the legacy
+    shape, and the result always carries every ``TIER_FLAGS`` key in
+    registry order."""
+    global _legacy_combo_warned
+    defaults = {n: vals[0] for n, vals in TIER_FLAGS}
+    unknown = sorted(set(combo) - set(defaults))
+    if unknown:
+        raise ValueError(
+            f"unknown tier-flag key(s) {unknown} in combo {combo!r}; "
+            f"valid keys: {sorted(defaults)}")
+    missing = [n for n in defaults if n not in combo]
+    if missing and not _legacy_combo_warned:
+        _legacy_combo_warned = True
+        import warnings
+        warnings.warn(
+            f"legacy tier-flag combo dict missing {missing} "
+            f"(pre-multislice 5-flag shape?); defaults filled — pass "
+            f"every TIER_FLAGS key explicitly", stacklevel=2)
+    return {n: combo.get(n, d) for n, d in defaults.items()}

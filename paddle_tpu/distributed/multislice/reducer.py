@@ -39,11 +39,21 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ...analysis.comm_check import (DCN_ALLREDUCE, FLAT_ICI_ALLREDUCE,
+                                    SLICE_ALL_GATHER, SLICE_REDUCE_SCATTER)
 from ...core.flags import flag
 from ..overlap import BucketedGradReducer
 from .topology import SLICE_AXIS
 
-__all__ = ["HierarchicalGradReducer"]
+__all__ = ["HierarchicalGradReducer", "MULTISLICE_COMM_SPECS"]
+
+# The CommSpec names one reduction pass of this module may register —
+# the three hierarchical stages plus the flat A/B baseline (canonical
+# values in ``analysis.comm_check``). The step pipeline's
+# ``multislice_reduce`` pass contract consumes this tuple, so the
+# trace-level G003 ownership check follows these stages by construction.
+MULTISLICE_COMM_SPECS = (SLICE_REDUCE_SCATTER, DCN_ALLREDUCE,
+                         SLICE_ALL_GATHER, FLAT_ICI_ALLREDUCE)
 
 
 class HierarchicalGradReducer(BucketedGradReducer):
@@ -86,7 +96,7 @@ class HierarchicalGradReducer(BucketedGradReducer):
         shard = -(-nbytes // max(ici_size, 1))
         return [
             comm_check.CommSpec(
-                name="flat_ici_allreduce", axis_size=ici_size,
+                name=FLAT_ICI_ALLREDUCE, axis_size=ici_size,
                 hops=2 * max(ici_size - 1, 0), bytes_per_hop=shard,
                 collective_bytes=2 * max(ici_size - 1, 0) * shard,
                 flops_per_hop=0, directions=1, axis=self.axis,

@@ -74,6 +74,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..analysis.comm_check import ALLGATHER_MATMUL, MATMUL_REDUCE_SCATTER
 from ..core.flags import flag
 
 __all__ = [
@@ -83,9 +84,16 @@ __all__ = [
     "pick_chunks", "tune_overlap_chunks",
     "spec_without_axis", "zero_gather_ahead", "gather_ahead_plan",
     "BucketedGradReducer", "MP_AXIS", "GATHER_AHEAD_DEPTH",
+    "SP_COMM_SPECS",
 ]
 
 MP_AXIS = "mp"
+
+# The CommSpec names this module's decomposed SP/TP pipelines register
+# (canonical values in ``analysis.comm_check``) — the step pipeline's
+# ``sp_decompose`` pass contract consumes this tuple, so the trace-level
+# G003 ownership check follows these call sites by construction.
+SP_COMM_SPECS = (ALLGATHER_MATMUL, MATMUL_REDUCE_SCATTER)
 
 # How many blocks of fsdp-sharded params may have their all-gather issued
 # ahead of the block currently computing (the prefetch window of the
@@ -267,9 +275,9 @@ def tune_overlap_chunks(op: str, x, w, b=None, mesh=None,
     from ..ops._pallas.autotune import get_cache
     mesh = _mesh_or_hybrid(mesh)
     n = mesh.shape[axis]
-    fn = {"allgather_matmul": allgather_matmul,
-          "matmul_reduce_scatter": matmul_reduce_scatter}[op]
-    s_local = (x.shape[1] // n) if op == "allgather_matmul" \
+    fn = {ALLGATHER_MATMUL: allgather_matmul,
+          MATMUL_REDUCE_SCATTER: matmul_reduce_scatter}[op]
+    s_local = (x.shape[1] // n) if op == ALLGATHER_MATMUL \
         else (x.shape[1] // n)
     best_c, best_ms = 1, float("inf")
     for c in candidates:

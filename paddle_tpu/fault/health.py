@@ -51,7 +51,8 @@ __all__ = ["StepSentinel", "Verdict", "HangWatchdog", "SdcCanary",
            "CanaryVerdict", "BatchCursor", "fused_stats", "fused_ok",
            "check_numerics", "flip_one_bit", "sentinel_on",
            "check_health_plan", "check_canary", "HANG_EXIT_CODE",
-           "SENTINEL_KINDS", "ANOMALY_KINDS"]
+           "SENTINEL_KINDS", "ANOMALY_KINDS",
+           "SENTINEL_STATS_BUFFER", "SENTINEL_CAPABILITIES"]
 
 # Distinct from the preemption exit (101) and the auto-parallel re-tune
 # exit (102): the elastic manager relaunches on it (budgeted), and the
@@ -62,6 +63,14 @@ HANG_EXIT_CODE = 103
 SENTINEL_KINDS = ("nan_loss", "nan_grad", "loss_spike", "grad_explosion")
 # ...plus the out-of-band detectors (canary / watchdog).
 ANOMALY_KINDS = SENTINEL_KINDS + ("sdc", "hang")
+
+# The plan buffer class the fused sentinel writes (the ``[loss, gnorm,
+# ok]`` vector ``sentinel_verdict`` classifies) and the capability keys
+# the sentinel tier provides — consumed by the step pipeline's
+# ``health_sentinel`` pass contract, so the composed StepPlan and the
+# G-rule capability graph name this tier with the sentinel's own terms.
+SENTINEL_STATS_BUFFER = "stats"
+SENTINEL_CAPABILITIES = (SENTINEL_STATS_BUFFER, "update_gate")
 
 
 def sentinel_on() -> bool:

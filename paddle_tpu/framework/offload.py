@@ -121,6 +121,39 @@ def group_by_block(names: Sequence[str]) -> List[Tuple[Tuple[str, int],
     return [(k, groups[k]) for k in sorted(groups)]
 
 
+def plan_nodes_for(param_names: Sequence[str], clip: bool = False):
+    """The streaming update's dispatch sequence as declared
+    :class:`~paddle_tpu.analysis.plan_check.PlanNode`\\ s, from the
+    parameter name set alone — the step-pipeline's offload pass emits
+    these in plan-only composition, and the live
+    :meth:`StreamingUpdate.plan_nodes` delegates here. Per block: H2D
+    moment prefetch, the donating block update (params/grads/in-flight
+    moments), D2H write-back donating the fresh device moments — the
+    shape the step-plan verifier's donation-lifetime walk (D001/D002)
+    checks."""
+    from ..analysis.plan_check import PlanNode
+    nodes = []
+    if clip:
+        nodes.append(PlanNode("offload.clip", reads=("grads",),
+                              writes=("grads",)))
+    groups = group_by_block(list(param_names))
+    for i in range(len(groups)):
+        nodes.append(PlanNode(
+            f"offload.prefetch[{i}]",
+            reads=(f"host_moments[{i}]",),
+            writes=(f"moments[{i}]",)))
+        nodes.append(PlanNode(
+            f"offload.update[{i}]",
+            reads=("opt_scalars",),
+            donates=(f"params[{i}]", f"grads[{i}]", f"moments[{i}]"),
+            writes=(f"params[{i}]", f"moments[{i}]")))
+        nodes.append(PlanNode(
+            f"offload.writeback[{i}]",
+            donates=(f"moments[{i}]",),
+            writes=(f"host_moments[{i}]",)))
+    return nodes
+
+
 # ---------------------------------------------------------------------------
 # Capacity plan
 # ---------------------------------------------------------------------------
@@ -280,31 +313,10 @@ class StreamingUpdate:
     def plan_nodes(self, param_names: Sequence[str]):
         """The streaming update's dispatch sequence as declared
         :class:`~paddle_tpu.analysis.plan_check.PlanNode`\\ s, for the
-        step-plan verifier's donation-lifetime walk (rules D001/D002):
-        per block — H2D moment prefetch, the donating block update
-        (params/grads/in-flight moments), D2H write-back donating the
-        fresh device moments. Mirrors :meth:`update` exactly."""
-        from ..analysis.plan_check import PlanNode
-        nodes = []
-        if self._clip_fn is not None:
-            nodes.append(PlanNode("offload.clip", reads=("grads",),
-                                  writes=("grads",)))
-        groups = group_by_block(list(param_names))
-        for i in range(len(groups)):
-            nodes.append(PlanNode(
-                f"offload.prefetch[{i}]",
-                reads=(f"host_moments[{i}]",),
-                writes=(f"moments[{i}]",)))
-            nodes.append(PlanNode(
-                f"offload.update[{i}]",
-                reads=("opt_scalars",),
-                donates=(f"params[{i}]", f"grads[{i}]", f"moments[{i}]"),
-                writes=(f"params[{i}]", f"moments[{i}]")))
-            nodes.append(PlanNode(
-                f"offload.writeback[{i}]",
-                donates=(f"moments[{i}]",),
-                writes=(f"host_moments[{i}]",)))
-        return nodes
+        step-plan verifier's donation-lifetime walk (rules D001/D002).
+        Mirrors :meth:`update` exactly."""
+        return plan_nodes_for(param_names,
+                              clip=self._clip_fn is not None)
 
     # -- the streaming loop -------------------------------------------------
 

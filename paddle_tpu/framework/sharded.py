@@ -170,22 +170,6 @@ class TrainStep:
         self._multislice = self._resolve_multislice(mesh)
         if self._multislice is not None and "slice" not in self.data_axes:
             self.data_axes = ("slice",) + tuple(self.data_axes)
-        # FLAGS_comm_overlap=tp_zero|all: ZeRO-3 gather-ahead — per-block
-        # param all-gathers issued ahead of the consuming block's compute
-        # (distributed/overlap.zero_gather_ahead), instead of GSPMD's
-        # gather-at-first-use. Decided at construction like the offload
-        # tier; off leaves the step graph byte-identical.
-        from ..distributed import overlap as _overlap
-        self._gather_specs = None
-        if (_overlap.zero_enabled() and fsdp_axis is not None
-                and fsdp_axis in mesh.axis_names
-                and mesh.shape[fsdp_axis] > 1):
-            gspecs = {n: _overlap.spec_without_axis(specs[n], fsdp_axis)
-                      for n in params}
-            gspecs = {n: s for n, s in gspecs.items() if s != specs[n]}
-            if gspecs:
-                self._gather_specs = gspecs
-
         def _place(v, sh):
             out = jax.device_put(v, sh)
             if out is v:
@@ -205,194 +189,58 @@ class TrainStep:
             lambda v, s: jax.device_put(v, s), self.opt_state, ssh,
             is_leaf=lambda x: isinstance(x, jax.Array))
         self._state_shardings = ssh
-        # FLAGS_offload_optimizer=moments: moments move to the host tier
-        # (same partitioning, host memory kind) and the update streams them
-        # through HBM per block — the compiled step below then carries
-        # grads, not the optimizer update (framework/offload.py).
-        from . import offload as _offload
-        self._offload = None
-        if (_offload.offload_mode() == "moments"
-                and optimizer.offloadable_state_keys()
-                and _offload.host_memory_kind() is not None):
-            self._offload = _offload.StreamingUpdate(optimizer)
-            self.opt_state = self._offload.place(self.opt_state)
-        # FLAGS_health_sentinel=on: fuse the training-health anomaly
-        # check into the compiled step (fault/health.py) — one
-        # [loss, grad-global-norm] reduction, the update gated in-graph
-        # on finiteness + host-fed rolling-median thresholds. Off leaves
-        # the step byte-identical. The verdict/recovery side is host
-        # bookkeeping (StepSentinel / fault.Guardian).
-        from ..fault import health as _health
-        self._sentinel = None
-        self.last_stats = None
-        if _health.sentinel_on():
-            if self._offload is not None:
-                raise ValueError(
-                    "FLAGS_health_sentinel does not compose with "
-                    "FLAGS_offload_optimizer=moments yet: the streamed "
-                    "update cannot be gated in-graph — use the "
-                    "FLAGS_check_nan_inf scans for detection there")
-            self._sentinel = _health.StepSentinel()
-        repl = NamedSharding(mesh, P())
 
-        model_obj, lf = model, loss_fn
         # 4-arg loss_fn = buffer-threading mode: loss_fn(model, params,
         # buffers, batch) -> (loss, new_buffers). BatchNorm-style running
         # stats flow through the compiled step as explicit state.
         import inspect
         n_args = len(inspect.signature(loss_fn).parameters)
         self._threads_buffers = n_args >= 4
-        from ..core.random import rng_scope
 
-        def plain_grads(params, buffers, batch, key):
-            def loss_of(p):
-                # Gather-ahead INSIDE the differentiated fn: the
-                # constraint transpose re-scatters the cotangents, so
-                # grads arrive fsdp-sharded and the update runs on
-                # shards (ZeRO-3 fwd gather / bwd reduce-scatter).
-                if self._gather_specs is not None:
-                    p = _overlap.zero_gather_ahead(
-                        p, self._gather_specs, mesh)
-                with rng_scope(key):
-                    if self._threads_buffers:
-                        return lf(model_obj, p, buffers, batch)
-                    return lf(model_obj, p, batch), buffers
-
-            (loss, new_buffers), grads = jax.value_and_grad(
-                loss_of, has_aux=True)(params)
-            return loss, grads, new_buffers
-
-        def multislice_grads(params, buffers, batch, key):
-            # The multi-slice grad path: per-device local loss/grads in a
-            # shard_map over the data axes, grads reduced by the declared
-            # 2-tier reducer (FLAGS_multislice=flat keeps the naive
-            # full-bucket-over-DCN plan as the A/B arm; both modes are
-            # bitwise-identical in values). Params are replicated over the
-            # manual {slice, dp} axes — fsdp/gather-ahead do not compose
-            # here (gated in _resolve_multislice).
-            mode, manual, reducer, world = self._multislice
-
-            def local_fn(p, bufs, b, k):
-                def loss_of(pp):
-                    with rng_scope(k):
-                        if self._threads_buffers:
-                            return lf(model_obj, pp, bufs, b)
-                        return lf(model_obj, pp, b), bufs
-
-                (loss, newb), grads = jax.value_and_grad(
-                    loss_of, has_aux=True)(p)
-                grads = reducer.reduce_in_axes(grads, mode=mode)
-                grads = jax.tree_util.tree_map(
-                    lambda g: g * jnp.asarray(1.0 / world, g.dtype), grads)
-                loss = lax.psum(loss, manual) * jnp.asarray(
-                    1.0 / world, loss.dtype)
-                if self._threads_buffers:
-                    newb = jax.tree_util.tree_map(
-                        lambda x: lax.psum(x, manual) * jnp.asarray(
-                            1.0 / world, x.dtype), newb)
-                return loss, grads, newb
-
-            data_spec = tuple(a for a in self.data_axes
-                              if a in mesh.axis_names
-                              and mesh.shape[a] > 1 and a in manual)
-            repl_tree = lambda tree: jax.tree_util.tree_map(  # noqa: E731
-                lambda _: P(), tree)
-            batch_specs = jax.tree_util.tree_map(
-                lambda x: P(data_spec if len(data_spec) > 1
-                            else (data_spec[0] if data_spec else None),
-                            *([None] * (jnp.ndim(x) - 1))), batch)
-            fn = _overlap.shard_map_compat(
-                local_fn, mesh,
-                (repl_tree(params), repl_tree(buffers), batch_specs, P()),
-                (P(), repl_tree(params), repl_tree(buffers)),
-                manual)
-            return fn(params, buffers, batch, key)
-
-        compute_grads = (multislice_grads if self._multislice is not None
-                         else plain_grads)
-
-        def step(params, opt_state, buffers, batch, lr, key):
-            loss, grads, new_buffers = compute_grads(params, buffers,
-                                                     batch, key)
-            # FLAGS_check_nan_inf (ref nan_inf_utils.h:38); moment/
-            # variance corruption hides in optimizer state long after
-            # the offending grad step — scan new_state too
-            _health.check_numerics(loss=loss, grads=grads,
-                                   where="train_step")
-            new_params, new_state = optimizer.apply_gradients(
-                params, grads, opt_state, lr)
-            _health.check_numerics(opt_state=new_state, where="train_step")
-            return loss, new_params, new_state, new_buffers
-
-        def sentinel_step(params, opt_state, buffers, batch, lr, key,
-                          guard):
-            loss, grads, new_buffers = compute_grads(params, buffers,
-                                                     batch, key)
-            _health.check_numerics(loss=loss, grads=grads,
-                                   where="train_step")
-            stats = _health.fused_stats(loss, grads)
-            ok = _health.fused_ok(stats, guard)
-            new_params, new_state = optimizer.apply_gradients(
-                params, grads, opt_state, lr)
-            _health.check_numerics(opt_state=new_state, where="train_step")
-            # gate the whole update in-graph: an anomalous step can never
-            # poison params/opt-state/buffers (the jnp.where select is
-            # the sentinel's only non-reduction cost)
-            keep = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
-            new_params = jax.tree_util.tree_map(keep, new_params, params)
-            new_state = jax.tree_util.tree_map(keep, new_state, opt_state)
-            new_buffers = jax.tree_util.tree_map(keep, new_buffers,
-                                                 buffers)
-            stats = jnp.concatenate(
-                [stats, ok.astype(jnp.float32)[None]])
-            return loss, stats, new_params, new_state, new_buffers
-
-        def grad_step(params, buffers, batch, key):
-            loss, grads, new_buffers = compute_grads(params, buffers,
-                                                     batch, key)
-            _health.check_numerics(loss=loss, grads=grads,
-                                   where="train_step")
-            return loss, grads, new_buffers
-
+        # The step is COMPOSED, not spliced: framework/step_pipeline.py
+        # resolves the live tier flags (offload streaming, ZeRO
+        # gather-ahead, decomposed SP, multislice reduction, remat, the
+        # health sentinel, telemetry) into an ordered list of contract-
+        # bearing passes, each emitting its slice of ONE declared StepPlan
+        # and its live graph transform; analysis/pass_check.py's G-rules
+        # verify the composition before anything traces.
+        from . import step_pipeline as _pipeline
+        build = _pipeline.build_for_train_step(
+            model, optimizer, loss_fn, mesh, self.data_axes, donate,
+            self.params, specs, self.pshardings, ssh, self.buffers,
+            self.opt_state, self._fsdp_axis, self._multislice,
+            self._threads_buffers)
+        _pipeline.compose(build)
+        self._gather_specs = build.gather_specs
+        self._offload = build.offload
+        self._sentinel = build.sentinel
+        self.last_stats = None
+        self.opt_state = build.opt_state
         # the SDC canary re-executes exactly this (nothing donated, no
         # state mutated) — see canary_step()
-        self._compute_grads = compute_grads
+        self._compute_grads = build.compute_grads
         self._canary_jit = None
-
-        if self._offload is not None:
-            # Params are NOT donated here — the streaming update consumes
-            # and donates them per block right after.
-            self._compiled = jax.jit(
-                grad_step,
-                in_shardings=(self.pshardings, None, None, None),
-                out_shardings=(repl, self.pshardings, None))
-            self._step_fn = grad_step
-        elif self._sentinel is not None:
-            self._compiled = jax.jit(
-                sentinel_step,
-                in_shardings=(self.pshardings, ssh, None, None, repl, None,
-                              repl),
-                out_shardings=(repl, repl, self.pshardings, ssh, None),
-                donate_argnums=(0, 1) if donate else ())
-            self._step_fn = sentinel_step
-        else:
-            self._compiled = jax.jit(
-                step,
-                in_shardings=(self.pshardings, ssh, None, None, repl, None),
-                out_shardings=(repl, self.pshardings, ssh, None),
-                # Buffers are NOT donated: TrainStep.buffers initially
-                # aliases the Layer tree's arrays; donating would delete
-                # them under the model.
-                donate_argnums=(0, 1) if donate else ())
-            self._step_fn = step
+        self._compiled = build.compiled
+        self._step_fn = build.step_fn
+        self._step_kind = build.step_kind
         self._donate = donate
         self._linted = False
         self._step_count = 0
         self._base_key = jax.random.key(0)
         # Declared composition of this step under the live tier flags —
         # the object analysis/plan_check.py verifies (donation lifetimes,
-        # gather-ahead barrier chain, declared-vs-traced collectives).
-        self.plan = self._build_plan(specs, params, donate)
+        # gather-ahead barrier chain, declared-vs-traced collectives) —
+        # plus the pass contracts and G diagnostics _maybe_lint reports
+        # ahead of the S/D/X rules.
+        self.plan = build.plan
+        self._pass_contracts = build.contracts
+        self._pass_diags = build.diagnostics
+        from ..analysis import jaxpr_lint as _jl
+        if (_jl.analysis_mode() == "error"
+                and any(d.severity == _jl.ERROR for d in self._pass_diags)):
+            # composition is illegal — fail at construction, before any
+            # trace/compile work happens
+            _jl.emit(self._pass_diags, where="sharded.TrainStep.passes")
 
     def _resolve_multislice(self, mesh):
         """Resolve ``FLAGS_multislice`` against this mesh. Returns
@@ -428,83 +276,6 @@ class TrainStep:
         world = int(mesh.shape["slice"]) * int(mesh.shape["dp"])
         return mode, manual, reducer, world
 
-    def _build_plan(self, specs, params, donate):
-        """Assemble the StepPlan from the decisions made above: one node
-        per dispatch-level sub-program, the gather-ahead ordering plan,
-        and (filled at trace time) the recorded CommSpecs."""
-        from ..analysis import plan_check
-        from ..distributed import overlap as _overlap
-        plan = plan_check.StepPlan(
-            flags={
-                "offload_optimizer": ("moments" if self._offload is not None
-                                      else "off"),
-                "comm_overlap": _overlap.overlap_mode(),
-                "multislice": (self._multislice[0]
-                               if self._multislice is not None else "off"),
-                "gather_ahead": self._gather_specs is not None,
-                "donate": bool(donate) and self._offload is None,
-                "health_sentinel": self._sentinel is not None,
-            },
-            mesh_axes={str(a): int(self.mesh.shape[a])
-                       for a in self.mesh.axis_names},
-            fsdp_axis=self._fsdp_axis,
-            params={n: plan_check.ParamInfo(
-                tuple(int(d) for d in params[n].shape), specs[n])
-                for n in params})
-        if self._multislice is not None:
-            # The in-step 2-tier reduction as declared sub-nodes (the
-            # stages live inside the compiled step — no donations among
-            # them; the CommSpecs the reducer enforces at trace time fill
-            # plan.comm_specs via trace_step's recording, which is what
-            # the S001/S002 declared-vs-traced rules verify).
-            mode = self._multislice[0]
-            plan.nodes.append(plan_check.PlanNode(
-                "multislice_local_grads",
-                reads=("params", "buffers", "batch"),
-                writes=("grads_local",)))
-            if mode == "hierarchical":
-                plan.nodes.extend([
-                    plan_check.PlanNode("multislice_reduce_scatter[ici]",
-                                        reads=("grads_local",),
-                                        writes=("grads_shard",)),
-                    plan_check.PlanNode("multislice_allreduce[dcn]",
-                                        reads=("grads_shard",),
-                                        writes=("grads_shard",)),
-                    plan_check.PlanNode("multislice_all_gather[ici]",
-                                        reads=("grads_shard",),
-                                        writes=("grads",)),
-                ])
-            else:
-                plan.nodes.extend([
-                    plan_check.PlanNode("multislice_flat_allreduce[ici]",
-                                        reads=("grads_local",),
-                                        writes=("grads_full",)),
-                    plan_check.PlanNode("multislice_flat_allreduce[dcn]",
-                                        reads=("grads_full",),
-                                        writes=("grads",)),
-                ])
-        if self._offload is not None:
-            # grad-only compiled step (params NOT donated — the streaming
-            # update consumes and donates them per block right after)
-            plan.nodes.append(plan_check.PlanNode(
-                "grad_step",
-                reads=("params", "opt_scalars", "buffers", "batch"),
-                writes=("loss", "grads", "buffers")))
-            plan.nodes.extend(self._offload.plan_nodes(list(params)))
-        else:
-            writes = ("loss", "params", "opt_state", "buffers")
-            if self._sentinel is not None:
-                writes = ("loss", "stats") + writes[1:]
-            plan.nodes.append(plan_check.PlanNode(
-                "train_step",
-                reads=("params", "opt_state", "buffers", "batch"),
-                writes=writes,
-                donates=("params", "opt_state") if donate else ()))
-        if self._gather_specs is not None:
-            plan.gather = _overlap.gather_ahead_plan(
-                list(params), self._gather_specs)
-        return plan
-
     def trace_step(self, batch, lr=None, key=None):
         """Trace the composed step once (no compile) with the comm-spec
         registry recording, completing ``self.plan`` with the hop plans
@@ -516,11 +287,16 @@ class TrainStep:
         if key is None:
             key = self._base_key
         with comm_check.recording() as rec:
-            if self._offload is not None:
+            if self._step_kind == "offload":
                 closed = jax.make_jaxpr(self._step_fn)(
                     self.params, self.buffers, batch, key)
                 donate = ()
-            elif self._sentinel is not None:
+            elif self._step_kind == "offload_sentinel":
+                closed = jax.make_jaxpr(self._step_fn)(
+                    self.params, self.buffers, batch, key,
+                    jnp.asarray(self._sentinel.guard_vector()))
+                donate = ()
+            elif self._step_kind == "sentinel":
                 closed = jax.make_jaxpr(self._step_fn)(
                     self.params, self.opt_state, self.buffers, batch, lr,
                     key, jnp.asarray(self._sentinel.guard_vector()))
@@ -549,11 +325,16 @@ class TrainStep:
         prev_mesh = get_hybrid_mesh()
         set_hybrid_mesh(self.mesh)
         try:
-            if self._offload is not None:
+            if self._step_kind == "offload":
                 compiled = self._compiled.lower(
                     self.params, self.buffers, batch, key).compile()
                 return compiled, 0
-            if self._sentinel is not None:
+            if self._step_kind == "offload_sentinel":
+                compiled = self._compiled.lower(
+                    self.params, self.buffers, batch, key,
+                    jnp.asarray(self._sentinel.guard_vector())).compile()
+                return compiled, 0
+            if self._step_kind == "sentinel":
                 compiled = self._compiled.lower(
                     self.params, self.opt_state, self.buffers, batch, lr,
                     key, jnp.asarray(self._sentinel.guard_vector())
@@ -579,7 +360,8 @@ class TrainStep:
         and its optimized HLO checked against the same plan (X-rules,
         analysis/hlo_check.py — GSPMD-inserted collectives, unrealized
         donations, dtype churn)."""
-        from ..analysis import hlo_check, jaxpr_lint, plan_check
+        from ..analysis import hlo_check, jaxpr_lint, pass_check, plan_check
+        from .step_pipeline import AMBIENT_COMM_SPECS
         if self._linted or jaxpr_lint.analysis_mode() == "off":
             return
         self._linted = True
@@ -587,8 +369,16 @@ class TrainStep:
             closed, donate = self.trace_step(batch, lr, key)
         except Exception:
             return
-        diags = jaxpr_lint.lint_jaxpr(closed, donate_argnums=donate,
-                                      where="sharded.TrainStep")
+        # G rules first: the composition's own diagnostics (computed at
+        # construction, before tracing) plus the trace-level ownership
+        # check — every CommSpec the composed step recorded must be
+        # declared by some active pass contract.
+        diags = list(self._pass_diags)
+        diags += pass_check.check_traced_comm(
+            self._pass_contracts, self.plan.comm_specs,
+            ambient=AMBIENT_COMM_SPECS, where="sharded.TrainStep.passes")
+        diags += jaxpr_lint.lint_jaxpr(closed, donate_argnums=donate,
+                                       where="sharded.TrainStep")
         diags += plan_check.check_plan(self.plan, closed,
                                        donate_argnums=donate,
                                        where="sharded.TrainStep")
@@ -657,13 +447,29 @@ class TrainStep:
                 dispatch_phase = tm.observe_dispatch(
                     ("sharded.TrainStep", id(self)), (batch, lr),
                     where="sharded.TrainStep")
-            if self._offload is not None:
+            if self._step_kind == "offload":
                 with tm.phase(dispatch_phase):
                     loss, grads, self.buffers = self._compiled(
                         self.params, self.buffers, batch, key)
                 self.params, self.opt_state = self._offload.update(
                     self.params, grads, self.opt_state, lr)
-            elif self._sentinel is not None:
+            elif self._step_kind == "offload_sentinel":
+                # sentinel x offload: the grad-only compiled step computes
+                # the fused stats + in-graph verdict; the streamed update
+                # is gated ON that verdict at dispatch — an anomalous
+                # step's grads are dropped before they ever touch the
+                # host-resident moments, so params/opt-state/buffers stay
+                # exactly as the fused sentinel path would leave them.
+                guard = jnp.asarray(self._sentinel.guard_vector())
+                with tm.phase(dispatch_phase):
+                    loss, self.last_stats, grads, self.buffers = \
+                        self._compiled(self.params, self.buffers, batch,
+                                       key, guard)
+                applied = bool(np.asarray(self.last_stats)[-1] >= 0.5)
+                if applied:
+                    self.params, self.opt_state = self._offload.update(
+                        self.params, grads, self.opt_state, lr)
+            elif self._step_kind == "sentinel":
                 guard = jnp.asarray(self._sentinel.guard_vector())
                 with tm.phase(dispatch_phase):
                     (loss, self.last_stats, self.params, self.opt_state,

@@ -1,0 +1,834 @@
+"""Step-compiler pass pipeline: TrainStep assembly as verified passes.
+
+Before this module, every flag-gated tier (offload streaming, ZeRO
+gather-ahead, decomposed SP, DP buckets, multislice hierarchical
+reduction, remat, the health sentinel, telemetry) spliced into
+``framework.sharded.TrainStep.__init__`` as its own if-branch, and
+``analysis/plan_check.py`` verified the 128-combo matrix only *after
+the fact* — nothing verified composition itself, so legal-looking
+combinations (sentinel x offload) were hand-rejected instead of proven.
+
+Now the step is COMPOSED: an ordered list of graph-transform passes
+
+    base_grad -> remat -> sp_decompose -> zero_gather_ahead ->
+    dp_buckets -> multislice_reduce -> offload_stream ->
+    health_sentinel -> telemetry
+
+each declaring a static :class:`~paddle_tpu.analysis.pass_check.
+PassContract` (requires/provides capabilities, the plan nodes and
+buffer classes it introduces, the CommSpecs it registers, the
+invariants it preserves) and emitting its slice of ONE declared
+``plan_check.StepPlan``. ``analysis/pass_check.py``'s G-rules verify
+the composition *before tracing*: unsatisfied requires (G001), buffer
+ownership conflicts without a declared handoff (G002), plan deltas
+exceeding a contract — found by diffing the plan around each pass —
+(G003), undeclared order sensitivity — found by swap-rebuilding
+adjacent contract-commutative pairs in plan-only mode — (G004), and
+orphan capabilities (G005).
+
+Two composition modes share the same passes:
+
+- **live**: ``compose(build_for_train_step(...))`` additionally runs
+  each pass's ``fn_apply`` (the actual graph transforms: closures,
+  StreamingUpdate, StepSentinel) and finalizes the jitted step — this
+  is what ``TrainStep.__init__`` calls;
+- **plan-only**: ``compose(plan_only_build(combo))`` emits just the
+  StepPlan from static facts — what ``tools/lint_graph.py --passes``
+  enumerates over every tier combo, what G004 swap-rebuilds use, and
+  what keys the matrix trace cache (equal composed-plan hash ==
+  identical traced step).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..analysis import pass_check, plan_check
+from ..analysis.comm_check import CP_RING
+from ..analysis.pass_check import PassContract
+from ..distributed.multislice.reducer import MULTISLICE_COMM_SPECS
+from ..distributed.overlap import SP_COMM_SPECS
+from ..fault.health import SENTINEL_CAPABILITIES, SENTINEL_STATS_BUFFER
+
+__all__ = [
+    "StepBuild", "StepPass", "PIPELINE", "active_passes", "compose",
+    "build_for_train_step", "plan_only_build", "pipeline_report",
+    "AMBIENT_COMM_SPECS",
+]
+
+# CommSpec names owned by model-level tiers that live INSIDE the loss
+# function (ring-CP attention, the Pallas conv path, serving), not by a
+# step-pipeline pass — the trace-level G003 ownership check exempts
+# them.
+AMBIENT_COMM_SPECS = frozenset({CP_RING})
+
+
+# ---------------------------------------------------------------------------
+# The build context
+# ---------------------------------------------------------------------------
+
+@dataclass
+class StepBuild:
+    """Everything one composition reads and produces.
+
+    The *static* fields are sufficient for plan-only composition (and
+    are all a pass's ``plan_apply`` may touch — that restriction is
+    what makes G004's swap-rebuild sound). The *live* fields are only
+    populated by :func:`build_for_train_step` and only read by
+    ``fn_apply``/``_finalize``.
+    """
+
+    # -- static facts (plan_apply may only read these) --
+    flags: Dict[str, Any]
+    mesh_axes: Dict[str, int]
+    fsdp_axis: Optional[str]
+    param_names: Tuple[str, ...]
+    donate: bool = True
+    plan_only: bool = False
+    param_shapes: Dict[str, Tuple[int, ...]] = field(default_factory=dict)
+    specs: Dict[str, Any] = field(default_factory=dict)
+    gather_specs: Optional[Dict[str, Any]] = None
+    offload_clip: bool = False
+    # -- live refs (None/unused in plan-only mode) --
+    model: Any = None
+    optimizer: Any = None
+    loss_fn: Any = None
+    mesh: Any = None
+    data_axes: Tuple[str, ...] = ()
+    pshardings: Any = None
+    state_shardings: Any = None
+    params: Any = None
+    buffers: Any = None
+    opt_state: Any = None
+    multislice: Any = None  # resolved (mode, manual, reducer, world) | None
+    threads_buffers: bool = False
+    # -- produced by the passes --
+    plan: Any = None
+    offload: Any = None
+    sentinel: Any = None
+    compute_grads: Any = None
+    loss_preludes: List[Callable] = field(default_factory=list)
+    step_kind: str = "plain"
+    step_fn: Any = None
+    compiled: Any = None
+    contracts: List[PassContract] = field(default_factory=list)
+    diagnostics: List[Any] = field(default_factory=list)
+
+    def static_clone(self) -> "StepBuild":
+        """A plan-only twin sharing this build's static facts — the
+        G004 swap-rebuilds compose on it so a reordering probe can
+        never touch live state."""
+        return StepBuild(
+            flags=dict(self.flags), mesh_axes=dict(self.mesh_axes),
+            fsdp_axis=self.fsdp_axis, param_names=tuple(self.param_names),
+            donate=self.donate, plan_only=True,
+            param_shapes=dict(self.param_shapes), specs=dict(self.specs),
+            gather_specs=(dict(self.gather_specs)
+                          if self.gather_specs else None),
+            offload_clip=self.offload_clip)
+
+
+def _new_plan(build: StepBuild) -> plan_check.StepPlan:
+    return plan_check.StepPlan(
+        flags={},
+        mesh_axes=dict(build.mesh_axes),
+        fsdp_axis=build.fsdp_axis,
+        params={n: plan_check.ParamInfo(
+            tuple(build.param_shapes.get(n, ())),
+            build.specs.get(n)) for n in build.param_names})
+
+
+# ---------------------------------------------------------------------------
+# The passes
+# ---------------------------------------------------------------------------
+
+class StepPass:
+    """One graph-transform pass. ``plan_apply`` emits the pass's slice
+    of the declared StepPlan from STATIC build facts only; ``fn_apply``
+    performs the live transform (closures, placements, host objects)."""
+
+    contract: PassContract
+
+    def active(self, build: StepBuild) -> bool:
+        return True
+
+    def plan_apply(self, build: StepBuild) -> None:  # pragma: no cover
+        pass
+
+    def fn_apply(self, build: StepBuild) -> None:  # pragma: no cover
+        pass
+
+
+def _terminal_index(plan) -> int:
+    """Index of the terminal grad program (train_step before the offload
+    pass replaces it, grad_step after)."""
+    for i, n in enumerate(plan.nodes):
+        if n.name in ("train_step", "grad_step"):
+            return i
+    raise ValueError("no terminal train_step/grad_step node in plan — "
+                     "base_grad must run first")
+
+
+class BaseGradPass(StepPass):
+    """The foundation: one fused fwd+bwd+update program. Every other
+    pass transforms what this one establishes."""
+
+    contract = PassContract(
+        name="base_grad",
+        provides=("loss", "grads", "update"),
+        terminal=("loss", "grads", "update"),
+        node_prefixes=("train_step",),
+        plan_reads=("params", "opt_state", "buffers", "batch"),
+        plan_writes=("loss", "params", "opt_state", "buffers"),
+        plan_donates=("params", "opt_state"),
+        invariants=("loss-parity", "grad-parity"),
+    )
+
+    def plan_apply(self, build: StepBuild) -> None:
+        plan = build.plan
+        plan.flags.update({
+            "offload_optimizer": "off",
+            "comm_overlap": build.flags.get("comm_overlap", "off"),
+            "multislice": "off",
+            "gather_ahead": False,
+            "donate": bool(build.donate),
+            "health_sentinel": False,
+        })
+        plan.nodes.append(plan_check.PlanNode(
+            "train_step",
+            reads=("params", "opt_state", "buffers", "batch"),
+            writes=("loss", "params", "opt_state", "buffers"),
+            donates=("params", "opt_state") if build.donate else ()))
+
+    def fn_apply(self, build: StepBuild) -> None:
+        from ..core.random import rng_scope
+        model_obj, lf = build.model, build.loss_fn
+        buffers_threaded = build.threads_buffers
+        preludes = build.loss_preludes  # later passes append; read at trace
+
+        def plain_grads(params, buffers, batch, key):
+            def loss_of(p):
+                # Gather-ahead (and any later param prelude) INSIDE the
+                # differentiated fn: the constraint transpose re-scatters
+                # the cotangents, so grads arrive fsdp-sharded and the
+                # update runs on shards (ZeRO-3 fwd gather / bwd
+                # reduce-scatter).
+                for prelude in preludes:
+                    p = prelude(p)
+                with rng_scope(key):
+                    if buffers_threaded:
+                        return lf(model_obj, p, buffers, batch)
+                    return lf(model_obj, p, batch), buffers
+
+            (loss, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            return loss, grads, new_buffers
+
+        build.compute_grads = plain_grads
+
+
+class RematPass(StepPass):
+    """Activation recomputation. The transform itself lives at the model
+    layer (``GPTConfig.recompute`` wraps blocks in ``jax.checkpoint``);
+    the pass declares it so remat combos hash distinctly and its
+    invariants are part of the verified composition."""
+
+    contract = PassContract(
+        name="remat",
+        provides=("remat",),
+        terminal=("remat",),
+        invariants=("loss-parity", "grad-parity", "peak-hbm-reduced"),
+    )
+
+    def active(self, build: StepBuild) -> bool:
+        return bool(build.flags.get("remat"))
+
+    def plan_apply(self, build: StepBuild) -> None:
+        build.plan.flags["remat"] = True
+
+
+class SpDecomposePass(StepPass):
+    """Decomposed sequence/tensor-parallel matmuls
+    (``FLAGS_comm_overlap=tp|tp_zero|all``): the allgather-matmul /
+    matmul-reduce-scatter pipelines trace inside the model layers; the
+    pass owns their CommSpec names for the trace-level G003 check."""
+
+    contract = PassContract(
+        name="sp_decompose",
+        provides=("sp_decomposed",),
+        terminal=("sp_decomposed",),
+        comm_specs=SP_COMM_SPECS,
+        invariants=("matmul-parity",),
+    )
+
+    def active(self, build: StepBuild) -> bool:
+        return build.flags.get("comm_overlap", "off") in (
+            "tp", "tp_zero", "all")
+
+
+class ZeroGatherAheadPass(StepPass):
+    """ZeRO-3 gather-ahead (``FLAGS_comm_overlap=tp_zero|all``):
+    per-block param all-gathers issued ahead of the consuming block's
+    compute instead of GSPMD's gather-at-first-use."""
+
+    contract = PassContract(
+        name="zero_gather_ahead",
+        requires=("grads",),
+        provides=("gather_ahead",),
+        terminal=("gather_ahead",),
+        declares_gather=True,
+        invariants=("grad-sharding-preserved", "loss-parity"),
+    )
+
+    def active(self, build: StepBuild) -> bool:
+        return bool(build.gather_specs)
+
+    def plan_apply(self, build: StepBuild) -> None:
+        from ..distributed import overlap as _overlap
+        build.plan.gather = _overlap.gather_ahead_plan(
+            list(build.param_names), build.gather_specs)
+        build.plan.flags["gather_ahead"] = True
+
+    def fn_apply(self, build: StepBuild) -> None:
+        from ..distributed import overlap as _overlap
+        gspecs, mesh = build.gather_specs, build.mesh
+        build.loss_preludes.append(
+            lambda p: _overlap.zero_gather_ahead(p, gspecs, mesh))
+
+
+class DpBucketsPass(StepPass):
+    """Bucketed DP gradient reduction (``FLAGS_comm_overlap=all``). On
+    the GSPMD step the dp psum is XLA-inserted; the declared reducer
+    path (``overlap.BucketedGradReducer``) is manual-mode only — the
+    pass records the tier so the composition names it."""
+
+    contract = PassContract(
+        name="dp_buckets",
+        provides=("dp_buckets",),
+        terminal=("dp_buckets",),
+        invariants=("grad-parity",),
+    )
+
+    def active(self, build: StepBuild) -> bool:
+        return build.flags.get("comm_overlap", "off") == "all"
+
+
+class MultisliceReducePass(StepPass):
+    """2-tier {ICI, DCN} gradient reduction over a slice-aware mesh
+    (``FLAGS_multislice=flat|hierarchical``): the grad computation moves
+    into a shard_map over {slice, dp} and the reduction is issued by the
+    declared reducer instead of GSPMD."""
+
+    contract = PassContract(
+        name="multislice_reduce",
+        requires=("grads",),
+        provides=("grads_reduced",),
+        terminal=("grads_reduced",),
+        node_prefixes=("multislice_",),
+        plan_reads=("params", "buffers", "batch", "grads_local",
+                    "grads_shard", "grads_full"),
+        plan_writes=("grads_local", "grads_shard", "grads_full", "grads"),
+        comm_specs=MULTISLICE_COMM_SPECS,
+        invariants=("bitwise-equal-to-flat", "loss-parity"),
+    )
+
+    def active(self, build: StepBuild) -> bool:
+        return build.flags.get("multislice", "off") != "off"
+
+    def plan_apply(self, build: StepBuild) -> None:
+        plan = build.plan
+        mode = build.flags["multislice"]
+        nodes = [plan_check.PlanNode(
+            "multislice_local_grads",
+            reads=("params", "buffers", "batch"),
+            writes=("grads_local",))]
+        if mode == "hierarchical":
+            nodes.extend([
+                plan_check.PlanNode("multislice_reduce_scatter[ici]",
+                                    reads=("grads_local",),
+                                    writes=("grads_shard",)),
+                plan_check.PlanNode("multislice_allreduce[dcn]",
+                                    reads=("grads_shard",),
+                                    writes=("grads_shard",)),
+                plan_check.PlanNode("multislice_all_gather[ici]",
+                                    reads=("grads_shard",),
+                                    writes=("grads",)),
+            ])
+        else:
+            nodes.extend([
+                plan_check.PlanNode("multislice_flat_allreduce[ici]",
+                                    reads=("grads_local",),
+                                    writes=("grads_full",)),
+                plan_check.PlanNode("multislice_flat_allreduce[dcn]",
+                                    reads=("grads_full",),
+                                    writes=("grads",)),
+            ])
+        # The in-step reduction precedes the terminal grad program in
+        # dispatch order regardless of pass order (commutes with the
+        # offload replacement — G004 proves it).
+        idx = _terminal_index(plan)
+        plan.nodes[idx:idx] = nodes
+        plan.flags["multislice"] = mode
+
+    def fn_apply(self, build: StepBuild) -> None:
+        from ..core.random import rng_scope
+        from ..distributed import overlap as _overlap
+        mode, manual, reducer, world = build.multislice
+        mesh, lf, model_obj = build.mesh, build.loss_fn, build.model
+        buffers_threaded = build.threads_buffers
+        data_axes = build.data_axes
+
+        def multislice_grads(params, buffers, batch, key):
+            # Per-device local loss/grads in a shard_map over the data
+            # axes, grads reduced by the declared 2-tier reducer
+            # (FLAGS_multislice=flat keeps the naive full-bucket-over-DCN
+            # plan as the A/B arm; both modes are bitwise-identical in
+            # values). Params are replicated over the manual {slice, dp}
+            # axes — fsdp/gather-ahead do not compose here (gated in
+            # TrainStep._resolve_multislice).
+            def local_fn(p, bufs, b, k):
+                def loss_of(pp):
+                    with rng_scope(k):
+                        if buffers_threaded:
+                            return lf(model_obj, pp, bufs, b)
+                        return lf(model_obj, pp, b), bufs
+
+                (loss, newb), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(p)
+                grads = reducer.reduce_in_axes(grads, mode=mode)
+                grads = jax.tree_util.tree_map(
+                    lambda g: g * jnp.asarray(1.0 / world, g.dtype), grads)
+                loss = lax.psum(loss, manual) * jnp.asarray(
+                    1.0 / world, loss.dtype)
+                if buffers_threaded:
+                    newb = jax.tree_util.tree_map(
+                        lambda x: lax.psum(x, manual) * jnp.asarray(
+                            1.0 / world, x.dtype), newb)
+                return loss, grads, newb
+
+            data_spec = tuple(a for a in data_axes
+                              if a in mesh.axis_names
+                              and mesh.shape[a] > 1 and a in manual)
+            repl_tree = lambda tree: jax.tree_util.tree_map(  # noqa: E731
+                lambda _: P(), tree)
+            batch_specs = jax.tree_util.tree_map(
+                lambda x: P(data_spec if len(data_spec) > 1
+                            else (data_spec[0] if data_spec else None),
+                            *([None] * (jnp.ndim(x) - 1))), batch)
+            fn = _overlap.shard_map_compat(
+                local_fn, mesh,
+                (repl_tree(params), repl_tree(buffers), batch_specs, P()),
+                (P(), repl_tree(params), repl_tree(buffers)),
+                manual)
+            return fn(params, buffers, batch, key)
+
+        build.compute_grads = multislice_grads
+
+
+class OffloadStreamPass(StepPass):
+    """Host-offloaded optimizer moments (``FLAGS_offload_optimizer=
+    moments``): replaces the fused train_step with a grad-only compiled
+    step plus the per-block streaming update — the pass takes over the
+    params/opt-state/loss/buffers lifetimes from base_grad (declared
+    handoffs) and grads from the multislice reducer when both compose."""
+
+    contract = PassContract(
+        name="offload_stream",
+        requires=("grads", "update"),
+        provides=("streamed_update",),
+        terminal=("streamed_update",),
+        node_prefixes=("grad_step", "offload."),
+        node_removals=("train_step",),
+        plan_reads=("params", "opt_scalars", "buffers", "batch",
+                    "host_moments", "grads"),
+        plan_writes=("loss", "grads", "buffers", "params", "moments",
+                     "host_moments"),
+        plan_donates=("params", "grads", "moments"),
+        handoffs=(("loss", "base_grad"), ("params", "base_grad"),
+                  ("buffers", "base_grad"), ("opt_state", "base_grad"),
+                  ("grads", "multislice_reduce")),
+        invariants=("update-parity", "moments-host-resident",
+                    "peak-hbm-two-blocks"),
+    )
+
+    def active(self, build: StepBuild) -> bool:
+        return build.flags.get("offload_optimizer", "off") == "moments"
+
+    def plan_apply(self, build: StepBuild) -> None:
+        from . import offload as _offload
+        plan = build.plan
+        idx = _terminal_index(plan)
+        # grad-only compiled step (params NOT donated — the streaming
+        # update consumes and donates them per block right after)
+        plan.nodes[idx] = plan_check.PlanNode(
+            "grad_step",
+            reads=("params", "opt_scalars", "buffers", "batch"),
+            writes=("loss", "grads", "buffers"))
+        plan.nodes[idx + 1:idx + 1] = _offload.plan_nodes_for(
+            list(build.param_names), clip=build.offload_clip)
+        plan.flags["offload_optimizer"] = "moments"
+        plan.flags["donate"] = False
+
+    def fn_apply(self, build: StepBuild) -> None:
+        from . import offload as _offload
+        build.offload = _offload.StreamingUpdate(build.optimizer)
+        build.opt_state = build.offload.place(build.opt_state)
+        build.step_kind = "offload"
+
+
+class HealthSentinelPass(StepPass):
+    """In-graph training-health gate (``FLAGS_health_sentinel=on``): one
+    fused [loss, grad-global-norm] reduction per step, the update gated
+    on finiteness + host-fed rolling-median thresholds. Wraps whichever
+    terminal program the earlier passes composed — on the offload path
+    the compiled grad step computes the verdict and the dispatch gates
+    the streamed update on it (``order_after=offload_stream``)."""
+
+    contract = PassContract(
+        name="health_sentinel",
+        requires=("loss", "grads"),
+        provides=SENTINEL_CAPABILITIES,
+        terminal=SENTINEL_CAPABILITIES,
+        node_updates=("train_step", "grad_step"),
+        plan_writes=(SENTINEL_STATS_BUFFER,),
+        order_after=("offload_stream",),
+        invariants=("clean-step-parity", "anomalous-step-isolated"),
+    )
+
+    def active(self, build: StepBuild) -> bool:
+        return bool(build.flags.get("health_sentinel"))
+
+    def plan_apply(self, build: StepBuild) -> None:
+        plan = build.plan
+        idx = _terminal_index(plan)
+        node = plan.nodes[idx]
+        writes = ("loss", SENTINEL_STATS_BUFFER) + tuple(
+            w for w in node.writes if w != "loss")
+        plan.nodes[idx] = plan_check.PlanNode(
+            node.name, reads=node.reads, writes=writes,
+            donates=node.donates)
+        plan.flags["health_sentinel"] = True
+
+    def fn_apply(self, build: StepBuild) -> None:
+        from ..fault import health as _health
+        build.sentinel = _health.StepSentinel()
+        build.step_kind = ("offload_sentinel"
+                           if build.step_kind == "offload" else "sentinel")
+
+
+class TelemetryPass(StepPass):
+    """Step telemetry (``FLAGS_telemetry=metrics|trace``) is dispatch-
+    level by construction (rule J013: no host callbacks in the compiled
+    step) — the pass declares the tier so the composition names it and
+    G004 proves it commutes with everything."""
+
+    contract = PassContract(
+        name="telemetry",
+        requires=("loss",),
+        provides=("telemetry",),
+        terminal=("telemetry",),
+        invariants=("dispatch-level-only", "step-graph-byte-identical"),
+    )
+
+    def active(self, build: StepBuild) -> bool:
+        return build.flags.get("telemetry", "off") != "off"
+
+    def plan_apply(self, build: StepBuild) -> None:
+        build.plan.flags["telemetry"] = build.flags["telemetry"]
+
+
+PIPELINE: Tuple[StepPass, ...] = (
+    BaseGradPass(), RematPass(), SpDecomposePass(), ZeroGatherAheadPass(),
+    DpBucketsPass(), MultisliceReducePass(), OffloadStreamPass(),
+    HealthSentinelPass(), TelemetryPass(),
+)
+
+
+def active_passes(build: StepBuild,
+                  order: Sequence[StepPass] = PIPELINE) -> List[StepPass]:
+    return [p for p in order if p.active(build)]
+
+
+# ---------------------------------------------------------------------------
+# Composition
+# ---------------------------------------------------------------------------
+
+def compose(build: StepBuild, order: Optional[Sequence[StepPass]] = None,
+            check: bool = True) -> StepBuild:
+    """Run the pipeline over one build: emit the declared StepPlan slice
+    by slice (diffing around each pass for G003), apply the live graph
+    transforms unless plan-only, finalize the jitted step, and verify
+    the composition with the G rules — all before anything traces."""
+    order = tuple(PIPELINE if order is None else order)
+    actives = active_passes(build, order)
+    build.contracts = [p.contract for p in actives]
+    if check:
+        # Contract-only structural rules (G001/G002/G005) run BEFORE any
+        # plan slice is emitted — a structurally-bad ordering is reported,
+        # not crashed into (a pass's plan_apply may legitimately assume
+        # its declared predecessors ran).
+        pre = pass_check.check_passes(build.contracts,
+                                      where="step_pipeline")
+        if any(d.severity == pass_check.ERROR for d in pre):
+            build.diagnostics = pre
+            return build
+    build.plan = _new_plan(build)
+    deltas = []
+    for p in actives:
+        before = pass_check.snapshot_plan(build.plan)
+        p.plan_apply(build)
+        deltas.append(pass_check.diff_plan(before, build.plan, p.contract))
+        if not build.plan_only:
+            p.fn_apply(build)
+    if not build.plan_only:
+        _finalize(build)
+    if check:
+        build.diagnostics = pass_check.check_passes(
+            build.contracts, deltas=deltas,
+            rebuild=_make_rebuilder(build, order),
+            base_hash=pass_check.composed_plan_hash(build.plan),
+            where="step_pipeline")
+    return build
+
+
+def _make_rebuilder(build: StepBuild, order: Sequence[StepPass]):
+    """Plan-only rebuild callback for G004: compose the same static
+    facts under a reordered active-pass sequence, return the hash."""
+    by_name = {p.contract.name: p for p in order}
+    static = build.static_clone()
+
+    def rebuild(names: Tuple[str, ...]) -> str:
+        b = static.static_clone()
+        sub = [by_name[n] for n in names]
+        compose(b, order=sub, check=False)
+        return pass_check.composed_plan_hash(b.plan)
+
+    return rebuild
+
+
+def _finalize(build: StepBuild) -> None:
+    """The pipeline epilogue (live mode): close the composed grad
+    computation over the optimizer update / sentinel gate and jit the
+    step for this build's step_kind. Not a pass — it consumes what the
+    passes composed; it introduces nothing a contract would declare."""
+    from ..fault import health as _health
+    optimizer = build.optimizer
+    compute_grads = build.compute_grads
+    donate = build.donate
+    repl = NamedSharding(build.mesh, P())
+    psh = build.pshardings
+    ssh = build.state_shardings
+
+    def step(params, opt_state, buffers, batch, lr, key):
+        loss, grads, new_buffers = compute_grads(params, buffers,
+                                                 batch, key)
+        # FLAGS_check_nan_inf (ref nan_inf_utils.h:38); moment/
+        # variance corruption hides in optimizer state long after
+        # the offending grad step — scan new_state too
+        _health.check_numerics(loss=loss, grads=grads,
+                               where="train_step")
+        new_params, new_state = optimizer.apply_gradients(
+            params, grads, opt_state, lr)
+        _health.check_numerics(opt_state=new_state, where="train_step")
+        return loss, new_params, new_state, new_buffers
+
+    def sentinel_step(params, opt_state, buffers, batch, lr, key,
+                      guard):
+        loss, grads, new_buffers = compute_grads(params, buffers,
+                                                 batch, key)
+        _health.check_numerics(loss=loss, grads=grads,
+                               where="train_step")
+        stats = _health.fused_stats(loss, grads)
+        ok = _health.fused_ok(stats, guard)
+        new_params, new_state = optimizer.apply_gradients(
+            params, grads, opt_state, lr)
+        _health.check_numerics(opt_state=new_state, where="train_step")
+        # gate the whole update in-graph: an anomalous step can never
+        # poison params/opt-state/buffers (the jnp.where select is
+        # the sentinel's only non-reduction cost)
+        keep = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+        new_params = jax.tree_util.tree_map(keep, new_params, params)
+        new_state = jax.tree_util.tree_map(keep, new_state, opt_state)
+        new_buffers = jax.tree_util.tree_map(keep, new_buffers,
+                                             buffers)
+        stats = jnp.concatenate(
+            [stats, ok.astype(jnp.float32)[None]])
+        return loss, stats, new_params, new_state, new_buffers
+
+    def grad_step(params, buffers, batch, key):
+        loss, grads, new_buffers = compute_grads(params, buffers,
+                                                 batch, key)
+        _health.check_numerics(loss=loss, grads=grads,
+                               where="train_step")
+        return loss, grads, new_buffers
+
+    def sentinel_grad_step(params, buffers, batch, key, guard):
+        # sentinel x offload: the grad-only compiled step computes the
+        # verdict; the in-graph gate covers the buffers it returns, and
+        # the dispatch gates the streamed update on stats[-1] — an
+        # anomalous step leaves params/opt-state/buffers untouched,
+        # matching the fused path's semantics.
+        loss, grads, new_buffers = compute_grads(params, buffers,
+                                                 batch, key)
+        _health.check_numerics(loss=loss, grads=grads,
+                               where="train_step")
+        stats = _health.fused_stats(loss, grads)
+        ok = _health.fused_ok(stats, guard)
+        keep = lambda new, old: jnp.where(ok, new, old)  # noqa: E731
+        new_buffers = jax.tree_util.tree_map(keep, new_buffers, buffers)
+        stats = jnp.concatenate([stats, ok.astype(jnp.float32)[None]])
+        return loss, stats, grads, new_buffers
+
+    if build.step_kind == "offload":
+        # Params are NOT donated here — the streaming update consumes
+        # and donates them per block right after.
+        build.compiled = jax.jit(
+            grad_step,
+            in_shardings=(psh, None, None, None),
+            out_shardings=(repl, psh, None))
+        build.step_fn = grad_step
+    elif build.step_kind == "offload_sentinel":
+        build.compiled = jax.jit(
+            sentinel_grad_step,
+            in_shardings=(psh, None, None, None, repl),
+            out_shardings=(repl, repl, psh, None))
+        build.step_fn = sentinel_grad_step
+    elif build.step_kind == "sentinel":
+        build.compiled = jax.jit(
+            sentinel_step,
+            in_shardings=(psh, ssh, None, None, repl, None, repl),
+            out_shardings=(repl, repl, psh, ssh, None),
+            donate_argnums=(0, 1) if donate else ())
+        build.step_fn = sentinel_step
+    else:
+        build.compiled = jax.jit(
+            step,
+            in_shardings=(psh, ssh, None, None, repl, None),
+            out_shardings=(repl, psh, ssh, None),
+            # Buffers are NOT donated: TrainStep.buffers initially
+            # aliases the Layer tree's arrays; donating would delete
+            # them under the model.
+            donate_argnums=(0, 1) if donate else ())
+        build.step_fn = step
+
+
+# ---------------------------------------------------------------------------
+# Build construction
+# ---------------------------------------------------------------------------
+
+def build_for_train_step(model, optimizer, loss_fn, mesh, data_axes,
+                         donate, params, specs, pshardings,
+                         state_shardings, buffers, opt_state, fsdp_axis,
+                         multislice, threads_buffers) -> StepBuild:
+    """Resolve the live flag state into one StepBuild. Every activation
+    decision (does offload have a host tier? did the fsdp gather specs
+    come out non-empty?) is made HERE, once — the passes' ``active()``
+    predicates then read only the resolved static facts, so a plan-only
+    clone of this build composes identically."""
+    from ..core.flags import flag
+    from ..distributed import overlap as _overlap
+    from ..fault import health as _health
+    from . import offload as _offload
+
+    offload_on = (_offload.offload_mode() == "moments"
+                  and optimizer.offloadable_state_keys()
+                  and _offload.host_memory_kind() is not None)
+    gather_specs = None
+    if _overlap.zero_enabled() and fsdp_axis is not None:
+        gspecs = {n: _overlap.spec_without_axis(specs[n], fsdp_axis)
+                  for n in params}
+        gspecs = {n: s for n, s in gspecs.items() if s != specs[n]}
+        if gspecs:
+            gather_specs = gspecs
+    model_cfg = getattr(model, "config", None)
+    flags = {
+        "offload_optimizer": "moments" if offload_on else "off",
+        "comm_overlap": _overlap.overlap_mode(),
+        "multislice": multislice[0] if multislice is not None else "off",
+        "remat": bool(getattr(model_cfg, "recompute", False)),
+        "health_sentinel": _health.sentinel_on(),
+        "telemetry": str(flag("telemetry")),
+    }
+    return StepBuild(
+        flags=flags,
+        mesh_axes={str(a): int(mesh.shape[a]) for a in mesh.axis_names},
+        fsdp_axis=fsdp_axis,
+        param_names=tuple(params),
+        donate=donate,
+        param_shapes={n: tuple(int(d) for d in v.shape)
+                      for n, v in params.items()},
+        specs=dict(specs),
+        gather_specs=gather_specs,
+        offload_clip=getattr(optimizer, "grad_clip", None) is not None,
+        model=model, optimizer=optimizer, loss_fn=loss_fn, mesh=mesh,
+        data_axes=tuple(data_axes), pshardings=pshardings,
+        state_shardings=state_shardings, params=params, buffers=buffers,
+        opt_state=opt_state, multislice=multislice,
+        threads_buffers=threads_buffers)
+
+
+# Synthetic parameter profile for plan-only composition: two "blocks"
+# plus unblocked embeddings/head, so the offload streaming and the
+# gather-ahead chain both have real structure to plan over.
+_PLAN_ONLY_PARAMS: Tuple[Tuple[str, Tuple[int, ...]], ...] = (
+    ("embed.weight", (64, 32)),
+    ("h.0.attn.qkv.weight", (32, 96)),
+    ("h.0.mlp.fc.weight", (32, 128)),
+    ("h.1.attn.qkv.weight", (32, 96)),
+    ("h.1.mlp.fc.weight", (32, 128)),
+    ("head.weight", (32, 64)),
+)
+_PLAN_ONLY_MESH: Dict[str, int] = {"dp": 2, "sharding": 2, "mp": 2}
+
+
+def plan_only_build(combo: Dict[str, Any],
+                    mesh_axes: Optional[Dict[str, int]] = None,
+                    health_sentinel: bool = False,
+                    telemetry: str = "off",
+                    donate: bool = True,
+                    offload_clip: bool = False) -> StepBuild:
+    """A StepBuild from one tier-flag combo and static facts only —
+    what ``lint_graph --passes`` enumerates and the matrix trace cache
+    hashes. Combos normalize through ``plan_check.normalize_combo``
+    (the one entry point; legacy 5-flag dicts warn once)."""
+    combo = plan_check.normalize_combo(combo)
+    mesh_axes = dict(_PLAN_ONLY_MESH if mesh_axes is None else mesh_axes)
+    fsdp_axis = "sharding" if mesh_axes.get("sharding", 1) > 1 else None
+    multislice_on = mesh_axes.get("slice", 1) > 1
+    param_names = tuple(n for n, _ in _PLAN_ONLY_PARAMS)
+    gather_specs = None
+    if combo["comm_overlap"] in ("tp_zero", "all") and fsdp_axis:
+        gather_specs = {n: P(None) for n in param_names}
+    flags = {
+        "offload_optimizer": combo["offload_optimizer"],
+        "comm_overlap": combo["comm_overlap"],
+        "multislice": (combo["multislice"] if multislice_on else "off"),
+        "remat": bool(combo["remat"]),
+        "health_sentinel": health_sentinel,
+        "telemetry": telemetry,
+    }
+    return StepBuild(
+        flags=flags, mesh_axes=mesh_axes, fsdp_axis=fsdp_axis,
+        param_names=param_names, donate=donate, plan_only=True,
+        param_shapes=dict(_PLAN_ONLY_PARAMS),
+        specs={n: None for n in param_names},
+        gather_specs=gather_specs, offload_clip=offload_clip)
+
+
+def pipeline_report(build: StepBuild) -> Dict[str, Any]:
+    """The ``passes`` slice of the lint_graph JSON schema for one
+    composed build: ordered active passes, contract hashes, the
+    composed-plan hash, and any G diagnostics."""
+    return {
+        "order": [c.name for c in build.contracts],
+        "contracts": {c.name: pass_check.contract_hash(c)
+                      for c in build.contracts},
+        "plan_hash": pass_check.composed_plan_hash(build.plan),
+        "diagnostics": [d.to_json() for d in build.diagnostics],
+    }

@@ -22,6 +22,30 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+# Test FILES whose failures are known jax-0.4.37 API gaps (the wave-era
+# surface tests were written against newer jax.numpy / sharding APIs).
+# Every file here carries a module-level `requires_new_jax` pytestmark and
+# vice versa — pinned both directions by tests/test_repo_selfcheck.py —
+# so a tier-1 failure OUTSIDE this set is a real regression, not an
+# environment gap. Deselect with `-m "not requires_new_jax"`.
+REQUIRES_NEW_JAX_FILES = frozenset({
+    "test_context_parallel.py",
+    "test_determinism.py",
+    "test_ernie.py",
+    "test_launch.py",
+    "test_ops.py",
+    "test_pipeline.py",
+    "test_surface_wave4.py",
+    "test_tensor_extras.py",
+})
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "requires_new_jax: known jax-0.4.37 API-gap test (file-level); "
+        "fails on the pinned legacy jax, passes on current jax")
+
 
 @pytest.fixture(autouse=True)
 def _seed_everything():

@@ -116,14 +116,17 @@ class TestGuardedMin:
 
 
 class TestEndToEndSmoke:
-    def test_bench_small_emits_guard_fields(self):
+    def test_bench_small_emits_guard_fields(self, tmp_path):
         """BENCH_SMALL path on CPU: the emitted JSON carries the guard
-        fields (anomaly, windows, roofline_ms) for every config."""
+        fields (anomaly, windows, roofline_ms) for every config, and the
+        run persists its BENCH_r<NN>.json snapshot (here redirected to a
+        tmp dir so the test never dirties the repo)."""
         import json
         import subprocess
 
         env = dict(os.environ, BENCH_SMALL="1", BENCH_CONFIGS="gpt",
-                   JAX_PLATFORMS="cpu")
+                   JAX_PLATFORMS="cpu", BENCH_SNAPSHOT_DIR=str(tmp_path),
+                   BENCH_TRACE_OUT=str(tmp_path / "timeline.jsonl"))
         out = subprocess.run(
             [sys.executable, os.path.join(os.path.dirname(__file__),
                                           os.pardir, "bench.py")],
@@ -136,6 +139,59 @@ class TestEndToEndSmoke:
         assert "windows" in rec["extra"]
         assert "roofline_ms" in rec["extra"]
         assert rec["extra"]["anomaly"] is False
+        # the per-run snapshot landed (numbering scoped to the tmp dir:
+        # empty -> r01) with the committed r01..r05 shape, and its
+        # headline record is the primary metric line printed last
+        snap_path = tmp_path / "BENCH_r01.json"
+        assert snap_path.exists(), list(tmp_path.iterdir())
+        snap = json.loads(snap_path.read_text())
+        assert set(snap) == {"n", "cmd", "rc", "tail", "parsed"}
+        assert snap["n"] == 1 and snap["rc"] == 0
+        assert snap["parsed"]["metric"] == rec["metric"]
+        assert lines[-1] in snap["tail"]
+
+
+class TestSnapshotNumbering:
+    def test_next_n_from_committed_snapshots(self):
+        """In the repo, NN derives from the last COMMITTED BENCH_r<NN>
+        snapshot + 1 — reruns in a dirty tree must not walk the counter."""
+        import re
+        import subprocess
+
+        from bench import _next_snapshot_n
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = subprocess.run(["git", "ls-files", "BENCH_r*.json"],
+                             cwd=root, capture_output=True, text=True)
+        if out.returncode != 0 or not out.stdout.split():
+            pytest.skip("no git / no committed snapshots here")
+        committed = max(int(re.search(r"BENCH_r(\d+)\.json", n).group(1))
+                        for n in out.stdout.split())
+        assert _next_snapshot_n(root) == committed + 1
+
+    def test_next_n_falls_back_to_directory_scan(self, tmp_path):
+        from bench import _next_snapshot_n
+
+        assert _next_snapshot_n(str(tmp_path)) == 1
+        (tmp_path / "BENCH_r07.json").write_text("{}")
+        (tmp_path / "BENCH_r03.json").write_text("{}")
+        assert _next_snapshot_n(str(tmp_path)) == 8
+
+    def test_write_snapshot_schema_and_parsed_line(self, tmp_path):
+        import json
+
+        from bench import _write_snapshot
+
+        stdout = ('warmup noise\n'
+                  '{"metric": "bert", "value": 1.0}\n'
+                  '{"metric": "gpt", "value": 2.0}\n'
+                  'not json trailer\n')
+        path = _write_snapshot(str(tmp_path), stdout, 0, "python bench.py")
+        snap = json.loads(open(path).read())
+        assert os.path.basename(path) == "BENCH_r01.json"
+        assert set(snap) == {"n", "cmd", "rc", "tail", "parsed"}
+        assert snap["parsed"] == {"metric": "gpt", "value": 2.0}
+        assert snap["tail"].endswith("not json trailer\n")
 
 
 class TestFreshBatches:

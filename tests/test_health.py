@@ -144,14 +144,52 @@ def test_sentinel_gate_blocks_poisoned_update(sentinel_on):
     assert float(ts.step(bs[3], index=4)) == ref[3]
 
 
-def test_sentinel_rejects_offload_composition(sentinel_on):
+def test_sentinel_offload_composition_gates_streamed_update(sentinel_on):
+    """sentinel x offload composes legally now (the step pipeline proves
+    it instead of hand-rejecting it): the grad-only compiled step carries
+    the fused stats + in-graph verdict, and the dispatch gates the
+    streamed update on it. Clean steps match the offload-only trajectory
+    bitwise; a poisoned step leaves params and the host-resident moments
+    untouched; the composition carries zero G errors."""
     from paddle_tpu.framework import offload
     if offload.host_memory_kind() is None:
         pytest.skip("no host memory tier on this runtime")
-    flags.set_flags({"offload_optimizer": "moments"})
+    bs = _batches(4, poison_seam=True)
+    flags.set_flags({"offload_optimizer": "moments",
+                     "health_sentinel": "off"})
     try:
-        with pytest.raises(ValueError, match="health_sentinel"):
-            _mlp_step()
+        ts_ref = _mlp_step(poison_seam=True)
+        assert ts_ref._step_kind == "offload"
+        ref = [float(ts_ref.step(b, index=i + 1)) for i, b in enumerate(bs)]
+
+        flags.set_flags({"health_sentinel": "on"})
+        ts = _mlp_step(poison_seam=True)
+        assert ts._offload is not None and ts._sentinel is not None
+        assert ts._step_kind == "offload_sentinel"
+        assert not [d for d in ts._pass_diags if d.severity == "error"]
+        got = [float(ts.step(b, index=i + 1)) for i, b in enumerate(bs[:2])]
+        assert got == ref[:2]
+        v = ts.sentinel_verdict()
+        assert v.ok and v.applied
+
+        before_p = jax.tree_util.tree_map(np.asarray, ts.params)
+        before_m = jax.tree_util.tree_map(np.asarray, ts.opt_state)
+        poisoned = (bs[2][0], bs[2][1], np.asarray([np.nan], np.float32))
+        ts.step(poisoned, index=3)
+        v = ts.sentinel_verdict()
+        assert v.kind == "nan_loss" and not v.applied
+        def same(a, b):
+            assert a.tobytes() == b.tobytes()
+
+        jax.tree_util.tree_map(
+            same, jax.tree_util.tree_map(np.asarray, ts.params), before_p)
+        jax.tree_util.tree_map(
+            same, jax.tree_util.tree_map(np.asarray, ts.opt_state),
+            before_m)
+        # replay the same index with the clean batch: bitwise back on the
+        # never-poisoned offload trajectory
+        assert float(ts.step(bs[2], index=3)) == ref[2]
+        assert float(ts.step(bs[3], index=4)) == ref[3]
     finally:
         flags.set_flags({"offload_optimizer": "off"})
 

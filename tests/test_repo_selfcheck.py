@@ -116,12 +116,13 @@ def test_rules_md_catalog_matches_code():
     import glob
     import re
     from paddle_tpu.analysis import (concurrency_check, hlo_check,
-                                     jaxpr_lint, plan_check)
+                                     jaxpr_lint, pass_check, plan_check)
 
     code_ids = {r.rule_id for r in jaxpr_lint.all_rules()}
     code_ids |= {r.rule_id for r in plan_check.all_plan_rules()}
     code_ids |= {r.rule_id for r in hlo_check.all_hlo_rules()}
     code_ids |= {r.rule_id for r in concurrency_check.all_thread_rules()}
+    code_ids |= {r.rule_id for r in pass_check.all_pass_rules()}
     sources = (
         glob.glob(os.path.join(REPO, "paddle_tpu", "analysis", "*.py")) +
         glob.glob(os.path.join(REPO, "paddle_tpu", "observability",
@@ -165,6 +166,43 @@ def test_plan_rules_registered():
     assert ids == {"S001", "S002", "S003", "D001", "D002", "D003", "D004",
                    "D005"}
     assert all(r.doc for r in plan_check.all_plan_rules())
+
+
+def test_pass_rules_registered():
+    """The G family (pass-composition rules) is registry-enumerable,
+    lives in its own registry (plan_check's stays pinned), and every
+    rule carries a doc line for the RULES.md meta-test."""
+    from paddle_tpu.analysis import pass_check
+    ids = {r.rule_id for r in pass_check.all_pass_rules()}
+    assert ids == {"G001", "G002", "G003", "G004", "G005"}
+    assert all(r.doc for r in pass_check.all_pass_rules())
+
+
+def test_requires_new_jax_marker_matches_known_gap_files():
+    """Selfcheck both directions: every file in the pinned jax-0.4.37
+    API-gap set carries the module-level `requires_new_jax` pytestmark,
+    and no other test file does — so `-m "not requires_new_jax"` is a
+    known-green tier-1 run and a failure outside the set is a real
+    regression."""
+    import glob
+    import re
+
+    from conftest import REQUIRES_NEW_JAX_FILES
+
+    mark_pat = re.compile(
+        r"^pytestmark = pytest\.mark\.requires_new_jax$", re.MULTILINE)
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    marked = set()
+    for path in glob.glob(os.path.join(tests_dir, "test_*.py")):
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+        if mark_pat.search(src):
+            marked.add(os.path.basename(path))
+    assert marked == set(REQUIRES_NEW_JAX_FILES), (
+        f"unmarked known-gap files: "
+        f"{sorted(set(REQUIRES_NEW_JAX_FILES) - marked)}; "
+        f"marked but not in conftest.REQUIRES_NEW_JAX_FILES: "
+        f"{sorted(marked - set(REQUIRES_NEW_JAX_FILES))}")
 
 
 def test_repo_lint_default_coverage_is_wide():

@@ -8,6 +8,7 @@ the Pallas kernel configs and the source tree) with paddle_tpu.analysis.
     python tools/lint_graph.py --matrix              # tier-flag matrix gate
     python tools/lint_graph.py --matrix --json       # machine-readable
     python tools/lint_graph.py --hlo                 # compiled-HLO X-rules
+    python tools/lint_graph.py --passes              # pass-pipeline G-rules
 
 Exits nonzero when any error-severity diagnostic is found — the CI gate
 that needs no TPU. Clean models print their diagnostic count (0) and the
@@ -24,9 +25,16 @@ and runs the compiled-HLO X-rules (``analysis/hlo_check`` — skip with
 ``--no-hlo``) — then runs the ten multichip dryrun scenarios (skipped
 with a note on legacy jax, where they cannot trace). ``--hlo`` runs the
 X-rules standalone over the representative composed steps plus a seeded
-X001 self-test. ``--json`` switches stdout to one machine-readable
-report for CI (schema v2: ``schema_version`` + per-family
-``rule_index``).
+X001 self-test. ``--passes`` runs the step-compiler pass-pipeline
+verifier standalone: the ordered pass list and per-pass contract hashes,
+every tier combo (both sentinel arms) composed plan-only through
+``framework/step_pipeline.py`` and checked with the G-rules
+(``analysis/pass_check``), plus seeded self-tests that G001/G002/G004
+each fire on a bad composition. ``--json`` switches stdout to one
+machine-readable report for CI (schema v3: v2's ``schema_version`` +
+per-family ``rule_index``, plus the ``passes`` section — ordered pass
+list, contract hashes, per-combo composed-plan hash — so CI can diff
+pipeline composition across PRs).
 """
 
 import argparse
@@ -385,8 +393,10 @@ _SEV_RANK = {"info": 0, "warning": 1, "error": 2}
 
 # --json report schema. v2 adds schema_version itself plus the
 # rule_index section (family -> {count, ids -> per-id counts}) so CI can
-# diff reports across PRs without re-deriving the rule taxonomy.
-SCHEMA_VERSION = 2
+# diff reports across PRs without re-deriving the rule taxonomy. v3 adds
+# the passes section (ordered pass list, per-pass contract hashes,
+# per-combo composed-plan hash) so CI can diff step-pipeline composition.
+SCHEMA_VERSION = 3
 
 
 def _rule_index(diags):
@@ -519,9 +529,12 @@ def _run_impl(models, with_kernels=False, with_repo=False,
 # --matrix: the tier-flag composition gate
 # ---------------------------------------------------------------------------
 
-# the six tier flags (analysis/plan_check.TIER_FLAGS): which parts of a
-# combination need a fresh step trace, vs. arithmetic-only component checks
-_TRACE_KEYS = ("offload_optimizer", "comm_overlap", "remat")
+# The matrix's step traces are cached by the composed-plan hash
+# (pass_check.composed_plan_hash over the plan-only pipeline build):
+# combos whose pipelines compose the same StepPlan trace/compile once.
+# cp_nested_ring and pallas_conv live inside the loss function, not the
+# pipeline, so they hash equal by construction (their components are
+# checked separately below).
 
 
 def _matrix_micro_step(remat: bool):
@@ -763,19 +776,28 @@ def run_matrix(min_severity="info", json_mode=False, with_dryrun=True,
 def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None,
                      with_hlo=True):
     import tools.hbm_budget as hbm_budget
-    from paddle_tpu.analysis import plan_check
+    from paddle_tpu.analysis import pass_check, plan_check
     from paddle_tpu.core import flags as core_flags
+    from paddle_tpu.framework import step_pipeline
     from paddle_tpu.ops._pallas import conv as _pconv  # registers the flag
     del _pconv
 
     tier_names = [n for n, _ in plan_check.TIER_FLAGS]
     prev = {n: core_flags.flag(n) for n in tier_names
             if n in core_flags.get_flags()}
+    # every combo — caller-supplied included — through the one
+    # normalization entry point (legacy 5-flag dicts warn once there)
     combos = list(plan_check.iter_tier_combos()) if combos is None \
         else list(combos)
+    combos = [plan_check.normalize_combo(c) for c in combos]
     step_cache = {}
     component_cache = {}
-    report = {"combos": [], "errors": 0}
+    report = {"combos": [], "errors": 0,
+              "passes": {
+                  "order": [p.contract.name for p in step_pipeline.PIPELINE],
+                  "contracts": {
+                      p.contract.name: pass_check.contract_hash(p.contract)
+                      for p in step_pipeline.PIPELINE}}}
     n_errors = 0
     all_diags = []
     try:
@@ -783,20 +805,29 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None,
             core_flags.set_flags({
                 "offload_optimizer": combo["offload_optimizer"],
                 "comm_overlap": combo["comm_overlap"],
-                "multislice": combo.get("multislice", "off"),
+                "multislice": combo["multislice"],
                 "cp_nested_ring": combo["cp_nested_ring"],
                 "pallas_conv": combo["pallas_conv"],
             })
             diags = []
             entry = {"flags": dict(combo)}
-            # (a) the composed StepPlan, traced + verified (cached per
-            # trace-relevant sub-key: cp/pallas_conv don't change the
-            # micro step's graph — their components are checked below)
-            sub = tuple(combo[k] for k in _TRACE_KEYS)
-            if sub not in step_cache:
-                step_cache[sub] = _matrix_step_diags(combo["remat"],
-                                                     with_hlo=with_hlo)
-            sdiags, sinfo = step_cache[sub]
+            # (a0) the combo composed plan-only through the pass pipeline:
+            # the G-rule gate, and the composed-plan hash that keys the
+            # step trace cache + the CI composition diff
+            pbuild = step_pipeline.compose(step_pipeline.plan_only_build(combo))
+            diags += pbuild.diagnostics
+            plan_hash = pass_check.composed_plan_hash(pbuild.plan)
+            entry["passes"] = {
+                "order": [c.name for c in pbuild.contracts],
+                "plan_hash": plan_hash}
+            # (a) the composed StepPlan, traced + verified (cached by the
+            # composed-plan hash: combos whose pipelines emit the same
+            # plan share one trace; cp/pallas_conv don't enter the
+            # pipeline — their components are checked below)
+            if plan_hash not in step_cache:
+                step_cache[plan_hash] = _matrix_step_diags(
+                    combo["remat"], with_hlo=with_hlo)
+            sdiags, sinfo = step_cache[plan_hash]
             diags += sdiags
             entry["step"] = {"eqns": sinfo.get("eqns")}
             if "hlo" in sinfo:
@@ -806,7 +837,7 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None,
                 if "sp" not in component_cache:
                     component_cache["sp"] = _matrix_sp_pair_diags()
                 diags += component_cache["sp"][0]
-            if combo.get("multislice", "off") != "off":
+            if combo["multislice"] != "off":
                 # the micro step's mesh has no 'slice' axis (the tier is
                 # inert there by design); the 2-slice composition is
                 # checked once as a component
@@ -864,6 +895,133 @@ def _run_matrix_impl(min_severity="info", with_dryrun=True, combos=None,
     print(f"matrix total: {len(report['combos'])} combination(s), "
           f"{n_errors} error(s)")
     return (1 if n_errors else 0), report
+
+
+# ---------------------------------------------------------------------------
+# --passes: the step-compiler pass-pipeline verifier (G rules)
+# ---------------------------------------------------------------------------
+
+def _passes_selftests():
+    """Seeded bad compositions: G001 (a pass ordered before its
+    provider), G002 (conflicting buffer ownership with no declared
+    handoff), G004 (an undeclared order-sensitive pair) must each fire —
+    the gate that proves each rule still detects its hazard class."""
+    import dataclasses
+    from paddle_tpu.analysis.jaxpr_lint import Diagnostic
+    from paddle_tpu.analysis.pass_check import PassContract
+    from paddle_tpu.framework import step_pipeline as sp
+
+    pipe = {p.contract.name: p for p in sp.PIPELINE}
+    combo = {"offload_optimizer": "moments", "comm_overlap": "tp_zero",
+             "multislice": "off", "cp_nested_ring": False,
+             "pallas_conv": 0, "remat": False}
+
+    def fired(rule, order, **kw):
+        b = sp.plan_only_build(combo, **kw)
+        sp.compose(b, order=order)
+        return any(d.rule == rule for d in b.diagnostics)
+
+    class _Rogue(sp.StepPass):
+        # writes/donates base_grad's params with no declared handoff
+        contract = PassContract(
+            name="rogue", requires=("grads",), provides=("rogue",),
+            terminal=("rogue",), plan_writes=("params",),
+            plan_donates=("params",))
+
+    class _NoEdgeSentinel(sp.HealthSentinelPass):
+        # the genuinely order-sensitive sentinel<->offload pair with its
+        # declared edge stripped
+        contract = dataclasses.replace(sp.HealthSentinelPass.contract,
+                                       order_after=())
+
+    results = {
+        "G001": fired("G001", [pipe["offload_stream"], pipe["base_grad"]]),
+        "G002": fired("G002", [pipe["base_grad"], _Rogue(),
+                               pipe["offload_stream"]]),
+        "G004": fired("G004",
+                      [_NoEdgeSentinel() if isinstance(
+                          p, sp.HealthSentinelPass) else p
+                       for p in sp.PIPELINE],
+                      health_sentinel=True),
+    }
+    diags = []
+    for rule, ok in sorted(results.items()):
+        if not ok:
+            diags.append(Diagnostic(
+                rule=rule, name="selftest-missing", severity="error",
+                message=f"self-test: {rule} did not fire on its seeded "
+                        "bad composition",
+                where="passes.selftest"))
+    return results, diags
+
+
+def run_passes(min_severity="info", json_mode=False):
+    """The pass-pipeline G-rule gate standalone: the declared pipeline
+    (ordered pass list + per-pass contract hashes), every tier combo in
+    BOTH sentinel arms composed plan-only and G-rule-verified (256
+    compositions, incl. sentinel x offload), and the seeded per-rule
+    self-tests."""
+    if json_mode:
+        import contextlib
+        with contextlib.redirect_stdout(sys.stderr):
+            rc, report = _run_passes_impl(min_severity)
+        print(json.dumps(report, indent=2))
+        return rc
+    rc, _ = _run_passes_impl(min_severity)
+    return rc
+
+
+def _run_passes_impl(min_severity="info"):
+    from paddle_tpu.analysis import pass_check, plan_check
+    from paddle_tpu.framework import step_pipeline as sp
+    all_diags = []
+    report = {
+        "schema_version": SCHEMA_VERSION,
+        "passes": {
+            "order": [p.contract.name for p in sp.PIPELINE],
+            "contracts": {
+                p.contract.name: pass_check.contract_hash(p.contract)
+                for p in sp.PIPELINE}},
+        "combos": [],
+    }
+    print("== pass pipeline: "
+          + " -> ".join(report["passes"]["order"]))
+    for name, h in report["passes"]["contracts"].items():
+        print(f"  contract {name}: {h}")
+    n_hashes = set()
+    for combo in plan_check.iter_tier_combos():
+        for sentinel in (False, True):
+            b = sp.plan_only_build(combo, health_sentinel=sentinel)
+            sp.compose(b)
+            h = pass_check.composed_plan_hash(b.plan)
+            n_hashes.add(h)
+            errors = [d for d in b.diagnostics if d.severity == "error"]
+            report["combos"].append({
+                "flags": dict(combo, health_sentinel=sentinel),
+                "order": [c.name for c in b.contracts],
+                "plan_hash": h,
+                "diagnostics": [d.to_json() for d in b.diagnostics],
+                "errors": len(errors)})
+            all_diags += b.diagnostics
+            for d in b.diagnostics:
+                if _SEV_RANK[d.severity] >= _SEV_RANK[min_severity]:
+                    print("  " + d.format())
+    print(f"== {len(report['combos'])} compositions "
+          f"(incl. sentinel arms), {len(n_hashes)} distinct plan hash(es)")
+    fired, st_diags = _passes_selftests()
+    print("== passes self-tests (each rule must fire on its seeded "
+          "bad composition)")
+    for rule, ok in sorted(fired.items()):
+        print(f"  {rule}: {'fires' if ok else 'MISSING'}")
+    report["selftests"] = fired
+    all_diags += st_diags
+    errors = [d for d in all_diags if d.severity == "error"]
+    report["rule_index"] = _rule_index(all_diags)
+    report["total_diagnostics"] = len(all_diags)
+    report["errors"] = len(errors)
+    print(f"passes total: {len(all_diags)} diagnostic(s), "
+          f"{len(errors)} error(s)")
+    return (1 if errors else 0), report
 
 
 # ---------------------------------------------------------------------------
@@ -1149,6 +1307,10 @@ def main(argv=None):
                    help="host-concurrency verifier (T-rules): per-rule "
                         "seeded self-tests + the repo sweep + the "
                         "static lock-order graph")
+    p.add_argument("--passes", action="store_true",
+                   help="step-compiler pass-pipeline verifier (G-rules): "
+                        "contract hashes, every tier combo composed "
+                        "plan-only, + seeded G001/G002/G004 self-tests")
     p.add_argument("--no-dryrun", action="store_true",
                    help="with --matrix: skip the multichip dryrun scenarios")
     p.add_argument("--no-hlo", action="store_true",
@@ -1165,6 +1327,8 @@ def main(argv=None):
                           with_hlo=not a.no_hlo)
     if a.hlo:
         return run_hlo(min_severity=a.min_severity, json_mode=a.json)
+    if a.passes:
+        return run_passes(min_severity=a.min_severity, json_mode=a.json)
     if a.threads:
         return run_threads(min_severity=a.min_severity, json_mode=a.json)
     if a.all:
