@@ -1021,6 +1021,94 @@ def bench_flight_recorder_overhead(small: bool):
     })
 
 
+def bench_fleet_telemetry_overhead(small: bool):
+    """A/B one instrumented ``sharded.TrainStep`` with
+    FLAGS_fleet_telemetry=off vs =on (exporter armed to a scratch dir,
+    its daemon thread publishing CRC-framed registry snapshots at the
+    default cadence, FLAGS_telemetry=metrics both arms) and emit
+    ``fleet_telemetry_overhead_pct`` — the live fleet plane must cost
+    <2% step time on the CPU mesh, measured with interleaved windows
+    exactly like the recorder A/B above."""
+    import tempfile
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.core import flags as _flags
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.observability import live as _live
+    from paddle_tpu.observability import step_monitor
+    from paddle_tpu.optimizer import AdamW
+
+    batch = 32 if small else 64
+    hidden = 512 if small else 2048
+    # windows must span several export ticks at the drills' 0.2s
+    # cadence, or min-of-windows would just pick an export-free window
+    steps = 120 if small else 150
+    windows = 4
+
+    def loss_fn(model, params, b):
+        x, y = b
+        return F.cross_entropy(functional_call(model, params, x), y).mean()
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((batch, hidden)).astype(np.float32)
+    y = rng.integers(0, 10, (batch,)).astype(np.int64)
+
+    step_monitor.reset_default()
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(hidden, hidden), nn.Tanh(),
+                        nn.Linear(hidden, hidden), nn.Tanh(),
+                        nn.Linear(hidden, 10))
+    ts = make_sharded_train_step(net, AdamW(1e-3), loss_fn)
+    run_dir = tempfile.mkdtemp(prefix="bench_fleet_")
+    prev = _flags.get_flags(["fleet_telemetry", "telemetry"])
+    best = {"off": None, "on": None}
+    n = {"steps": 0}
+    try:
+        _flags.set_flags({"telemetry": "metrics"})
+        # armed with the thread running BOTH arms: the off arm measures
+        # the gate (the thread wakes, sees off, publishes nothing), the
+        # on arm the full snapshot+publish path — at the 0.2s cadence
+        # the drills themselves arm (FLAGS_fleet_export_interval=0.2)
+        exp = _live.arm(run_dir, role="bench", interval_s=0.2)
+        float(ts.step((x, y)))  # compile + warm
+        float(ts.step((x, y)))
+        for _ in range(windows):
+            for mode in ("off", "on"):
+                _flags.set_flags({"fleet_telemetry": mode})
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    loss = ts.step((x, y))
+                    n["steps"] += 1
+                    _live.note_progress(n["steps"])
+                float(loss)  # sync the window
+                dt = (time.perf_counter() - t0) / steps
+                best[mode] = dt if best[mode] is None \
+                    else min(best[mode], dt)
+        snap = _live.read_snapshot(exp.path)
+    finally:
+        _live.disarm(final_export=False)
+        _flags.set_flags(prev)
+    t_off, t_on = best["off"], best["on"]
+    overhead_pct = 100.0 * (t_on / t_off - 1.0)
+    _emit("fleet_telemetry_overhead_pct", overhead_pct, "pct", 0.0, {
+        "overhead_pct": round(overhead_pct, 3),
+        "step_ms_off": round(t_off * 1e3, 3),
+        "step_ms_on": round(t_on * 1e3, 3),
+        "steps_per_window": steps, "windows": windows,
+        "batch": batch, "hidden": hidden,
+        "exports_published": (snap or {}).get("seq"),
+        "note": "min-of-windows wall per instrumented sharded.TrainStep "
+                "step, FLAGS_fleet_telemetry=off vs =on (exporter "
+                "thread armed both arms at the drills' 0.2s cadence, "
+                "FLAGS_telemetry=metrics both arms), identical "
+                "model/batch/seed; aggregate the snapshots with "
+                "tools/fleet_top.py",
+    })
+
+
 # ---------------------------------------------------------------------------
 # Config 4 (PRIMARY): GPT decoder LM
 # ---------------------------------------------------------------------------
@@ -1461,8 +1549,9 @@ def bench_fault(small: bool):
         }
 
     def _pm_timeline(drill_name, rep):
-        # machine-readable postmortem record per drill run, riding the
-        # shared timeline JSONL like the serving/health records do
+        # machine-readable postmortem + live-fleet records per drill
+        # run, riding the shared timeline JSONL like the serving/health
+        # records do
         out_path = os.environ.get("BENCH_TRACE_OUT",
                                   "BENCH_timeline.jsonl")
         try:
@@ -1470,6 +1559,14 @@ def bench_fault(small: bool):
                 f.write(json.dumps({"kind": "postmortem",
                                     "drill": drill_name,
                                     **_pm_summary(rep)}) + "\n")
+                fl = rep.get("fleet")
+                if fl:
+                    f.write(json.dumps({
+                        "kind": "fleet_live", "drill": drill_name,
+                        **{k: fl.get(k) for k in (
+                            "workers", "incarnations_seen",
+                            "silent_incarnations", "final_status",
+                            "final_step", "ok")}}) + "\n")
         except OSError:
             pass
 
@@ -1774,6 +1871,19 @@ def bench_serve_resilience(model, max_pos, vocab, small: bool):
     if not drill_report.get("ok"):
         raise RuntimeError(f"serve drill failed: {drill_report}")
     once = drill_report["exactly_once"]
+    fl = drill_report.get("fleet") or {}
+    try:
+        with open(os.environ.get("BENCH_TRACE_OUT",
+                                 "BENCH_timeline.jsonl"), "a") as f:
+            f.write(json.dumps({
+                "kind": "fleet_live", "drill": "serve",
+                **{k: fl.get(k) for k in (
+                    "workers", "incarnations_seen",
+                    "silent_incarnations", "final_status",
+                    "live_goodput", "postmortem_goodput",
+                    "goodput_match", "ok")}}) + "\n")
+    except OSError:
+        pass
 
     # -- (2) fault-injected overload trace ----------------------------------
     # The pool hog goes FIRST (closed-loop serve submits in order, so it
@@ -2425,6 +2535,12 @@ def _main_impl():
         except Exception as e:
             print(json.dumps(
                 {"metric": "bench_flight_recorder_overhead_FAILED",
+                 "error": str(e)[:500]}), flush=True)
+        try:
+            bench_fleet_telemetry_overhead(small)
+        except Exception as e:
+            print(json.dumps(
+                {"metric": "bench_fleet_telemetry_overhead_FAILED",
                  "error": str(e)[:500]}), flush=True)
     # comm-overlap A/B (FLAGS_comm_overlap off vs tp): emits the
     # comm_overlap metric — measured on >=2-device meshes, static hop

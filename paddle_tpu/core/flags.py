@@ -178,6 +178,23 @@ define_flag("flight_recorder", "off",
             "os._exit with no flush — the input to observability.fleet "
             "and tools/postmortem.py.",
             choices=("off", "on"))
+define_flag("fleet_telemetry", "off",
+            "Live fleet telemetry exporter (paddle_tpu.observability."
+            "live): 'off' (default) keeps every export seam a no-op "
+            "(byte-identical on step outputs, the FLAGS_telemetry "
+            "contract); 'on' runs a per-process daemon thread that "
+            "every FLAGS_fleet_export_interval seconds publishes a "
+            "CRC-framed, atomically-replaced snapshot of the metrics "
+            "registry (plus step index / heartbeat / role.replica."
+            "incarnation identity) under <run>/fleet/ — the input to "
+            "the fleet aggregator, the SLO/alert rule engine "
+            "(observability/alerts.py) and tools/fleet_top.py.",
+            choices=("off", "on"))
+define_flag("fleet_export_interval", 1.0,
+            "Seconds between live fleet snapshot publications per "
+            "worker (observability/live.py). Staleness classification "
+            "keys off this: a worker whose latest snapshot is older "
+            "than 2x its own advertised interval is 'dead'.")
 define_flag("flight_recorder_mb", 4,
             "Flight-recorder ring capacity per process incarnation in "
             "MiB (the ring wraps — oldest records are overwritten).")

@@ -23,9 +23,9 @@ measure the composition chiplessly on the CPU mesh; the guarded drill
 trainer (``fault/_trainer.py`` health mode) beats the monitor per step.
 """
 
-from .heartbeat import SliceHeartbeatMonitor
+from .heartbeat import SliceHeartbeatMonitor, classify_liveness
 from .reducer import HierarchicalGradReducer
 from .topology import SLICE_AXIS, SliceTopology
 
 __all__ = ["SliceTopology", "HierarchicalGradReducer", "SLICE_AXIS",
-           "SliceHeartbeatMonitor"]
+           "SliceHeartbeatMonitor", "classify_liveness"]

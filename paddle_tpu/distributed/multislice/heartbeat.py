@@ -30,7 +30,24 @@ import os
 import time
 from typing import Dict, Optional
 
-__all__ = ["SliceHeartbeatMonitor"]
+__all__ = ["SliceHeartbeatMonitor", "classify_liveness"]
+
+
+def classify_liveness(age_s: Optional[float], ttl_s: float,
+                      step: int, max_step: int, lag_steps: int,
+                      fresh_label: str = "alive") -> str:
+    """The one staleness rule, shared between this monitor (labels
+    ``alive``/``slow``/``dead``) and the live fleet aggregator
+    (``observability/live.py``, which labels the healthy state
+    ``fresh``): dead when the last signal is older than ``ttl_s`` (or
+    absent — ``age_s=None``); slow when the signal is fresh but the
+    step counter trails the fleet maximum by more than ``lag_steps``;
+    healthy otherwise."""
+    if age_s is None or age_s > ttl_s:
+        return "dead"
+    if max_step - step > lag_steps:
+        return "slow"
+    return fresh_label
 
 
 class SliceHeartbeatMonitor:
@@ -78,13 +95,11 @@ class SliceHeartbeatMonitor:
                        default=0)
         out: Dict[int, str] = {}
         for sid in range(self.num_slices):
-            r = fresh.get(sid)
-            if r is None:
-                out[sid] = "dead"
-            elif max_step - r.get("step", 0) > self.lag_steps:
-                out[sid] = "slow"
-            else:
-                out[sid] = "alive"
+            r = recs.get(sid)
+            age = (now - r.get("time", 0)) if r is not None else None
+            out[sid] = classify_liveness(
+                age, self.ttl_s, r.get("step", 0) if r else 0,
+                max_step, self.lag_steps)
         return out
 
     def summary(self, now: Optional[float] = None) -> Dict[str, object]:

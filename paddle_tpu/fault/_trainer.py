@@ -163,12 +163,18 @@ def train(work_dir: str, total_steps: int = 8, ckpt_every: int = 2,
     from paddle_tpu.fault.checkpoint_manager import CheckpointManager
     from paddle_tpu.fault.injection import FaultInjector, FaultPlan
     from paddle_tpu.observability import flight_recorder as flr
+    from paddle_tpu.observability import live as fleet_live
 
     os.makedirs(work_dir, exist_ok=True)
     # the black box: one crash-persistent ring per incarnation, keyed
     # (role, replica, incarnation) — no-op unless FLAGS_flight_recorder=on
     box = flr.arm_if_enabled(
         os.path.join(work_dir, "flr"), role="trainer",
+        replica_id=int(os.environ.get("FAULT_SLICE_ID") or 0))
+    # the live plane: periodic registry snapshots under work_dir/fleet
+    # (no-op unless FLAGS_fleet_telemetry=on)
+    fleet_live.arm_if_enabled(
+        work_dir, role="trainer",
         replica_id=int(os.environ.get("FAULT_SLICE_ID") or 0))
     log = _Log(os.path.join(work_dir, "train_log.jsonl"))
     plan = FaultPlan.from_json(plan_json)
@@ -220,6 +226,7 @@ def train(work_dir: str, total_steps: int = 8, ckpt_every: int = 2,
         dt = time.perf_counter() - t0
         inj.poll_step_end(step)  # mid-step kill: loss computed, never logged
         log.write({"step": step, "loss": loss, "t": round(dt, 6)})
+        fleet_live.note_progress(step)
         if (step + 1) % ckpt_every == 0 and step + 1 < total_steps:
             mgr.save(step + 1, make_state(step + 1))
     mgr.save(total_steps, make_state(total_steps), block=True)
@@ -227,6 +234,7 @@ def train(work_dir: str, total_steps: int = 8, ckpt_every: int = 2,
     if len(plan):
         inj.disarm()
     log.write({"event": "done"})
+    fleet_live.disarm(final_export=True)  # the closed "exited" farewell
     if box is not None:  # inline runs reuse the process: detach the box
         flr.disarm()
 
@@ -251,11 +259,15 @@ def _train_guarded(work_dir: str, total_steps: int, ckpt_every: int,
     from paddle_tpu.fault.guardian import Guardian
     from paddle_tpu.fault.injection import FaultInjector, FaultPlan
     from paddle_tpu.observability import flight_recorder as flr
+    from paddle_tpu.observability import live as fleet_live
     from paddle_tpu.observability import step_monitor
 
     os.makedirs(work_dir, exist_ok=True)
     box = flr.arm_if_enabled(
         os.path.join(work_dir, "flr"), role="trainer",
+        replica_id=int(os.environ.get("FAULT_SLICE_ID") or 0))
+    fleet_live.arm_if_enabled(
+        work_dir, role="trainer",
         replica_id=int(os.environ.get("FAULT_SLICE_ID") or 0))
     log = _Log(os.path.join(work_dir, "train_log.jsonl"))
     plan = FaultPlan.from_json(plan_json)
@@ -453,6 +465,7 @@ def _train_guarded(work_dir: str, total_steps: int, ckpt_every: int,
         loss = float(loss_arr)
         inj.poll_step_end(applied)
         log.write({"step": applied, "loss": loss, "t": round(dt, 6)})
+        fleet_live.note_progress(applied)
         if hb is not None:
             hb.beat(applied)
         guardian.note_clean_step(applied)
@@ -467,6 +480,7 @@ def _train_guarded(work_dir: str, total_steps: int, ckpt_every: int,
     if len(plan):
         inj.disarm()
     log.write({"event": "done"})
+    fleet_live.disarm(final_export=True)  # the closed "exited" farewell
     if box is not None:  # inline runs reuse the process: detach the box
         flr.disarm()
 

@@ -105,7 +105,8 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
               size: str = "quick", max_restarts: Optional[int] = None,
               reference: str = "inline",
               health: bool = False, canary_every: int = 3,
-              flight_recorder: bool = True
+              flight_recorder: bool = True,
+              fleet_telemetry: bool = True
               ) -> Dict[str, Any]:
     """Run the fault-injected job + the uninterrupted reference, return the
     full report (goodput record, parity verdict, plan, per-run logs).
@@ -145,6 +146,12 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
         # every incarnation writes a crash-persistent black box; the
         # postmortem below reconstructs the run from those + journals
         env["FLAGS_flight_recorder"] = "on"
+    if fleet_telemetry:
+        # the live plane: every incarnation exports registry snapshots
+        # under fault_dir/fleet while it runs — the drill-end view must
+        # show the killed incarnations as silent and the survivor exited
+        env["FLAGS_fleet_telemetry"] = "on"
+        env["FLAGS_fleet_export_interval"] = "0.2"
     if health:
         env.update({"FAULT_HEALTH": "1",
                     "FAULT_CANARY_EVERY": str(canary_every),
@@ -219,6 +226,25 @@ def run_drill(workdir: str, total_steps: int = 8, ckpt_every: int = 2,
         report["postmortem"] = fleet.postmortem_report(
             fault_dir, plan=report["plan"]["events"],
             ckpt_every=ckpt_every)
+
+    # -- live fleet plane: the trainer exported snapshots the whole run —
+    # the final incarnation must have said its closed farewell and every
+    # SIGKILLed one must be a silent incarnation in the aggregated view
+    if fleet_telemetry:
+        from ..observability import live as fleet_live
+        view = fleet_live.aggregate(fault_dir)
+        worker = next(iter(view["workers"].values()), {})
+        report["fleet"] = {
+            "workers": {k: w["status"]
+                        for k, w in view["workers"].items()},
+            "incarnations_seen": int(worker.get("incarnations", 0)),
+            "silent_incarnations": list(
+                worker.get("silent_incarnations", [])),
+            "final_status": worker.get("status"),
+            "final_step": worker.get("step"),
+            "derived": view["derived"],
+            "ok": bool(worker) and worker.get("status") == "exited",
+        }
     return report
 
 
@@ -282,4 +308,11 @@ def report_summary(report: Dict[str, Any]) -> str:
             f"latency_steps={h.get('detection_latency_steps')} "
             f"skipped={h.get('skipped_batches')} "
             f"rewound={h.get('rewound_steps')}")
+    fl = report.get("fleet")
+    if fl:
+        lines.append(
+            f"  fleet: final={fl.get('final_status')} "
+            f"step={fl.get('final_step')} "
+            f"silent_incs={fl.get('silent_incarnations')} "
+            f"ok={fl.get('ok')}")
     return "\n".join(lines)

@@ -27,6 +27,14 @@ low-overhead measurement layer that is always there (gated by
   with no flush; :mod:`.fleet` merges every incarnation's ring with the
   fsynced journals into one globally-ordered fleet timeline, and
   ``tools/postmortem.py`` reconstructs + verifies the story.
+- :mod:`.live` — the live tier (``FLAGS_fleet_telemetry=off|on``): each
+  worker publishes CRC-framed, atomically-replaced registry snapshots
+  under ``<run>/fleet/`` on a fixed cadence; the aggregator merges them
+  into one labeled fleet view (exact log2-bucket histogram merge,
+  fresh/slow/dead staleness) and :mod:`.alerts` evaluates declarative
+  threshold/rate/absence SLO rules against it (Diagnostics L001-L003 +
+  flight-recorder ``alert`` records — the autoscaler-input contract);
+  ``tools/fleet_top.py`` renders the view live or as ``--once --json``.
 
 Wiring: ``framework.sharded.TrainStep``, ``framework.offload``,
 ``distributed.pipeline_schedule``, ``io.dataloader`` and ``hapi`` report
@@ -41,6 +49,8 @@ from . import flight_recorder  # noqa: F401
 from . import step_monitor  # noqa: F401
 from . import request_timeline  # noqa: F401
 from . import fleet  # noqa: F401
+from . import live  # noqa: F401
+from . import alerts  # noqa: F401
 from .trace import span, telemetry_mode  # noqa: F401
 from .step_monitor import (StepTimeline, RecompileSentinel,  # noqa: F401
                            current, reset_default, instrument_jitted,
@@ -50,7 +60,7 @@ from .flight_recorder import FlightRecorder  # noqa: F401
 
 __all__ = [
     "metrics", "trace", "step_monitor", "request_timeline",
-    "flight_recorder", "fleet",
+    "flight_recorder", "fleet", "live", "alerts",
     "span", "telemetry_mode",
     "StepTimeline", "RecompileSentinel", "RequestTimeline",
     "FlightRecorder",

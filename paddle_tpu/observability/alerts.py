@@ -1,0 +1,325 @@
+"""Declarative SLO/alert rules over the live fleet view.
+
+The third observability arm's *decision* layer: :mod:`.live` merges
+per-worker snapshots into one fleet view; this module evaluates a small
+declarative rule set against that view — the Prometheus alerting-rule
+shape (PromQL condition + ``for:`` window + labels) reduced to the
+three primitives the repo's drills actually exercise:
+
+- ``threshold`` — a derived fleet signal (or per-worker signal)
+  crosses a bound: ``min_free_block_frac < 0.1``;
+- ``rate`` — a cumulative counter's per-second rate over a sliding
+  window exceeds a bound, computed from the history ring each worker
+  embeds in its own snapshot (so one file read yields the window):
+  ``rate(serving.shed + serving.rejected) > 0``;
+- ``absence`` — absence-of-export: a worker classified ``dead`` (no
+  snapshot within its staleness TTL and no ``closed`` farewell).
+
+Every firing produces a typed :class:`Alert` record routed BOTH through
+the Diagnostic channel (rule ids L001/L002/L003, honoring
+``FLAGS_static_analysis`` like every other lint family) and into the
+flight recorder (``kind="alert"``), so a postmortem timeline shows what
+the live plane was screaming when the process died. Firings are
+edge-triggered per ``(rule, worker)``: an alert re-arms only after its
+condition clears.
+
+:func:`default_rules` is the declared **autoscaler-input contract** for
+ROADMAP item 2 (elastic replica scale-out/in): the overload signals
+serving already emits — shed/reject rate, free-block-frac, p99 decode
+vs deadline — plus watchdog hangs and worker absence.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..analysis import jaxpr_lint
+from . import flight_recorder, live
+
+__all__ = [
+    "AlertRule", "Alert", "AlertEngine", "default_rules",
+    "evaluate_dir", "RULE_IDS",
+]
+
+#: Diagnostic rule id per alert kind (catalog: analysis/RULES.md).
+RULE_IDS = {"threshold": "L001", "rate": "L002", "absence": "L003"}
+
+_OPS = {
+    ">": lambda v, t: v > t,
+    "<": lambda v, t: v < t,
+    ">=": lambda v, t: v >= t,
+    "<=": lambda v, t: v <= t,
+}
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One declarative SLO rule.
+
+    ``signal`` names a derived fleet signal (``live.aggregate``'s
+    ``derived`` keys), a per-worker signal key for ``scope="worker"``,
+    or — for ``rate`` rules — one or more ``+``-joined cumulative
+    signal keys from the embedded history ring.
+    """
+
+    name: str
+    kind: str                      # threshold | rate | absence
+    signal: str = ""               # unused for absence
+    op: str = ">"
+    threshold: float = 0.0
+    window_s: float = 60.0         # rate rules: sliding window width
+    scope: str = "fleet"           # fleet | worker
+    severity: str = "warning"      # info | warning | error
+    description: str = ""
+
+    def __post_init__(self):
+        if self.kind not in RULE_IDS:
+            raise ValueError(f"unknown alert kind {self.kind!r}; "
+                             f"one of {sorted(RULE_IDS)}")
+        if self.op not in _OPS:
+            raise ValueError(f"unknown op {self.op!r}; one of "
+                             f"{sorted(_OPS)}")
+
+
+@dataclass
+class Alert:
+    """One firing — the typed record drills and the autoscaler consume."""
+
+    rule: str                      # AlertRule.name
+    rule_id: str                   # L001 / L002 / L003
+    kind: str
+    severity: str
+    worker: Optional[str]          # None for fleet-scope firings
+    value: Optional[float]
+    threshold: float
+    window_s: float
+    message: str
+    ts: float = field(default_factory=time.time)
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"rule": self.rule, "rule_id": self.rule_id,
+                "kind": self.kind, "severity": self.severity,
+                "worker": self.worker, "value": self.value,
+                "threshold": self.threshold, "window_s": self.window_s,
+                "message": self.message, "ts": self.ts}
+
+    def as_diagnostic(self) -> jaxpr_lint.Diagnostic:
+        where = f"fleet.{self.worker}" if self.worker else "fleet"
+        if self.kind == "threshold":
+            return jaxpr_lint.Diagnostic(
+                rule="L001", name=self.rule, severity=self.severity,
+                message=self.message, where=where)
+        if self.kind == "rate":
+            return jaxpr_lint.Diagnostic(
+                rule="L002", name=self.rule, severity=self.severity,
+                message=self.message, where=where)
+        return jaxpr_lint.Diagnostic(
+            rule="L003", name=self.rule, severity=self.severity,
+            message=self.message, where=where)
+
+
+def default_rules(deadline_ms: Optional[float] = None,
+                  min_free_block_frac: float = 0.1,
+                  shed_window_s: float = 60.0) -> Tuple[AlertRule, ...]:
+    """The shipped SLO set over signals serving/fault already emit.
+
+    The p99-decode rule needs a deadline to compare against (the shed
+    policy's ``max_p99_decode_ms`` is the natural source); it is only
+    included when ``deadline_ms`` is given.
+    """
+    rules = [
+        AlertRule("shed-rate", "rate", signal="shed+rejected", op=">",
+                  threshold=0.0, window_s=shed_window_s,
+                  severity="warning",
+                  description="any shed or rejected admissions over the "
+                              "window — the overload signal the "
+                              "autoscaler scales out on"),
+        AlertRule("free-block-frac", "threshold",
+                  signal="min_free_block_frac", op="<",
+                  threshold=min_free_block_frac, severity="warning",
+                  description="tightest KV pool across workers below "
+                              "the floor"),
+        AlertRule("watchdog-hang", "rate", signal="hangs", op=">",
+                  threshold=0.0, window_s=300.0, severity="error",
+                  description="any watchdog hang verdicts over the "
+                              "window (fault.hangs)"),
+        AlertRule("worker-absent", "absence", severity="error",
+                  description="a worker stopped exporting without a "
+                              "closed farewell (SIGKILL-shaped death; "
+                              "heartbeat absence)"),
+    ]
+    if deadline_ms is not None:
+        rules.insert(2, AlertRule(
+            "p99-decode-deadline", "threshold",
+            signal="max_p99_decode_ms", op=">",
+            threshold=float(deadline_ms), severity="warning",
+            description="worst per-worker decode p99 above the serving "
+                        "deadline"))
+    return tuple(rules)
+
+
+def _sum_signals(source: Dict[str, Any], parts: Sequence[str]):
+    vals = [source[p] for p in parts if source.get(p) is not None]
+    return sum(vals) if vals else None
+
+
+def _window_rate(history: List[Dict[str, Any]], parts: Sequence[str],
+                 window_s: float, now: float) -> Optional[float]:
+    """Per-second increase of summed cumulative signals over the last
+    ``window_s`` seconds of one worker's history ring: latest sample vs
+    the newest sample at-or-before the window start (Prometheus
+    ``increase`` semantics on an uneven-cadence series).
+
+    A part absent from an *individual* sample counts as 0 — registry
+    counters are born at zero, so a series appearing mid-window (the
+    first shed creates ``serving.shed``) is an increase from 0, not a
+    hole that silently drops the baseline sample. Only a worker with
+    none of the parts in any sample (a trainer has no serving.*) yields
+    None."""
+    present = {p for h in history for p in parts
+               if h.get(p) is not None}
+    if not present:
+        return None
+    pts = []
+    for h in history:
+        if h.get("ts") is not None:
+            pts.append((float(h["ts"]),
+                        sum(float(h.get(p) or 0.0) for p in present)))
+    if len(pts) < 2:
+        return None
+    pts.sort(key=lambda p: p[0])
+    start = now - window_s
+    base = pts[0]
+    for p in pts:
+        if p[0] <= start:
+            base = p
+        else:
+            break
+    last = pts[-1]
+    if last[0] <= base[0]:
+        return None
+    return (last[1] - base[1]) / (last[0] - base[0])
+
+
+class AlertEngine:
+    """Evaluate a rule set against successive fleet views.
+
+    Stateless per view except for edge-trigger bookkeeping: a
+    ``(rule, worker)`` pair fires once when its condition becomes true
+    and re-arms when it clears. ``evaluate`` returns the *new* firings;
+    :meth:`active` lists everything currently firing.
+    """
+
+    def __init__(self, rules: Sequence[AlertRule] = (),
+                 emit_mode: Optional[str] = None,
+                 to_recorder: bool = True):
+        self.rules: Tuple[AlertRule, ...] = tuple(rules) or default_rules()
+        self.emit_mode = emit_mode
+        self.to_recorder = to_recorder
+        self._active: Dict[Tuple[str, Optional[str]], Alert] = {}
+
+    def active(self) -> List[Alert]:
+        return [self._active[k] for k in sorted(
+            self._active, key=lambda k: (k[0], k[1] or ""))]
+
+    # -- evaluation ----------------------------------------------------------
+
+    def evaluate(self, view: Dict[str, Any],
+                 now: Optional[float] = None) -> List[Alert]:
+        now = float(now if now is not None else view.get("ts")
+                    or time.time())
+        firing: Dict[Tuple[str, Optional[str]], Alert] = {}
+        for rule in self.rules:
+            for worker, value in self._probe(rule, view, now):
+                a = Alert(
+                    rule=rule.name, rule_id=RULE_IDS[rule.kind],
+                    kind=rule.kind, severity=rule.severity, worker=worker,
+                    value=value, threshold=rule.threshold,
+                    window_s=rule.window_s, ts=now,
+                    message=self._message(rule, worker, value))
+                firing[(rule.name, worker)] = a
+        fresh = [firing[k] for k in sorted(
+            firing, key=lambda k: (k[0], k[1] or ""))
+            if k not in self._active]
+        self._active = firing
+        if fresh:
+            self._route(fresh)
+        return fresh
+
+    def _probe(self, rule: AlertRule, view: Dict[str, Any],
+               now: float) -> List[Tuple[Optional[str], Optional[float]]]:
+        """[(worker_or_None, observed_value)] per satisfied condition."""
+        cmp = _OPS[rule.op]
+        out: List[Tuple[Optional[str], Optional[float]]] = []
+        if rule.kind == "absence":
+            for key, status in sorted(view.get("staleness", {}).items()):
+                if status == "dead":
+                    out.append((key, view["workers"][key]["age_s"]))
+            return out
+        parts = [p.strip() for p in rule.signal.split("+") if p.strip()]
+        if rule.kind == "rate":
+            total, seen = 0.0, False
+            for key, w in sorted(view.get("workers", {}).items()):
+                r = _window_rate(w.get("history") or [], parts,
+                                 rule.window_s, now)
+                if r is not None:
+                    total += r
+                    seen = True
+            if seen and cmp(total, rule.threshold):
+                out.append((None, total))
+            return out
+        # threshold
+        if rule.scope == "worker":
+            for key, w in sorted(view.get("workers", {}).items()):
+                v = _sum_signals(w.get("signals") or {}, parts)
+                if v is not None and cmp(v, rule.threshold):
+                    out.append((key, float(v)))
+            return out
+        v = _sum_signals(view.get("derived") or {}, parts)
+        if v is not None and cmp(float(v), rule.threshold):
+            out.append((None, float(v)))
+        return out
+
+    def _message(self, rule: AlertRule, worker: Optional[str],
+                 value: Optional[float]) -> str:
+        where = f"worker {worker}" if worker else "fleet"
+        if rule.kind == "absence":
+            return (f"{where} stopped exporting (snapshot age "
+                    f"{value:.2f}s past its staleness TTL, no closed "
+                    f"farewell)")
+        shown = "n/a" if value is None else f"{value:.6g}"
+        verb = {"rate": f"rate({rule.signal})",
+                "threshold": rule.signal}[rule.kind]
+        win = f" over {rule.window_s:g}s" if rule.kind == "rate" else ""
+        return (f"{where}: {verb} = {shown} {rule.op} "
+                f"{rule.threshold:g}{win}"
+                + (f" — {rule.description}" if rule.description else ""))
+
+    def _route(self, alerts: Sequence[Alert]) -> None:
+        """Both output channels: Diagnostics (FLAGS_static_analysis
+        routing, same as every lint family) and the flight recorder
+        (so alerts land in the postmortem timeline)."""
+        if self.to_recorder:
+            for a in alerts:
+                flight_recorder.emit("alert", **a.to_json())
+        try:
+            jaxpr_lint.emit([a.as_diagnostic() for a in alerts],
+                            where="fleet", mode=self.emit_mode)
+        except jaxpr_lint.GraphLintError:
+            raise
+        except Exception:
+            pass
+
+
+def evaluate_dir(run_dir: str, rules: Sequence[AlertRule] = (),
+                 now: Optional[float] = None,
+                 ttl_s: Optional[float] = None,
+                 **engine_kwargs: Any) -> Tuple[Dict[str, Any], List[Alert]]:
+    """One-shot: aggregate ``run_dir`` and evaluate ``rules`` (default
+    set when empty) — the fleet_top/CI entry point. Returns
+    ``(view, fired_alerts)``."""
+    view = live.aggregate(run_dir, now=now, ttl_s=ttl_s)
+    engine = AlertEngine(rules, **engine_kwargs)
+    return view, engine.evaluate(view, now=now)

@@ -1,0 +1,687 @@
+"""Live fleet telemetry plane: per-worker export + fleet aggregation.
+
+PR 4's metrics registry sees one process from inside; PR 15's flight
+recorder explains the fleet after it dies. This module is the third arm
+— seeing the fleet *while it runs* — in the Monarch/Prometheus shape:
+each worker **pushes** its local registry to a shared directory on a
+fixed cadence, a stateless **aggregator** merges the per-worker
+snapshots into one labeled fleet view, and a declarative rule engine
+(:mod:`.alerts`) evaluates SLOs against that view. ROADMAP item 2's
+autoscaler consumes the rule output; item 1's pod-scale goodput becomes
+a live number instead of a postmortem artifact.
+
+Export discipline (the flight recorder's crash-safety, file-per-state
+instead of ring-of-records):
+
+- **One snapshot file per process incarnation**, named by the same
+  fleet key the recorder uses — ``<role>.r<replica>.i<inc>.fsnap``
+  under ``<run>/fleet/`` — so the postmortem and the live plane agree
+  on worker identity.
+- **CRC-framed, atomically published.** Each export serializes the
+  whole registry (histograms with raw per-bucket counts — buckets are
+  fixed log2, so cross-host merge is exact element-wise addition),
+  frames it as ``PDLFSN01 | payload_len u32 | crc32 u32 | JSON``,
+  writes to a temp file and ``os.replace``\\ s over the previous
+  snapshot. A SIGKILL mid-export tears only the invisible temp file;
+  the previous complete snapshot stays readable, and a reader that
+  races a slow filesystem still rejects any torn bytes by CRC.
+- **Self-describing staleness.** Every snapshot carries its own export
+  interval and a monotone ``seq``; a worker whose snapshot age exceeds
+  ``STALENESS_GRACE`` intervals is ``dead`` — i.e. the flip happens
+  within one interval of the first missed export. A clean shutdown
+  stamps ``closed=true`` on its final export, so ``exited`` (told us it
+  was leaving) is distinguishable from ``dead`` (SIGKILL — never said
+  goodbye).
+
+Gating: ``FLAGS_fleet_telemetry`` (``off`` default). Off is bitwise
+non-intrusive on step outputs — the :func:`note_progress` seam is a
+global None-check, exactly the ``FLAGS_telemetry`` /
+``FLAGS_flight_recorder`` contract. Nothing here may be called from
+traced code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import struct
+import threading
+import time
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.flags import flag
+from . import flight_recorder, metrics
+
+__all__ = [
+    "FleetExporter", "arm", "arm_if_enabled", "disarm", "current",
+    "enabled", "fleet_on", "note_progress", "export_now",
+    "read_snapshot", "fleet_files", "load_fleet", "aggregate",
+    "publish", "retire_worker", "percentile_from_buckets",
+    "snapshot_path", "next_incarnation",
+    "FILE_MAGIC", "FLEET_SUBDIR", "STALENESS_GRACE", "DEFAULT_HISTORY",
+]
+
+#: First 8 bytes of every snapshot file.
+FILE_MAGIC = b"PDLFSN01"
+#: Snapshots live under ``<run>/fleet/``.
+FLEET_SUBDIR = "fleet"
+#: A worker is ``dead`` once its snapshot age exceeds this many of its
+#: own advertised export intervals — the first missed export starts the
+#: clock, so the flip lands within one interval of it.
+STALENESS_GRACE = 2.0
+#: Ring length of per-export derived-signal samples embedded in each
+#: snapshot (the sliding window rate/threshold rules evaluate over).
+DEFAULT_HISTORY = 64
+
+# payload_len u32 | crc32 u32 (of the JSON payload), after FILE_MAGIC
+_HDR = struct.Struct("<II")
+
+_SNAP_RE = re.compile(
+    r"^(?P<role>[A-Za-z0-9_\-]+)\.r(?P<replica>\d+)\.i(?P<inc>\d+)\.fsnap$")
+
+#: Flat registry series sampled into each export's ``signals`` dict —
+#: the keys the default alert rules and fleet_top columns read.
+SIGNAL_SERIES: Tuple[Tuple[str, str], ...] = (
+    ("tokens", "serving.tokens_generated"),
+    ("ok", "serving.requests_completed"),
+    ("shed", "serving.shed"),
+    ("rejected", "serving.rejected"),
+    ("expired", "serving.expired"),
+    ("failed", "serving.failed"),
+    ("queue_depth", "serving.queue_depth"),
+    ("running", "serving.running"),
+    ("free_block_frac", "serving.free_block_frac"),
+    ("p99_decode_ms", "serving.decode_p99_ms"),
+    ("overload_iterations", "serving.overload_iterations"),
+    ("hangs", "fault.hangs"),
+    ("goodput", "fault.goodput"),
+)
+
+
+def _new_lock(name: str):
+    # the FLAGS_lockcheck instrumentation seam, resolved lazily so the
+    # exporter stays importable before the analysis package
+    try:
+        from ..analysis.concurrency_check import make_lock
+    except Exception:
+        return threading.Lock()
+    return make_lock(name)
+
+
+def fleet_on() -> bool:
+    """Current ``FLAGS_fleet_telemetry`` gate."""
+    try:
+        return str(flag("fleet_telemetry")) == "on"
+    except KeyError:  # core.flags not initialized (partial import)
+        return False
+
+
+def _fleet_dir(run_dir: str) -> str:
+    if os.path.basename(os.path.normpath(run_dir)) == FLEET_SUBDIR:
+        return run_dir
+    return os.path.join(run_dir, FLEET_SUBDIR)
+
+
+def snapshot_path(run_dir: str, role: str, replica_id: int,
+                  incarnation: int) -> str:
+    return os.path.join(
+        _fleet_dir(run_dir),
+        f"{role}.r{int(replica_id)}.i{int(incarnation)}.fsnap")
+
+
+def next_incarnation(run_dir: str, role: str, replica_id: int) -> int:
+    """Smallest unused incarnation for ``(role, replica_id)`` — same
+    slot discipline as :func:`flight_recorder.next_incarnation`."""
+    taken = set()
+    try:
+        names = os.listdir(_fleet_dir(run_dir))
+    except OSError:
+        return 0
+    for name in names:
+        m = _SNAP_RE.match(name)
+        if m and m.group("role") == role \
+                and int(m.group("replica")) == int(replica_id):
+            taken.add(int(m.group("inc")))
+    return max(taken) + 1 if taken else 0
+
+
+def extract_signals(flat: Dict[str, Any]) -> Dict[str, Any]:
+    """Project the flat registry snapshot onto the named signal keys
+    (absent series stay absent — a trainer has no serving.* families)."""
+    out: Dict[str, Any] = {}
+    for key, series in SIGNAL_SERIES:
+        if series in flat:
+            out[key] = flat[series]
+    return out
+
+
+class FleetExporter:
+    """One process incarnation's live telemetry publisher.
+
+    Thread-safe; :meth:`export_now` never raises into the caller (an
+    unwritable directory counts exports as dropped). The daemon thread
+    re-checks ``FLAGS_fleet_telemetry`` every tick so flipping the flag
+    at runtime pauses/resumes publication without re-arming.
+    """
+
+    def __init__(self, run_dir: str, role: str, replica_id: int = 0,
+                 run_id: Optional[str] = None,
+                 incarnation: Optional[int] = None,
+                 interval_s: Optional[float] = None,
+                 history: int = DEFAULT_HISTORY,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.dir = _fleet_dir(run_dir)
+        os.makedirs(self.dir, exist_ok=True)
+        if interval_s is None:
+            try:
+                interval_s = float(flag("fleet_export_interval"))
+            except KeyError:
+                interval_s = 1.0
+        self.interval_s = max(float(interval_s), 0.01)
+        rec = flight_recorder.current()
+        rec_meta = rec.meta if rec is not None else {}
+        if incarnation is None:
+            # share the recorder's incarnation index when this process
+            # armed one under the same fleet key, else scan for a slot
+            if rec_meta.get("role") == str(role) and \
+                    int(rec_meta.get("replica_id", -1)) == int(replica_id):
+                incarnation = int(rec_meta.get("incarnation", 0))
+            else:
+                incarnation = next_incarnation(self.dir, role, replica_id)
+        if run_id is None:
+            run_id = rec_meta.get("run_id") or os.path.basename(
+                os.path.abspath(os.path.dirname(self.dir) or self.dir))
+        self.meta: Dict[str, Any] = {
+            "run_id": str(run_id), "role": str(role),
+            "replica_id": int(replica_id), "incarnation": int(incarnation),
+            "pid": os.getpid(), "start_ts": time.time(),
+        }
+        self.meta.update(meta or {})
+        self.path = snapshot_path(self.dir, role, replica_id, incarnation)
+        self.dropped = 0
+        self._mu = _new_lock("FleetExporter._mu")
+        self._seq = 0
+        self._step: Optional[int] = None
+        self._history: "deque[Dict[str, Any]]" = deque(maxlen=max(history, 2))
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- write side ----------------------------------------------------------
+
+    def note_progress(self, step: int) -> None:
+        """Record the caller's step/iteration index for the next export
+        (the engine/trainer loop calls this once per iteration)."""
+        with self._mu:
+            self._step = int(step)
+
+    def export_now(self, closed: bool = False) -> Optional[str]:
+        """Publish one snapshot (atomic replace). Returns the snapshot
+        path, or None if the write was dropped."""
+        try:
+            flat = metrics.stats_snapshot()
+            full = metrics.snapshot(include_buckets=True)
+        except Exception:
+            flat, full = {}, {}
+        sig = extract_signals(flat)
+        now = time.time()
+        with self._mu:
+            seq = self._seq
+            self._seq += 1
+            step = self._step
+            self._history.append({"ts": now, "step": step, **sig})
+            hist = list(self._history)
+        payload = dict(self.meta)
+        payload.update({
+            "seq": seq, "ts": now,
+            "uptime_s": now - float(self.meta["start_ts"]),
+            "interval_s": self.interval_s, "step": step,
+            "closed": bool(closed), "signals": sig, "history": hist,
+            "metrics": full,
+        })
+        try:
+            data = json.dumps(payload, sort_keys=True,
+                              default=str).encode()
+        except (TypeError, ValueError):
+            with self._mu:
+                self.dropped += 1
+            return None
+        frame = FILE_MAGIC + _HDR.pack(
+            len(data), zlib.crc32(data) & 0xFFFFFFFF) + data
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(frame)
+            os.replace(tmp, self.path)
+        except OSError:
+            with self._mu:
+                self.dropped += 1
+            return None
+        return self.path
+
+    # -- thread lifecycle ----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            if not fleet_on():
+                continue
+            self.export_now()
+
+    def start(self) -> None:
+        with self._mu:
+            if self._thread is not None:
+                return
+            self._stop.clear()
+            t = threading.Thread(
+                target=self._run, daemon=True,
+                name=("fleet-export-" + self.meta["role"]
+                      + ".r" + str(self.meta["replica_id"])))
+            self._thread = t
+        t.start()
+
+    def stop(self, final_export: bool = True) -> None:
+        """Stop the export thread; by default stamp a final
+        ``closed=true`` snapshot so the aggregator classifies this
+        incarnation ``exited`` rather than (eventually) ``dead``."""
+        with self._mu:
+            t, self._thread = self._thread, None
+        self._stop.set()
+        if t is not None:
+            t.join(timeout=max(5.0, 2 * self.interval_s))
+        if final_export and fleet_on():
+            self.export_now(closed=True)
+
+    def __repr__(self) -> str:
+        return (f"FleetExporter({self.path!r}, seq={self._seq}, "
+                f"dropped={self.dropped})")
+
+
+# ---------------------------------------------------------------------------
+# Process-wide exporter + gated seams
+# ---------------------------------------------------------------------------
+
+_proc: Optional[FleetExporter] = None
+_proc_mu = threading.Lock()
+
+
+def current() -> Optional[FleetExporter]:
+    return _proc
+
+
+def enabled() -> bool:
+    return _proc is not None and fleet_on()
+
+
+def arm(run_dir: str, role: str, replica_id: int = 0,
+        start_thread: bool = True, **kwargs: Any) -> FleetExporter:
+    """Attach (and start) this process's exporter under
+    ``<run_dir>/fleet/``, replacing any previous one."""
+    global _proc
+    with _proc_mu:
+        prev, _proc = _proc, None
+    if prev is not None:  # re-arming replaces the old exporter
+        prev.stop(final_export=False)
+    exp = FleetExporter(run_dir, role, replica_id=replica_id, **kwargs)
+    with _proc_mu:
+        _proc = exp
+    if start_thread:
+        exp.start()
+    return exp
+
+
+def arm_if_enabled(run_dir: str, role: str, replica_id: int = 0,
+                   **kwargs: Any) -> Optional[FleetExporter]:
+    """:func:`arm` gated on ``FLAGS_fleet_telemetry=on`` — the one-line
+    seam drill trainers/workers call at incarnation start."""
+    if not fleet_on():
+        return None
+    return arm(run_dir, role, replica_id=replica_id, **kwargs)
+
+
+def disarm(final_export: bool = True) -> None:
+    global _proc
+    with _proc_mu:
+        exp, _proc = _proc, None
+    if exp is not None:
+        exp.stop(final_export=final_export)
+
+
+def note_progress(step: int) -> None:
+    """The wiring seam loops call unconditionally: a global None-check
+    when nothing is armed, never an exception into the caller."""
+    exp = _proc
+    if exp is None:
+        return
+    try:
+        exp.note_progress(step)
+    except Exception:
+        pass
+
+
+def export_now(closed: bool = False) -> Optional[str]:
+    """Force an immediate publication from the armed exporter."""
+    exp = _proc
+    if exp is None or not fleet_on():
+        return None
+    try:
+        return exp.export_now(closed=closed)
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Read side: snapshots -> one labeled fleet view
+# ---------------------------------------------------------------------------
+
+def read_snapshot(path: str) -> Optional[Dict[str, Any]]:
+    """Parse one snapshot file; None if missing, torn, or CRC-invalid
+    (a torn write is indistinguishable from absence, by design)."""
+    try:
+        with open(path, "rb") as f:
+            buf = f.read()
+    except OSError:
+        return None
+    hdr_end = len(FILE_MAGIC) + _HDR.size
+    if buf[:len(FILE_MAGIC)] != FILE_MAGIC or len(buf) < hdr_end:
+        return None
+    plen, crc = _HDR.unpack_from(buf, len(FILE_MAGIC))
+    data = buf[hdr_end:hdr_end + plen]
+    if len(data) != plen or (zlib.crc32(data) & 0xFFFFFFFF) != crc:
+        return None
+    try:
+        return json.loads(data.decode())
+    except (ValueError, UnicodeDecodeError):
+        return None
+
+
+def fleet_files(run_dir: str) -> List[str]:
+    """Every ``*.fsnap`` under ``run_dir`` (recursive), sorted."""
+    out = []
+    for dirpath, _dirnames, filenames in os.walk(run_dir):
+        for name in filenames:
+            if _SNAP_RE.match(name):
+                out.append(os.path.join(dirpath, name))
+    return sorted(out)
+
+
+def load_fleet(run_dir: str) -> Dict[str, List[Dict[str, Any]]]:
+    """All readable snapshots grouped by worker key ``role.rN`` (the
+    postmortem's `_worker_key`), incarnation-ordered within each."""
+    workers: Dict[str, List[Dict[str, Any]]] = {}
+    for path in fleet_files(run_dir):
+        snap = read_snapshot(path)
+        if snap is None:
+            continue
+        key = f"{snap.get('role', '?')}.r{int(snap.get('replica_id', 0))}"
+        workers.setdefault(key, []).append(snap)
+    for key in workers:
+        workers[key].sort(key=lambda s: int(s.get("incarnation", 0)))
+    return workers
+
+
+def percentile_from_buckets(le: List[float], counts: List[float],
+                            q: float) -> Optional[float]:
+    """q-th percentile upper bound from raw bucket counts (``counts``
+    has one trailing +Inf overflow entry beyond ``le``). Exact in the
+    merge sense: summed fixed-log2 buckets give the same answer any
+    single host would for the union of observations."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    need = q / 100.0 * total
+    running = 0.0
+    for bound, c in zip(le, counts):
+        running += c
+        if running >= need:
+            return float(bound)
+    return float("inf")
+
+
+def _merge_hist(acc: Dict[str, Any], buckets: Dict[str, Any],
+                value: Dict[str, Any]) -> None:
+    le = [float(x) for x in buckets.get("le", [])]
+    counts = [float(c) for c in buckets.get("counts", [])]
+    if not acc:
+        acc["le"] = le
+        acc["counts"] = [0.0] * len(counts)
+    if acc["le"] == le and len(acc["counts"]) == len(counts):
+        acc["counts"] = [a + b for a, b in zip(acc["counts"], counts)]
+    else:  # differing bucket config (custom buckets): merge by bound
+        merged = {b: c for b, c in zip(acc["le"], acc["counts"])}
+        for b, c in zip(le, counts[:len(le)]):
+            merged[b] = merged.get(b, 0.0) + c
+        bounds = sorted(merged)
+        acc["le"] = bounds
+        acc["counts"] = [merged[b] for b in bounds] + [0.0]
+    acc["count"] = acc.get("count", 0) + int(value.get("count", 0))
+    acc["sum"] = acc.get("sum", 0.0) + float(value.get("sum", 0.0))
+
+
+def aggregate(run_dir: str, now: Optional[float] = None,
+              ttl_s: Optional[float] = None, lag_steps: int = 3,
+              grace: float = STALENESS_GRACE) -> Dict[str, Any]:
+    """Merge every worker's snapshots into one fleet view.
+
+    Per worker: the **latest incarnation** supplies identity, step,
+    gauges, signals and the embedded history; **counters and histograms
+    are summed across all incarnations** (each incarnation counts from
+    zero, so the cross-incarnation sum is the worker's lifetime total —
+    the same reconstruction rule the postmortem applies to journals).
+    Rollups merge across workers: counters add, gauges min/max/mean,
+    histograms exact bucket-wise addition (fixed log2 buckets).
+
+    Staleness per worker — ``exited`` when the latest snapshot is a
+    ``closed=true`` final export, else
+    :func:`~paddle_tpu.distributed.multislice.heartbeat.classify_liveness`
+    with ``ttl = grace * interval`` (``fresh``/``slow``/``dead``).
+    """
+    # the one staleness rule, shared with SliceHeartbeatMonitor.classify
+    # (imported lazily: distributed's package __init__ is heavy)
+    from ..distributed.multislice.heartbeat import classify_liveness
+    now = float(now if now is not None else time.time())
+    raw = load_fleet(run_dir)
+    workers: Dict[str, Dict[str, Any]] = {}
+    counters: Dict[str, float] = {}
+    hists: Dict[str, Dict[str, Any]] = {}
+    gauges: Dict[str, Dict[str, float]] = {}
+
+    for key, incs in raw.items():
+        latest = incs[-1]
+        totals: Dict[str, float] = {}
+        for snap in incs:
+            for name, fam in (snap.get("metrics") or {}).items():
+                if fam.get("type") == "counter":
+                    val = sum(float(s.get("value", 0))
+                              for s in fam.get("series", []))
+                    totals[name] = totals.get(name, 0.0) + val
+                    counters[name] = counters.get(name, 0.0) + val
+                elif fam.get("type") == "histogram":
+                    acc = hists.setdefault(name, {})
+                    for s in fam.get("series", []):
+                        if "buckets" in s:
+                            _merge_hist(acc, s["buckets"], s["value"])
+        for name, fam in (latest.get("metrics") or {}).items():
+            if fam.get("type") == "gauge" and fam.get("series"):
+                val = sum(float(s.get("value", 0))
+                          for s in fam.get("series", []))
+                gauges.setdefault(name, {})[key] = val
+        workers[key] = {
+            "role": latest.get("role"),
+            "replica_id": latest.get("replica_id"),
+            "incarnation": latest.get("incarnation"),
+            "incarnations": len(incs),
+            "pid": latest.get("pid"),
+            "seq": latest.get("seq"),
+            "ts": latest.get("ts"),
+            "age_s": max(0.0, now - float(latest.get("ts", now))),
+            "uptime_s": float(latest.get("uptime_s", 0.0)),
+            "interval_s": float(latest.get("interval_s", 1.0)),
+            "step": latest.get("step"),
+            "closed": bool(latest.get("closed")),
+            # superseded incarnations that never published a closed
+            # farewell: each is one SIGKILL-shaped death the live plane
+            # witnessed (the postmortem's deaths, seen from this side)
+            "silent_incarnations": [int(s.get("incarnation", 0))
+                                    for s in incs[:-1]
+                                    if not s.get("closed")],
+            "signals": dict(latest.get("signals") or {}),
+            "totals": totals,
+            "history": list(latest.get("history") or []),
+        }
+
+    # staleness: fleet max step over non-closed fresh workers first
+    fresh_steps = [int(w["step"]) for w in workers.values()
+                   if not w["closed"] and w["step"] is not None
+                   and w["age_s"] <= (ttl_s if ttl_s is not None
+                                      else grace * w["interval_s"])]
+    max_step = max(fresh_steps, default=0)
+    staleness: Dict[str, str] = {}
+    for key, w in workers.items():
+        if w["closed"]:
+            staleness[key] = "exited"
+            continue
+        ttl = ttl_s if ttl_s is not None else grace * w["interval_s"]
+        staleness[key] = classify_liveness(
+            w["age_s"], ttl, int(w["step"] or 0), max_step, lag_steps,
+            fresh_label="fresh")
+    for key, w in workers.items():
+        w["status"] = staleness[key]
+
+    derived = _derive(workers, staleness, hists)
+    gauge_roll = {
+        name: {"min": min(per.values()), "max": max(per.values()),
+               "mean": sum(per.values()) / len(per), "per_worker": per}
+        for name, per in gauges.items() if per
+    }
+    return {
+        "ts": now,
+        "run_dir": run_dir,
+        "workers": workers,
+        "staleness": staleness,
+        "rollup": {"counters": counters, "gauges": gauge_roll,
+                   "histograms": hists},
+        "derived": derived,
+    }
+
+
+def _window_rate(history: List[Dict[str, Any]], key: str) -> Optional[float]:
+    """Per-second rate of a cumulative signal over the embedded history
+    window (first sample carrying the key vs the last)."""
+    pts = [(h["ts"], h[key]) for h in history
+           if key in h and h.get(key) is not None]
+    if len(pts) < 2 or pts[-1][0] <= pts[0][0]:
+        return None
+    return (pts[-1][1] - pts[0][1]) / (pts[-1][0] - pts[0][0])
+
+
+def _derive(workers: Dict[str, Dict[str, Any]], staleness: Dict[str, str],
+            hists: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    live = {k: w for k, w in workers.items() if staleness[k] != "dead"}
+    tokens_per_s = 0.0
+    have_rate = False
+    for w in live.values():
+        r = _window_rate(w["history"], "tokens")
+        if r is None and w["totals"].get("serving.tokens_generated"):
+            up = float(w.get("uptime_s") or 0.0)
+            r = (w["totals"]["serving.tokens_generated"] / up) if up > 0 \
+                else None
+        if r is not None:
+            tokens_per_s += max(r, 0.0)
+            have_rate = True
+    acks = {o: sum(w["totals"].get(f"serving.{s}", 0.0)
+                   for w in workers.values())
+            for o, s in (("ok", "requests_completed"), ("shed", "shed"),
+                         ("rejected", "rejected"), ("expired", "expired"),
+                         ("failed", "failed"))}
+    total_acks = sum(acks.values())
+    if total_acks > 0:
+        live_goodput: Optional[float] = acks["ok"] / total_acks
+    else:  # training fleet: mean host goodput gauge
+        gp = [w["signals"]["goodput"] for w in live.values()
+              if w["signals"].get("goodput") is not None]
+        live_goodput = sum(gp) / len(gp) if gp else None
+    free = [w["signals"]["free_block_frac"] for w in live.values()
+            if w["signals"].get("free_block_frac") is not None]
+    p99s = [w["signals"]["p99_decode_ms"] for w in live.values()
+            if w["signals"].get("p99_decode_ms") is not None]
+    decode = hists.get("serving.decode_step_ms") or {}
+    fleet_p99 = percentile_from_buckets(
+        decode.get("le", []), decode.get("counts", []), 99.0) \
+        if decode.get("counts") else None
+    steps = [int(w["step"]) for k, w in live.items()
+             if w["step"] is not None and staleness[k] != "exited"]
+    return {
+        "fleet_size": len(workers),
+        "live_workers": sum(1 for s in staleness.values()
+                            if s in ("fresh", "slow")),
+        "dead_workers": sum(1 for s in staleness.values() if s == "dead"),
+        "fleet_tokens_per_s": tokens_per_s if have_rate else None,
+        "live_goodput": live_goodput,
+        "acks": acks,
+        "min_free_block_frac": min(free) if free else None,
+        "max_p99_decode_ms": max(p99s) if p99s else None,
+        "fleet_p99_decode_ms": fleet_p99,
+        "step_lag_spread": (max(steps) - min(steps)) if steps else 0,
+        "max_step": max(steps, default=0),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Publishing the fleet view back into a registry (fleet.* families)
+# ---------------------------------------------------------------------------
+
+def retire_worker(worker: str,
+                  registry: Optional[metrics.Registry] = None) -> int:
+    """Label-child GC for one retired worker: drop every ``fleet.*``
+    series labeled ``worker=<key>`` (the Family.remove/Registry.expire
+    satellite's consumer)."""
+    reg = registry or metrics.get_registry()
+    return reg.expire(lambda name, labels:
+                      name.startswith("fleet.") and
+                      labels.get("worker") == worker)
+
+
+def publish(view: Dict[str, Any],
+            registry: Optional[metrics.Registry] = None) -> None:
+    """Mirror a fleet view into ``fleet.*`` metric families (per-worker
+    series labeled ``worker=role.rN``), expiring series of workers no
+    longer present so a long-lived aggregator doesn't leak children."""
+    reg = registry or metrics.get_registry()
+    keys = set(view["workers"])
+    reg.expire(lambda name, labels:
+               name.startswith("fleet.") and "worker" in labels
+               and labels["worker"] not in keys)
+    status_rank = {"fresh": 0, "slow": 1, "exited": 2, "dead": 3}
+    for key, w in view["workers"].items():
+        reg.gauge("fleet.worker.step",
+                  "latest step index per worker").labels(
+                      worker=key).set(int(w["step"] or 0))
+        reg.gauge("fleet.worker.age_s",
+                  "snapshot age per worker (s)").labels(
+                      worker=key).set(float(w["age_s"]))
+        reg.gauge("fleet.worker.status",
+                  "0 fresh / 1 slow / 2 exited / 3 dead").labels(
+                      worker=key).set(status_rank.get(w["status"], 3))
+    d = view["derived"]
+    reg.gauge("fleet.size", "workers ever seen").set(d["fleet_size"])
+    reg.gauge("fleet.live_workers",
+              "workers fresh or slow").set(d["live_workers"])
+    if d.get("fleet_tokens_per_s") is not None:
+        reg.gauge("fleet.tokens_per_s",
+                  "fleet decode throughput").set(d["fleet_tokens_per_s"])
+    if d.get("live_goodput") is not None:
+        reg.gauge("fleet.live_goodput",
+                  "ok acks / all acks (serving) or mean host goodput "
+                  "(training)").set(d["live_goodput"])
+    if d.get("min_free_block_frac") is not None:
+        reg.gauge("fleet.min_free_block_frac",
+                  "tightest KV pool across workers").set(
+                      d["min_free_block_frac"])
+    if d.get("max_p99_decode_ms") is not None:
+        reg.gauge("fleet.max_p99_decode_ms",
+                  "worst per-worker decode p99").set(
+                      d["max_p99_decode_ms"])
+    reg.gauge("fleet.step_lag_spread",
+              "max-min step over live workers").set(d["step_lag_spread"])

@@ -167,6 +167,15 @@ class Histogram(_Child):
                 "max": self._max,
             }
 
+    def bucket_counts(self) -> Dict[str, List[float]]:
+        """Raw per-bucket counts ``{"le": [...], "counts": [...]}`` (the
+        final count is the +Inf overflow bucket). Buckets are fixed
+        log2, so two processes' histograms merge by exact element-wise
+        addition of ``counts`` — the cross-host contract the live fleet
+        aggregator (observability/live.py) relies on."""
+        with self._mu:
+            return {"le": list(self.buckets), "counts": list(self._counts)}
+
     def cumulative(self) -> List[Tuple[float, int]]:
         """[(le, cumulative_count)] per bucket, +Inf last."""
         with self._mu:
@@ -235,6 +244,28 @@ class Family:
         for c in self.children():
             c.reset()
 
+    # -- label-child GC ------------------------------------------------------
+
+    def remove(self, **labels: Any) -> bool:
+        """Drop the child with exactly these labels (True if it existed).
+        Long-lived registries with per-replica/per-request labels grow
+        without bound otherwise; the fleet aggregator calls this when a
+        worker is retired. A later ``labels(...)`` call with the same
+        label set recreates a fresh zeroed child."""
+        key = _label_key(labels)
+        with self._mu:
+            return self._children.pop(key, None) is not None
+
+    def expire(self, predicate) -> int:
+        """Drop every child whose label dict satisfies ``predicate``;
+        returns the number removed."""
+        with self._mu:
+            doomed = [k for k, c in self._children.items()
+                      if predicate(dict(c.labels))]
+            for k in doomed:
+                del self._children[k]
+            return len(doomed)
+
 
 _KIND_NAMES = {Counter: "counter", Gauge: "gauge", Histogram: "histogram"}
 
@@ -270,15 +301,35 @@ class Registry:
         with self._mu:
             return [self._families[k] for k in sorted(self._families)]
 
+    def expire(self, predicate) -> int:
+        """Registry-wide label-child GC: drop every series (in every
+        family) whose ``(name, labels)`` satisfies ``predicate``;
+        returns the number of series removed. Families themselves stay
+        registered (type/help survive). Used by the fleet aggregator to
+        retire a dead worker's ``worker=...`` children."""
+        removed = 0
+        for fam in self.families():
+            removed += fam.expire(
+                lambda labels, _n=fam.name: predicate(_n, labels))
+        return removed
+
     # -- exposition ----------------------------------------------------------
 
-    def snapshot(self) -> Dict[str, Any]:
-        """JSON-able dump: {name: {"type", "help", "series": [...]}}."""
+    def snapshot(self, include_buckets: bool = False) -> Dict[str, Any]:
+        """JSON-able dump: {name: {"type", "help", "series": [...]}}.
+
+        ``include_buckets=True`` additionally attaches each histogram
+        series' raw per-bucket counts under ``"buckets"`` (exact-merge
+        food for the fleet aggregator); the default keeps the compact
+        count/sum/avg/min/max shape bench records already embed."""
         out: Dict[str, Any] = {}
         for fam in self.families():
             series = []
             for c in fam.children():
-                series.append({"labels": dict(c.labels), "value": c.get()})
+                entry = {"labels": dict(c.labels), "value": c.get()}
+                if include_buckets and isinstance(c, Histogram):
+                    entry["buckets"] = c.bucket_counts()
+                series.append(entry)
             out[fam.name] = {"type": _KIND_NAMES[fam.kind],
                              "help": fam.help, "series": series}
         return out
@@ -331,10 +382,19 @@ def _prom_name(name: str) -> str:
                    for ch in name)
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus text-format label-value escaping: backslash first,
+    then double-quote and newline (exposition spec, in that order so an
+    injected ``\\n`` doesn't double-escape)."""
+    return (str(v).replace("\\", "\\\\")
+                  .replace('"', '\\"')
+                  .replace("\n", "\\n"))
+
+
 def _prom_labels(labels: Dict[str, str]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{_prom_name(k)}="{v}"'
+    inner = ",".join(f'{_prom_name(k)}="{_escape_label_value(v)}"'
                      for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
@@ -367,8 +427,8 @@ def histogram(name: str, help: str = "",
     return _default.histogram(name, help, buckets)
 
 
-def snapshot() -> Dict[str, Any]:
-    return _default.snapshot()
+def snapshot(include_buckets: bool = False) -> Dict[str, Any]:
+    return _default.snapshot(include_buckets=include_buckets)
 
 
 def prometheus_text() -> str:

@@ -89,12 +89,17 @@ def arm_serving_faults(workdir, plan_json):
 
 def run(workdir, cfg, plan_json=""):
     from paddle_tpu.observability import flight_recorder as flr
+    from paddle_tpu.observability import live
     from paddle_tpu.serving import RequestJournal, ServingEngine
     from paddle_tpu.serving.resilience import prompt_hash
 
     # the serving black box: request outcomes + fired faults survive the
     # SIGKILLs this worker exists to absorb (no-op unless the flag is on)
     flr.arm_if_enabled(os.path.join(workdir, "flr"), role="server")
+    # the live plane: periodic registry snapshots under workdir/fleet
+    # (shares the recorder's incarnation index when both are armed;
+    # no-op unless FLAGS_fleet_telemetry=on)
+    live.arm_if_enabled(workdir, role="server")
     trace = load_trace(os.path.join(workdir, "trace.jsonl"))
     journal = RequestJournal(os.path.join(workdir, "journal.jsonl"))
     pending_rids = set(journal.pending_rids([r.rid for r in trace]))
@@ -125,6 +130,10 @@ def run(workdir, cfg, plan_json=""):
         # re-prefilling cold
         pending.sort(key=lambda r: tuple(int(t) for t in r.prompt_ids))
     engine.serve(pending)
+    # clean exit: stamp the closed=true farewell snapshot so the fleet
+    # view reads "exited", not (eventually) "dead" — only a SIGKILLed
+    # incarnation goes silent without one
+    live.disarm(final_export=True)
     return 0
 
 

@@ -30,7 +30,8 @@ from typing import Any, Dict
 
 from .resilience import RequestJournal
 
-__all__ = ["quick_serve_config", "run_serve_drill", "report_summary"]
+__all__ = ["quick_serve_config", "run_serve_drill", "run_overload_drill",
+           "report_summary"]
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
@@ -105,6 +106,8 @@ def run_serve_drill(workdir: str, **overrides: Any) -> Dict[str, Any]:
     env = dict(os.environ)
     env.update({
         "FLAGS_flight_recorder": "on",  # arm the worker's black box
+        "FLAGS_fleet_telemetry": "on",  # arm the live telemetry plane
+        "FLAGS_fleet_export_interval": "0.2",
         "SERVE_WORK_DIR": workdir,
         "SERVE_PLAN": plan.to_json(),
         "SERVE_CFG": json.dumps({k: v for k, v in cfg.items()
@@ -160,11 +163,167 @@ def run_serve_drill(workdir: str, **overrides: Any) -> Dict[str, Any]:
     from ..observability import fleet
     report["postmortem"] = fleet.postmortem_report(
         workdir, plan=report["plan"]["events"], expected_rids=expected)
+
+    # live fleet plane cross-check: the drill worker exported snapshots
+    # under workdir/fleet the whole time (FLAGS_fleet_telemetry=on) —
+    # the final incarnation must have said a closed farewell ("exited"),
+    # every killed incarnation must have gone silent without one, and
+    # the live goodput ratio must agree with the journal reconstruction
+    report["fleet"] = _fleet_section(workdir, journal)
     report["ok"] = bool(
         once["exactly_once"] and not mismatched
         and len(fired) == len(plan)
         and report["restarts"] == len(plan)
-        and report["postmortem"]["ok"])
+        and report["postmortem"]["ok"]
+        and report["fleet"]["ok"])
+    return report
+
+
+def _fleet_section(workdir: str, journal: RequestJournal) -> Dict[str, Any]:
+    """Drill-end live-plane verdict from the exported snapshots."""
+    from ..observability import alerts as fleet_alerts
+    from ..observability import live as fleet_live
+    view = fleet_live.aggregate(workdir)
+    engine = fleet_alerts.AlertEngine(fleet_alerts.default_rules(),
+                                      emit_mode="off")
+    fired_alerts = engine.evaluate(view)
+    worker = view["workers"].get("server.r0", {})
+    silent = list(worker.get("silent_incarnations", []))
+    if worker and worker.get("status") == "dead":
+        silent.append(int(worker.get("incarnation", 0)))
+    # live goodput = ok acks / all acks over every incarnation's
+    # exported counters; the journal's ack mix is the exact postmortem
+    # number it must match (a SIGKILL between an ack and the next
+    # export may lag the live *counts*, never the final incarnation's,
+    # and the quick drill's remainder all lands there)
+    live_gp = view["derived"].get("live_goodput")
+    outcomes = journal.ack_outcomes()
+    pm_gp = (sum(1 for o in outcomes.values() if o == "done")
+             / len(outcomes)) if outcomes else None
+    match = (live_gp is not None and pm_gp is not None
+             and abs(live_gp - pm_gp) < 1e-9)
+    return {
+        "workers": {k: w["status"] for k, w in view["workers"].items()},
+        "incarnations_seen": int(worker.get("incarnations", 0)),
+        "silent_incarnations": silent,
+        "final_status": worker.get("status"),
+        "live_goodput": live_gp,
+        "postmortem_goodput": pm_gp,
+        "goodput_match": match,
+        "derived": view["derived"],
+        "alerts": [a.to_json() for a in fired_alerts],
+        "ok": bool(worker) and worker.get("status") == "exited"
+        and match,
+    }
+
+
+def run_overload_drill(workdir: str, **overrides: Any) -> Dict[str, Any]:
+    """The injected-overload drill: an in-process tiny engine under a
+    :class:`~paddle_tpu.serving.resilience.ShedPolicy` is offered more
+    work than the paged pool tolerates while the live exporter publishes
+    snapshots — the aggregated fleet view must show the sheds and the
+    default shed-rate SLO rule (L002) must fire from the exported
+    history alone.
+
+    Unlike :func:`run_serve_drill` this never forks: the exporter is
+    armed in this process (thread off; explicit ``export_now`` before
+    and after ``serve`` brackets the overload window), so the alert
+    evaluates a *rate* — registry counters are process-lifetime
+    cumulative and other engines may have shed before us, but the
+    window delta is exactly this drill's. Returns the report;
+    ``ok`` requires sheds > 0, the L002 firing, and the live window
+    goodput matching the engine's own outcome mix."""
+    import numpy as np
+
+    from ..core.flags import get_flags, set_flags
+    from ..observability import alerts as fleet_alerts
+    from ..observability import live as fleet_live
+    from ._drill_worker import build_model
+    from .engine import ServingEngine
+    from .resilience import Rejected, ShedPolicy
+    from .scheduler import Request, Status
+
+    cfg = quick_serve_config()
+    cfg.update(requests=10, events=(), shed_free_frac=0.5)
+    cfg.update(overrides)
+    os.makedirs(workdir, exist_ok=True)
+    trace = _write_trace(os.path.join(workdir, "trace.jsonl"), cfg)
+
+    prev = get_flags(["fleet_telemetry", "fleet_export_interval"])
+    set_flags({"fleet_telemetry": "on", "fleet_export_interval": 0.05})
+    try:
+        exporter = fleet_live.arm(workdir, role="server",
+                                  start_thread=False)
+        model = build_model(cfg)
+        engine = ServingEngine(
+            model, block_size=cfg["block_size"],
+            num_blocks=cfg["num_blocks"], max_batch=cfg["max_batch"],
+            max_seq_len=cfg["max_pos"],
+            shed_policy=ShedPolicy(
+                min_free_block_frac=float(cfg["shed_free_frac"])))
+        requests = [Request(rid=rec["rid"],
+                            prompt_ids=np.asarray(rec["prompt"], np.int32),
+                            max_new_tokens=int(rec["max_new_tokens"]))
+                    for rec in trace]
+        exporter.export_now()           # baseline sample: counters before
+        done = engine.serve(requests)
+        exporter.export_now()           # post sample: the overload delta
+        fleet_live.disarm(final_export=True)
+    finally:
+        fleet_live.disarm(final_export=False)  # no-op on the clean path
+        set_flags(prev)
+
+    # engine truth for the window: the drill's own outcome mix
+    outcomes = {"ok": 0, "shed": 0, "rejected": 0, "expired": 0,
+                "failed": 0}
+    for res in done.values():
+        if isinstance(res, Rejected):
+            outcomes["rejected"] += 1
+        elif res.status is Status.FINISHED:
+            outcomes["ok"] += 1
+        else:
+            outcomes[res.status.value] += 1
+
+    view = fleet_live.aggregate(workdir)
+    alert_engine = fleet_alerts.AlertEngine(
+        fleet_alerts.default_rules(
+            min_free_block_frac=float(cfg["shed_free_frac"])),
+        emit_mode="off")
+    fired = alert_engine.evaluate(view)
+    worker = view["workers"].get("server.r0", {})
+
+    # live window goodput: first vs last exported sample (delta over the
+    # overload bracket — immune to whatever this process served before)
+    hist = worker.get("history", [])
+    deltas: Dict[str, float] = {}
+    if len(hist) >= 2:
+        for k in outcomes:
+            deltas[k] = float(hist[-1].get(k, 0) or 0) \
+                - float(hist[0].get(k, 0) or 0)
+    acks = sum(deltas.values()) if deltas else 0.0
+    live_gp = (deltas.get("ok", 0.0) / acks) if acks else None
+    truth_acks = sum(outcomes.values())
+    truth_gp = (outcomes["ok"] / truth_acks) if truth_acks else None
+    gp_match = (live_gp is not None and truth_gp is not None
+                and abs(live_gp - truth_gp) < 1e-9)
+
+    shed_alert = any(a.rule == "shed-rate" for a in fired)
+    report = {
+        "requests": len(trace),
+        "outcomes": outcomes,
+        "window_deltas": deltas,
+        "live_goodput": live_gp,
+        "engine_goodput": truth_gp,
+        "goodput_match": gp_match,
+        "final_status": worker.get("status"),
+        "derived": view["derived"],
+        "alerts": [a.to_json() for a in fired],
+        "shed_alert_fired": shed_alert,
+        "ok": bool(outcomes["shed"] > 0 and shed_alert and gp_match
+                   and worker.get("status") == "exited"),
+    }
+    with open(os.path.join(workdir, "overload_report.json"), "w") as f:
+        json.dump(report, f, indent=2, sort_keys=True, default=str)
     return report
 
 
@@ -189,4 +348,13 @@ def report_summary(report: Dict[str, Any]) -> str:
             f"coherent={pm.get('coherent')} "
             f"recorder_files={pm.get('recorder_files')} "
             f"deaths={[(d['kind'], d['step']) for d in pm.get('deaths', [])]}")
+    fl = report.get("fleet")
+    if fl:
+        lines.append(
+            f"  fleet: final={fl.get('final_status')} "
+            f"silent_incs={fl.get('silent_incarnations')} "
+            f"goodput live={fl.get('live_goodput')} "
+            f"pm={fl.get('postmortem_goodput')} "
+            f"match={fl.get('goodput_match')} "
+            f"alerts={[a['rule'] for a in fl.get('alerts', [])]}")
     return "\n".join(lines)

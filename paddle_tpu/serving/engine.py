@@ -72,6 +72,7 @@ import numpy as np
 
 from ..core import flags as _flags
 from ..fault.injection import fire as _fault_fire
+from ..observability import live as fleet_live
 from ..observability import metrics, request_timeline
 from ..observability.request_timeline import percentile
 from ..observability.step_monitor import RecompileSentinel
@@ -236,6 +237,9 @@ class ServingEngine:
         self.sched = FCFSScheduler(max_batch, max_waiting=max_waiting)
         self._seqs: Dict[str, Sequence] = {}
         self._t0 = time.perf_counter()
+        #: scheduler iterations run — the "step index" the live fleet
+        #: exporter publishes for a serving worker
+        self.n_iterations = 0
         self.peak_blocks_used = 0
         #: peak blocks referenced by live sequences (tree-idle cache
         #: holds excluded — they evict on demand); the fair
@@ -737,6 +741,17 @@ class ServingEngine:
         live = used - (self.prefix.n_idle_device_blocks()
                        if self.prefix is not None else 0)
         self.peak_live_blocks = max(self.peak_live_blocks, live)
+        usable = self.cache.num_blocks - 1
+        metrics.gauge("serving.free_block_frac",
+                      "free fraction of the usable KV pool (the shed "
+                      "policy's admission signal)").set(
+                          self.cache.allocator.n_free / usable
+                          if usable else 0.0)
+        p99 = percentile(list(self._decode_ms), 99)
+        if p99 is not None:
+            metrics.gauge("serving.decode_p99_ms",
+                          "sliding-window decode-iteration p99 (ms, "
+                          "the shed policy's latency signal)").set(p99)
 
     def reset_peaks(self) -> None:
         """Restart the peak-blocks watermarks (bench arms measure the
@@ -1465,6 +1480,8 @@ class ServingEngine:
         self._ensure_decode_blocks()
         self._decode_iteration()
         self._gauges()
+        self.n_iterations += 1
+        fleet_live.note_progress(self.n_iterations)
         return self.sched.finished[n0:]
 
     def serve(self, requests: Seq[Request],

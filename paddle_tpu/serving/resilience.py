@@ -210,6 +210,16 @@ class RequestJournal:
     def acknowledged_rids(self) -> Set[str]:
         return {e["rid"] for e in self._events if e["event"] in ACK_EVENTS}
 
+    def ack_outcomes(self) -> Dict[str, str]:
+        """rid -> first acknowledged outcome (``done`` or a terminal
+        refusal kind) — the exact ack mix the live fleet goodput must
+        reproduce at drill end."""
+        out: Dict[str, str] = {}
+        for e in self._events:
+            if e["event"] in ACK_EVENTS and e["rid"] not in out:
+                out[e["rid"]] = e["event"]
+        return out
+
     def submitted_rids(self) -> Set[str]:
         return {e["rid"] for e in self._events if e["event"] == "submitted"}
 

@@ -1,0 +1,656 @@
+"""Live fleet telemetry plane tests (ISSUE 19).
+
+Covers the snapshot framing (CRC round-trip, torn-file rejection,
+atomic-replace crash safety), the flag-gated seams (off = no-op, bitwise
+non-intrusive on TrainStep outputs — mirroring TestRecorderOffBitwise),
+the cross-incarnation aggregation (counter summing, exact histogram
+bucket merge, staleness classification incl. the dead-within-one-interval
+contract), the SLO/alert rule engine (threshold/rate/absence, edge
+triggering, Diagnostic + recorder routing), the subprocess SIGKILL drill,
+the in-process overload drill (injected overload must fire the shed-rate
+alert), and the tools/fleet_top.py CLI.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import flags as core_flags
+from paddle_tpu.observability import alerts, flight_recorder as flr, live
+from paddle_tpu.observability import metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+@pytest.fixture(autouse=True)
+def _fleet_off():
+    """Default-off flags, detached process exporter around every test."""
+    prev = core_flags.get_flags(
+        ["fleet_telemetry", "fleet_export_interval", "flight_recorder"])
+    yield
+    core_flags.set_flags(prev)
+    live.disarm(final_export=False)
+    flr.disarm()
+
+
+def _on(interval=0.05):
+    core_flags.set_flags({"fleet_telemetry": "on",
+                          "fleet_export_interval": interval})
+
+
+def _write_snap(run_dir, role, replica, inc, *, ts, interval_s=1.0,
+                step=None, closed=False, seq=0, signals=None,
+                history=None, metrics_block=None, uptime_s=10.0):
+    """Hand-framed snapshot file — full control over every payload field
+    (the exporter serializes the live process registry, which synthetic
+    aggregation fixtures must not depend on)."""
+    import struct
+    import zlib
+    path = live.snapshot_path(run_dir, role, replica, inc)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    payload = {
+        "run_id": "syn", "role": role, "replica_id": replica,
+        "incarnation": inc, "pid": 4242, "start_ts": ts - uptime_s,
+        "seq": seq, "ts": ts, "uptime_s": uptime_s,
+        "interval_s": interval_s, "step": step, "closed": closed,
+        "signals": signals or {}, "history": history or [],
+        "metrics": metrics_block or {},
+    }
+    data = json.dumps(payload).encode()
+    hdr = struct.pack("<II", len(data), zlib.crc32(data) & 0xFFFFFFFF)
+    with open(path, "wb") as f:
+        f.write(live.FILE_MAGIC + hdr + data)
+    return path
+
+
+def _counter_block(name, value):
+    return {name: {"type": "counter",
+                   "series": [{"labels": {}, "value": value}]}}
+
+
+def _hist_block(name, le, counts, count, total):
+    return {name: {"type": "histogram", "series": [{
+        "labels": {},
+        "value": {"count": count, "sum": total},
+        "buckets": {"le": le, "counts": counts}}]}}
+
+
+# ---------------------------------------------------------------------------
+# snapshot framing + crash safety
+# ---------------------------------------------------------------------------
+
+class TestFraming:
+    def test_export_roundtrip_and_identity(self, tmp_path):
+        _on()
+        exp = live.FleetExporter(str(tmp_path), "server", replica_id=2,
+                                 interval_s=0.5)
+        metrics.counter("fltest.events").labels().inc(3)
+        exp.note_progress(7)
+        path = exp.export_now()
+        assert path == live.snapshot_path(str(tmp_path), "server", 2, 0)
+        assert os.path.basename(path) == "server.r2.i0.fsnap"
+        snap = live.read_snapshot(path)
+        assert snap["role"] == "server" and snap["replica_id"] == 2
+        assert snap["incarnation"] == 0 and snap["pid"] == os.getpid()
+        assert snap["seq"] == 0 and snap["step"] == 7
+        assert snap["interval_s"] == 0.5 and not snap["closed"]
+        assert snap["metrics"]["fltest.events"]["type"] == "counter"
+        # monotone seq, embedded history grows with each export
+        exp.export_now()
+        snap2 = live.read_snapshot(path)
+        assert snap2["seq"] == 1 and len(snap2["history"]) == 2
+
+    def test_histograms_export_raw_bucket_counts(self, tmp_path):
+        _on()
+        metrics.histogram("fltest.ms").observe(3.0)
+        exp = live.FleetExporter(str(tmp_path), "w")
+        snap = live.read_snapshot(exp.export_now())
+        series = snap["metrics"]["fltest.ms"]["series"][0]
+        b = series["buckets"]
+        assert len(b["counts"]) == len(b["le"]) + 1  # +Inf overflow slot
+        assert sum(b["counts"]) == 1
+
+    def test_torn_or_foreign_bytes_rejected(self, tmp_path):
+        _on()
+        exp = live.FleetExporter(str(tmp_path), "w")
+        path = exp.export_now()
+        data = open(path, "rb").read()
+        # one flipped payload byte: CRC rejects
+        torn = tmp_path / "fleet" / "w.r0.i1.fsnap"
+        torn.write_bytes(data[:-4] + b"\xff" + data[-3:])
+        assert live.read_snapshot(str(torn)) is None
+        # truncated mid-payload: length check rejects
+        torn.write_bytes(data[:len(data) // 2])
+        assert live.read_snapshot(str(torn)) is None
+        # wrong magic: rejected outright
+        torn.write_bytes(b"NOTMAGIC" + data[8:])
+        assert live.read_snapshot(str(torn)) is None
+        # absent: None, not an exception
+        assert live.read_snapshot(str(tmp_path / "nope.fsnap")) is None
+        # and the aggregator just skips the torn file
+        view = live.aggregate(str(tmp_path))
+        assert list(view["workers"]) == ["w.r0"]
+
+    def test_kill_mid_export_leaves_previous_snapshot(self, tmp_path):
+        """The atomic-replace contract, simulated exactly: a SIGKILL
+        mid-export tears only the invisible temp file."""
+        _on()
+        exp = live.FleetExporter(str(tmp_path), "w")
+        exp.note_progress(1)
+        path = exp.export_now()
+        before = live.read_snapshot(path)
+        # the torn temp a mid-write SIGKILL leaves behind
+        with open(f"{path}.tmp.{os.getpid()}", "wb") as f:
+            f.write(live.FILE_MAGIC + b"\x00\x01")
+        assert live.read_snapshot(path) == before
+        assert live.fleet_files(str(tmp_path)) == [path]  # tmp invisible
+        # the next successful export replaces atomically over it
+        exp.note_progress(2)
+        exp.export_now()
+        assert live.read_snapshot(path)["step"] == 2
+
+    def test_incarnation_slot_scan(self, tmp_path):
+        d = str(tmp_path)
+        assert live.next_incarnation(d, "trainer", 0) == 0
+        _write_snap(d, "trainer", 0, 0, ts=1.0)
+        _write_snap(d, "trainer", 0, 1, ts=2.0)
+        assert live.next_incarnation(d, "trainer", 0) == 2
+        assert live.next_incarnation(d, "trainer", 1) == 0
+        assert live.next_incarnation(d, "server", 0) == 0
+        _on()
+        exp = live.FleetExporter(d, "trainer")
+        assert exp.meta["incarnation"] == 2
+
+    def test_exporter_shares_recorder_incarnation(self, tmp_path):
+        """Armed next to a flight recorder under the same fleet key, the
+        exporter reuses the recorder's incarnation index so postmortem
+        and live plane agree on identity."""
+        _on()
+        core_flags.set_flags({"flight_recorder": "on"})
+        flr.arm(str(tmp_path / "flr"), role="trainer", replica_id=0)
+        flr.arm(str(tmp_path / "flr"), role="trainer", replica_id=0)
+        exp = live.FleetExporter(str(tmp_path), "trainer", replica_id=0)
+        assert exp.meta["incarnation"] == 1  # the recorder's second slot
+        assert exp.meta["run_id"] == flr.current().meta["run_id"]
+
+
+# ---------------------------------------------------------------------------
+# gated seams + bitwise off-arm
+# ---------------------------------------------------------------------------
+
+class TestGating:
+    def test_seams_noop_when_off_or_unarmed(self, tmp_path):
+        assert live.current() is None and not live.enabled()
+        live.note_progress(3)                       # nothing armed: no-op
+        assert live.export_now() is None
+        assert live.arm_if_enabled(str(tmp_path), role="t") is None
+        exp = live.arm(str(tmp_path), role="t", start_thread=False)
+        assert live.export_now() is None            # armed but flag off
+        assert not live.enabled()
+        _on()
+        assert live.enabled()
+        assert live.export_now() is not None
+        live.disarm(final_export=False)
+        assert live.export_now() is None
+        assert live.fleet_files(str(tmp_path)) == [exp.path]
+
+    def test_clean_disarm_stamps_closed_farewell(self, tmp_path):
+        _on()
+        live.arm(str(tmp_path), role="t", start_thread=False)
+        live.note_progress(5)
+        live.disarm(final_export=True)
+        view = live.aggregate(str(tmp_path), now=time.time() + 3600)
+        assert view["workers"]["t.r0"]["status"] == "exited"
+        assert view["workers"]["t.r0"]["closed"]
+        assert view["workers"]["t.r0"]["step"] == 5
+
+    def test_rearm_replaces_and_opens_next_incarnation(self, tmp_path):
+        _on()
+        a = live.arm(str(tmp_path), role="t", start_thread=False)
+        a.export_now()
+        b = live.arm(str(tmp_path), role="t", start_thread=False)
+        assert live.current() is b
+        assert b.meta["incarnation"] == a.meta["incarnation"] + 1
+
+    def test_export_thread_respects_flag_flips(self, tmp_path):
+        _on(0.02)
+        exp = live.arm(str(tmp_path), role="t")  # thread on
+        deadline = time.time() + 10
+        while live.read_snapshot(exp.path) is None:
+            assert time.time() < deadline, "exporter thread never published"
+            time.sleep(0.01)
+        core_flags.set_flags({"fleet_telemetry": "off"})
+        time.sleep(0.08)  # let in-flight exports drain
+        seq = live.read_snapshot(exp.path)["seq"]
+        time.sleep(0.1)
+        assert live.read_snapshot(exp.path)["seq"] == seq  # paused
+        live.disarm(final_export=False)
+
+
+def _tiny_train_step():
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.framework.functional import functional_call
+    from paddle_tpu.framework.sharded import make_sharded_train_step
+    from paddle_tpu.nn import functional as F
+    from paddle_tpu.optimizer import AdamW
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+
+    def loss_fn(model, params, batch):
+        x, y = batch
+        return F.cross_entropy(functional_call(model, params, x), y).mean()
+
+    return make_sharded_train_step(net, AdamW(1e-3), loss_fn)
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((8, 8)).astype(np.float32),
+            rng.integers(0, 4, (8,)).astype(np.int64))
+
+
+class TestFleetOffBitwise:
+    def test_on_mode_is_bitwise_nonintrusive_on_trainstep(self, tmp_path):
+        """Mirror of TestRecorderOffBitwise / TestTelemetryOffBitwise:
+        arming the live plane (exporter thread running, note_progress
+        called per step) must not change a single bit of TrainStep
+        outputs."""
+        results = {}
+        for mode in ("off", "on"):
+            core_flags.set_flags({"fleet_telemetry": mode,
+                                  "fleet_export_interval": 0.02})
+            if mode == "on":
+                live.arm(str(tmp_path / "run"), role="test")
+            ts = _tiny_train_step()
+            losses = []
+            for s in range(3):
+                losses.append(np.asarray(ts.step(_batch(seed=s))))
+                live.note_progress(s)
+            results[mode] = (losses, {k: np.asarray(v)
+                                      for k, v in ts.params.items()})
+        for a, b in zip(results["off"][0], results["on"][0]):
+            np.testing.assert_array_equal(a, b)
+        for k in results["off"][1]:
+            np.testing.assert_array_equal(results["off"][1][k],
+                                          results["on"][1][k])
+        # and the armed run DID publish what it observed
+        snap = live.read_snapshot(live.current().path)
+        assert snap is not None or live.current().dropped == 0
+
+
+# ---------------------------------------------------------------------------
+# aggregation: incarnation sums, histogram merge, staleness
+# ---------------------------------------------------------------------------
+
+class TestAggregation:
+    def test_counters_sum_across_incarnations_latest_wins_identity(
+            self, tmp_path):
+        d = str(tmp_path)
+        t = 1000.0
+        # incarnation 0: SIGKILLed (no closed farewell), 5 requests
+        _write_snap(d, "server", 0, 0, ts=t, step=3, seq=9,
+                    metrics_block=_counter_block(
+                        "serving.requests_completed", 5))
+        # incarnation 1: alive, 2 more (its counters started from zero)
+        _write_snap(d, "server", 0, 1, ts=t + 10, step=11, seq=2,
+                    metrics_block=_counter_block(
+                        "serving.requests_completed", 2))
+        view = live.aggregate(d, now=t + 10.5)
+        w = view["workers"]["server.r0"]
+        assert w["incarnation"] == 1 and w["incarnations"] == 2
+        assert w["step"] == 11 and w["seq"] == 2
+        assert w["silent_incarnations"] == [0]  # one witnessed death
+        assert w["totals"]["serving.requests_completed"] == 7.0
+        assert view["rollup"]["counters"][
+            "serving.requests_completed"] == 7.0
+
+    def test_closed_predecessor_is_not_a_silent_death(self, tmp_path):
+        d = str(tmp_path)
+        _write_snap(d, "w", 0, 0, ts=1000.0, closed=True)
+        _write_snap(d, "w", 0, 1, ts=1010.0)
+        view = live.aggregate(d, now=1010.2)
+        assert view["workers"]["w.r0"]["silent_incarnations"] == []
+
+    def test_histogram_merge_is_exact_bucketwise_addition(self, tmp_path):
+        d = str(tmp_path)
+        le = [1.0, 2.0, 4.0]
+        _write_snap(d, "a", 0, 0, ts=1000.0, metrics_block=_hist_block(
+            "serving.decode_step_ms", le, [1, 0, 2, 1], 4, 11.0))
+        _write_snap(d, "b", 0, 0, ts=1000.0, metrics_block=_hist_block(
+            "serving.decode_step_ms", le, [0, 3, 0, 0], 3, 4.5))
+        view = live.aggregate(d, now=1000.5)
+        h = view["rollup"]["histograms"]["serving.decode_step_ms"]
+        assert h["le"] == le
+        assert h["counts"] == [1.0, 3.0, 2.0, 1.0]  # element-wise sum
+        assert h["count"] == 7 and abs(h["sum"] - 15.5) < 1e-9
+        # the union percentile equals any single host's over the union:
+        # 7 observations, p99 needs the last one -> +Inf overflow slot
+        assert view["derived"]["fleet_p99_decode_ms"] == float("inf")
+        assert live.percentile_from_buckets(le, h["counts"], 50.0) == 2.0
+
+    def test_staleness_dead_within_one_interval(self, tmp_path):
+        """A worker flips dead when its snapshot age exceeds
+        STALENESS_GRACE x its own advertised interval — i.e. within one
+        interval of the first missed export."""
+        d = str(tmp_path)
+        t = 1000.0
+        _write_snap(d, "w", 0, 0, ts=t, interval_s=1.0, step=4)
+        grace = live.STALENESS_GRACE
+        assert live.aggregate(d, now=t + grace - 0.1)[
+            "staleness"]["w.r0"] == "fresh"
+        assert live.aggregate(d, now=t + grace + 0.1)[
+            "staleness"]["w.r0"] == "dead"
+        # the TTL scales with the snapshot's own interval
+        _write_snap(d, "w", 0, 0, ts=t, interval_s=5.0, step=4)
+        assert live.aggregate(d, now=t + grace + 0.1)[
+            "staleness"]["w.r0"] == "fresh"
+
+    def test_staleness_slow_vs_fresh_step_lag(self, tmp_path):
+        d = str(tmp_path)
+        t = 1000.0
+        _write_snap(d, "a", 0, 0, ts=t, step=10)
+        _write_snap(d, "b", 0, 0, ts=t, step=2)
+        view = live.aggregate(d, now=t + 0.5, lag_steps=3)
+        assert view["staleness"] == {"a.r0": "fresh", "b.r0": "slow"}
+        assert view["derived"]["step_lag_spread"] == 8
+        assert view["derived"]["max_step"] == 10
+
+    def test_derived_serving_signals(self, tmp_path):
+        d = str(tmp_path)
+        t = 1000.0
+        hist = [{"ts": t - 10, "tokens": 100},
+                {"ts": t, "tokens": 300}]
+        _write_snap(
+            d, "server", 0, 0, ts=t, step=5, history=hist,
+            signals={"free_block_frac": 0.25, "p99_decode_ms": 40.0},
+            metrics_block={
+                **_counter_block("serving.requests_completed", 9),
+                **_counter_block("serving.shed", 1)})
+        view = live.aggregate(d, now=t + 0.5)
+        drv = view["derived"]
+        assert drv["fleet_tokens_per_s"] == pytest.approx(20.0)
+        assert drv["live_goodput"] == pytest.approx(0.9)
+        assert drv["min_free_block_frac"] == 0.25
+        assert drv["max_p99_decode_ms"] == 40.0
+        assert drv["fleet_size"] == 1 and drv["live_workers"] == 1
+
+
+# ---------------------------------------------------------------------------
+# alert rules
+# ---------------------------------------------------------------------------
+
+class TestAlertRules:
+    def _view_with_free_frac(self, frac):
+        return {"ts": 1000.0, "workers": {}, "staleness": {},
+                "derived": {"min_free_block_frac": frac}}
+
+    def test_threshold_fires_and_edge_triggers(self):
+        eng = alerts.AlertEngine([alerts.AlertRule(
+            "free-block-frac", "threshold", signal="min_free_block_frac",
+            op="<", threshold=0.1)], emit_mode="off", to_recorder=False)
+        fired = eng.evaluate(self._view_with_free_frac(0.05))
+        assert [a.rule_id for a in fired] == ["L001"]
+        assert fired[0].value == 0.05 and fired[0].worker is None
+        # still true -> active but not re-fired (edge triggering)
+        assert eng.evaluate(self._view_with_free_frac(0.04)) == []
+        assert len(eng.active()) == 1
+        # clears -> re-arms -> fires again on the next crossing
+        assert eng.evaluate(self._view_with_free_frac(0.5)) == []
+        assert eng.active() == []
+        assert len(eng.evaluate(self._view_with_free_frac(0.01))) == 1
+
+    def test_rate_rule_counts_counter_birth_as_increase(self):
+        """A counter born mid-window (first shed creates serving.shed)
+        is an increase from zero, not a dropped sample."""
+        hist = [{"ts": 990.0, "ok": 3},               # no shed yet
+                {"ts": 1000.0, "ok": 5, "shed": 4}]   # 4 sheds since
+        view = {"ts": 1000.5, "staleness": {"s.r0": "fresh"},
+                "workers": {"s.r0": {"history": hist}}, "derived": {}}
+        eng = alerts.AlertEngine([alerts.AlertRule(
+            "shed-rate", "rate", signal="shed+rejected", op=">",
+            threshold=0.0, window_s=60.0)],
+            emit_mode="off", to_recorder=False)
+        fired = eng.evaluate(view)
+        assert [a.rule_id for a in fired] == ["L002"]
+        assert fired[0].value == pytest.approx(0.4)  # 4 over 10s
+        # a worker with NONE of the parts anywhere stays silent
+        view2 = {"ts": 1000.5, "staleness": {"t.r0": "fresh"}, "derived": {},
+                 "workers": {"t.r0": {"history": [
+                     {"ts": 990.0, "tokens": 1},
+                     {"ts": 1000.0, "tokens": 9}]}}}
+        eng2 = alerts.AlertEngine(eng.rules, emit_mode="off",
+                                  to_recorder=False)
+        assert eng2.evaluate(view2) == []
+
+    def test_absence_fires_per_dead_worker(self, tmp_path):
+        d = str(tmp_path)
+        t = 1000.0
+        _write_snap(d, "a", 0, 0, ts=t, interval_s=0.5)
+        _write_snap(d, "b", 0, 0, ts=t, interval_s=0.5, closed=True)
+        now = t + live.STALENESS_GRACE * 0.5 + 0.1
+        view, fired = alerts.evaluate_dir(
+            d, alerts.default_rules(), now=now, emit_mode="off",
+            to_recorder=False)
+        assert view["staleness"] == {"a.r0": "dead", "b.r0": "exited"}
+        absent = [a for a in fired if a.rule == "worker-absent"]
+        assert [a.worker for a in absent] == ["a.r0"]
+        assert absent[0].rule_id == "L003"
+        assert absent[0].severity == "error"
+
+    def test_rule_ids_and_diagnostics(self):
+        assert alerts.RULE_IDS == {"threshold": "L001", "rate": "L002",
+                                   "absence": "L003"}
+        a = alerts.Alert(rule="x", rule_id="L001", kind="threshold",
+                         severity="warning", worker="w.r0", value=1.0,
+                         threshold=2.0, window_s=0.0, message="m")
+        d = a.as_diagnostic()
+        assert d.rule == "L001" and d.where == "fleet.w.r0"
+        for kind, rid in alerts.RULE_IDS.items():
+            a2 = alerts.Alert(rule="x", rule_id=rid, kind=kind,
+                              severity="warning", worker=None, value=0.0,
+                              threshold=0.0, window_s=1.0, message="m")
+            assert a2.as_diagnostic().rule == rid
+        json.dumps(a.to_json())  # machine-consumable record
+
+    def test_default_rules_cover_the_autoscaler_contract(self):
+        names = {r.name for r in alerts.default_rules()}
+        assert names == {"shed-rate", "free-block-frac", "watchdog-hang",
+                         "worker-absent"}
+        with_deadline = alerts.default_rules(deadline_ms=50.0)
+        assert "p99-decode-deadline" in {r.name for r in with_deadline}
+        with pytest.raises(ValueError):
+            alerts.AlertRule("bad", "gradient")
+        with pytest.raises(ValueError):
+            alerts.AlertRule("bad", "threshold", op="~")
+
+    def test_firings_land_in_flight_recorder(self, tmp_path):
+        core_flags.set_flags({"flight_recorder": "on"})
+        flr.arm(str(tmp_path / "flr"), role="watcher")
+        eng = alerts.AlertEngine([alerts.AlertRule(
+            "free-block-frac", "threshold", signal="min_free_block_frac",
+            op="<", threshold=0.1)], emit_mode="off")
+        eng.evaluate({"ts": 1.0, "workers": {}, "staleness": {},
+                      "derived": {"min_free_block_frac": 0.02}})
+        _meta, records, _rep = flr.replay(flr.current().path)
+        al = [r for r in records if r["k"] == "alert"]
+        assert len(al) == 1
+        assert al[0]["rule_id"] == "L001"
+        assert al[0]["value"] == 0.02
+
+
+# ---------------------------------------------------------------------------
+# publishing the view back into a registry + label-child GC
+# ---------------------------------------------------------------------------
+
+class TestPublishRetire:
+    def _view(self, tmp_path):
+        d = str(tmp_path)
+        _write_snap(d, "server", 0, 0, ts=1000.0, step=4,
+                    metrics_block=_counter_block("serving.shed", 2))
+        _write_snap(d, "server", 1, 0, ts=1000.0, step=6)
+        return live.aggregate(d, now=1000.5)
+
+    def test_publish_mirrors_view_into_fleet_families(self, tmp_path):
+        reg = metrics.Registry()
+        live.publish(self._view(tmp_path), registry=reg)
+        text = reg.prometheus_text()
+        assert 'fleet_worker_step{worker="server.r0"} 4' in text
+        assert 'fleet_worker_step{worker="server.r1"} 6' in text
+        assert "fleet_size 2" in text
+
+    def test_absent_workers_expire_and_retire_worker_gc(self, tmp_path):
+        reg = metrics.Registry()
+        view = self._view(tmp_path)
+        live.publish(view, registry=reg)
+        # the fleet shrinks: r1's snapshots vanish (run dir rotated)
+        view["workers"].pop("server.r1")
+        view["staleness"].pop("server.r1")
+        live.publish(view, registry=reg)
+        text = reg.prometheus_text()
+        assert 'worker="server.r0"' in text
+        assert 'worker="server.r1"' not in text  # label children GC'd
+        n = live.retire_worker("server.r0", registry=reg)
+        assert n > 0
+        assert 'worker="server.r0"' not in reg.prometheus_text()
+
+
+# ---------------------------------------------------------------------------
+# crash drill: SIGKILL a live exporter subprocess
+# ---------------------------------------------------------------------------
+
+_VICTIM = """
+import os, sys, time
+sys.path.insert(0, {repo!r})
+from paddle_tpu.observability import live, metrics
+exp = live.arm(sys.argv[1], role="victim")
+i = 0
+while True:
+    metrics.counter("victim.beats").labels().inc()
+    live.note_progress(i)
+    i += 1
+    time.sleep(0.01)
+"""
+
+
+class TestSigkillDrill:
+    def test_killed_worker_leaves_readable_snapshot_flips_dead(
+            self, tmp_path):
+        """SIGKILL mid-run: the last published snapshot stays readable
+        (atomic replace), the worker classifies dead within one export
+        interval of the first miss, and the absence rule fires."""
+        run = str(tmp_path / "run")
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   FLAGS_fleet_telemetry="on",
+                   FLAGS_fleet_export_interval="0.05",
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _VICTIM.format(repo=REPO), run],
+            env=env, cwd=REPO, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL)
+        path = live.snapshot_path(run, "victim", 0, 0)
+        try:
+            deadline = time.time() + 60
+            while live.read_snapshot(path) is None:
+                assert proc.poll() is None, "victim died on its own"
+                assert time.time() < deadline, "victim never exported"
+                time.sleep(0.02)
+        finally:
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+
+        snap = live.read_snapshot(path)
+        assert snap is not None                      # readable after kill
+        assert snap["role"] == "victim" and not snap["closed"]
+        assert snap["metrics"]["victim.beats"]["type"] == "counter"
+
+        # dead exactly when age exceeds GRACE x its advertised interval
+        ttl = live.STALENESS_GRACE * snap["interval_s"]
+        view = live.aggregate(run, now=snap["ts"] + ttl + 0.01)
+        assert view["staleness"]["victim.r0"] == "dead"
+        assert view["derived"]["dead_workers"] == 1
+        _view, fired = alerts.evaluate_dir(
+            run, alerts.default_rules(), now=snap["ts"] + ttl + 0.01,
+            emit_mode="off", to_recorder=False)
+        assert [a.rule_id for a in fired
+                if a.rule == "worker-absent"] == ["L003"]
+
+
+# ---------------------------------------------------------------------------
+# overload drill: injected overload must fire the shed-rate alert
+# ---------------------------------------------------------------------------
+
+class TestOverloadDrill:
+    def test_injected_overload_fires_shed_alert(self, tmp_path):
+        from paddle_tpu.serving.drill import run_overload_drill
+        report = run_overload_drill(str(tmp_path / "ov"))
+        assert report["outcomes"]["shed"] > 0
+        assert report["shed_alert_fired"], report["alerts"]
+        assert any(a["rule_id"] == "L002" for a in report["alerts"])
+        # the live window goodput agrees exactly with the engine's own
+        # outcome mix, and the clean shutdown said goodbye
+        assert report["goodput_match"], report
+        assert report["final_status"] == "exited"
+        assert report["ok"], report
+
+
+# ---------------------------------------------------------------------------
+# fleet_top CLI
+# ---------------------------------------------------------------------------
+
+class TestFleetTopCli:
+    def test_once_json_and_exit_codes(self, tmp_path, capsys):
+        from tools import fleet_top
+        d = str(tmp_path / "run")
+        _write_snap(d, "server", 0, 0, ts=time.time(), step=3,
+                    metrics_block=_counter_block(
+                        "serving.requests_completed", 4))
+        rc = fleet_top.main([d, "--once", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["view"]["workers"]["server.r0"]["step"] == 3
+        assert out["alerts"] == []
+        # human frame renders the worker row + footer
+        rc = fleet_top.main([d, "--once"])
+        text = capsys.readouterr().out
+        assert rc == 0 and "server.r0" in text and "no alerts" in text
+        # an empty dir is rc 2 (nothing to watch)
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        assert fleet_top.main([str(empty), "--once"]) == 2
+        capsys.readouterr()
+
+    def test_fail_on_alert_gates_ci(self, tmp_path, capsys):
+        from tools import fleet_top
+        d = str(tmp_path / "run")
+        _write_snap(d, "server", 0, 0, ts=time.time() - 3600,
+                    interval_s=0.5, step=3)  # long dead
+        rc = fleet_top.main([d, "--once", "--json", "--fail-on-alert"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert any(a["rule_id"] == "L003" for a in out["alerts"])
+        assert out["view"]["staleness"]["server.r0"] == "dead"
+
+
+# ---------------------------------------------------------------------------
+# the shared staleness rule (heartbeat <-> live plane)
+# ---------------------------------------------------------------------------
+
+class TestClassifyLiveness:
+    def test_one_rule_both_consumers(self):
+        from paddle_tpu.distributed.multislice import classify_liveness
+        assert classify_liveness(None, 1.0, 0, 0, 3) == "dead"
+        assert classify_liveness(2.0, 1.0, 0, 0, 3) == "dead"
+        assert classify_liveness(0.5, 1.0, 0, 8, 3) == "slow"
+        assert classify_liveness(0.5, 1.0, 7, 8, 3) == "alive"
+        assert classify_liveness(0.5, 1.0, 7, 8, 3,
+                                 fresh_label="fresh") == "fresh"

@@ -125,6 +125,71 @@ class TestMetrics:
         assert c.get() == 8000
 
 
+class TestMetricsExposition:
+    """Prometheus escaping, +Inf exposition, and label-child GC — the
+    surfaces the live fleet plane leans on."""
+
+    def test_hostile_label_values_escape(self):
+        hostile = 'a"b\\c\nd'
+        metrics.counter("t.esc").labels(path=hostile).inc()
+        text = metrics.prometheus_text()
+        assert 't_esc{path="a\\"b\\\\c\\nd"} 1' in text
+        # a raw newline inside a label value would split the sample line
+        for line in text.splitlines():
+            if line.startswith("t_esc{"):
+                assert line.endswith("} 1")
+
+    def test_histogram_exposes_explicit_inf_bucket(self):
+        h = metrics.histogram("t.inf", buckets=(1.0, 2.0))
+        h.observe(0.5)
+        h.observe(50.0)  # only the +Inf slot sees this one
+        text = metrics.prometheus_text()
+        assert 't_inf_bucket{le="1.0"} 1' in text
+        assert 't_inf_bucket{le="2.0"} 1' in text
+        assert 't_inf_bucket{le="+Inf"} 2' in text
+        assert "t_inf_count 2" in text
+        raw = h.labels().bucket_counts()
+        assert raw["le"] == [1.0, 2.0]
+        assert raw["counts"] == [1, 0, 1]  # trailing +Inf overflow slot
+
+    def test_family_remove_and_expire(self):
+        c = metrics.counter("t.gc")
+        c.labels(worker="a").inc(1)
+        c.labels(worker="b").inc(2)
+        assert c.remove(worker="a")
+        assert not c.remove(worker="a")  # second removal: nothing there
+        assert 'worker="a"' not in metrics.prometheus_text()
+        assert c.labels(worker="b").get() == 2
+        # a removed child re-created starts from zero
+        c.labels(worker="a").inc()
+        assert c.labels(worker="a").get() == 1
+        assert c.expire(lambda labels: labels.get("worker") == "b") == 1
+        assert 'worker="b"' not in metrics.prometheus_text()
+
+    def test_registry_expire_sweeps_by_name_and_labels(self):
+        reg = metrics.Registry()
+        reg.gauge("fleet.worker.step").labels(worker="x").set(1)
+        reg.gauge("fleet.worker.step").labels(worker="y").set(2)
+        reg.gauge("other.g").labels(worker="x").set(3)
+        n = reg.expire(lambda name, labels:
+                       name.startswith("fleet.") and
+                       labels.get("worker") == "x")
+        assert n == 1
+        text = reg.prometheus_text()
+        assert 'fleet_worker_step{worker="y"} 2' in text
+        assert 'fleet_worker_step{worker="x"}' not in text
+        assert 'other_g{worker="x"} 3' in text  # untouched family
+
+    def test_snapshot_include_buckets(self):
+        metrics.histogram("t.snapb", buckets=(1.0,)).observe(0.5)
+        lean = metrics.snapshot()
+        assert "buckets" not in lean["t.snapb"]["series"][0]
+        full = metrics.snapshot(include_buckets=True)
+        b = full["t.snapb"]["series"][0]["buckets"]
+        assert b["le"] == [1.0] and b["counts"] == [1, 0]
+        json.dumps(full)
+
+
 # ---------------------------------------------------------------------------
 # span tracer
 # ---------------------------------------------------------------------------

@@ -383,3 +383,68 @@ def test_serving_model_in_lint_graph_catalog():
     diags, n_eqns = lint_graph.MODELS["serving"]()
     assert n_eqns > 0, "serving steps must trace"
     assert diags == [], [d.format() for d in diags]
+
+
+def test_repo_lint_clean_over_fleet_live_tier():
+    """The live fleet plane (the per-worker exporter, the SLO rule
+    engine, the fleet-top console) passes the repo source rules —
+    the exporter thread and the CRC framing are exactly the code R002/
+    R003 sweep for; wall-clock timestamps are the staleness key, so
+    R001 host clocks are expected and fine."""
+    from paddle_tpu.analysis import repo_lint
+    for rel in (os.path.join("paddle_tpu", "observability", "live.py"),
+                os.path.join("paddle_tpu", "observability", "alerts.py"),
+                os.path.join("tools", "fleet_top.py")):
+        diags = repo_lint.lint_file(os.path.join(REPO, rel), rel)
+        errors = [d for d in diags if d.severity == "error"]
+        assert errors == [], [d.format() for d in errors]
+
+
+def test_concurrency_check_clean_over_fleet_live():
+    """The exporter publishes registry snapshots from a daemon thread
+    while the training/serving loop mutates the same counters — the
+    T-rule analyzer must find nothing in either module."""
+    from paddle_tpu.analysis import concurrency_check
+    for rel in (os.path.join("paddle_tpu", "observability", "live.py"),
+                os.path.join("paddle_tpu", "observability", "alerts.py")):
+        diags = concurrency_check.check_file(os.path.join(REPO, rel), rel)
+        assert diags == [], [d.format() for d in diags]
+
+
+def test_fleet_telemetry_flags_registered():
+    """FLAGS_fleet_telemetry / FLAGS_fleet_export_interval go through
+    the validated registry like every other observability arm."""
+    from paddle_tpu.core import flags
+    assert flags.flag("fleet_telemetry") in ("off", "on")
+    with pytest.raises(ValueError):
+        flags.set_flags({"fleet_telemetry": "maybe"})
+    assert float(flags.flag("fleet_export_interval")) > 0
+    assert "fleet_telemetry" not in flags.unknown_env_flags()
+    assert "fleet_export_interval" not in flags.unknown_env_flags()
+
+
+def test_fleet_top_once_json_smokes_in_process(tmp_path):
+    """`fleet_top --once --json` is the CI probe shape: over a live
+    export it must exit 0 and print one machine-parseable frame."""
+    import io
+    import json as _json
+    from contextlib import redirect_stdout
+    from paddle_tpu.core import flags
+    from paddle_tpu.observability import live
+    from tools import fleet_top
+    prev = flags.get_flags(["fleet_telemetry"])
+    flags.set_flags({"fleet_telemetry": "on"})
+    try:
+        live.arm(str(tmp_path), role="ci", start_thread=False)
+        live.note_progress(1)
+        live.disarm(final_export=True)
+    finally:
+        live.disarm(final_export=False)
+        flags.set_flags(prev)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = fleet_top.main([str(tmp_path), "--once", "--json",
+                             "--fail-on-alert"])
+    frame = _json.loads(buf.getvalue())
+    assert rc == 0, frame
+    assert frame["view"]["workers"]["ci.r0"]["status"] == "exited"

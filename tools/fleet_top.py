@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""fleet-top: live terminal view of a running fleet's telemetry plane.
+
+    python tools/fleet_top.py RUN_DIR                  # refreshing console
+    python tools/fleet_top.py RUN_DIR --interval 2
+    python tools/fleet_top.py RUN_DIR --once           # one frame, no ANSI
+    python tools/fleet_top.py RUN_DIR --once --json    # machine view (CI)
+    python tools/fleet_top.py RUN_DIR --once --json --fail-on-alert
+
+Reads the CRC-framed snapshots every worker publishes under
+``RUN_DIR/fleet/`` (``FLAGS_fleet_telemetry=on``), merges them with
+``observability.live.aggregate`` and evaluates the default SLO rules
+(``observability.alerts.default_rules``). Per worker: latest step,
+tokens/s over the embedded history window, request outcomes, staleness
+(fresh/slow/exited/dead) and snapshot age; fleet footer: size, live
+goodput, tokens/s, tightest KV pool, worst decode p99, step-lag spread,
+and every currently-firing alert.
+
+Exit code: 0 normally; 1 with ``--fail-on-alert`` when any alert fires;
+2 when RUN_DIR holds no readable snapshots at all.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _fmt(v, spec="{:.3g}", none="-"):
+    return none if v is None else spec.format(v)
+
+
+def _rate(history, key):
+    from paddle_tpu.observability import live
+    return live._window_rate(history, key)
+
+
+def render(view, alerts_active, color=True):
+    """One frame of the console view as a list of lines."""
+    dim = "\033[2m" if color else ""
+    bold = "\033[1m" if color else ""
+    red = "\033[31m" if color else ""
+    yellow = "\033[33m" if color else ""
+    reset = "\033[0m" if color else ""
+    status_color = {"fresh": "", "slow": yellow, "dead": red,
+                    "exited": dim}
+    d = view["derived"]
+    lines = [
+        f"{bold}fleet-top{reset}  {view['run_dir']}  "
+        f"workers={d['fleet_size']} live={d['live_workers']} "
+        f"dead={d['dead_workers']}  "
+        f"goodput={_fmt(d['live_goodput'], '{:.3f}')}  "
+        f"tok/s={_fmt(d['fleet_tokens_per_s'], '{:.1f}')}  "
+        f"free_frac={_fmt(d['min_free_block_frac'], '{:.3f}')}  "
+        f"p99_decode={_fmt(d['max_p99_decode_ms'], '{:.1f}ms')}  "
+        f"lag={d['step_lag_spread']}",
+        f"{dim}{'worker':<16}{'inc':>4}{'pid':>8}{'status':>8}"
+        f"{'step':>8}{'tok/s':>9}{'ok':>6}{'shed':>6}{'rej':>5}"
+        f"{'age_s':>8}{reset}",
+    ]
+    for key in sorted(view["workers"]):
+        w = view["workers"][key]
+        sig = w["signals"]
+        c = status_color.get(w["status"], "")
+        lines.append(
+            f"{c}{key:<16}{w['incarnation']:>4}{w['pid']:>8}"
+            f"{w['status']:>8}{_fmt(w['step'], '{:d}'):>8}"
+            f"{_fmt(_rate(w['history'], 'tokens'), '{:.1f}'):>9}"
+            f"{_fmt(w['totals'].get('serving.requests_completed'), '{:.0f}'):>6}"
+            f"{_fmt(w['totals'].get('serving.shed'), '{:.0f}'):>6}"
+            f"{_fmt(w['totals'].get('serving.rejected'), '{:.0f}'):>5}"
+            f"{w['age_s']:>8.1f}{reset}")
+    for a in alerts_active:
+        c = red if a.severity == "error" else yellow
+        lines.append(f"{c}ALERT [{a.rule_id}/{a.rule}] {a.message}{reset}")
+    if not alerts_active:
+        lines.append(f"{dim}no alerts firing{reset}")
+    return lines
+
+
+def one_frame(run_dir, engine, ttl_s=None, now=None):
+    from paddle_tpu.observability import live
+    view = live.aggregate(run_dir, now=now, ttl_s=ttl_s)
+    engine.evaluate(view, now=now)
+    return view, engine.active()
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("run_dir", help="run directory (or its fleet/ subdir)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh period in seconds (live mode)")
+    p.add_argument("--ttl", type=float, default=None,
+                   help="staleness TTL override in seconds (default: "
+                        "2x each worker's own export interval)")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="arm the p99-decode-deadline rule against this "
+                        "bound")
+    p.add_argument("--once", action="store_true",
+                   help="render a single frame and exit")
+    p.add_argument("--json", action="store_true",
+                   help="with --once: emit the machine-readable view")
+    p.add_argument("--fail-on-alert", action="store_true",
+                   help="exit 1 when any alert is firing (CI gate)")
+    args = p.parse_args(argv)
+
+    from paddle_tpu.observability import alerts
+    engine = alerts.AlertEngine(
+        alerts.default_rules(deadline_ms=args.deadline_ms),
+        emit_mode="off")  # the console IS the output channel here
+
+    if args.once:
+        view, active = one_frame(args.run_dir, engine, ttl_s=args.ttl)
+        if not view["workers"]:
+            print(f"no readable fleet snapshots under {args.run_dir}",
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(
+                {"view": view, "alerts": [a.to_json() for a in active]},
+                sort_keys=True, default=str))
+        else:
+            print("\n".join(render(view, active, color=False)))
+        return 1 if (args.fail_on_alert and active) else 0
+
+    try:
+        while True:
+            view, active = one_frame(args.run_dir, engine, ttl_s=args.ttl)
+            frame = render(view, active,
+                           color=sys.stdout.isatty())
+            sys.stdout.write("\033[2J\033[H" if sys.stdout.isatty()
+                             else "")
+            sys.stdout.write("\n".join(frame) + "\n")
+            sys.stdout.flush()
+            time.sleep(max(args.interval, 0.1))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
